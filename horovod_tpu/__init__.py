@@ -1,0 +1,282 @@
+"""horovod_tpu: a TPU-native distributed training framework.
+
+Horovod-compatible public API (reference: horovod/torch/mpi_ops.py,
+horovod/common/basics.py) over a TPU-first runtime:
+
+- control plane: rendezvous KV + coordinator protocol with response caching
+  over TCP (DCN), mirroring the reference's Gloo controller;
+- data plane: XLA collectives (psum/all_gather/all_to_all/ppermute) compiled
+  over the ICI device mesh inside jit for SPMD training, plus a CPU TCP ring
+  backend for multi-process worlds without TPUs;
+- the same semantics: tensor fusion, grouped ops, pre/postscale, Adasum,
+  Join-based uneven-data handling, elastic state, timeline, autotune.
+
+Synchronous ops return results in the caller's framework (numpy in → numpy
+out, torch in → torch out, jax in → jax out).
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from . import core
+from .common.exceptions import (HorovodInternalError, HorovodTpuError,
+                                HostsUpdatedInterrupt)
+from .common.status import Status
+from .core import (Handle, init, is_initialized, shutdown, rank, size,
+                   local_rank, local_size, cross_rank, cross_size,
+                   is_homogeneous, start_timeline, stop_timeline)
+
+__version__ = "0.1.0"
+
+
+# --- Reduce-op markers (reference: horovod/common/basics.py Sum/Average/Adasum)
+class _ReduceOp:
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    def __repr__(self) -> str:
+        return f"hvd.{self.name}"
+
+
+Sum = _ReduceOp("Sum")
+Average = _ReduceOp("Average")
+Adasum = _ReduceOp("Adasum")
+
+
+def _op_kind(op, average: bool | None) -> tuple[str, bool]:
+    """Map (op, legacy average flag) → (sum|average, adasum?)."""
+    if average is not None:
+        if op is not None and op is not Average and op is not Sum:
+            raise ValueError("Cannot specify both op and average")
+        return ("average" if average else "sum"), False
+    if op is None or op is Average:
+        return "average", False
+    if op is Sum:
+        return "sum", False
+    if op is Adasum:
+        return "sum", True
+    raise ValueError(f"Unknown reduce op: {op}")
+
+
+# --- Framework-preserving output wrapping ----------------------------------
+def _wrap_like(reference: Any, out: np.ndarray) -> Any:
+    mod = type(reference).__module__
+    if mod.startswith("torch"):
+        import torch
+        return torch.from_numpy(np.ascontiguousarray(out)).to(
+            reference.dtype)
+    if mod.startswith(("jax", "jaxlib")):
+        import jax.numpy as jnp
+        return jnp.asarray(out)
+    return out
+
+
+def _wrap_int_like(reference: Any, out: np.ndarray) -> Any:
+    """Wrap an integer auxiliary result (e.g. received splits) into the
+    caller's framework *keeping its integer dtype*."""
+    mod = type(reference).__module__
+    if mod.startswith("torch"):
+        import torch
+        return torch.from_numpy(np.ascontiguousarray(out))
+    if mod.startswith(("jax", "jaxlib")):
+        import jax.numpy as jnp
+        return jnp.asarray(out)
+    return out
+
+
+def _result(handle: Handle, reference: Any) -> Any:
+    status = handle.wait()
+    status.raise_if_error()
+    return _wrap_like(reference, handle.entries[0].output)
+
+
+_name_counters: dict[str, int] = {}
+
+
+def _auto_name(prefix: str, name: str | None) -> str:
+    if name is not None:
+        return name
+    n = _name_counters.get(prefix, 0)
+    _name_counters[prefix] = n + 1
+    return f"{prefix}.noname.{n}"
+
+
+# ---------------------------------------------------------------------------
+# Async collectives + handle plumbing (reference: torch/mpi_ops.py:95-900)
+# ---------------------------------------------------------------------------
+def allreduce_async(tensor, average: bool | None = None, name: str | None = None,
+                    op=None, prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> Handle:
+    kind, adasum = _op_kind(op, average)
+    _, handle = core.enqueue_allreduce(
+        _auto_name("allreduce", name), tensor, op=kind,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        adasum=adasum)
+    handle.wrap_refs = [tensor]
+    return handle
+
+
+def grouped_allreduce_async(tensors: Sequence[Any],
+                            average: bool | None = None,
+                            name: str | None = None, op=None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0) -> Handle:
+    kind, adasum = _op_kind(op, average)
+    base = _auto_name("grouped_allreduce", name)
+    names = [f"{base}.{i}" for i in range(len(tensors))]
+    _, handle = core.enqueue_grouped_allreduce(
+        names, list(tensors), op=kind, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, adasum=adasum)
+    handle.wrap_refs = list(tensors)
+    return handle
+
+
+def allgather_async(tensor, name: str | None = None) -> Handle:
+    _, handle = core.enqueue_allgather(_auto_name("allgather", name), tensor)
+    handle.wrap_refs = [tensor]
+    return handle
+
+
+def broadcast_async(tensor, root_rank: int, name: str | None = None) -> Handle:
+    _, handle = core.enqueue_broadcast(_auto_name("broadcast", name), tensor,
+                                       root_rank)
+    handle.wrap_refs = [tensor]
+    return handle
+
+
+def alltoall_async(tensor, splits=None, name: str | None = None) -> Handle:
+    _, handle = core.enqueue_alltoall(_auto_name("alltoall", name), tensor,
+                                      splits)
+    handle.wrap_refs = [tensor]
+    return handle
+
+
+def synchronize(handle: Handle):
+    """Wait for an async op; return its output(s) in the caller's framework
+    (reference: torch/mpi_ops.py:862-884)."""
+    status = handle.wait()
+    status.raise_if_error()
+    refs = handle.wrap_refs or [None] * len(handle.entries)
+    outs = [e.output if r is None else _wrap_like(r, e.output)
+            for r, e in zip(refs, handle.entries)]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def poll(handle: Handle) -> bool:
+    """True if the async op has completed
+    (reference: torch/mpi_ops.py:846)."""
+    return handle.done()
+
+
+# ---------------------------------------------------------------------------
+# Synchronous collectives
+# ---------------------------------------------------------------------------
+def allreduce(tensor, average: bool | None = None, name: str | None = None,
+              op=None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0):
+    handle = allreduce_async(tensor, average, name, op, prescale_factor,
+                             postscale_factor)
+    return _result(handle, tensor)
+
+
+def grouped_allreduce(tensors: Sequence[Any], average: bool | None = None,
+                      name: str | None = None, op=None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
+    handle = grouped_allreduce_async(tensors, average, name, op,
+                                     prescale_factor, postscale_factor)
+    status = handle.wait()
+    status.raise_if_error()
+    return [_wrap_like(t, e.output)
+            for t, e in zip(tensors, handle.entries)]
+
+
+def allgather(tensor, name: str | None = None):
+    return _result(allgather_async(tensor, name), tensor)
+
+
+def broadcast(tensor, root_rank: int, name: str | None = None):
+    return _result(broadcast_async(tensor, root_rank, name), tensor)
+
+
+def alltoall(tensor, splits=None, name: str | None = None):
+    handle = alltoall_async(tensor, splits, name)
+    status = handle.wait()
+    status.raise_if_error()
+    entry = handle.entries[0]
+    out = _wrap_like(tensor, entry.output)
+    if splits is None:
+        return out
+    recv_splits = np.asarray(entry.received_splits, dtype=np.int32)
+    return out, _wrap_int_like(tensor, recv_splits)
+
+
+def barrier() -> None:
+    _, handle = core.enqueue_barrier()
+    handle.wait().raise_if_error()
+
+
+def join() -> int:
+    """Block until every rank has joined; meanwhile this rank participates
+    in outstanding collectives with zero stand-ins
+    (reference: torch/mpi_ops.py:885-900)."""
+    _, handle = core.enqueue_join()
+    handle.wait().raise_if_error()
+    return int(handle.entries[0].output)
+
+
+# ---------------------------------------------------------------------------
+# Convenience object/parameter sync (reference: torch/functions.py)
+# ---------------------------------------------------------------------------
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: str | None = None) -> Any:
+    """Broadcast an arbitrary picklable object by serializing to bytes
+    (reference: torch/functions.py broadcast_object)."""
+    import pickle
+    name = _auto_name("broadcast_object", name)
+    if rank() == root_rank:
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+        sz = np.array([payload.size], dtype=np.int64)
+    else:
+        payload = None
+        sz = np.array([0], dtype=np.int64)
+    sz = broadcast(sz, root_rank, name=f"{name}.size")
+    if payload is None:
+        payload = np.zeros(int(sz[0]), dtype=np.uint8)
+    payload = broadcast(payload, root_rank, name=f"{name}.data")
+    return pickle.loads(payload.tobytes()) if rank() != root_rank else obj
+
+
+# Build-variant introspection (reference: horovod/common/util.py:137-186)
+def xla_built() -> bool:
+    try:
+        import jax  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def tcp_built() -> bool:
+    return True
+
+
+def gloo_built() -> bool:   # compat alias: our TCP plane plays gloo's role
+    return True
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    return False

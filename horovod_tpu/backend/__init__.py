@@ -1,0 +1,16 @@
+"""Collective data-plane backends.
+
+Reference analogue: horovod/common/ops/* — op implementations are registered
+in priority order and the first enabled one executes each Response
+(reference: operations.cc:143-252 CreateOperationManager).  The TPU rebuild
+keeps the same contract with these backends:
+
+- ``xla``: fused collectives compiled by XLA over the device mesh (the
+  NCCL-replacement; jitted psum/all_gather/all_to_all/ppermute riding ICI).
+- ``tcp``: pure-CPU numpy collectives over TCP sockets between processes
+  (the Gloo-replacement; keeps CPU-only paths working without TPUs).
+- ``basic``: single-process world — identity semantics with scaling.
+"""
+from .base import CollectiveBackend, OperationManager
+
+__all__ = ["CollectiveBackend", "OperationManager"]

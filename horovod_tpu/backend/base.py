@@ -1,0 +1,141 @@
+"""Backend interface + priority dispatch.
+
+Reference: horovod/common/ops/operation_manager.{cc,h}:27-66 and
+collective_operations.h:38-288.  `OperationManager` walks backends in
+registration priority order; the first whose `enabled()` returns True for a
+given Response executes it — this is how NCCL beats MPI beats Gloo in the
+reference, and how XLA beats TCP beats basic here.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+import numpy as np
+
+from ..common.dtypes import to_numpy
+from ..common.message import Response, ResponseType
+from ..common.status import Status
+from ..common.tensor_queue import TensorTableEntry
+
+
+class CollectiveBackend(ABC):
+    """One data-plane implementation of the collective ops."""
+
+    name = "abstract"
+
+    @abstractmethod
+    def enabled(self, response: Response, entries: list[TensorTableEntry]) -> bool:
+        ...
+
+    def execute(self, response: Response,
+                entries: list[TensorTableEntry]) -> Status:
+        rt = response.response_type
+        if rt in (ResponseType.ALLREDUCE, ResponseType.ADASUM):
+            return self.allreduce(response, entries)
+        if rt == ResponseType.ALLGATHER:
+            return self.allgather(response, entries)
+        if rt == ResponseType.BROADCAST:
+            return self.broadcast(response, entries)
+        if rt == ResponseType.ALLTOALL:
+            return self.alltoall(response, entries)
+        if rt == ResponseType.REDUCESCATTER:
+            return self.reducescatter(response, entries)
+        if rt == ResponseType.BARRIER:
+            return self.barrier(response, entries)
+        return Status.unknown_error(f"Unsupported response type {rt}")
+
+    @abstractmethod
+    def allreduce(self, response, entries) -> Status: ...
+
+    @abstractmethod
+    def allgather(self, response, entries) -> Status: ...
+
+    @abstractmethod
+    def broadcast(self, response, entries) -> Status: ...
+
+    @abstractmethod
+    def alltoall(self, response, entries) -> Status: ...
+
+    def reducescatter(self, response, entries) -> Status:
+        return Status.unknown_error("reducescatter not supported by "
+                                    f"backend {self.name}")
+
+    def barrier(self, response, entries) -> Status:
+        return Status.ok()
+
+    # ------------------------------------------------------------------
+    # Fusion-buffer staging helpers (reference:
+    # collective_operations.h:89-125 MemcpyInFusionBuffer / ScaleBuffer).
+    # ------------------------------------------------------------------
+    @staticmethod
+    def pack_fusion_buffer(response: Response,
+                           entries: list[TensorTableEntry]) -> np.ndarray:
+        """Concatenate flattened entry payloads into one fused buffer."""
+        np_dtype = to_numpy(response.tensor_type)
+        if len(entries) == 1:
+            e = entries[0]
+            if e.tensor is None:
+                return np.zeros(response.tensor_sizes[0], dtype=np_dtype)
+            return np.ascontiguousarray(
+                np.asarray(e.tensor, dtype=np_dtype).reshape(-1))
+        parts = []
+        for i, e in enumerate(entries):
+            if e.tensor is None:   # joined-rank zero stand-in
+                parts.append(np.zeros(response.tensor_sizes[i],
+                                      dtype=np_dtype))
+            else:
+                parts.append(np.asarray(e.tensor, dtype=np_dtype).reshape(-1))
+        return np.concatenate(parts)
+
+    @staticmethod
+    def unpack_fusion_buffer(buf: np.ndarray, response: Response,
+                             entries: list[TensorTableEntry]) -> None:
+        """Slice the fused result back into per-entry outputs, restoring
+        original shapes."""
+        offset = 0
+        for i, e in enumerate(entries):
+            n = response.tensor_sizes[i]
+            chunk = buf[offset:offset + n]
+            offset += n
+            if e.tensor is not None:
+                shape = np.asarray(e.tensor).shape
+                e.output = chunk.reshape(shape)
+            else:
+                e.output = chunk
+
+    @staticmethod
+    def scale_buffer(buf: np.ndarray, factor: float) -> np.ndarray:
+        if factor == 1.0:
+            return buf
+        # fp16/bf16 buffers scale in fp32 to avoid precision loss
+        # (reference: collective_operations.h:89-125 ScaleBuffer fp16 path).
+        if buf.dtype.itemsize <= 2 and buf.dtype.kind == "f":
+            return (buf.astype(np.float32) * factor).astype(buf.dtype)
+        if buf.dtype.kind in "iu":
+            return (buf * factor).astype(buf.dtype)
+        return buf * buf.dtype.type(factor)
+
+
+class OperationManager:
+    """Priority dispatch over registered backends
+    (reference: ops/operation_manager.cc)."""
+
+    def __init__(self, backends: list[CollectiveBackend]) -> None:
+        self._backends = backends
+
+    @property
+    def backends(self) -> list[CollectiveBackend]:
+        return list(self._backends)
+
+    def execute_operation(self, response: Response,
+                          entries: list[TensorTableEntry]) -> Status:
+        if response.response_type == ResponseType.ERROR:
+            return Status.precondition_error(response.error_message)
+        if response.response_type == ResponseType.JOIN:
+            return Status.ok()
+        for backend in self._backends:
+            if backend.enabled(response, entries):
+                return backend.execute(response, entries)
+        return Status.unknown_error(
+            f"No enabled backend for response type "
+            f"{response.response_type.name}")

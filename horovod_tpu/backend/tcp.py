@@ -1,0 +1,257 @@
+"""TCP/numpy data plane — the Gloo-replacement CPU backend.
+
+Reference: horovod/common/ops/gloo_operations.{cc,h} (ring / halving-doubling
+CPU collectives) and gloo's connectFullMesh bootstrap.  Used when the world
+has multiple processes but no shared XLA mesh: multi-process CPU tests and
+the control-plane-only deployments.  Bulk payloads ride a dedicated
+full-mesh socket set (PeerMesh) so they never interleave with controller
+messages.
+
+Algorithms:
+- allreduce: ring reduce-scatter + ring allgather (bandwidth-optimal,
+  2(N-1)/N · bytes per link) with fp32 accumulation for 16-bit dtypes;
+- allgatherv: ring rotation of variable-size blocks;
+- broadcast: star from the root;
+- alltoall: pairwise exchange with a sender thread (cycle-deadlock free).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..common.message import Response, ResponseType
+from ..common.status import Status
+from ..common.tensor_queue import TensorTableEntry
+from ..common.dtypes import to_numpy
+from ..runner.network import PeerMesh
+from .base import CollectiveBackend
+
+
+def _accum_dtype(dtype: np.dtype) -> np.dtype:
+    if dtype.kind == "f" and dtype.itemsize <= 2:
+        return np.dtype(np.float32)
+    return dtype
+
+
+class TcpCollectives:
+    """Raw collective algorithms over a PeerMesh (rank-symmetric calls)."""
+
+    def __init__(self, mesh: PeerMesh) -> None:
+        self.mesh = mesh
+        self.rank = mesh.rank
+        self.size = mesh.size
+
+    # -- helpers --------------------------------------------------------
+    def _sendrecv(self, to_rank: int, payload: bytes,
+                  from_rank: int) -> bytes:
+        """Concurrent send+recv so rings/pairwise exchanges cannot deadlock
+        on filled socket buffers."""
+        err: list[BaseException] = []
+
+        def _send():
+            try:
+                self.mesh.send(to_rank, payload)
+            except BaseException as e:  # noqa: BLE001 - propagated below
+                err.append(e)
+
+        t = threading.Thread(target=_send, daemon=True)
+        t.start()
+        data = self.mesh.recv(from_rank)
+        t.join()
+        if err:
+            raise err[0]
+        return data
+
+    # -- allreduce ------------------------------------------------------
+    def allreduce(self, buf: np.ndarray) -> np.ndarray:
+        """In-place-style ring allreduce; returns the reduced buffer."""
+        n, rank, size = buf.size, self.rank, self.size
+        if size == 1:
+            return buf
+        acc = buf.astype(_accum_dtype(buf.dtype), copy=True)
+        # Chunk boundaries: chunk i = [bounds[i], bounds[i+1])
+        base, rem = divmod(n, size)
+        sizes = [base + (1 if i < rem else 0) for i in range(size)]
+        bounds = np.cumsum([0] + sizes)
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+
+        # Reduce-scatter: after step s, rank owns-partial chunk
+        # (rank - s) % size.  Send the chunk we just accumulated.
+        for step in range(size - 1):
+            send_idx = (rank - step) % size
+            recv_idx = (rank - step - 1) % size
+            payload = acc[bounds[send_idx]:bounds[send_idx + 1]].tobytes()
+            data = self._sendrecv(nxt, payload, prv)
+            incoming = np.frombuffer(data, dtype=acc.dtype)
+            acc[bounds[recv_idx]:bounds[recv_idx + 1]] += incoming
+
+        # Ring allgather of the fully reduced chunks.
+        for step in range(size - 1):
+            send_idx = (rank + 1 - step) % size
+            recv_idx = (rank - step) % size
+            payload = acc[bounds[send_idx]:bounds[send_idx + 1]].tobytes()
+            data = self._sendrecv(nxt, payload, prv)
+            incoming = np.frombuffer(data, dtype=acc.dtype)
+            acc[bounds[recv_idx]:bounds[recv_idx + 1]] = incoming
+
+        return acc.astype(buf.dtype, copy=False)
+
+    # -- allgatherv -----------------------------------------------------
+    def allgatherv(self, local: np.ndarray,
+                   first_dims: list[int]) -> np.ndarray:
+        """Gather variable-first-dim blocks from every rank, rank order."""
+        size, rank = self.size, self.rank
+        if size == 1:
+            return np.asarray(local)
+        local = np.ascontiguousarray(local)
+        blocks: list[np.ndarray | None] = [None] * size
+        blocks[rank] = local
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        # Ring rotation: at step s we forward the block of rank (rank-s)%size.
+        for step in range(size - 1):
+            send_idx = (rank - step) % size
+            recv_idx = (rank - step - 1) % size
+            payload = np.ascontiguousarray(blocks[send_idx]).tobytes()
+            data = self._sendrecv(nxt, payload, prv)
+            rest_shape = local.shape[1:]
+            block = np.frombuffer(data, dtype=local.dtype).reshape(
+                (first_dims[recv_idx],) + rest_shape)
+            blocks[recv_idx] = block
+        return np.concatenate([np.asarray(b) for b in blocks], axis=0)
+
+    # -- broadcast ------------------------------------------------------
+    def broadcast(self, buf: np.ndarray | None, root: int,
+                  nbytes: int, dtype: np.dtype,
+                  shape: tuple[int, ...]) -> np.ndarray:
+        if self.size == 1:
+            assert buf is not None
+            return np.asarray(buf)
+        if self.rank == root:
+            payload = np.ascontiguousarray(buf).tobytes()
+            threads = []
+            for peer in range(self.size):
+                if peer == root:
+                    continue
+                t = threading.Thread(target=self.mesh.send,
+                                     args=(peer, payload), daemon=True)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join()
+            return np.asarray(buf)
+        data = self.mesh.recv(root)
+        return np.frombuffer(data, dtype=dtype).reshape(shape).copy()
+
+    # -- alltoall -------------------------------------------------------
+    def alltoallv(self, local: np.ndarray,
+                  splits: list[int]) -> tuple[np.ndarray, list[int]]:
+        """Send splits[j] rows to rank j; return concatenated received rows
+        and the per-rank received splits."""
+        size, rank = self.size, self.rank
+        local = np.ascontiguousarray(local)
+        bounds = np.cumsum([0] + list(splits))
+        my_block = local[bounds[rank]:bounds[rank + 1]]
+        received: list[np.ndarray | None] = [None] * size
+        received[rank] = my_block
+        rest_shape = local.shape[1:]
+        for offset in range(1, size):
+            to_peer = (rank + offset) % size
+            from_peer = (rank - offset) % size
+            payload = np.ascontiguousarray(
+                local[bounds[to_peer]:bounds[to_peer + 1]]).tobytes()
+            data = self._sendrecv(to_peer, payload, from_peer)
+            rows = len(data) // max(
+                1, int(np.prod(rest_shape)) * local.dtype.itemsize)
+            received[from_peer] = np.frombuffer(
+                data, dtype=local.dtype).reshape((rows,) + rest_shape)
+        received_splits = [int(np.asarray(b).shape[0]) for b in received]
+        out = np.concatenate([np.asarray(b) for b in received], axis=0) \
+            if any(s for s in received_splits) else my_block[:0]
+        return out, received_splits
+
+    def barrier(self) -> None:
+        token = np.zeros(1, dtype=np.uint8)
+        self.allreduce(token)
+
+
+class TcpBackend(CollectiveBackend):
+    """CollectiveBackend adapter over TcpCollectives."""
+
+    name = "tcp"
+
+    def __init__(self, collectives: TcpCollectives) -> None:
+        self.coll = collectives
+
+    def enabled(self, response, entries) -> bool:
+        return self.coll.size > 1
+
+    def allreduce(self, response: Response,
+                  entries: list[TensorTableEntry]) -> Status:
+        buf = self.pack_fusion_buffer(response, entries)
+        buf = self.scale_buffer(buf, response.prescale_factor)
+        if response.response_type == ResponseType.ADASUM:
+            from ..ops.adasum import adasum_tcp
+            buf = adasum_tcp(self.coll, buf)
+        else:
+            buf = self.coll.allreduce(buf)
+        buf = self.scale_buffer(buf, response.postscale_factor)
+        self.unpack_fusion_buffer(buf, response, entries)
+        return Status.ok()
+
+    def allgather(self, response: Response,
+                  entries: list[TensorTableEntry]) -> Status:
+        for e in entries:
+            local = np.asarray(e.tensor, dtype=to_numpy(response.tensor_type))
+            e.output = self.coll.allgatherv(local, response.tensor_sizes)
+        return Status.ok()
+
+    def broadcast(self, response: Response,
+                  entries: list[TensorTableEntry]) -> Status:
+        dtype = to_numpy(response.tensor_type)
+        for e in entries:
+            local = None if e.tensor is None else np.asarray(e.tensor,
+                                                             dtype=dtype)
+            shape = local.shape if local is not None else ()
+            e.output = self.coll.broadcast(local, response.root_rank,
+                                           response.tensor_sizes[0]
+                                           * dtype.itemsize, dtype, shape)
+        return Status.ok()
+
+    def alltoall(self, response: Response,
+                 entries: list[TensorTableEntry]) -> Status:
+        for e in entries:
+            local = np.asarray(e.tensor, dtype=to_numpy(response.tensor_type))
+            splits = list(e.splits) if e.splits else None
+            if splits is None:
+                if local.shape[0] % self.coll.size != 0:
+                    return Status.invalid_argument(
+                        "alltoall first dimension must be divisible by the "
+                        "world size when splits are not given")
+                splits = [local.shape[0] // self.coll.size] * self.coll.size
+            e.output, e.received_splits = self.coll.alltoallv(local, splits)
+        return Status.ok()
+
+    def reducescatter(self, response: Response,
+                      entries: list[TensorTableEntry]) -> Status:
+        # Correct but bandwidth-suboptimal: full allreduce then local slice.
+        buf = self.pack_fusion_buffer(response, entries)
+        buf = self.scale_buffer(buf, response.prescale_factor)
+        buf = self.coll.allreduce(buf)
+        buf = self.scale_buffer(buf, response.postscale_factor)
+        offset = 0
+        for i, e in enumerate(entries):
+            n = response.tensor_sizes[i]
+            chunk = buf[offset:offset + n]
+            offset += n
+            shape = np.asarray(e.tensor).shape
+            full = chunk.reshape(shape)
+            dim0 = shape[0]
+            base, rem = divmod(dim0, self.coll.size)
+            starts = [r * base + min(r, rem) for r in range(self.coll.size + 1)]
+            e.output = full[starts[self.coll.rank]:starts[self.coll.rank + 1]]
+        return Status.ok()
+
+    def barrier(self, response, entries) -> Status:
+        self.coll.barrier()
+        return Status.ok()

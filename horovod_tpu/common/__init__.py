@@ -1,0 +1,1 @@
+"""Shared infrastructure: messages, controller, caches, config, logging."""

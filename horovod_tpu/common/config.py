@@ -1,0 +1,160 @@
+"""Centralised knob registry, env-var driven like the reference.
+
+The reference scatters ~30 `HOROVOD_*` env knobs across
+horovod/common/common.h:66-96 and parses them ad hoc inside
+BackgroundThreadLoop (operations.cc:395-540) + utils/env_parser.cc.  Here
+every knob is declared once with its type, default and documentation, and the
+same `HOROVOD_*` names are honoured so existing launch scripts keep working.
+The runtime autotuner (parameter_manager) may override a subset at runtime.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable
+
+
+def _parse_bool(v: str) -> bool:
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class Knob:
+    name: str            # env var name (HOROVOD_* for compatibility)
+    default: Any
+    parser: Callable[[str], Any]
+    doc: str = ""
+
+    def get(self) -> Any:
+        raw = os.environ.get(self.name)
+        if raw is None or raw == "":
+            return self.default
+        try:
+            return self.parser(raw)
+        except (ValueError, TypeError):
+            return self.default
+
+
+_REGISTRY: dict[str, Knob] = {}
+
+
+def register(name: str, default: Any, parser: Callable[[str], Any], doc: str = "") -> Knob:
+    knob = Knob(name, default, parser, doc)
+    _REGISTRY[name] = knob
+    return knob
+
+
+def get(name: str) -> Any:
+    return _REGISTRY[name].get()
+
+
+def all_knobs() -> dict[str, Knob]:
+    return dict(_REGISTRY)
+
+
+# --- Core cycle / fusion knobs (reference: common/common.h:66-96) -----------
+FUSION_THRESHOLD = register(
+    "HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024, int,
+    "Tensor-fusion buffer threshold in bytes (0 disables fusion).")
+CYCLE_TIME = register(
+    "HOROVOD_CYCLE_TIME", 1.0, float,
+    "Background-loop cycle time in milliseconds.")
+CACHE_CAPACITY = register(
+    "HOROVOD_CACHE_CAPACITY", 1024, int,
+    "Response-cache capacity (0 disables caching).")
+HIERARCHICAL_ALLREDUCE = register(
+    "HOROVOD_HIERARCHICAL_ALLREDUCE", False, _parse_bool,
+    "Two-level reduce: reduce-scatter over ICI, cross-reduce over DCN, "
+    "all-gather over ICI.")
+HIERARCHICAL_ALLGATHER = register(
+    "HOROVOD_HIERARCHICAL_ALLGATHER", False, _parse_bool,
+    "Two-level allgather over (ICI, DCN) axes.")
+BATCH_D2D_MEMCOPIES = register(
+    "HOROVOD_BATCH_D2D_MEMCOPIES", True, _parse_bool,
+    "Fuse gather/scatter staging copies into batched device ops.")
+DISABLE_GROUP_FUSION = register(
+    "HOROVOD_DISABLE_GROUP_FUSION", False, _parse_bool,
+    "Disable fusion across explicitly grouped collectives.")
+ELASTIC = register(
+    "HOROVOD_ELASTIC", False, _parse_bool,
+    "Enable elastic (fault tolerant / autoscaling) mode.")
+
+# --- Autotune (reference: common/parameter_manager.cc) ----------------------
+AUTOTUNE = register(
+    "HOROVOD_AUTOTUNE", False, _parse_bool,
+    "Enable Bayesian autotuning of fusion threshold and cycle time.")
+AUTOTUNE_LOG = register(
+    "HOROVOD_AUTOTUNE_LOG", "", str,
+    "CSV file to log autotune samples to.")
+AUTOTUNE_WARMUP_SAMPLES = register(
+    "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3, int,
+    "Discarded warmup samples per autotune step.")
+AUTOTUNE_STEPS_PER_SAMPLE = register(
+    "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10, int,
+    "Training steps scored per autotune sample.")
+AUTOTUNE_BAYES_OPT_MAX_SAMPLES = register(
+    "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20, int,
+    "Max Bayesian-optimization samples before fixing parameters.")
+AUTOTUNE_GAUSSIAN_PROCESS_NOISE = register(
+    "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8, float,
+    "GP observation-noise hyperparameter (alpha).")
+
+# --- Timeline (reference: common/timeline.cc) -------------------------------
+TIMELINE = register(
+    "HOROVOD_TIMELINE", "", str,
+    "Path for the Chrome-trace timeline JSON ('DYNAMIC' = start stopped).")
+TIMELINE_MARK_CYCLES = register(
+    "HOROVOD_TIMELINE_MARK_CYCLES", False, _parse_bool,
+    "Mark background-loop cycles in the timeline.")
+
+# --- Stall inspector (reference: common/stall_inspector.cc) -----------------
+STALL_CHECK_DISABLE = register(
+    "HOROVOD_STALL_CHECK_DISABLE", False, _parse_bool,
+    "Disable the stalled-tensor warning check.")
+STALL_CHECK_TIME_SECONDS = register(
+    "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0, float,
+    "Seconds before warning about ranks with missing submissions.")
+STALL_SHUTDOWN_TIME_SECONDS = register(
+    "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0, float,
+    "Seconds before a stall aborts the job (0 = never).")
+
+# --- Logging ----------------------------------------------------------------
+LOG_LEVEL = register(
+    "HOROVOD_LOG_LEVEL", "warning", str,
+    "trace|debug|info|warning|error|fatal")
+LOG_HIDE_TIME = register(
+    "HOROVOD_LOG_HIDE_TIME", False, _parse_bool,
+    "Hide timestamps in log output.")
+
+# --- Rendezvous / cluster layout (set by the launcher) ----------------------
+# (reference: gloo_context.cc:136-152 reads the same family of variables)
+RANK = register("HOROVOD_RANK", -1, int, "Global rank of this process.")
+SIZE = register("HOROVOD_SIZE", -1, int, "Global number of ranks.")
+LOCAL_RANK = register("HOROVOD_LOCAL_RANK", -1, int, "Rank within this host.")
+LOCAL_SIZE = register("HOROVOD_LOCAL_SIZE", -1, int, "Ranks on this host.")
+CROSS_RANK = register("HOROVOD_CROSS_RANK", -1, int, "Host index.")
+CROSS_SIZE = register("HOROVOD_CROSS_SIZE", -1, int, "Number of hosts.")
+HOSTNAME = register("HOROVOD_HOSTNAME", "", str, "Assigned hostname.")
+RENDEZVOUS_ADDR = register(
+    "HOROVOD_GLOO_RENDEZVOUS_ADDR", "", str,
+    "Rendezvous KV-store host (control plane over DCN).")
+RENDEZVOUS_PORT = register(
+    "HOROVOD_GLOO_RENDEZVOUS_PORT", -1, int, "Rendezvous KV-store port.")
+CONTROLLER = register(
+    "HOROVOD_CONTROLLER", "local", str,
+    "Controller plane: local (in-process) | tcp (multi-process rendezvous).")
+GLOO_TIMEOUT_SECONDS = register(
+    "HOROVOD_GLOO_TIMEOUT_SECONDS", 30.0, float,
+    "Control-plane connect/recv timeout.")
+
+# --- TPU-specific knobs (no reference analogue) -----------------------------
+MESH_SHAPE = register(
+    "HOROVOD_TPU_MESH_SHAPE", "", str,
+    "Override device mesh shape, e.g. '4,2' → axes (replica, local).")
+XLA_DONATE = register(
+    "HOROVOD_TPU_DONATE_BUFFERS", True, _parse_bool,
+    "Donate input buffers to fused XLA collectives (in-place on HBM).")
+NUM_STREAMS = register(
+    "HOROVOD_NUM_STREAMS", 1, int,
+    "Parallel dispatch lanes for fused collective programs "
+    "(analogue of HOROVOD_NUM_NCCL_STREAMS).")

@@ -1,0 +1,107 @@
+"""Canonical dtype table shared by the wire format, controller and backends.
+
+TPU-native analogue of the reference DataType enum
+(reference: horovod/common/wire/message.fbs:18-33, message.cc).  bfloat16 is
+first-class here (it is the TPU matmul dtype); the reference's fp16 paths map
+onto both float16 and bfloat16.
+"""
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class DataType(enum.IntEnum):
+    UINT8 = 0
+    INT8 = 1
+    UINT16 = 2
+    INT16 = 3
+    INT32 = 4
+    INT64 = 5
+    FLOAT16 = 6
+    FLOAT32 = 7
+    FLOAT64 = 8
+    BOOL = 9
+    BFLOAT16 = 10
+
+
+_NP_BY_DTYPE: dict[DataType, np.dtype] = {}
+_DTYPE_BY_NAME: dict[str, DataType] = {}
+
+
+def _register(dt: DataType, np_dtype) -> None:
+    np_dtype = np.dtype(np_dtype)
+    _NP_BY_DTYPE[dt] = np_dtype
+    _DTYPE_BY_NAME[np_dtype.name] = dt
+
+
+_register(DataType.UINT8, np.uint8)
+_register(DataType.INT8, np.int8)
+_register(DataType.UINT16, np.uint16)
+_register(DataType.INT16, np.int16)
+_register(DataType.INT32, np.int32)
+_register(DataType.INT64, np.int64)
+_register(DataType.FLOAT16, np.float16)
+_register(DataType.FLOAT32, np.float32)
+_register(DataType.FLOAT64, np.float64)
+_register(DataType.BOOL, np.bool_)
+
+try:  # ml_dtypes ships with jax; bfloat16 is the TPU-native reduced dtype
+    import ml_dtypes
+
+    _register(DataType.BFLOAT16, ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes is bundled with jax
+    pass
+
+
+_ELEMENT_SIZE = {
+    DataType.UINT8: 1,
+    DataType.INT8: 1,
+    DataType.UINT16: 2,
+    DataType.INT16: 2,
+    DataType.INT32: 4,
+    DataType.INT64: 8,
+    DataType.FLOAT16: 2,
+    DataType.FLOAT32: 4,
+    DataType.FLOAT64: 8,
+    DataType.BOOL: 1,
+    DataType.BFLOAT16: 2,
+}
+
+_FLOATING = {
+    DataType.FLOAT16,
+    DataType.FLOAT32,
+    DataType.FLOAT64,
+    DataType.BFLOAT16,
+}
+
+
+def element_size(dt: DataType) -> int:
+    return _ELEMENT_SIZE[dt]
+
+
+def is_floating(dt: DataType) -> bool:
+    return dt in _FLOATING
+
+
+def to_numpy(dt: DataType) -> np.dtype:
+    return _NP_BY_DTYPE[dt]
+
+
+def from_any(dtype_like) -> DataType:
+    """Map a numpy/jax/torch dtype (or its name) to the canonical DataType."""
+    name = getattr(dtype_like, "name", None)
+    if name is None:
+        name = str(dtype_like)
+        # torch dtypes stringify as "torch.float32"
+        if name.startswith("torch."):
+            name = name[len("torch."):]
+        if name == "bool":
+            name = "bool_"
+    if name == "bool_":
+        name = "bool"
+    dt = _DTYPE_BY_NAME.get(name)
+    if dt is None:
+        raise ValueError(f"Unsupported dtype: {dtype_like!r}")
+    return dt

@@ -1,0 +1,47 @@
+"""Exception hierarchy (reference: horovod/common/exceptions.py:19-46).
+
+`HorovodInternalError` signals a failed collective — in elastic mode the
+training loop catches it, restores the last committed state and
+re-rendezvouses.  `HostsUpdatedInterrupt` is raised proactively when host
+membership changed so workers can re-form the mesh without losing state.
+"""
+
+
+class HorovodTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """Internal error raised when a collective routine fails.
+
+    In elastic mode this triggers state restore + re-rendezvous
+    (reference: horovod/common/elastic.py:151-175).
+    """
+
+
+class HorovodVersionMismatchError(HorovodInternalError):
+    pass
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """Host membership changed; re-rendezvous without restoring state.
+
+    ``skip_sync`` mirrors the reference's distinction between an immediate
+    update (state already consistent) and one discovered after a failure.
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class NotSupportedError(HorovodTpuError):
+    """Requested operation is not supported on this backend/topology."""
+
+
+class TensorShapeMismatchError(HorovodTpuError):
+    """Cross-rank tensor shape mismatch detected by the controller."""
+
+
+class TensorDtypeMismatchError(HorovodTpuError):
+    """Cross-rank tensor dtype mismatch detected by the controller."""

@@ -1,0 +1,190 @@
+"""Control-plane message types: Request / RequestList / Response / ResponseList.
+
+TPU-native rebuild of the reference message layer
+(reference: horovod/common/message.h:50-251, message.cc, wire/message.fbs).
+Semantics preserved:
+
+- a `Request` announces "rank R's tensor named N with dtype/shape S is ready
+  for collective op T";
+- workers batch them into a `RequestList` gathered by the coordinator;
+- the coordinator validates cross-rank consistency and answers with fused
+  `Response`s (one response may carry many tensor names = one fused buffer);
+- every rank executes the identical `ResponseList` in identical order — the
+  deadlock-freedom invariant (reference: SURVEY §5.8).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from .dtypes import DataType
+from .wire import Decoder, Encoder
+
+
+class RequestType(enum.IntEnum):
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ADASUM = 4
+    ALLTOALL = 5
+    BARRIER = 6
+    REDUCESCATTER = 7
+
+
+class ResponseType(enum.IntEnum):
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ADASUM = 4
+    ALLTOALL = 5
+    BARRIER = 6
+    REDUCESCATTER = 7
+    ERROR = 8
+
+
+@dataclass
+class Request:
+    request_rank: int = 0
+    request_type: RequestType = RequestType.ALLREDUCE
+    tensor_type: DataType = DataType.FLOAT32
+    tensor_name: str = ""
+    root_rank: int = -1
+    device: int = -1
+    tensor_shape: tuple[int, ...] = ()
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+
+    def tensor_size_elements(self) -> int:
+        n = 1
+        for d in self.tensor_shape:
+            n *= d
+        return n
+
+    def encode(self, enc: Encoder) -> None:
+        (enc.uvarint(self.request_rank)
+            .uvarint(int(self.request_type))
+            .uvarint(int(self.tensor_type))
+            .string(self.tensor_name)
+            .svarint(self.root_rank)
+            .svarint(self.device)
+            .svarint_list(list(self.tensor_shape))
+            .f64(self.prescale_factor)
+            .f64(self.postscale_factor))
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "Request":
+        return cls(
+            request_rank=dec.uvarint(),
+            request_type=RequestType(dec.uvarint()),
+            tensor_type=DataType(dec.uvarint()),
+            tensor_name=dec.string(),
+            root_rank=dec.svarint(),
+            device=dec.svarint(),
+            tensor_shape=tuple(dec.svarint_list()),
+            prescale_factor=dec.f64(),
+            postscale_factor=dec.f64(),
+        )
+
+
+@dataclass
+class RequestList:
+    requests: list[Request] = field(default_factory=list)
+    shutdown: bool = False
+
+    def to_bytes(self) -> bytes:
+        enc = Encoder()
+        enc.bool_(self.shutdown)
+        enc.uvarint(len(self.requests))
+        for r in self.requests:
+            r.encode(enc)
+        return enc.getvalue()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "RequestList":
+        dec = Decoder(raw)
+        shutdown = dec.bool_()
+        n = dec.uvarint()
+        return cls(requests=[Request.decode(dec) for _ in range(n)],
+                   shutdown=shutdown)
+
+
+@dataclass
+class Response:
+    response_type: ResponseType = ResponseType.ALLREDUCE
+    tensor_names: list[str] = field(default_factory=list)
+    error_message: str = ""
+    devices: list[int] = field(default_factory=list)
+    # Allgather/alltoall: per-rank first-dim sizes so every rank can size the
+    # output buffer (reference: message.h tensor_sizes()).
+    tensor_sizes: list[int] = field(default_factory=list)
+    tensor_type: DataType = DataType.FLOAT32
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+    # Ranks that have joined (zero-filled stand-ins participate on their
+    # behalf; reference: controller.cc:254-308).
+    last_joined_rank: int = -1
+    root_rank: int = -1          # broadcast root
+    grouped: bool = False        # built from an explicit tensor group
+
+    def encode(self, enc: Encoder) -> None:
+        (enc.uvarint(int(self.response_type))
+            .string_list(self.tensor_names)
+            .string(self.error_message)
+            .svarint_list(self.devices)
+            .svarint_list(self.tensor_sizes)
+            .uvarint(int(self.tensor_type))
+            .f64(self.prescale_factor)
+            .f64(self.postscale_factor)
+            .svarint(self.last_joined_rank)
+            .svarint(self.root_rank)
+            .bool_(self.grouped))
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "Response":
+        return cls(
+            response_type=ResponseType(dec.uvarint()),
+            tensor_names=dec.string_list(),
+            error_message=dec.string(),
+            devices=dec.svarint_list(),
+            tensor_sizes=dec.svarint_list(),
+            tensor_type=DataType(dec.uvarint()),
+            prescale_factor=dec.f64(),
+            postscale_factor=dec.f64(),
+            last_joined_rank=dec.svarint(),
+            root_rank=dec.svarint(),
+            grouped=dec.bool_(),
+        )
+
+
+@dataclass
+class ResponseList:
+    responses: list[Response] = field(default_factory=list)
+    shutdown: bool = False
+    # Autotuned parameters broadcast from the coordinator
+    # (reference: Controller::SynchronizeParameters, controller.cc:39-53).
+    tuned_fusion_threshold: int = -1
+    tuned_cycle_time_ms: float = -1.0
+
+    def to_bytes(self) -> bytes:
+        enc = Encoder()
+        enc.bool_(self.shutdown)
+        enc.svarint(self.tuned_fusion_threshold)
+        enc.f64(self.tuned_cycle_time_ms)
+        enc.uvarint(len(self.responses))
+        for r in self.responses:
+            r.encode(enc)
+        return enc.getvalue()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "ResponseList":
+        dec = Decoder(raw)
+        shutdown = dec.bool_()
+        threshold = dec.svarint()
+        cycle = dec.f64()
+        n = dec.uvarint()
+        return cls(responses=[Response.decode(dec) for _ in range(n)],
+                   shutdown=shutdown,
+                   tuned_fusion_threshold=threshold,
+                   tuned_cycle_time_ms=cycle)

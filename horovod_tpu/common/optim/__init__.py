@@ -1,0 +1,1 @@
+"""Numerical optimization helpers for the autotuner (GP + Bayesian opt)."""

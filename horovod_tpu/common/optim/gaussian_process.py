@@ -1,0 +1,44 @@
+"""Minimal Gaussian-process regressor (RBF kernel) for the autotuner.
+
+Reference: horovod/common/optim/gaussian_process.cc (Eigen + L-BFGS there;
+numpy closed-form here — the autotuner's 2-D, ≤20-sample problem doesn't
+need hyperparameter optimization, a fixed length-scale works).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class GaussianProcess:
+    def __init__(self, length_scale: float = 1.0, sigma_f: float = 1.0,
+                 alpha: float = 1e-6) -> None:
+        self.length_scale = length_scale
+        self.sigma_f = sigma_f
+        self.alpha = alpha   # observation noise on the diagonal
+        self._x: np.ndarray | None = None
+        self._y: np.ndarray | None = None
+        self._k_inv: np.ndarray | None = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        # RBF: sigma_f^2 * exp(-|a-b|^2 / (2 l^2))
+        sq = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return self.sigma_f ** 2 * np.exp(-0.5 * sq / self.length_scale ** 2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        y = np.asarray(y, dtype=np.float64).reshape(-1)
+        k = self._kernel(x, x) + self.alpha * np.eye(len(x))
+        self._x, self._y = x, y
+        self._k_inv = np.linalg.inv(k)
+
+    def predict(self, x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Return (mean, std) at query points."""
+        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        if self._x is None:
+            return np.zeros(len(x)), np.ones(len(x))
+        k_s = self._kernel(x, self._x)
+        k_ss = self._kernel(x, x)
+        mu = k_s @ self._k_inv @ self._y
+        cov = k_ss - k_s @ self._k_inv @ k_s.T
+        std = np.sqrt(np.maximum(np.diag(cov), 1e-12))
+        return mu, std

@@ -1,0 +1,96 @@
+"""Runtime autotuner for fusion threshold and cycle time.
+
+Reference: horovod/common/parameter_manager.{cc,h}:42-120 — scores each
+parameter setting by aggregate allreduce bytes/sec over a sampling window,
+drives new settings from Bayesian optimization, and broadcasts winning
+parameters from the coordinator so every rank stays consistent
+(reference: Controller::SynchronizeParameters, controller.cc:39-53; here the
+sync rides the ResponseList `tuned_*` fields).
+"""
+from __future__ import annotations
+
+import time
+
+from . import config
+from .logging import logger
+from .optim.bayesian_optimization import BayesianOptimization
+
+# Search space: log2(fusion threshold bytes) × cycle time ms.
+_THRESHOLD_LOG2_BOUNDS = (20.0, 28.0)      # 1 MiB .. 256 MiB
+_CYCLE_MS_BOUNDS = (1.0, 25.0)
+
+
+class ParameterManager:
+    def __init__(self, controller, active: bool) -> None:
+        self._controller = controller
+        self._active = active           # only the coordinator tunes
+        self._warmup_left = config.AUTOTUNE_WARMUP_SAMPLES.get()
+        self._steps_per_sample = config.AUTOTUNE_STEPS_PER_SAMPLE.get()
+        self._max_samples = config.AUTOTUNE_BAYES_OPT_MAX_SAMPLES.get()
+        self._bo = BayesianOptimization(
+            [_THRESHOLD_LOG2_BOUNDS, _CYCLE_MS_BOUNDS],
+            alpha=config.AUTOTUNE_GAUSSIAN_PROCESS_NOISE.get())
+        self._log_path = config.AUTOTUNE_LOG.get()
+        if self._log_path and active:
+            with open(self._log_path, "w") as f:
+                f.write("timestamp,fusion_threshold,cycle_time_ms,score\n")
+
+        self._steps = 0
+        self._bytes = 0
+        self._t0 = time.monotonic()
+        self._done = False
+        self._current = (float(controller.tensor_fusion_threshold),
+                         float(config.CYCLE_TIME.get()))
+
+    def observe(self, tensor_names: list[str], nbytes: int) -> None:
+        """Called once per background cycle with the allreduced bytes."""
+        if not self._active or self._done:
+            return
+        self._bytes += nbytes
+        if nbytes > 0:
+            self._steps += 1
+        if self._steps < self._steps_per_sample:
+            return
+
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        score = self._bytes / elapsed
+        self._steps = 0
+        self._bytes = 0
+        self._t0 = time.monotonic()
+
+        if self._warmup_left > 0:
+            self._warmup_left -= 1
+            return
+
+        import math
+        threshold, cycle = self._current
+        self._bo.add_sample(
+            [math.log2(max(threshold, 1.0)), cycle], score)
+        self._log(threshold, cycle, score)
+
+        if self._bo.num_samples >= self._max_samples:
+            best = self._bo.best()
+            assert best is not None
+            (log_thr, cycle), best_score = best
+            self._propose(2.0 ** log_thr, cycle)
+            self._done = True
+            logger.info(
+                "autotune converged: fusion_threshold=%d cycle_time=%.1fms "
+                "(%.1f MB/s)", int(2.0 ** log_thr), cycle,
+                best_score / 1e6)
+            return
+
+        log_thr, cycle = self._bo.suggest_next()
+        self._propose(2.0 ** log_thr, cycle)
+
+    def _propose(self, threshold: float, cycle_ms: float) -> None:
+        self._current = (threshold, cycle_ms)
+        # Stamped onto the next broadcast ResponseList so all ranks apply
+        # identical parameters on the same cycle.
+        self._controller.pending_tuned_params = (int(threshold),
+                                                 float(cycle_ms))
+
+    def _log(self, threshold: float, cycle: float, score: float) -> None:
+        if self._log_path:
+            with open(self._log_path, "a") as f:
+                f.write(f"{time.time()},{int(threshold)},{cycle},{score}\n")

@@ -1,0 +1,88 @@
+"""Controller transport over TCP sockets — the Gloo-controller equivalent.
+
+Reference: horovod/common/gloo/gloo_controller.cc:35-199 — the same
+coordination protocol as MPI (request gather to rank 0, response broadcast,
+bitvector sync) but over point-to-point TCP bootstrapped from the rendezvous
+KV store.  Here all three primitives run over a dedicated PeerMesh (separate
+from the bulk data-plane mesh so control never queues behind tensor bytes).
+"""
+from __future__ import annotations
+
+import struct
+
+from .controller import Transport
+from .message import RequestList, ResponseList
+from ..runner.network import PeerMesh
+
+_WORDLEN = struct.Struct(">I")
+
+
+def _pack_words(and_word: int, or_word: int) -> bytes:
+    a = and_word.to_bytes((max(and_word.bit_length(), 1) + 7) // 8, "big")
+    o = or_word.to_bytes((max(or_word.bit_length(), 1) + 7) // 8, "big")
+    return _WORDLEN.pack(len(a)) + a + _WORDLEN.pack(len(o)) + o
+
+def _unpack_words(raw: bytes) -> tuple[int, int]:
+    (la,) = _WORDLEN.unpack_from(raw, 0)
+    a = int.from_bytes(raw[4:4 + la], "big")
+    (lo,) = _WORDLEN.unpack_from(raw, 4 + la)
+    o = int.from_bytes(raw[8 + la:8 + la + lo], "big")
+    return a, o
+
+
+class TcpTransport(Transport):
+    def __init__(self, mesh: PeerMesh) -> None:
+        self.mesh = mesh
+        self.rank = mesh.rank
+        self.size = mesh.size
+
+    # -- bitvector sync (reference: gloo_controller.cc bitwise ops) ------
+    def bitwise_sync(self, and_word: int, or_word: int) -> tuple[int, int]:
+        if self.size == 1:
+            return and_word, or_word
+        if self.rank == 0:
+            for peer in range(1, self.size):
+                a, o = _unpack_words(self.mesh.recv(peer))
+                and_word &= a
+                or_word |= o
+            payload = _pack_words(and_word, or_word)
+            for peer in range(1, self.size):
+                self.mesh.send(peer, payload)
+            return and_word, or_word
+        self.mesh.send(0, _pack_words(and_word, or_word))
+        return _unpack_words(self.mesh.recv(0))
+
+    # -- RequestList gather (reference: gloo_controller.cc allgatherv) ---
+    def gather_requests(self, request_list: RequestList):
+        if self.size == 1:
+            return [request_list]
+        if self.rank == 0:
+            lists = [request_list]
+            for peer in range(1, self.size):
+                lists.append(RequestList.from_bytes(self.mesh.recv(peer)))
+            return lists
+        self.mesh.send(0, request_list.to_bytes())
+        return None
+
+    # -- ResponseList broadcast ------------------------------------------
+    def broadcast_responses(self, response_list):
+        if self.size == 1:
+            return response_list
+        if self.rank == 0:
+            payload = response_list.to_bytes()
+            for peer in range(1, self.size):
+                self.mesh.send(peer, payload)
+            return response_list
+        return ResponseList.from_bytes(self.mesh.recv(0))
+
+    def barrier(self) -> None:
+        if self.size == 1:
+            return
+        if self.rank == 0:
+            for peer in range(1, self.size):
+                self.mesh.recv(peer)
+            for peer in range(1, self.size):
+                self.mesh.send(peer, b"\x01")
+        else:
+            self.mesh.send(0, b"\x01")
+            self.mesh.recv(0)

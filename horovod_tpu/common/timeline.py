@@ -1,0 +1,129 @@
+"""Chrome-trace timeline of collective negotiation and execution.
+
+Reference: horovod/common/timeline.{cc,h}:37-80 — per-tensor phase machine
+NEGOTIATING → <OP> → activities, written as Chrome trace events ("cat ph ts
+pid name args") by an async writer thread fed through a queue so the hot
+path never blocks on file IO.  Controlled by HOROVOD_TIMELINE
+('DYNAMIC' starts stopped; start_timeline/stop_timeline flip it at runtime —
+reference: operations.cc:740-769).
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+
+
+class Timeline:
+    def __init__(self, path: str = "", mark_cycles: bool = False) -> None:
+        self._path = path
+        self._mark_cycles = mark_cycles
+        self._queue: queue.Queue = queue.Queue()
+        self._active = False
+        self._writer: threading.Thread | None = None
+        self._file = None
+        self._start = time.monotonic()
+        self._tensor_tids: dict[str, int] = {}
+        self._lock = threading.Lock()
+        if path and path != "DYNAMIC":
+            self.start(path)
+        elif path == "DYNAMIC":
+            self._path = ""
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self, path: str) -> None:
+        with self._lock:
+            if self._active:
+                return
+            self._path = path
+            self._file = open(path, "w")
+            self._file.write("[\n")
+            self._active = True
+            self._writer = threading.Thread(target=self._write_loop,
+                                            daemon=True,
+                                            name="hvd-timeline")
+            self._writer.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            if not self._active:
+                return
+            # The end marker goes through the queue so the writer thread
+            # handles comma placement uniformly.
+            self._queue.put({"name": "end", "ph": "i", "ts": self._ts(),
+                             "pid": 0, "s": "g"})
+            self._active = False
+            self._queue.put(None)
+        if self._writer is not None:
+            self._writer.join(timeout=5)
+        if self._file is not None:
+            self._file.write("\n]\n")
+            self._file.close()
+            self._file = None
+
+    @property
+    def enabled(self) -> bool:
+        return self._active
+
+    # -- event emission -------------------------------------------------
+    def _ts(self) -> int:
+        return int((time.monotonic() - self._start) * 1e6)
+
+    def _tid(self, tensor_name: str) -> int:
+        tid = self._tensor_tids.get(tensor_name)
+        if tid is None:
+            tid = len(self._tensor_tids)
+            self._tensor_tids[tensor_name] = tid
+            self._emit({"name": "thread_name", "ph": "M", "pid": 0,
+                        "tid": tid, "args": {"name": tensor_name}})
+        return tid
+
+    def _emit(self, event: dict) -> None:
+        if self._active:
+            self._queue.put(event)
+
+    def negotiate_start(self, tensor_name: str, request_type) -> None:
+        if not self._active:
+            return
+        name = getattr(request_type, "name", str(request_type))
+        self._emit({"name": f"NEGOTIATE_{name}", "ph": "B",
+                    "ts": self._ts(), "pid": 0,
+                    "tid": self._tid(tensor_name)})
+
+    def negotiate_end(self, tensor_name: str) -> None:
+        if not self._active:
+            return
+        self._emit({"name": "", "ph": "E", "ts": self._ts(), "pid": 0,
+                    "tid": self._tid(tensor_name)})
+
+    def activity_start(self, tensor_name: str, activity: str) -> None:
+        if not self._active:
+            return
+        self._emit({"name": activity, "ph": "B", "ts": self._ts(),
+                    "pid": 0, "tid": self._tid(tensor_name)})
+
+    def activity_end(self, tensor_name: str) -> None:
+        if not self._active:
+            return
+        self._emit({"name": "", "ph": "E", "ts": self._ts(), "pid": 0,
+                    "tid": self._tid(tensor_name)})
+
+    def mark_cycle(self) -> None:
+        if self._active and self._mark_cycles:
+            self._emit({"name": "CYCLE", "ph": "i", "ts": self._ts(),
+                        "pid": 0, "s": "g"})
+
+    # -- writer thread --------------------------------------------------
+    def _write_loop(self) -> None:
+        first = True
+        while True:
+            event = self._queue.get()
+            if event is None:
+                break
+            line = json.dumps(event)
+            if not first:
+                line = ",\n" + line
+            first = False
+            self._file.write(line)
+            self._file.flush()

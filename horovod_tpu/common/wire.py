@@ -1,0 +1,144 @@
+"""Compact binary codec for control-plane messages.
+
+The reference serializes Request/Response with flatbuffers
+(reference: horovod/common/wire/message.fbs:18-119, message.cc).  The rebuild
+uses a tiny self-contained varint+struct codec: the control plane exchanges
+kilobyte-scale metadata messages over DCN/TCP, so a dependency-free format
+that both the Python controller and a future C++ core can read is worth more
+than flatbuffers' zero-copy.
+
+Layout primitives: unsigned varints (LEB128), length-prefixed UTF-8 strings,
+little-endian fixed-width scalars.
+"""
+from __future__ import annotations
+
+import struct
+
+
+class Encoder:
+    __slots__ = ("_parts",)
+
+    def __init__(self) -> None:
+        self._parts: list[bytes] = []
+
+    def uvarint(self, value: int) -> "Encoder":
+        if value < 0:
+            raise ValueError("uvarint requires a non-negative value")
+        out = bytearray()
+        while True:
+            b = value & 0x7F
+            value >>= 7
+            if value:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                break
+        self._parts.append(bytes(out))
+        return self
+
+    def svarint(self, value: int) -> "Encoder":
+        # zigzag encoding
+        return self.uvarint((value << 1) ^ (value >> 63))
+
+    def f64(self, value: float) -> "Encoder":
+        self._parts.append(struct.pack("<d", float(value)))
+        return self
+
+    def string(self, value: str) -> "Encoder":
+        raw = value.encode("utf-8")
+        self.uvarint(len(raw))
+        self._parts.append(raw)
+        return self
+
+    def blob(self, value: bytes) -> "Encoder":
+        self.uvarint(len(value))
+        self._parts.append(bytes(value))
+        return self
+
+    def bool_(self, value: bool) -> "Encoder":
+        self._parts.append(b"\x01" if value else b"\x00")
+        return self
+
+    def uvarint_list(self, values) -> "Encoder":
+        self.uvarint(len(values))
+        for v in values:
+            self.uvarint(v)
+        return self
+
+    def svarint_list(self, values) -> "Encoder":
+        self.uvarint(len(values))
+        for v in values:
+            self.svarint(v)
+        return self
+
+    def string_list(self, values) -> "Encoder":
+        self.uvarint(len(values))
+        for v in values:
+            self.string(v)
+        return self
+
+    def getvalue(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class Decoder:
+    __slots__ = ("_buf", "_pos")
+
+    def __init__(self, buf: bytes) -> None:
+        self._buf = buf
+        self._pos = 0
+
+    def uvarint(self) -> int:
+        result = 0
+        shift = 0
+        buf = self._buf
+        pos = self._pos
+        while True:
+            if pos >= len(buf):
+                raise ValueError("truncated uvarint")
+            b = buf[pos]
+            pos += 1
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        self._pos = pos
+        return result
+
+    def svarint(self) -> int:
+        z = self.uvarint()
+        return (z >> 1) ^ -(z & 1)
+
+    def f64(self) -> float:
+        v = struct.unpack_from("<d", self._buf, self._pos)[0]
+        self._pos += 8
+        return v
+
+    def string(self) -> str:
+        n = self.uvarint()
+        raw = self._buf[self._pos:self._pos + n]
+        self._pos += n
+        return raw.decode("utf-8")
+
+    def blob(self) -> bytes:
+        n = self.uvarint()
+        raw = self._buf[self._pos:self._pos + n]
+        self._pos += n
+        return raw
+
+    def bool_(self) -> bool:
+        v = self._buf[self._pos] != 0
+        self._pos += 1
+        return v
+
+    def uvarint_list(self) -> list[int]:
+        return [self.uvarint() for _ in range(self.uvarint())]
+
+    def svarint_list(self) -> list[int]:
+        return [self.svarint() for _ in range(self.uvarint())]
+
+    def string_list(self) -> list[str]:
+        return [self.string() for _ in range(self.uvarint())]
+
+    def eof(self) -> bool:
+        return self._pos >= len(self._buf)

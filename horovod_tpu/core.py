@@ -1,0 +1,540 @@
+"""Global state, background coordination thread, and the enqueue API.
+
+TPU-native rebuild of the reference core runtime
+(reference: horovod/common/operations.cc — InitializeHorovodOnce at 651-699,
+BackgroundThreadLoop at 589-647, RunLoopOnce + PerformOperation at 256-329,
+EnqueueTensor* at 919-1226) plus the handle/future layer
+(reference: horovod/torch/handle_manager.cc).
+
+Design: user threads enqueue TensorTableEntries + Requests; a single
+background thread runs the controller protocol every CycleTime ms, receives
+the identical fused ResponseList on every rank, and executes each Response
+through the backend priority chain.  Completion flows back through per-entry
+callbacks into Handle futures, never blocking the background thread.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+
+from .backend.base import OperationManager
+from .backend.basic import BasicBackend
+from .common import config
+from .common.controller import Controller, LocalTransport
+from .common.dtypes import from_any
+from .common.group_table import GroupTable
+from .common.logging import configure as configure_logging
+from .common.logging import logger
+from .common.message import (Request, RequestType, Response, ResponseType)
+from .common.response_cache import ResponseCache
+from .common.stall_inspector import StallInspector
+from .common.status import Status
+from .common.tensor_queue import TensorQueue, TensorTableEntry
+from .common.timeline import Timeline
+
+JOIN_TENSOR_NAME = "__join__"
+
+
+class Handle:
+    """Future for one (possibly grouped) async collective
+    (reference: torch/handle_manager.cc)."""
+
+    __slots__ = ("_event", "status", "entries", "_pending", "_hid",
+                 "wrap_refs")
+
+    def __init__(self, entries: list[TensorTableEntry]) -> None:
+        self._event = threading.Event()
+        self.status: Status | None = None
+        self.entries = entries
+        self._pending = len(entries)
+        self._hid = -1
+        # Original framework tensors (torch/jax/...) so async results can be
+        # returned in the caller's framework, same as the sync API.
+        self.wrap_refs: list[Any] = []
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: float | None = None) -> Status:
+        if not self._event.wait(timeout):
+            raise TimeoutError("collective did not complete in time")
+        assert self.status is not None
+        return self.status
+
+    def outputs(self) -> list[Any]:
+        return [e.output for e in self.entries]
+
+
+class HandleManager:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next = 0
+        self._handles: dict[int, Handle] = {}
+
+    def allocate(self, entries: list[TensorTableEntry]) -> tuple[int, Handle]:
+        handle = Handle(entries)
+        with self._lock:
+            hid = self._next
+            self._next += 1
+            handle._hid = hid
+            self._handles[hid] = handle
+        return hid, handle
+
+    def get(self, hid: int) -> Handle:
+        with self._lock:
+            return self._handles[hid]
+
+    def entry_done(self, handle: Handle, status: Status) -> None:
+        with self._lock:
+            handle._pending -= 1
+            # First error wins; OK only recorded if nothing failed.
+            if handle.status is None or (handle.status.ok_p()
+                                         and not status.ok_p()):
+                handle.status = status
+            if handle._pending <= 0:
+                # Auto-release: once complete, the caller's Handle reference
+                # is the only owner — the table must not pin tensors forever.
+                self._handles.pop(handle._hid, None)
+                handle._event.set()
+
+    def release(self, hid: int) -> None:
+        with self._lock:
+            self._handles.pop(hid, None)
+
+
+@dataclass
+class GlobalState:
+    rank: int = 0
+    size: int = 1
+    local_rank: int = 0
+    local_size: int = 1
+    cross_rank: int = 0
+    cross_size: int = 1
+    initialized: bool = False
+    shutdown_requested: bool = False
+    background_thread: threading.Thread | None = None
+    tensor_queue: TensorQueue = field(default_factory=TensorQueue)
+    group_table: GroupTable = field(default_factory=GroupTable)
+    controller: Controller | None = None
+    op_manager: OperationManager | None = None
+    handle_manager: HandleManager = field(default_factory=HandleManager)
+    timeline: Timeline | None = None
+    parameter_manager: Any = None
+    cycle_time_ms: float = 1.0
+    joined: bool = False
+    elastic_enabled: bool = False
+    # resources to close at shutdown (sockets, rendezvous server, ...)
+    resources: list[Any] = field(default_factory=list)
+
+    def mark_done_callback(self, handle: Handle):
+        def _cb(status: Status) -> None:
+            self.handle_manager.entry_done(handle, status)
+        return _cb
+
+
+_global = GlobalState()
+_init_lock = threading.Lock()
+
+
+def global_state() -> GlobalState:
+    return _global
+
+
+# ---------------------------------------------------------------------------
+# Initialization / shutdown (reference: operations.cc:651-769)
+# ---------------------------------------------------------------------------
+def init(*, rank: int | None = None, size: int | None = None,
+         rendezvous_addr: str | None = None,
+         rendezvous_port: int | None = None,
+         local_rank: int | None = None, local_size: int | None = None,
+         cross_rank: int | None = None, cross_size: int | None = None) -> None:
+    """Initialize the runtime: discover the world from env/args, connect the
+    control plane, build backends, spawn the background thread."""
+    with _init_lock:
+        if _global.initialized:
+            return
+
+        def _resolve(kwarg, knob, fallback):
+            if kwarg is not None:
+                return kwarg
+            env = knob.get()
+            return env if env >= 0 else fallback
+
+        rank = _resolve(rank, config.RANK, 0)
+        size = _resolve(size, config.SIZE, 1)
+        # Topology default: one host holding every rank (local == global),
+        # matching single-host launches without explicit env.
+        local_rank = _resolve(local_rank, config.LOCAL_RANK, rank)
+        local_size = _resolve(local_size, config.LOCAL_SIZE, size)
+        cross_rank = _resolve(cross_rank, config.CROSS_RANK, 0)
+        cross_size = _resolve(cross_size, config.CROSS_SIZE, 1)
+
+        configure_logging(rank)
+        _global.rank, _global.size = rank, size
+        _global.local_rank, _global.local_size = local_rank, local_size
+        _global.cross_rank, _global.cross_size = cross_rank, cross_size
+        _global.cycle_time_ms = config.CYCLE_TIME.get()
+        _global.shutdown_requested = False
+        _global.tensor_queue.reset()
+        _global.joined = False
+        _global.elastic_enabled = config.ELASTIC.get()
+
+        timeline_path = config.TIMELINE.get()
+        _global.timeline = Timeline(
+            timeline_path if rank == 0 else "",
+            mark_cycles=config.TIMELINE_MARK_CYCLES.get())
+
+        backends = []
+        if size > 1:
+            addr = rendezvous_addr or config.RENDEZVOUS_ADDR.get()
+            port = rendezvous_port if rendezvous_port is not None \
+                else config.RENDEZVOUS_PORT.get()
+            if not addr or port <= 0:
+                raise RuntimeError(
+                    "Multi-process world requires a rendezvous server: set "
+                    "HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT (the launcher does "
+                    "this automatically).")
+            from .common.tcp_transport import TcpTransport
+            from .backend.tcp import TcpBackend, TcpCollectives
+            from .runner.network import PeerMesh, RendezvousClient
+
+            timeout = config.GLOO_TIMEOUT_SECONDS.get()
+            kv = RendezvousClient(addr, port, timeout)
+            epoch = os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", "0")
+            ctrl_mesh = PeerMesh(rank, size, kv, scope=f"ctrl{epoch}",
+                                 timeout=timeout)
+            data_mesh = PeerMesh(rank, size, kv, scope=f"data{epoch}",
+                                 timeout=timeout)
+            _global.resources.extend([ctrl_mesh, data_mesh])
+            transport = TcpTransport(ctrl_mesh)
+            backends.append(TcpBackend(TcpCollectives(data_mesh)))
+        else:
+            transport = LocalTransport()
+        backends.append(BasicBackend(size))
+
+        _global.controller = Controller(
+            rank=rank, size=size, transport=transport,
+            tensor_queue=_global.tensor_queue,
+            group_table=_global.group_table,
+            response_cache=ResponseCache(config.CACHE_CAPACITY.get()),
+            stall_inspector=StallInspector(),
+            local_rank=local_rank, local_size=local_size,
+            cross_rank=cross_rank, cross_size=cross_size,
+            timeline=_global.timeline)
+        _global.op_manager = OperationManager(backends)
+
+        if config.AUTOTUNE.get():
+            from .common.parameter_manager import ParameterManager
+            _global.parameter_manager = ParameterManager(
+                _global.controller, rank == 0)
+
+        _global.background_thread = threading.Thread(
+            target=_background_loop, daemon=True, name="hvd-background")
+        _global.initialized = True
+        _global.background_thread.start()
+        logger.debug("horovod_tpu initialized: rank=%d size=%d", rank, size)
+
+
+def shutdown() -> None:
+    with _init_lock:
+        if not _global.initialized:
+            return
+        _global.shutdown_requested = True
+        thread = _global.background_thread
+    if thread is not None:
+        thread.join(timeout=60)
+    with _init_lock:
+        _global.tensor_queue.finalize()
+        if _global.timeline is not None:
+            _global.timeline.stop()
+        for res in _global.resources:
+            try:
+                res.close()
+            except Exception:  # noqa: BLE001 - best-effort cleanup
+                pass
+        _global.resources.clear()
+        _global.initialized = False
+        _global.background_thread = None
+
+
+def is_initialized() -> bool:
+    return _global.initialized
+
+
+def _require_init() -> GlobalState:
+    if not _global.initialized:
+        raise RuntimeError(
+            "horovod_tpu has not been initialized; call hvd.init().")
+    return _global
+
+
+def rank() -> int:
+    return _require_init().rank
+
+
+def size() -> int:
+    return _require_init().size
+
+
+def local_rank() -> int:
+    return _require_init().local_rank
+
+
+def local_size() -> int:
+    return _require_init().local_size
+
+
+def cross_rank() -> int:
+    return _require_init().cross_rank
+
+
+def cross_size() -> int:
+    return _require_init().cross_size
+
+
+def is_homogeneous() -> bool:
+    """True when every host runs the same number of ranks
+    (reference: mpi_controller.cc:30-82 homogeneity check)."""
+    st = _require_init()
+    return st.size % max(st.local_size, 1) == 0 and \
+        st.cross_size * st.local_size == st.size
+
+
+def start_timeline(path: str, mark_cycles: bool = False) -> None:
+    st = _require_init()
+    if st.timeline is not None:
+        st.timeline._mark_cycles = mark_cycles
+        st.timeline.start(path)
+
+
+def stop_timeline() -> None:
+    st = _require_init()
+    if st.timeline is not None:
+        st.timeline.stop()
+
+
+# ---------------------------------------------------------------------------
+# Background loop (reference: operations.cc:589-647 RunLoopOnce)
+# ---------------------------------------------------------------------------
+def _background_loop() -> None:
+    st = _global
+    while True:
+        t0 = time.monotonic()
+        try:
+            response_list = st.controller.compute_response_list(
+                st.shutdown_requested)
+        except Exception as exc:  # noqa: BLE001 - control-plane failure
+            logger.error("controller failure: %s", exc)
+            st.tensor_queue.finalize()
+            return
+        if st.timeline is not None:
+            st.timeline.mark_cycle()
+
+        total_bytes = 0
+        tensor_names: list[str] = []
+        for response in response_list.responses:
+            _perform_operation(st, response)
+            if response.response_type in (ResponseType.ALLREDUCE,
+                                          ResponseType.ADASUM):
+                from .common.dtypes import element_size
+                total_bytes += sum(response.tensor_sizes) * \
+                    element_size(response.tensor_type)
+                tensor_names.extend(response.tensor_names)
+
+        # Autotune: coordinator scores the window and proposes new params;
+        # every rank applies parameters broadcast through the ResponseList.
+        if response_list.tuned_cycle_time_ms > 0:
+            st.cycle_time_ms = response_list.tuned_cycle_time_ms
+        if st.parameter_manager is not None:
+            st.parameter_manager.observe(tensor_names, total_bytes)
+
+        if response_list.shutdown:
+            st.tensor_queue.finalize()
+            return
+
+        elapsed = time.monotonic() - t0
+        sleep_s = st.cycle_time_ms / 1000.0 - elapsed
+        if sleep_s > 0:
+            time.sleep(sleep_s)
+
+
+def _perform_operation(st: GlobalState, response: Response) -> None:
+    """Reference: operations.cc:256-329 PerformOperation."""
+    if response.response_type == ResponseType.JOIN:
+        st.joined = False
+        if st.tensor_queue.has_tensor_entry(JOIN_TENSOR_NAME):
+            entry = st.tensor_queue.pop_tensor_entry(JOIN_TENSOR_NAME)
+            entry.output = np.int32(response.last_joined_rank)
+            entry.finish(Status.ok())
+        return
+
+    entries: list[TensorTableEntry] = []
+    for i, name in enumerate(response.tensor_names):
+        if st.tensor_queue.has_tensor_entry(name):
+            entries.append(st.tensor_queue.pop_tensor_entry(name))
+        else:
+            # Joined rank: participate with a zero stand-in
+            # (reference: controller.cc:254-308 joined-rank handling).
+            entries.append(TensorTableEntry(tensor_name=name))
+
+    timeline = st.timeline
+    if timeline is not None and timeline.enabled:
+        for e in entries:
+            timeline.negotiate_end(e.tensor_name)
+            timeline.activity_start(e.tensor_name,
+                                    response.response_type.name)
+
+    if response.response_type == ResponseType.ERROR:
+        status = Status.precondition_error(response.error_message)
+    else:
+        try:
+            status = st.op_manager.execute_operation(response, entries)
+        except Exception as exc:  # noqa: BLE001 - backend failure
+            logger.error("collective execution failed: %s", exc)
+            status = Status.unknown_error(str(exc))
+
+    if timeline is not None and timeline.enabled:
+        for e in entries:
+            timeline.activity_end(e.tensor_name)
+
+    # Release explicit groups everywhere — the coordinator deregisters
+    # during response construction, but worker ranks would otherwise leak
+    # one group per grouped collective.
+    st.group_table.deregister_groups(response.tensor_names)
+
+    for e in entries:
+        e.finish(status)
+
+
+# ---------------------------------------------------------------------------
+# Enqueue API (reference: operations.cc:919-1226)
+# ---------------------------------------------------------------------------
+def _as_array(tensor) -> np.ndarray:
+    """Stage a framework tensor as a numpy array (zero-copy where the
+    framework allows it; torch CPU and jax host arrays both support the
+    buffer protocol / __array__)."""
+    return np.asarray(tensor)
+
+
+def _enqueue(entries: list[TensorTableEntry],
+             requests: list[Request]) -> tuple[int, Handle]:
+    st = _require_init()
+    hid, handle = st.handle_manager.allocate(entries)
+    cb = st.mark_done_callback(handle)
+    for e in entries:
+        e.callback = cb
+    status = st.tensor_queue.add_to_tensor_queue_multi(entries, requests)
+    if not status.ok_p():
+        # Fail synchronously (duplicate name / shut down).
+        for e in entries:
+            e.callback = None
+        handle.status = status
+        st.handle_manager.release(hid)
+        handle._event.set()
+    return hid, handle
+
+
+def enqueue_allreduce(name: str, tensor, *, op: str = "sum",
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0,
+                      adasum: bool = False) -> tuple[int, Handle]:
+    return enqueue_grouped_allreduce([name], [tensor], op=op,
+                                     prescale_factor=prescale_factor,
+                                     postscale_factor=postscale_factor,
+                                     adasum=adasum, register_group=False)
+
+
+def enqueue_grouped_allreduce(names: Sequence[str], tensors: Sequence[Any], *,
+                              op: str = "sum",
+                              prescale_factor: float = 1.0,
+                              postscale_factor: float = 1.0,
+                              adasum: bool = False,
+                              register_group: bool = True) -> tuple[int, Handle]:
+    st = _require_init()
+    if op == "average":
+        postscale_factor = postscale_factor / st.size
+    elif op != "sum":
+        raise ValueError(f"Unknown allreduce op: {op}")
+    rtype = RequestType.ADASUM if adasum else RequestType.ALLREDUCE
+    entries, requests = [], []
+    if register_group and len(names) > 1:
+        st.group_table.register_group(list(names))
+    for name, tensor in zip(names, tensors):
+        arr = _as_array(tensor)
+        entries.append(TensorTableEntry(tensor_name=name, tensor=arr))
+        requests.append(Request(
+            request_rank=st.rank, request_type=rtype,
+            tensor_type=from_any(arr.dtype), tensor_name=name,
+            tensor_shape=tuple(arr.shape),
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor))
+    return _enqueue(entries, requests)
+
+
+def enqueue_allgather(name: str, tensor) -> tuple[int, Handle]:
+    st = _require_init()
+    arr = _as_array(tensor)
+    entry = TensorTableEntry(tensor_name=name, tensor=arr)
+    request = Request(request_rank=st.rank,
+                      request_type=RequestType.ALLGATHER,
+                      tensor_type=from_any(arr.dtype), tensor_name=name,
+                      tensor_shape=tuple(arr.shape))
+    return _enqueue([entry], [request])
+
+
+def enqueue_broadcast(name: str, tensor, root_rank: int) -> tuple[int, Handle]:
+    st = _require_init()
+    arr = _as_array(tensor)
+    entry = TensorTableEntry(tensor_name=name, tensor=arr,
+                             root_rank=root_rank)
+    request = Request(request_rank=st.rank,
+                      request_type=RequestType.BROADCAST,
+                      tensor_type=from_any(arr.dtype), tensor_name=name,
+                      root_rank=root_rank, tensor_shape=tuple(arr.shape))
+    return _enqueue([entry], [request])
+
+
+def enqueue_alltoall(name: str, tensor,
+                     splits=None) -> tuple[int, Handle]:
+    st = _require_init()
+    arr = _as_array(tensor)
+    split_list = [int(x) for x in np.asarray(splits).reshape(-1)] \
+        if splits is not None else []
+    if split_list and sum(split_list) != arr.shape[0]:
+        raise ValueError(
+            f"alltoall splits sum to {sum(split_list)} but tensor first "
+            f"dimension is {arr.shape[0]}")
+    entry = TensorTableEntry(tensor_name=name, tensor=arr,
+                             splits=split_list)
+    request = Request(request_rank=st.rank,
+                      request_type=RequestType.ALLTOALL,
+                      tensor_type=from_any(arr.dtype), tensor_name=name,
+                      tensor_shape=tuple(arr.shape))
+    return _enqueue([entry], [request])
+
+
+def enqueue_barrier() -> tuple[int, Handle]:
+    st = _require_init()
+    name = "__barrier__"
+    entry = TensorTableEntry(tensor_name=name)
+    request = Request(request_rank=st.rank, request_type=RequestType.BARRIER,
+                      tensor_name=name)
+    return _enqueue([entry], [request])
+
+
+def enqueue_join() -> tuple[int, Handle]:
+    """Graceful uneven-data exit (reference: operations.cc:1202-1226).
+
+    After join() this rank keeps participating in negotiated collectives
+    with zero stand-ins until every rank has joined."""
+    st = _require_init()
+    st.joined = True
+    entry = TensorTableEntry(tensor_name=JOIN_TENSOR_NAME)
+    request = Request(request_rank=st.rank, request_type=RequestType.JOIN,
+                      tensor_name=JOIN_TENSOR_NAME)
+    return _enqueue([entry], [request])

@@ -1,0 +1,1 @@
+"""Collective-op algorithms and TPU kernels (adasum, compression, fused ops)."""

@@ -1,0 +1,116 @@
+"""Adasum: scale-insensitive gradient reduction.
+
+Reference: horovod/common/ops/adasum/adasum.h:38-552 — recursive
+vector-halving distance-doubling (VHDD): at each level ranks pair up
+(partner = rank XOR distance), split their fragment in half, exchange the
+half they don't keep, compute the pairwise dot products, sum those dots over
+the aligned 2·distance rank group, and combine with the scale-adaptive rule
+
+    a' = a·(1 − ab/(2·aa)) + b·(1 − ab/(2·bb))
+
+which orthogonalises the pair of gradients instead of summing them, making
+the effective step robust to learning-rate × world-size blowup.  After the
+down-sweep each rank holds the combined result for its fragment; the reverse
+sweep reassembles the full vector.
+
+`adasum_combine` is the pure math shared by the TCP (CPU) and XLA (TPU)
+paths; `adasum_tcp` runs VHDD over the PeerMesh sockets.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def adasum_combine(a: np.ndarray, b: np.ndarray,
+                   aa: float, bb: float, ab: float) -> np.ndarray:
+    """Combine fragments a,b given *global* dot products aa=‖a‖², bb=‖b‖²,
+    ab=a·b (reference: adasum.h ComputeDotAndNormSqrds + ScaledAdd)."""
+    if aa == 0.0 and bb == 0.0:
+        return a + b
+    acoef = 1.0 if aa == 0.0 else 1.0 - ab / (2.0 * aa)
+    bcoef = 1.0 if bb == 0.0 else 1.0 - ab / (2.0 * bb)
+    return acoef * a + bcoef * b
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def _group_scalar_allreduce(coll, values: np.ndarray, group_bits: int) -> np.ndarray:
+    """Sum small fp64 vectors over the aligned 2^group_bits rank group via
+    recursive doubling (reference: adasum reduction_comms_)."""
+    acc = values.astype(np.float64, copy=True)
+    for j in range(group_bits):
+        peer = coll.rank ^ (1 << j)
+        data = coll._sendrecv(peer, acc.tobytes(), peer)
+        acc += np.frombuffer(data, dtype=np.float64)
+    return acc
+
+
+def adasum_tcp(coll, buf: np.ndarray) -> np.ndarray:
+    """Full Adasum allreduce over the TCP PeerMesh.
+
+    Requires a power-of-2 world size (the reference's VHDD has the same
+    constraint; reference: adasum.h power-of-2 rank pairing).
+    """
+    size, rank = coll.size, coll.rank
+    if size == 1:
+        return buf
+    if not _is_pow2(size):
+        raise ValueError(
+            f"Adasum requires a power-of-2 world size, got {size}")
+
+    orig_dtype = buf.dtype
+    frag = buf.astype(np.float64, copy=True)
+    path: list[tuple[int, bool, int]] = []   # (partner, kept_first, my_len)
+
+    distance = 1
+    level = 0
+    while distance < size:
+        partner = rank ^ distance
+        n = frag.size
+        mid = n // 2
+        kept_first = rank < partner
+        keep = frag[:mid] if kept_first else frag[mid:]
+        give = frag[mid:] if kept_first else frag[:mid]
+        data = coll._sendrecv(partner, give.tobytes(), partner)
+        partner_frag = np.frombuffer(data, dtype=np.float64)
+
+        # Consistent vector identity across the pair: `a` is the vector held
+        # by the lower half of the group (ranks with bit `level` clear),
+        # `b` by the upper half — otherwise the summed dot products mix
+        # ‖a‖² and ‖b‖² pieces (reference: adasum.h rank pairing).
+        a_frag, b_frag = (keep, partner_frag) if kept_first \
+            else (partner_frag, keep)
+        dots = np.array([a_frag @ a_frag, b_frag @ b_frag, a_frag @ b_frag],
+                        dtype=np.float64)
+        # Dots must cover the *whole* vectors being combined, whose fragments
+        # are spread over the aligned 2·distance rank group.
+        dots = _group_scalar_allreduce(coll, dots, level + 1)
+        aa, bb, ab = dots
+        frag = adasum_combine(a_frag, b_frag, aa, bb, ab)
+        path.append((partner, kept_first, frag.size))
+        distance <<= 1
+        level += 1
+
+    # Reverse sweep: reassemble the full combined vector.
+    for partner, kept_first, _ in reversed(path):
+        data = coll._sendrecv(partner, frag.tobytes(), partner)
+        other = np.frombuffer(data, dtype=np.float64)
+        frag = np.concatenate([frag, other] if kept_first else [other, frag])
+
+    return frag.astype(orig_dtype, copy=False)
+
+
+def adasum_reference(tensors: list[np.ndarray]) -> np.ndarray:
+    """Serial n-way Adasum for test oracles: combine in the same pairwise
+    tree order VHDD uses ((0,1),(2,3)) → ((01),(23)) → ..."""
+    vals = [np.asarray(t, dtype=np.float64).reshape(-1) for t in tensors]
+    while len(vals) > 1:
+        nxt = []
+        for i in range(0, len(vals), 2):
+            a, b = vals[i], vals[i + 1]
+            nxt.append(adasum_combine(a, b, float(a @ a), float(b @ b),
+                                      float(a @ b)))
+        vals = nxt
+    return vals[0].reshape(np.asarray(tensors[0]).shape)

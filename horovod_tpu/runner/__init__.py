@@ -1,0 +1,1 @@
+"""Launcher, rendezvous server, and cluster plumbing (horovodrun analogue)."""

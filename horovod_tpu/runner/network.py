@@ -1,0 +1,248 @@
+"""Socket primitives for the DCN control/data planes.
+
+Reference analogues: horovod/common/gloo/http_store.cc (KV client),
+horovod/runner/http/http_server.py:35-241 (rendezvous KV server), and the
+point-to-point plumbing under runner/common/service/.  Framing is a 4-byte
+big-endian length prefix; payloads are opaque bytes (wire.py messages or raw
+numpy buffers).
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+_LEN = struct.Struct(">I")
+
+
+def send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("socket closed mid-message")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket) -> bytes:
+    (length,) = _LEN.unpack(recv_exact(sock, 4))
+    return recv_exact(sock, length)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous KV store (HTTP, like the reference's RendezvousServer/HTTPStore)
+# ---------------------------------------------------------------------------
+class _KVHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence default stderr logging
+        pass
+
+    def _split(self) -> tuple[str, str]:
+        parts = self.path.lstrip("/").split("/", 1)
+        scope = parts[0] if parts else ""
+        key = parts[1] if len(parts) > 1 else ""
+        return scope, key
+
+    def do_PUT(self):
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        with self.server.kv_lock:
+            self.server.kv.setdefault(scope, {})[key] = value
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        scope, key = self._split()
+        with self.server.kv_lock:
+            value = self.server.kv.get(scope, {}).get(key)
+        if value is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+        else:
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(value)))
+            self.end_headers()
+            self.wfile.write(value)
+
+    def do_DELETE(self):
+        scope, key = self._split()
+        with self.server.kv_lock:
+            if key:
+                self.server.kv.get(scope, {}).pop(key, None)
+            else:
+                self.server.kv.pop(scope, None)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class RendezvousServer:
+    """Threaded HTTP KV store (reference: runner/http/http_server.py)."""
+
+    def __init__(self, port: int = 0) -> None:
+        self._httpd = ThreadingHTTPServer(("", port), _KVHandler)
+        self._httpd.kv = {}
+        self._httpd.kv_lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="hvd-rendezvous")
+        self._thread.start()
+        return self.port
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        with self._httpd.kv_lock:
+            self._httpd.kv.setdefault(scope, {})[key] = value
+
+    def get(self, scope: str, key: str) -> bytes | None:
+        with self._httpd.kv_lock:
+            return self._httpd.kv.get(scope, {}).get(key)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class RendezvousClient:
+    """HTTP KV client with blocking get (reference: gloo/http_store.cc wait)."""
+
+    def __init__(self, addr: str, port: int, timeout: float = 30.0) -> None:
+        self._base = f"http://{addr}:{port}"
+        self.timeout = timeout
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        req = urlrequest.Request(f"{self._base}/{scope}/{key}", data=value,
+                                 method="PUT")
+        with urlrequest.urlopen(req, timeout=self.timeout):
+            pass
+
+    def get(self, scope: str, key: str) -> bytes | None:
+        try:
+            req = urlrequest.Request(f"{self._base}/{scope}/{key}",
+                                     method="GET")
+            with urlrequest.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urlerror.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def wait(self, scope: str, key: str,
+             timeout: float | None = None) -> bytes:
+        deadline = time.monotonic() + (timeout or self.timeout)
+        while True:
+            value = self.get(scope, key)
+            if value is not None:
+                return value
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"Rendezvous key {scope}/{key} not available after "
+                    f"{timeout or self.timeout}s")
+            time.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# Full-mesh point-to-point connections between ranks
+# ---------------------------------------------------------------------------
+class PeerMesh:
+    """Connect every pair of ranks once; expose send/recv by peer rank.
+
+    Bootstraps peer addresses through the rendezvous KV store, then lower
+    rank listens / higher rank connects (the reference's gloo
+    connectFullMesh does the same through its HTTPStore).
+    """
+
+    def __init__(self, rank: int, size: int, kv: RendezvousClient,
+                 scope: str = "mesh", timeout: float = 30.0) -> None:
+        self.rank = rank
+        self.size = size
+        self._socks: dict[int, socket.socket] = {}
+        self._lock = threading.Lock()
+        if size == 1:
+            return
+
+        listener = socket.socket()
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("", 0))
+        listener.listen(size)
+        port = listener.getsockname()[1]
+        host = socket.gethostbyname(socket.gethostname())
+        kv.put(scope, f"addr:{rank}", f"{host}:{port}".encode())
+
+        expected_inbound = size - 1 - rank   # peers with higher rank dial in
+        accepted: dict[int, socket.socket] = {}
+
+        def _accept():
+            for _ in range(expected_inbound):
+                conn, _ = listener.accept()
+                peer = int.from_bytes(recv_exact(conn, 4), "big")
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                accepted[peer] = conn
+
+        acceptor = threading.Thread(target=_accept, daemon=True)
+        acceptor.start()
+
+        for peer in range(rank):   # dial every lower-ranked peer
+            raw = kv.wait(scope, f"addr:{peer}", timeout).decode()
+            peer_host, peer_port = raw.rsplit(":", 1)
+            deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    sock = socket.create_connection(
+                        (peer_host, int(peer_port)), timeout=timeout)
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.05)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.sendall(self.rank.to_bytes(4, "big"))
+            self._socks[peer] = sock
+
+        acceptor.join(timeout)
+        if len(accepted) != expected_inbound:
+            raise TimeoutError(
+                f"rank {rank}: only {len(accepted)}/{expected_inbound} "
+                f"inbound peers connected")
+        self._socks.update(accepted)
+        listener.close()
+
+    def send(self, peer: int, payload: bytes) -> None:
+        send_msg(self._socks[peer], payload)
+
+    def recv(self, peer: int) -> bytes:
+        return recv_msg(self._socks[peer])
+
+    def close(self) -> None:
+        for sock in self._socks.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._socks.clear()

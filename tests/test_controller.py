@@ -1,0 +1,451 @@
+"""Controller protocol unit tests against the in-process fake world.
+
+Covers the reference behaviors from controller.cc: readiness counting,
+cross-rank consistency validation (mismatch → structured ERROR, never a
+hang), fusion with look-ahead, response caching with bitvector sync, join
+handling, and grouped collectives (SURVEY §2.1, §4).
+"""
+import numpy as np
+import pytest
+
+from horovod_tpu.common.message import (Request, RequestType, Response,
+                                        ResponseType)
+from horovod_tpu.common.dtypes import DataType
+
+from util_world import InProcWorld, make_controller, run_ranks
+
+
+def _allreduce_req(rank, name, shape=(4,), dtype=DataType.FLOAT32, **kw):
+    return Request(request_rank=rank, request_type=RequestType.ALLREDUCE,
+                   tensor_type=dtype, tensor_name=name, tensor_shape=shape,
+                   **kw)
+
+
+def test_single_tensor_ready_when_all_ranks_submit():
+    size = 3
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world) for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        ctrl.tensor_queue.push_back_to_queue(_allreduce_req(rank, "t0"))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        assert len(rl.responses) == 1
+        resp = rl.responses[0]
+        assert resp.response_type == ResponseType.ALLREDUCE
+        assert resp.tensor_names == ["t0"]
+        assert resp.tensor_sizes == [4]
+
+
+def test_tensor_not_ready_until_all_ranks():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world) for r in range(size)]
+
+    def step1(rank):
+        ctrl = controllers[rank]
+        if rank == 0:   # only rank 0 submits
+            ctrl.tensor_queue.push_back_to_queue(_allreduce_req(rank, "t0"))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step1)
+    assert all(len(rl.responses) == 0 for rl in results)
+
+    def step2(rank):
+        ctrl = controllers[rank]
+        if rank == 1:   # now rank 1 catches up
+            ctrl.tensor_queue.push_back_to_queue(_allreduce_req(rank, "t0"))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step2)
+    for rl in results:
+        assert [r.tensor_names for r in rl.responses] == [["t0"]]
+
+
+def test_shape_mismatch_produces_error_response():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world) for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        shape = (4,) if rank == 0 else (5,)
+        ctrl.tensor_queue.push_back_to_queue(
+            _allreduce_req(rank, "bad", shape=shape))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        assert len(rl.responses) == 1
+        assert rl.responses[0].response_type == ResponseType.ERROR
+        assert "shape" in rl.responses[0].error_message.lower()
+
+
+def test_dtype_mismatch_produces_error_response():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world) for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        dtype = DataType.FLOAT32 if rank == 0 else DataType.FLOAT64
+        ctrl.tensor_queue.push_back_to_queue(
+            _allreduce_req(rank, "bad", dtype=dtype))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        assert rl.responses[0].response_type == ResponseType.ERROR
+        assert "data type" in rl.responses[0].error_message.lower()
+
+
+def test_op_mismatch_produces_error_response():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world) for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        rtype = RequestType.ALLREDUCE if rank == 0 else RequestType.BROADCAST
+        ctrl.tensor_queue.push_back_to_queue(
+            Request(request_rank=rank, request_type=rtype,
+                    tensor_name="bad", tensor_shape=(2,),
+                    root_rank=0 if rtype == RequestType.BROADCAST else -1))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        assert rl.responses[0].response_type == ResponseType.ERROR
+
+
+def test_fusion_merges_small_allreduces():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world,
+                                   fusion_threshold=64 * 1024 * 1024)
+                   for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        for i in range(5):
+            ctrl.tensor_queue.push_back_to_queue(
+                _allreduce_req(rank, f"g{i}", shape=(16,)))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        assert len(rl.responses) == 1
+        assert rl.responses[0].tensor_names == [f"g{i}" for i in range(5)]
+        assert rl.responses[0].tensor_sizes == [16] * 5
+
+
+def test_fusion_respects_threshold():
+    size = 2
+    world = InProcWorld(size)
+    # Threshold rounds to 128 bytes (atomic unit 64 × local_size 1):
+    # fits exactly two 16-float tensors (64B each).
+    controllers = [make_controller(r, size, world, fusion_threshold=128)
+                   for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        for i in range(5):
+            ctrl.tensor_queue.push_back_to_queue(
+                _allreduce_req(rank, f"g{i}", shape=(16,)))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        sizes = [len(r.tensor_names) for r in rl.responses]
+        assert sizes == [2, 2, 1]
+        assert sum(sizes) == 5
+
+
+def test_fusion_does_not_merge_different_dtypes():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world,
+                                   fusion_threshold=1 << 20)
+                   for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        ctrl.tensor_queue.push_back_to_queue(
+            _allreduce_req(rank, "f32", dtype=DataType.FLOAT32))
+        ctrl.tensor_queue.push_back_to_queue(
+            _allreduce_req(rank, "f64", dtype=DataType.FLOAT64))
+        ctrl.tensor_queue.push_back_to_queue(
+            _allreduce_req(rank, "f32b", dtype=DataType.FLOAT32))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        by_names = sorted(tuple(r.tensor_names) for r in rl.responses)
+        # f32 + f32b fuse (look-ahead past f64); f64 stays alone
+        assert by_names == [("f32", "f32b"), ("f64",)]
+
+
+def test_response_cache_skips_negotiation_in_steady_state():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world, cache_capacity=64)
+                   for r in range(size)]
+
+    def cycle(rank):
+        ctrl = controllers[rank]
+        ctrl.tensor_queue.push_back_to_queue(_allreduce_req(rank, "t0"))
+        return ctrl.compute_response_list()
+
+    run_ranks(size, cycle)
+    gathers_after_first = world.gather_count
+    assert gathers_after_first > 0
+
+    for _ in range(3):
+        results = run_ranks(size, cycle)
+        for rl in results:
+            assert [r.tensor_names for r in rl.responses] == [["t0"]]
+    # Steady state: no further RequestList gathers happened.
+    assert world.gather_count == gathers_after_first
+
+
+def test_cache_invalidated_on_shape_change():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world, cache_capacity=64)
+                   for r in range(size)]
+
+    def cycle_shape(shape):
+        def _run(rank):
+            ctrl = controllers[rank]
+            ctrl.tensor_queue.push_back_to_queue(
+                _allreduce_req(rank, "t0", shape=shape))
+            return ctrl.compute_response_list()
+        return _run
+
+    run_ranks(size, cycle_shape((4,)))
+    before = world.gather_count
+    results = run_ranks(size, cycle_shape((8,)))   # same name, new shape
+    assert world.gather_count > before              # forced renegotiation
+    for rl in results:
+        assert rl.responses[0].tensor_sizes == [8]
+
+
+def test_join_counts_and_completes():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world) for r in range(size)]
+
+    # Rank 1 joins; rank 0 still allreduces: tensor is ready with 1 rank.
+    def step1(rank):
+        ctrl = controllers[rank]
+        if rank == 0:
+            ctrl.tensor_queue.push_back_to_queue(_allreduce_req(rank, "t0"))
+        else:
+            ctrl.tensor_queue.push_back_to_queue(
+                Request(request_rank=rank, request_type=RequestType.JOIN,
+                        tensor_name="__join__"))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step1)
+    for rl in results:
+        assert [r.response_type for r in rl.responses] == \
+            [ResponseType.ALLREDUCE]
+
+    # Rank 0 joins too: JOIN response emitted for everyone.
+    def step2(rank):
+        ctrl = controllers[rank]
+        if rank == 0:
+            ctrl.tensor_queue.push_back_to_queue(
+                Request(request_rank=rank, request_type=RequestType.JOIN,
+                        tensor_name="__join__"))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step2)
+    for rl in results:
+        assert [r.response_type for r in rl.responses] == [ResponseType.JOIN]
+        assert rl.responses[0].last_joined_rank == 1
+
+
+def test_allgather_with_join_is_error():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world) for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        if rank == 0:
+            ctrl.tensor_queue.push_back_to_queue(
+                Request(request_rank=rank,
+                        request_type=RequestType.ALLGATHER,
+                        tensor_name="g", tensor_shape=(2, 3)))
+        else:
+            ctrl.tensor_queue.push_back_to_queue(
+                Request(request_rank=rank, request_type=RequestType.JOIN,
+                        tensor_name="__join__"))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        assert rl.responses[0].response_type == ResponseType.ERROR
+        assert "join" in rl.responses[0].error_message.lower()
+
+
+def test_allgather_variable_first_dim():
+    size = 3
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world) for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        ctrl.tensor_queue.push_back_to_queue(
+            Request(request_rank=rank, request_type=RequestType.ALLGATHER,
+                    tensor_name="g", tensor_shape=(rank + 1, 7)))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        resp = rl.responses[0]
+        assert resp.response_type == ResponseType.ALLGATHER
+        assert resp.tensor_sizes == [1, 2, 3]
+
+
+def test_broadcast_root_mismatch_is_error():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world) for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        ctrl.tensor_queue.push_back_to_queue(
+            Request(request_rank=rank, request_type=RequestType.BROADCAST,
+                    tensor_name="b", tensor_shape=(2,), root_rank=rank))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        assert rl.responses[0].response_type == ResponseType.ERROR
+        assert "root" in rl.responses[0].error_message.lower()
+
+
+def test_grouped_tensors_wait_for_all_members():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world,
+                                   fusion_threshold=1 << 20)
+                   for r in range(size)]
+    for ctrl in controllers:
+        gid = ctrl.group_table.register_group(["ga", "gb"])
+        assert gid == 0
+
+    def step1(rank):
+        ctrl = controllers[rank]
+        ctrl.tensor_queue.push_back_to_queue(_allreduce_req(rank, "ga"))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step1)
+    assert all(len(rl.responses) == 0 for rl in results)   # gb missing
+
+    def step2(rank):
+        ctrl = controllers[rank]
+        ctrl.tensor_queue.push_back_to_queue(_allreduce_req(rank, "gb"))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step2)
+    for rl in results:
+        assert len(rl.responses) == 1
+        assert sorted(rl.responses[0].tensor_names) == ["ga", "gb"]
+
+
+def test_shutdown_propagates():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world) for r in range(size)]
+
+    def step(rank):
+        # only rank 1 requests shutdown; everyone must see it
+        return controllers[rank].compute_response_list(
+            shutdown_requested=(rank == 1))
+
+    results = run_ranks(size, step)
+    assert all(rl.shutdown for rl in results)
+
+
+def test_arrival_order_is_deterministic():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world, fusion_threshold=0)
+                   for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        # ranks submit in different local order; response order must match
+        names = ["x", "y", "z"] if rank == 0 else ["z", "y", "x"]
+        for n in names:
+            ctrl.tensor_queue.push_back_to_queue(_allreduce_req(rank, n))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    orders = [[r.tensor_names[0] for r in rl.responses] for rl in results]
+    assert orders[0] == orders[1]   # identical order on every rank
+
+
+def test_cached_responses_fuse_without_corrupting_cache():
+    """Regression: fusing cache-served responses must not mutate the cached
+    entries (they were corrupted in place, growing every cycle)."""
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world, cache_capacity=64,
+                                   fusion_threshold=1 << 20)
+                   for r in range(size)]
+
+    def submit(rank, names):
+        ctrl = controllers[rank]
+        for n in names:
+            ctrl.tensor_queue.push_back_to_queue(_allreduce_req(rank, n))
+        return ctrl.compute_response_list()
+
+    run_ranks(size, lambda r: submit(r, ["x"]))        # x negotiated+cached
+    run_ranks(size, lambda r: submit(r, ["y"]))        # y negotiated+cached
+    for _ in range(5):
+        results = run_ranks(size, lambda r: submit(r, ["x", "y"]))
+        for rl in results:
+            assert len(rl.responses) == 1               # fused from cache
+            assert sorted(rl.responses[0].tensor_names) == ["x", "y"]
+            assert rl.responses[0].tensor_sizes == [4, 4]
+
+
+def test_joined_rank_does_not_block_cached_collectives():
+    """Regression: with the cache enabled, a joined rank must assert all
+    active cache bits so remaining ranks' cached collectives keep flowing."""
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world, cache_capacity=64)
+                   for r in range(size)]
+
+    def warm(rank):
+        ctrl = controllers[rank]
+        ctrl.tensor_queue.push_back_to_queue(_allreduce_req(rank, "t0"))
+        return ctrl.compute_response_list()
+
+    run_ranks(size, warm)   # negotiate + cache
+    run_ranks(size, warm)   # steady state
+
+    def rank1_joins(rank):
+        ctrl = controllers[rank]
+        if rank == 0:
+            ctrl.tensor_queue.push_back_to_queue(_allreduce_req(rank, "t0"))
+        else:
+            ctrl.tensor_queue.push_back_to_queue(
+                Request(request_rank=rank, request_type=RequestType.JOIN,
+                        tensor_name="__join__"))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, rank1_joins)
+    # Rank 0's cached allreduce must have been served this very cycle.
+    for rl in results:
+        assert any(resp.response_type == ResponseType.ALLREDUCE and
+                   resp.tensor_names == ["t0"] for resp in rl.responses), \
+            [r.response_type for r in rl.responses]

@@ -1,0 +1,67 @@
+"""Multi-process parallel tests: N real worker processes on localhost
+against one rendezvous server — the reference's `mpirun -np 2 pytest`
+pattern without MPI (SURVEY §4 "multi-node-without-a-cluster trick")."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.runner.network import RendezvousServer
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "mp_worker.py")
+
+
+def _run_world(size: int, battery: str, timeout: float = 90.0) -> None:
+    server = RendezvousServer()
+    port = server.start()
+    env = dict(os.environ)
+    env.pop("HOROVOD_RANK", None)
+    env.pop("HOROVOD_SIZE", None)
+    env["HOROVOD_RENDEZVOUS_EPOCH"] = f"{battery}{size}"
+    procs = [
+        subprocess.Popen([sys.executable, _WORKER, str(r), str(size),
+                          str(port), battery],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT)
+        for r in range(size)
+    ]
+    failed = []
+    outputs = []
+    try:
+        for r, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+                failed.append((r, "timeout"))
+            outputs.append(f"--- rank {r} (rc={p.returncode}) ---\n"
+                           + out.decode(errors="replace"))
+            if p.returncode != 0:
+                failed.append((r, p.returncode))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+    assert not failed, "worker failures: %s\n%s" % (failed, "\n".join(outputs))
+
+
+@pytest.mark.parametrize("size", [2, 3])
+def test_collectives(size):
+    _run_world(size, "collectives")
+
+
+def test_error_handling():
+    _run_world(2, "errors")
+
+
+def test_join_uneven_data():
+    _run_world(2, "join")
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_adasum(size):
+    _run_world(size, "adasum")

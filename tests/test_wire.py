@@ -1,0 +1,99 @@
+"""Wire-format round-trip tests (reference test analogue: message
+serialization exercised implicitly by test/parallel/*)."""
+import numpy as np
+import pytest
+
+from horovod_tpu.common.dtypes import DataType, element_size, from_any, to_numpy
+from horovod_tpu.common.message import (Request, RequestList, RequestType,
+                                        Response, ResponseList, ResponseType)
+from horovod_tpu.common.wire import Decoder, Encoder
+
+
+def test_varint_roundtrip():
+    enc = Encoder()
+    values = [0, 1, 127, 128, 300, 2 ** 32, 2 ** 60]
+    for v in values:
+        enc.uvarint(v)
+    dec = Decoder(enc.getvalue())
+    assert [dec.uvarint() for _ in values] == values
+
+
+def test_svarint_roundtrip():
+    enc = Encoder()
+    values = [0, -1, 1, -64, 64, -(2 ** 40), 2 ** 40]
+    for v in values:
+        enc.svarint(v)
+    dec = Decoder(enc.getvalue())
+    assert [dec.svarint() for _ in values] == values
+
+
+def test_mixed_fields():
+    enc = Encoder()
+    enc.string("tensor/äöü").f64(3.5).bool_(True).svarint_list([1, -2, 3]) \
+       .string_list(["a", "b"]).blob(b"\x00\x01")
+    dec = Decoder(enc.getvalue())
+    assert dec.string() == "tensor/äöü"
+    assert dec.f64() == 3.5
+    assert dec.bool_() is True
+    assert dec.svarint_list() == [1, -2, 3]
+    assert dec.string_list() == ["a", "b"]
+    assert dec.blob() == b"\x00\x01"
+    assert dec.eof()
+
+
+def test_request_list_roundtrip():
+    reqs = [
+        Request(request_rank=3, request_type=RequestType.ALLREDUCE,
+                tensor_type=DataType.FLOAT32, tensor_name="grad/w1",
+                tensor_shape=(4, 5), prescale_factor=0.5),
+        Request(request_rank=1, request_type=RequestType.BROADCAST,
+                tensor_type=DataType.INT64, tensor_name="step",
+                root_rank=0, tensor_shape=()),
+    ]
+    rl = RequestList(requests=reqs, shutdown=True)
+    decoded = RequestList.from_bytes(rl.to_bytes())
+    assert decoded.shutdown is True
+    assert decoded.requests == reqs
+
+
+def test_response_list_roundtrip():
+    resps = [
+        Response(response_type=ResponseType.ALLREDUCE,
+                 tensor_names=["a", "b"], devices=[0, 1],
+                 tensor_sizes=[20, 12], tensor_type=DataType.BFLOAT16,
+                 postscale_factor=0.25),
+        Response(response_type=ResponseType.ERROR, tensor_names=["c"],
+                 error_message="shape mismatch"),
+    ]
+    rl = ResponseList(responses=resps, tuned_fusion_threshold=1 << 20,
+                      tuned_cycle_time_ms=2.5)
+    decoded = ResponseList.from_bytes(rl.to_bytes())
+    assert decoded.responses == resps
+    assert decoded.tuned_fusion_threshold == 1 << 20
+    assert decoded.tuned_cycle_time_ms == 2.5
+    assert decoded.shutdown is False
+
+
+@pytest.mark.parametrize("dt,np_dtype", [
+    (DataType.FLOAT32, np.float32),
+    (DataType.FLOAT16, np.float16),
+    (DataType.INT64, np.int64),
+    (DataType.BOOL, np.bool_),
+])
+def test_dtype_table(dt, np_dtype):
+    assert from_any(np.dtype(np_dtype)) == dt
+    assert to_numpy(dt) == np.dtype(np_dtype)
+    assert element_size(dt) == np.dtype(np_dtype).itemsize
+
+
+def test_bfloat16_dtype():
+    import ml_dtypes
+    assert from_any(np.dtype(ml_dtypes.bfloat16)) == DataType.BFLOAT16
+    assert element_size(DataType.BFLOAT16) == 2
+
+
+def test_torch_dtype_mapping():
+    import torch
+    assert from_any(torch.float32) == DataType.FLOAT32
+    assert from_any(torch.int64) == DataType.INT64
+    assert from_any(torch.bfloat16) == DataType.BFLOAT16

@@ -1,0 +1,129 @@
+"""In-process fake multi-rank world for controller unit tests.
+
+SURVEY §4 rebuild guidance: single-process unit tests drive the controller /
+fusion / cache logic against a fake in-process transport (the analogue of
+the reference's mocked-out MPI in test/single/).  N controllers run in N
+threads; the transport synchronises them with barriers over shared dicts.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from horovod_tpu.common.controller import Controller, Transport
+from horovod_tpu.common.message import RequestList, ResponseList
+
+
+class InProcWorld:
+    """Shared state for `size` in-process ranks."""
+
+    def __init__(self, size: int) -> None:
+        self.size = size
+        self._deposits: dict[int, object] = {}
+        self._result: object = None
+        self._clear = threading.Barrier(size, action=self._do_clear)
+        self._full = threading.Barrier(size)
+        self.gather_count = 0
+        self.sync_count = 0
+
+    def _do_clear(self) -> None:
+        self._deposits = {}
+        self._result = None
+
+    def transport(self, rank: int) -> "InProcTransport":
+        return InProcTransport(self, rank)
+
+
+class InProcTransport(Transport):
+    def __init__(self, world: InProcWorld, rank: int) -> None:
+        self.world = world
+        self.rank = rank
+
+    def _exchange(self, value, combine: Callable[[dict], object]):
+        w = self.world
+        w._deposits[self.rank] = value
+        w._full.wait()          # all deposited
+        if self.rank == 0:
+            w._result = combine(dict(w._deposits))
+        w._full.wait()          # result ready
+        result = w._result
+        w._clear.wait()         # all read; clears shared state
+        return result
+
+    def bitwise_sync(self, and_word: int, or_word: int):
+        self.world.sync_count += 1
+
+        def combine(deposits: dict) -> tuple[int, int]:
+            a, o = -1, 0   # -1 = all ones
+            for aw, ow in deposits.values():
+                a &= aw
+                o |= ow
+            return a, o
+
+        return self._exchange((and_word, or_word), combine)
+
+    def gather_requests(self, request_list: RequestList):
+        self.world.gather_count += 1
+
+        def combine(deposits: dict) -> list[RequestList]:
+            return [deposits[r] for r in sorted(deposits)]
+
+        gathered = self._exchange(request_list, combine)
+        return gathered if self.rank == 0 else None
+
+    def broadcast_responses(self, response_list):
+        def combine(deposits: dict):
+            rl = deposits[0]
+            assert rl is not None
+            # serialize/deserialize so ranks never share mutable responses
+            return rl.to_bytes()
+
+        raw = self._exchange(response_list if self.rank == 0 else None,
+                             combine)
+        return response_list if self.rank == 0 \
+            else ResponseList.from_bytes(raw)
+
+    def barrier(self) -> None:
+        self._exchange(None, lambda d: None)
+
+
+def run_ranks(size: int, fn: Callable[[int], object],
+              timeout: float = 30.0) -> list:
+    """Run fn(rank) on `size` threads; re-raise the first failure."""
+    results: list = [None] * size
+    errors: list = []
+
+    def _worker(r: int) -> None:
+        try:
+            results[r] = fn(r)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=_worker, args=(r,), daemon=True)
+               for r in range(size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "rank thread hung"
+    if errors:
+        raise errors[0]
+    return results
+
+
+def make_controller(rank: int, size: int, world: InProcWorld,
+                    cache_capacity: int = 0,
+                    fusion_threshold: int | None = None) -> Controller:
+    from horovod_tpu.common.group_table import GroupTable
+    from horovod_tpu.common.response_cache import ResponseCache
+    from horovod_tpu.common.stall_inspector import StallInspector
+    from horovod_tpu.common.tensor_queue import TensorQueue
+
+    ctrl = Controller(
+        rank=rank, size=size, transport=world.transport(rank),
+        tensor_queue=TensorQueue(), group_table=GroupTable(),
+        response_cache=ResponseCache(cache_capacity),
+        stall_inspector=StallInspector())
+    if fusion_threshold is not None:
+        ctrl.tensor_fusion_threshold = fusion_threshold
+    return ctrl
