@@ -192,7 +192,15 @@ class TcpBackend(CollectiveBackend):
         buf = self.scale_buffer(buf, response.prescale_factor)
         if response.response_type == ResponseType.ADASUM:
             from ..ops.adasum import adasum_tcp
-            buf = adasum_tcp(self.coll, buf)
+            # Adasum semantics are per-tensor: the reference computes
+            # per-layer dot products even inside fused buffers
+            # (adasum.h:38-552), so a fused response must not mix norms
+            # across tensor boundaries — run VHDD per segment.
+            offset, parts = 0, []
+            for n in response.tensor_sizes:
+                parts.append(adasum_tcp(self.coll, buf[offset:offset + n]))
+                offset += n
+            buf = np.concatenate(parts) if len(parts) > 1 else parts[0]
         else:
             buf = self.coll.allreduce(buf)
         buf = self.scale_buffer(buf, response.postscale_factor)

@@ -1,0 +1,30 @@
+"""TPU-native parallelism layer: device meshes, SPMD collectives, and the
+fused gradient-synchronization pipeline.
+
+This package is the data-plane heart of horovod_tpu (SURVEY §7 step 3):
+where the reference dispatches NCCL/MPI calls from a C++ background thread
+(reference: horovod/common/ops/nccl_operations.cc), we compile collectives
+into the training step itself — `jax.lax.psum` / `all_gather` /
+`ppermute` / `all_to_all` over a `jax.sharding.Mesh`, traced once under
+`jit` and executed on the ICI fabric by XLA.
+
+Topology model (reference: horovod/common/common.h:119-136 — GLOBAL /
+LOCAL / CROSS communicators): ICI mesh axes play the "local" role, the
+DCN (inter-host) axis plays "cross"; hierarchical reductions ride ICI
+first, then DCN.
+"""
+from .mesh import MeshSpec, build_mesh, axis_size, data_axes, DEFAULT_AXES
+from .collectives import (allreduce, allgather, alltoall, broadcast,
+                          reduce_scatter, adasum_allreduce, device_collective)
+from .grad_sync import GradSyncConfig, build_grad_sync, sync_gradients
+from .sharding import (ShardingRules, shard_params, named_sharding,
+                       constrain, replicated)
+
+__all__ = [
+    "MeshSpec", "build_mesh", "axis_size", "data_axes", "DEFAULT_AXES",
+    "allreduce", "allgather", "alltoall", "broadcast", "reduce_scatter",
+    "adasum_allreduce", "device_collective",
+    "GradSyncConfig", "build_grad_sync", "sync_gradients",
+    "ShardingRules", "shard_params", "named_sharding", "constrain",
+    "replicated",
+]

@@ -1,0 +1,81 @@
+"""Parameter/activation sharding rules.
+
+A lightweight, framework-agnostic path→PartitionSpec rule system: params
+are placed by matching their pytree path against ordered regex rules,
+first match wins, default replicated. This plays the role the reference
+never needed (it only ever sees whole replicated tensors) but which a
+mesh-native framework requires to express tp/fsdp/ep layouts.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) rules.
+
+    >>> rules = ShardingRules([
+    ...     (r".*attention.*kernel", P(None, "tp")),
+    ...     (r".*mlp/up.*kernel",    P(None, "tp")),
+    ...     (r".*mlp/down.*kernel",  P("tp", None)),
+    ... ])
+    """
+
+    def __init__(self, rules: Sequence[tuple[str, P]] = (),
+                 default: P = P()) -> None:
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self._default = default
+
+    def spec_for(self, path: str, leaf=None) -> P:
+        for pat, spec in self._rules:
+            if pat.search(path):
+                if leaf is not None and len(spec) > getattr(leaf, "ndim", 99):
+                    continue   # rule doesn't fit this rank; keep looking
+                return spec
+        return self._default
+
+    def tree_specs(self, tree: Any) -> Any:
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: self.spec_for(_path_str(path), leaf), tree)
+
+
+def named_sharding(mesh: Mesh, spec: P = P()) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_params(params: Any, mesh: Mesh,
+                 rules: ShardingRules | None = None) -> Any:
+    """Place a parameter pytree onto the mesh according to the rules
+    (default: fully replicated, the reference's DP layout)."""
+    rules = rules or ShardingRules()
+    specs = rules.tree_specs(params)
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(
+            leaf, NamedSharding(mesh, spec)), params, specs)
+
+
+def constrain(x: jax.Array, mesh: Mesh, spec: P) -> jax.Array:
+    """Annotate an intermediate's layout inside jit."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -1,17 +1,27 @@
-"""Test configuration: force an 8-device virtual CPU mesh before jax loads.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Mirrors the reference strategy of running "multi-node" tests as multiple
 local processes (SURVEY §4): SPMD sharding tests use
 --xla_force_host_platform_device_count=8, and multi-process controller
 tests spawn real subprocesses on localhost.
+
+Note: a sitecustomize may import jax at interpreter startup (e.g. the
+axon TPU tunnel), so env vars alone are too late — we also flip the jax
+config before any backend initializes.
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

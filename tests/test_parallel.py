@@ -1,0 +1,195 @@
+"""SPMD data-plane tests on the 8-device virtual CPU mesh (SURVEY §4:
+the JAX analogue of the reference's multi-process localhost testing)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from horovod_tpu.ops.adasum import adasum_reference
+from horovod_tpu.parallel import (GradSyncConfig, MeshSpec, adasum_allreduce,
+                                  build_grad_sync, build_mesh,
+                                  device_collective, ShardingRules,
+                                  shard_params, sync_gradients)
+from horovod_tpu.parallel import collectives as coll
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return build_mesh(dp=8)
+
+
+@pytest.fixture(scope="module")
+def mesh_dp_tp():
+    return build_mesh(dp=4, tp=2)
+
+
+def stacked(n, shape, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    return rng.randn(n, *shape).astype(dtype)
+
+
+class TestMeshBuild:
+    def test_resolve_infers_dp(self):
+        assert MeshSpec(tp=2).resolve(8)["dp"] == 4
+
+    def test_bad_divisibility(self):
+        with pytest.raises(ValueError):
+            MeshSpec(tp=3).resolve(8)
+
+    def test_axis_names(self, mesh_dp_tp):
+        assert mesh_dp_tp.shape["dp"] == 4
+        assert mesh_dp_tp.shape["tp"] == 2
+        assert mesh_dp_tp.shape["pp"] == 1
+
+
+class TestCollectives:
+    def test_psum(self, mesh8):
+        x = stacked(8, (4, 3))
+        fn = device_collective(lambda v: coll.allreduce(v, "dp", "sum"),
+                               mesh8, "dp")
+        out = np.asarray(fn(x))
+        expect = x.sum(axis=0, keepdims=True).repeat(8, axis=0)
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_pmean(self, mesh8):
+        x = stacked(8, (5,))
+        fn = device_collective(lambda v: coll.allreduce(v, "dp", "average"),
+                               mesh8, "dp")
+        np.testing.assert_allclose(np.asarray(fn(x))[0], x.mean(0),
+                                   rtol=1e-5)
+
+    def test_broadcast(self, mesh8):
+        x = stacked(8, (6,))
+        fn = device_collective(lambda v: coll.broadcast(v, "dp", root=3),
+                               mesh8, "dp")
+        out = np.asarray(fn(x))
+        for r in range(8):
+            np.testing.assert_allclose(out[r], x[3], rtol=1e-6)
+
+    def test_allgather_reduce_scatter_roundtrip(self, mesh8):
+        x = stacked(8, (4,))
+        fn = device_collective(
+            lambda v: coll.reduce_scatter(coll.allgather(v, "dp"), "dp"),
+            mesh8, "dp")
+        out = np.asarray(fn(x))
+        # allgather stacks all shards; reduce_scatter sums and re-shards:
+        # each rank ends with 8 * its own shard
+        np.testing.assert_allclose(out, 8 * x, rtol=1e-5)
+
+    def test_alltoall(self, mesh8):
+        x = stacked(8, (8, 2))
+        # shard_map keeps the stacked leading dim (size 1 per rank), so the
+        # exchange axis of the local block is axis 1.
+        fn = device_collective(
+            lambda v: coll.alltoall(v, "dp", split_axis=1, concat_axis=1),
+            mesh8, "dp")
+        out = np.asarray(fn(x))
+        # row j of rank i's output == row i of rank j's input
+        for i in range(8):
+            for j in range(8):
+                np.testing.assert_allclose(out[i, j], x[j, i], rtol=1e-6)
+
+
+class TestAdasum:
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_matches_reference_tree(self, n):
+        mesh = build_mesh(dp=n, devices=jax.devices()[:n])
+        x = stacked(n, (33,), seed=n)
+        fn = device_collective(lambda v: adasum_allreduce(v, "dp"),
+                               mesh, "dp")
+        out = np.asarray(fn(x))
+        expect = adasum_reference(list(x))
+        for r in range(n):
+            np.testing.assert_allclose(out[r], expect, rtol=1e-4)
+
+    def test_identical_inputs_average(self, mesh8):
+        # Adasum of identical vectors = the vector itself (a·b = ‖a‖²
+        # → coefs 1/2) — the scale-insensitivity property.
+        v = np.tile(stacked(1, (16,), seed=3), (8, 1))
+        fn = device_collective(lambda t: adasum_allreduce(t, "dp"),
+                               mesh8, "dp")
+        np.testing.assert_allclose(np.asarray(fn(v))[0], v[0], rtol=1e-4)
+
+    def test_non_pow2_raises(self):
+        mesh = build_mesh(dp=3, devices=jax.devices()[:3])
+        x = stacked(3, (8,))
+        fn = device_collective(lambda v: adasum_allreduce(v, "dp"),
+                               mesh, "dp")
+        with pytest.raises(ValueError, match="power-of-2"):
+            fn(x)
+
+
+class TestGradSync:
+    def _tree(self, n, seed=0):
+        rng = np.random.RandomState(seed)
+        return {
+            "dense": {"kernel": rng.randn(n, 8, 4).astype(np.float32),
+                      "bias": rng.randn(n, 4).astype(np.float32)},
+            "head": {"kernel": rng.randn(n, 4, 2).astype(np.float32)},
+        }
+
+    def test_average_matches_manual(self, mesh8):
+        tree = self._tree(8)
+        fn = build_grad_sync(mesh8, GradSyncConfig(op="average"))
+        out = fn(tree)
+        for path in [("dense", "kernel"), ("dense", "bias"),
+                     ("head", "kernel")]:
+            got = np.asarray(out[path[0]][path[1]])
+            want = tree[path[0]][path[1]].mean(0, keepdims=True)
+            np.testing.assert_allclose(got, np.repeat(want, 8, 0), rtol=1e-5)
+
+    def test_fusion_small_buckets_same_result(self, mesh8):
+        tree = self._tree(8, seed=1)
+        big = build_grad_sync(mesh8, GradSyncConfig(op="sum"))
+        tiny = build_grad_sync(
+            mesh8, GradSyncConfig(op="sum", fusion_threshold_bytes=16))
+        a, b = big(tree), tiny(tree)
+        jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-5), a, b)
+
+    def test_fp16_compression_reduces_in_fp16(self, mesh8):
+        tree = {"w": stacked(8, (64,), seed=2)}
+        fn = build_grad_sync(
+            mesh8, GradSyncConfig(op="average", compression="fp16"))
+        out = np.asarray(fn(tree)["w"])
+        expect = np.mean(tree["w"].astype(np.float16), axis=0,
+                         dtype=np.float32)
+        np.testing.assert_allclose(out[0], expect, atol=2e-3)
+        assert out.dtype == np.float32   # decompressed back
+
+    def test_adasum_tree(self, mesh8):
+        tree = {"w": stacked(8, (17,), seed=5)}
+        fn = build_grad_sync(mesh8, GradSyncConfig(op="adasum"))
+        out = np.asarray(fn(tree)["w"])
+        expect = adasum_reference(list(tree["w"]))
+        np.testing.assert_allclose(out[0], expect, rtol=1e-4)
+
+    def test_mixed_dtype_tree(self, mesh8):
+        tree = {"f32": stacked(8, (10,), seed=6),
+                "bf16": stacked(8, (12,), seed=7).astype(jnp.bfloat16)}
+        fn = build_grad_sync(mesh8, GradSyncConfig(op="sum"))
+        out = fn(tree)
+        np.testing.assert_allclose(np.asarray(out["f32"])[0],
+                                   tree["f32"].sum(0), rtol=1e-5)
+        assert out["bf16"].dtype == jnp.bfloat16
+
+
+class TestSharding:
+    def test_rules_place_params(self, mesh_dp_tp):
+        params = {"attn": {"kernel": np.zeros((8, 16), np.float32)},
+                  "bias": np.zeros((16,), np.float32)}
+        rules = ShardingRules([(r"attn.*kernel", P(None, "tp"))])
+        placed = shard_params(params, mesh_dp_tp, rules)
+        kspec = placed["attn"]["kernel"].sharding.spec
+        assert tuple(kspec) == (None, "tp")
+        bspec = placed["bias"].sharding.spec
+        assert tuple(bspec) == ()
+
+    def test_rule_rank_mismatch_falls_through(self, mesh_dp_tp):
+        rules = ShardingRules([(r".*", P(None, "tp"))])
+        params = {"bias": np.zeros((4,), np.float32)}
+        placed = shard_params(params, mesh_dp_tp, rules)
+        assert tuple(placed["bias"].sharding.spec) == ()
