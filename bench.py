@@ -1,0 +1,75 @@
+"""Headline benchmark: synthetic ResNet-50 training throughput.
+
+TPU-native analogue of the reference's synthetic benchmark
+(reference: examples/pytorch/pytorch_synthetic_benchmark.py): time the full
+compiled train step (forward + backward + fused gradient allreduce +
+SGD-momentum update) on random ImageNet-shaped data, bf16 compute.
+
+Baseline: the reference's published absolute number is 1656.82 images/sec
+on 16 P100 GPUs for ResNet-101 tf_cnn_benchmarks (docs/benchmarks.rst:32-43)
+= 103.55 images/sec/device. vs_baseline = our images/sec/chip / 103.55.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # reference, P100
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--warmup", type=int, default=3)
+    parser.add_argument("--iters", type=int, default=20)
+    args = parser.parse_args()
+
+    import jax
+    import optax
+
+    from horovod_tpu import models, training
+    from horovod_tpu.parallel import GradSyncConfig, MeshSpec, build_mesh
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    mesh = build_mesh(MeshSpec(dp=n_dev), devices=devices)
+
+    model = models.ResNet50(num_classes=1000)  # bf16 compute by default
+    trainer = training.Trainer(
+        model, optax.sgd(0.1, momentum=0.9), mesh,
+        sync=GradSyncConfig(axes=("dp",), op="average",
+                            compression="bf16"))
+
+    global_batch = args.batch_size * n_dev
+    batch = training.synthetic_image_batch(global_batch,
+                                           image_size=args.image_size)
+    state = trainer.init(jax.random.key(0), batch)
+
+    for _ in range(args.warmup):
+        state, metrics = trainer.step(state, batch)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        state, metrics = trainer.step(state, batch)
+    jax.block_until_ready(metrics)
+    elapsed = time.perf_counter() - t0
+
+    img_per_sec = global_batch * args.iters / elapsed
+    per_chip = img_per_sec / n_dev
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

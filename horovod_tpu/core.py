@@ -45,7 +45,7 @@ class Handle:
     (reference: torch/handle_manager.cc)."""
 
     __slots__ = ("_event", "status", "entries", "_pending", "_hid",
-                 "wrap_refs")
+                 "wrap_refs", "inplace_targets", "wants_recv_splits")
 
     def __init__(self, entries: list[TensorTableEntry]) -> None:
         self._event = threading.Event()
@@ -56,6 +56,10 @@ class Handle:
         # Original framework tensors (torch/jax/...) so async results can be
         # returned in the caller's framework, same as the sync API.
         self.wrap_refs: list[Any] = []
+        # torch binding extras: in-place copy-back targets, alltoall
+        # received-splits flag (see horovod_tpu/torch/mpi_ops.py).
+        self.inplace_targets: list[Any] = []
+        self.wants_recv_splits = False
 
     def done(self) -> bool:
         return self._event.is_set()

@@ -1,0 +1,15 @@
+"""Flagship model families for horovod_tpu benchmarks and examples.
+
+The reference frames its headline numbers around ImageNet CNNs
+(ResNet-50/101, Inception V3, VGG-16 — reference: docs/benchmarks.rst:13-43)
+trained data-parallel via its synthetic/ImageNet example scripts
+(reference: examples/pytorch/pytorch_synthetic_benchmark.py,
+examples/pytorch/pytorch_imagenet_resnet50.py). These are TPU-native
+re-implementations in flax, bf16-first, designed so every FLOP-heavy op
+lands on the MXU.
+"""
+from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet101,
+                     ResNet152)
+
+__all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
+           "ResNet152"]

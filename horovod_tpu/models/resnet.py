@@ -1,0 +1,134 @@
+"""ResNet v1.5 family, TPU-first.
+
+The reference benchmarks ResNet-50/101 through torchvision/tf-slim models
+(reference: examples/pytorch/pytorch_synthetic_benchmark.py:24-38,
+docs/benchmarks.rst:13-43); this is a from-scratch flax implementation
+shaped for the TPU MXU:
+
+- NHWC layout (XLA's native conv layout on TPU);
+- bf16 compute / fp32 params by default — convolutions and the final
+  matmul hit the MXU at full rate, batch-norm statistics accumulate in
+  fp32;
+- v1.5 stride placement (stride on the 3x3, not the 1x1) matching the
+  torchvision models the reference benchmarks;
+- optional cross-replica batch norm over a mesh axis (the reference ships
+  SyncBatchNorm as an opt-in, reference: torch/sync_batch_norm.py:40-218);
+  flax's BatchNorm takes `axis_name` and lowers to a psum on ICI.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block (ResNet-18/34)."""
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 reduce → 3x3 (strided: v1.5) → 1x1 expand (ResNet-50+)."""
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # Zero-init the last norm scale so each block starts as identity —
+        # standard large-batch ResNet trick (Goyal et al.), good for the
+        # large global batches data-parallel TPU training runs at.
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet v1.5 over NHWC inputs.
+
+    `axis_name` enables cross-replica (sync) batch norm over that mesh
+    axis; leave None for per-replica statistics (the reference's default
+    DP behavior).
+    """
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    act: Callable = nn.relu
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=self.param_dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=self.param_dtype,
+                       axis_name=self.axis_name if train else None)
+
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2),
+                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = self.act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(self.num_filters * 2 ** i, conv=conv,
+                                   norm=norm, act=self.act,
+                                   strides=strides)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        # Classifier in fp32: small matmul, and fp32 logits keep the
+        # softmax/cross-entropy numerically stable.
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=self.param_dtype, name="head")(
+                         x.astype(jnp.float32))
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3),
+                   block_cls=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=(3, 4, 23, 3),
+                    block_cls=BottleneckBlock)
+ResNet152 = partial(ResNet, stage_sizes=(3, 8, 36, 3),
+                    block_cls=BottleneckBlock)

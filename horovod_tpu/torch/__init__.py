@@ -1,0 +1,37 @@
+"""horovod_tpu.torch — the PyTorch binding.
+
+Drop-in surface of the reference's horovod.torch module
+(reference: horovod/torch/__init__.py): `hvd.init()`, collectives with
+sync/async/in-place variants, `DistributedOptimizer`, `Compression`,
+parameter/optimizer-state broadcast. Torch tensors stage through host
+memory into the TPU-native core.
+"""
+from .. import (Adasum, Average, Sum, barrier, broadcast_object, join,
+                HorovodInternalError, HostsUpdatedInterrupt)
+from ..core import (init, is_initialized, shutdown, rank, size, local_rank,
+                    local_size, cross_rank, cross_size, is_homogeneous,
+                    start_timeline, stop_timeline)
+from .compression import Compression
+from .functions import broadcast_optimizer_state, broadcast_parameters
+from .mpi_ops import (allgather, allgather_async, allreduce, allreduce_,
+                      allreduce_async, allreduce_async_, alltoall,
+                      alltoall_async, broadcast, broadcast_,
+                      broadcast_async, broadcast_async_, grouped_allreduce,
+                      grouped_allreduce_, grouped_allreduce_async,
+                      grouped_allreduce_async_, poll, synchronize)
+from .optimizer import DistributedOptimizer
+from .sync_batch_norm import SyncBatchNorm
+
+__all__ = [
+    "Adasum", "Average", "Sum", "Compression", "DistributedOptimizer",
+    "SyncBatchNorm", "allgather", "allgather_async", "allreduce",
+    "allreduce_", "allreduce_async", "allreduce_async_", "alltoall",
+    "alltoall_async", "barrier", "broadcast", "broadcast_",
+    "broadcast_async", "broadcast_async_", "broadcast_object",
+    "broadcast_optimizer_state", "broadcast_parameters", "cross_rank",
+    "cross_size", "grouped_allreduce", "grouped_allreduce_",
+    "grouped_allreduce_async", "grouped_allreduce_async_", "init",
+    "is_homogeneous", "is_initialized", "join", "local_rank", "local_size",
+    "poll", "rank", "shutdown", "size", "start_timeline", "stop_timeline",
+    "synchronize", "HorovodInternalError", "HostsUpdatedInterrupt",
+]

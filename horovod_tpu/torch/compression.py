@@ -1,0 +1,74 @@
+"""Gradient compression algorithms for the torch binding.
+
+Same contract as the reference (reference: horovod/torch/compression.py):
+`Compression.fp16.compress(tensor) -> (compressed, ctx)` casts floating
+tensors to fp16 before the wire, `decompress` casts back. The reduction
+itself then runs in the wire dtype, halving allreduce bytes.
+"""
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Pass-through (reference: compression.py NoneCompressor)."""
+
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast floating tensors to fp16 for the wire
+    (reference: compression.py:46-63)."""
+
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.type(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        if ctx is not None:
+            return tensor.type(ctx)
+        return tensor
+
+
+class BF16Compressor(Compressor):
+    """TPU-native wire dtype: bfloat16 keeps fp32's exponent range, so no
+    loss-scale plumbing is needed (no reference analogue — the reference
+    only ships fp16)."""
+
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.type(torch.bfloat16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        if ctx is not None:
+            return tensor.type(ctx)
+        return tensor
+
+
+class Compression:
+    """Optional gradient compression algorithm used during allreduce."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,89 @@
+"""Parameter/state broadcast helpers (reference: horovod/torch/functions.py).
+
+`broadcast_parameters` pushes rank 0's model weights to every rank before
+training; `broadcast_optimizer_state` does the same for optimizer state
+(tensors broadcast element-wise, non-tensor hyperparameters via pickled
+object broadcast); `broadcast_object` ships any picklable object.
+"""
+from __future__ import annotations
+
+import collections
+
+import torch
+
+from .. import broadcast_object  # core object bcast (pickle over wire)
+from .mpi_ops import broadcast_, rank, synchronize, broadcast_async_
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast model parameters from root to all ranks. Accepts
+    `model.state_dict()`, `model.named_parameters()`, or a list of
+    (name, tensor) (reference: functions.py broadcast_parameters)."""
+    if isinstance(params, dict):
+        params = sorted(params.items())
+    elif isinstance(params, collections.abc.Iterable):
+        params = list(params)
+        if params and not isinstance(params[0], tuple):
+            raise ValueError("invalid params: expected (name, tensor) pairs")
+    handles = []
+    for name, p in params:
+        if p is None or not isinstance(p, torch.Tensor):
+            continue
+        handles.append(broadcast_async_(p.data, root_rank,
+                                        name=f"bcast_param.{name}"))
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Broadcast rank 0's optimizer state
+    (reference: functions.py broadcast_optimizer_state: scalars are
+    wrapped as tensors; non-numeric state travels as pickled objects)."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+    state_dict = optimizer.state_dict()
+
+    # Non-tensor payload (param_groups + scalar state) and the root's
+    # tensor-entry key list travel as one pickled object; tensor entries
+    # then broadcast in the root's key order so every rank enqueues the
+    # identical op sequence.
+    meta = {
+        "param_groups": state_dict["param_groups"],
+        "scalars": {
+            (sid, k): v
+            for sid, s in state_dict["state"].items()
+            for k, v in s.items() if not isinstance(v, torch.Tensor)},
+        "tensor_keys": [
+            (sid, k)
+            for sid, s in sorted(state_dict["state"].items())
+            for k, v in sorted(s.items()) if isinstance(v, torch.Tensor)],
+    }
+    meta = broadcast_object(meta, root_rank, name="opt_state.meta")
+
+    if rank() != root_rank:
+        # Materialize state on ranks whose optimizers are still empty by
+        # stepping with zero gradients (same trick as the reference,
+        # functions.py:120-150) — but only when the root has state.
+        if meta["tensor_keys"] and not state_dict["state"]:
+            for group in optimizer.param_groups:
+                for p in group["params"]:
+                    if p.requires_grad and p.grad is None:
+                        p.grad = torch.zeros_like(p)
+            optimizer.step()
+            state_dict = optimizer.state_dict()
+        state_dict["param_groups"] = meta["param_groups"]
+        for (sid, k), v in meta["scalars"].items():
+            state_dict["state"].setdefault(sid, {})[k] = v
+
+    handles = []
+    for sid, k in meta["tensor_keys"]:
+        v = state_dict["state"].get(sid, {}).get(k)
+        if not isinstance(v, torch.Tensor):
+            raise ValueError(
+                f"optimizer state [{sid}][{k}] is a tensor on the root "
+                f"but {type(v).__name__} on rank {rank()}")
+        handles.append(broadcast_async_(v, root_rank,
+                                        name=f"opt_state.{sid}.{k}"))
+    for h in handles:
+        synchronize(h)
+    optimizer.load_state_dict(state_dict)

@@ -1,0 +1,167 @@
+"""Torch collective ops: the reference torch/mpi_ops.py surface over the
+horovod_tpu core.
+
+The reference binds these through pybind11 into the C++ enqueue API
+(reference: horovod/torch/mpi_ops_v2.cc:64-686, torch/mpi_ops.py:95-900);
+here CPU torch tensors stage zero-copy into the core via the buffer
+protocol, and completion flows back through Handle futures. In-place
+variants copy the reduced result back into the caller's tensor at
+synchronize time (the reference's callback does the same divide+copy,
+mpi_ops_v2.cc:81-87).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+import torch
+
+from .. import (Adasum, Average, Sum, barrier, join)  # noqa: F401
+from .. import (allgather_async as _allgather_async,
+                allreduce_async as _allreduce_async,
+                alltoall_async as _alltoall_async,
+                broadcast_async as _broadcast_async,
+                grouped_allreduce_async as _grouped_allreduce_async)
+from ..core import (Handle, init, is_initialized, shutdown, rank, size,
+                    local_rank, local_size, cross_rank, cross_size)
+
+
+def _check_cpu(tensor: torch.Tensor) -> torch.Tensor:
+    if tensor.device.type != "cpu":
+        raise ValueError(
+            "horovod_tpu.torch stages through host memory; move the "
+            "tensor to CPU (TPU-resident training should use the JAX "
+            "path, horovod_tpu.training.Trainer).")
+    return tensor.detach().contiguous()
+
+
+def _copy_out(target: torch.Tensor, out: np.ndarray) -> torch.Tensor:
+    src = torch.from_numpy(np.ascontiguousarray(out))
+    with torch.no_grad():
+        if target.shape != src.shape:
+            target.resize_(src.shape)
+        target.copy_(src.to(target.dtype))
+    return target
+
+
+# -- allreduce ---------------------------------------------------------------
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0) -> Handle:
+    return _allreduce_async(_check_cpu(tensor), average, name, op,
+                            prescale_factor, postscale_factor)
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0) -> torch.Tensor:
+    handle = allreduce_async(tensor, average, name, op, prescale_factor,
+                             postscale_factor)
+    return synchronize(handle)
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0) -> Handle:
+    handle = _allreduce_async(_check_cpu(tensor), average, name, op,
+                              prescale_factor, postscale_factor)
+    handle.inplace_targets = [tensor]
+    return handle
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, average, name, op,
+                                        prescale_factor, postscale_factor))
+
+
+def grouped_allreduce_async(tensors: Sequence[torch.Tensor], average=None,
+                            name=None, op=None, prescale_factor=1.0,
+                            postscale_factor=1.0) -> Handle:
+    return _grouped_allreduce_async([_check_cpu(t) for t in tensors],
+                                    average, name, op, prescale_factor,
+                                    postscale_factor)
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0):
+    return synchronize(grouped_allreduce_async(
+        tensors, average, name, op, prescale_factor, postscale_factor))
+
+
+def grouped_allreduce_async_(tensors, average=None, name=None, op=None,
+                             prescale_factor=1.0,
+                             postscale_factor=1.0) -> Handle:
+    handle = _grouped_allreduce_async([_check_cpu(t) for t in tensors],
+                                      average, name, op, prescale_factor,
+                                      postscale_factor)
+    handle.inplace_targets = list(tensors)
+    return handle
+
+
+def grouped_allreduce_(tensors, average=None, name=None, op=None,
+                       prescale_factor=1.0, postscale_factor=1.0):
+    return synchronize(grouped_allreduce_async_(
+        tensors, average, name, op, prescale_factor, postscale_factor))
+
+
+# -- allgather / broadcast / alltoall ---------------------------------------
+def allgather_async(tensor, name=None) -> Handle:
+    return _allgather_async(_check_cpu(tensor), name)
+
+
+def allgather(tensor, name=None) -> torch.Tensor:
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast_async(tensor, root_rank, name=None) -> Handle:
+    return _broadcast_async(_check_cpu(tensor), root_rank, name)
+
+
+def broadcast(tensor, root_rank, name=None) -> torch.Tensor:
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def broadcast_async_(tensor, root_rank, name=None) -> Handle:
+    handle = _broadcast_async(_check_cpu(tensor), root_rank, name)
+    handle.inplace_targets = [tensor]
+    return handle
+
+
+def broadcast_(tensor, root_rank, name=None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+def alltoall_async(tensor, splits=None, name=None) -> Handle:
+    if splits is not None and isinstance(splits, torch.Tensor):
+        splits = splits.numpy()
+    handle = _alltoall_async(_check_cpu(tensor), splits, name)
+    handle.wants_recv_splits = splits is not None
+    return handle
+
+
+def alltoall(tensor, splits=None, name=None):
+    return synchronize(alltoall_async(tensor, splits, name))
+
+
+# -- completion --------------------------------------------------------------
+def synchronize(handle: Handle):
+    """Wait for an async op; in-place variants copy back into the original
+    tensors (reference: torch/mpi_ops.py:862-884 synchronize)."""
+    status = handle.wait()
+    status.raise_if_error()
+    targets = getattr(handle, "inplace_targets", None)
+    if targets:
+        outs = [_copy_out(t, e.output)
+                for t, e in zip(targets, handle.entries)]
+        return outs[0] if len(outs) == 1 else outs
+    outs = []
+    for e in handle.entries:
+        out = torch.from_numpy(np.ascontiguousarray(e.output))
+        outs.append(out)
+    if getattr(handle, "wants_recv_splits", False):
+        recv = torch.from_numpy(np.asarray(handle.entries[0].received_splits,
+                                           dtype=np.int32))
+        return outs[0], recv
+    return outs[0] if len(outs) == 1 else outs
+
+
+def poll(handle: Handle) -> bool:
+    return handle.done()

@@ -1,0 +1,224 @@
+"""SPMD training loop construction: the TPU-native DistributedOptimizer.
+
+Reference shape: horovod's per-framework `DistributedOptimizer` wraps a
+local optimizer and splices a gradient allreduce between backward and step
+(reference: horovod/torch/optimizer.py:173-292,
+horovod/tensorflow/__init__.py:427-502). On TPU the idiomatic equivalent
+compiles the whole train step — forward, backward, fused gradient
+allreduce, optimizer update — into ONE XLA program over the device mesh:
+`shard_map` gives each device its batch shard, `sync_gradients` emits the
+fused AllReduce HLOs that ride ICI, and the optimizer update runs
+replicated. Zero host round-trips per step; negotiation cost is zero by
+SPMD construction (every rank runs the identical program — the invariant
+the reference's controller protocol exists to establish dynamically).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .parallel.collectives import allreduce
+from .parallel.grad_sync import GradSyncConfig, sync_gradients
+from .parallel.mesh import data_axes
+from .parallel.sharding import ShardingRules
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    """Replicated training state (params + optimizer + BN statistics)."""
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    batch_stats: Any
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
+                       label_smoothing: float = 0.0) -> jax.Array:
+    """Mean softmax cross entropy over integer labels (fp32)."""
+    num_classes = logits.shape[-1]
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+    if label_smoothing > 0.0:
+        onehot = (1.0 - label_smoothing) * onehot \
+            + label_smoothing / num_classes
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+class Trainer:
+    """Builds and owns a compiled SPMD train step.
+
+    >>> trainer = Trainer(model, optax.sgd(0.1), mesh)
+    >>> state = trainer.init(jax.random.key(0), sample_batch)
+    >>> state, metrics = trainer.step(state, batch)
+
+    `sync` controls the gradient data plane exactly like the reference's
+    env knobs control its fusion pipeline: fusion threshold bytes,
+    fp16/bf16 wire compression (reference: torch/compression.py:46-63),
+    and sum/average/adasum reduction.
+    """
+
+    def __init__(self, model: Any, tx: optax.GradientTransformation,
+                 mesh: Mesh, *,
+                 sync: GradSyncConfig | None = None,
+                 param_rules: ShardingRules | None = None,
+                 loss_fn: Callable = cross_entropy_loss,
+                 batch_spec: P | None = None) -> None:
+        self.model = model
+        self.tx = tx
+        self.mesh = mesh
+        axes = data_axes(mesh) or ("dp",)
+        self.sync = sync or GradSyncConfig(axes=axes, op="average")
+        self.param_rules = param_rules or ShardingRules()
+        self.loss_fn = loss_fn
+        self.batch_spec = batch_spec if batch_spec is not None else P(axes)
+        self._step_fn: Callable | None = None
+
+    # -- initialization ----------------------------------------------------
+    def init(self, rng: jax.Array, sample_batch: dict) -> TrainState:
+        images = sample_batch["image"]
+        variables = jax.eval_shape(
+            partial(self.model.init, train=False), rng,
+            jnp.zeros((1,) + images.shape[1:], images.dtype))
+        param_specs = self.param_rules.tree_specs(variables["params"])
+
+        def _init():
+            variables = self.model.init(
+                rng, jnp.zeros((1,) + images.shape[1:], images.dtype),
+                train=False)
+            params = variables["params"]
+            batch_stats = variables.get("batch_stats", {})
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=self.tx.init(params),
+                              batch_stats=batch_stats)
+
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s),
+            TrainState(step=P(),
+                       params=param_specs,
+                       opt_state=_opt_state_specs(self.tx, variables["params"],
+                                                  param_specs),
+                       batch_stats=jax.tree_util.tree_map(
+                           lambda _: P(),
+                           variables.get("batch_stats", {}))),
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.jit(_init, out_shardings=shardings)()
+
+    # -- the compiled step -------------------------------------------------
+    def _build(self, state: TrainState) -> Callable:
+        sync_cfg = self.sync
+        # Manual-map only the data axes; model axes (tp/sp/ep/pp) stay in
+        # GSPMD-automatic mode so the model code keeps global shapes and
+        # XLA inserts the tensor-parallel collectives from the arrays' own
+        # shardings (set at init).
+        manual_axes = frozenset(sync_cfg.axes)
+        state_specs = jax.tree_util.tree_map(lambda _: P(), state)
+
+        def local_step(state: TrainState, batch: dict):
+            def loss_of(params):
+                variables = {"params": params}
+                mutable = []
+                if state.batch_stats:
+                    variables["batch_stats"] = state.batch_stats
+                    mutable = ["batch_stats"]
+                out = self.model.apply(variables, batch["image"],
+                                       train=True, mutable=mutable)
+                logits, updated = out if mutable else (out, {})
+                loss = self.loss_fn(logits, batch["label"])
+                return loss, (logits, updated)
+
+            grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+            (loss, (logits, updated)), grads = grad_fn(state.params)
+
+            # The horovod moment: fused, compressed allreduce of the
+            # gradient pytree over the data axes.
+            grads = sync_gradients(grads, sync_cfg)
+
+            updates, opt_state = self.tx.update(grads, state.opt_state,
+                                                state.params)
+            params = optax.apply_updates(state.params, updates)
+
+            acc = jnp.mean(
+                (jnp.argmax(logits, -1) == batch["label"]).astype(
+                    jnp.float32))
+            metrics = {
+                "loss": allreduce(loss, sync_cfg.axes, "average"),
+                "accuracy": allreduce(acc, sync_cfg.axes, "average"),
+            }
+            new_stats = updated.get("batch_stats", state.batch_stats)
+            if state.batch_stats and getattr(self.model, "axis_name",
+                                             None) is None:
+                # Per-replica BN stats must stay replicated state: average
+                # them over the data axes (what the reference achieves by
+                # broadcasting rank 0's stats at checkpoints).
+                new_stats = jax.tree_util.tree_map(
+                    lambda x: allreduce(x, sync_cfg.axes, "average"),
+                    new_stats)
+            return dataclasses.replace(
+                state, step=state.step + 1, params=params,
+                opt_state=opt_state, batch_stats=new_stats), metrics
+
+        mapped = shard_map(
+            local_step, mesh=self.mesh,
+            in_specs=(state_specs, self.batch_spec),
+            out_specs=(state_specs, P()),
+            axis_names=manual_axes,
+            check_vma=False)
+        return jax.jit(mapped, donate_argnums=(0,))
+
+    def step(self, state: TrainState, batch: dict):
+        if self._step_fn is None:
+            self._step_fn = self._build(state)
+        return self._step_fn(state, batch)
+
+    # -- evaluation --------------------------------------------------------
+    def eval_step(self, state: TrainState, batch: dict):
+        @partial(jax.jit, static_argnums=())
+        def _eval(state, batch):
+            variables = {"params": state.params}
+            if state.batch_stats:
+                variables["batch_stats"] = state.batch_stats
+            logits = self.model.apply(variables, batch["image"],
+                                      train=False)
+            loss = self.loss_fn(logits, batch["label"])
+            acc = jnp.mean((jnp.argmax(logits, -1)
+                            == batch["label"]).astype(jnp.float32))
+            return {"loss": loss, "accuracy": acc}
+        return _eval(state, batch)
+
+
+def _opt_state_specs(tx: optax.GradientTransformation, params: Any,
+                     param_specs: Any) -> Any:
+    """Optimizer-state PartitionSpecs: moment-like leaves mirror the param
+    layout, scalars replicate."""
+    shapes = jax.eval_shape(tx.init, params)
+    flat_params, _ = jax.tree_util.tree_flatten(params)
+    by_shape = {}
+    specs_flat, _ = jax.tree_util.tree_flatten(param_specs)
+    for leaf, spec in zip(flat_params, specs_flat):
+        by_shape.setdefault(leaf.shape, spec)
+
+    def spec_for(leaf):
+        return by_shape.get(getattr(leaf, "shape", ()), P())
+
+    return jax.tree_util.tree_map(spec_for, shapes)
+
+
+def synthetic_image_batch(batch_size: int, image_size: int = 224,
+                          num_classes: int = 1000,
+                          seed: int = 0) -> dict:
+    """Random batch matching the reference's synthetic benchmark inputs
+    (reference: examples/pytorch/pytorch_synthetic_benchmark.py:55-58)."""
+    k1, k2 = jax.random.split(jax.random.key(seed))
+    return {
+        "image": jax.random.normal(
+            k1, (batch_size, image_size, image_size, 3), jnp.float32),
+        "label": jax.random.randint(k2, (batch_size,), 0, num_classes),
+    }

@@ -138,11 +138,134 @@ def battery_adasum(hvd, rank, size):
     np.testing.assert_allclose(out, expected, rtol=1e-10)
 
 
+def battery_torch(hvd, rank, size):
+    """DistributedOptimizer end-to-end: sharded-batch DP training matches a
+    single-process run on the full batch (the reference's core semantic,
+    torch/optimizer.py)."""
+    import torch
+    import horovod_tpu.torch as hvt
+
+    def make_model():
+        torch.manual_seed(7)
+        return torch.nn.Sequential(
+            torch.nn.Linear(8, 16), torch.nn.Tanh(), torch.nn.Linear(16, 4))
+
+    g = torch.Generator().manual_seed(42)
+    X = torch.randn(4 * size, 8, generator=g)
+    Y = torch.randn(4 * size, 4, generator=g)
+    xs, ys = X[rank * 4:(rank + 1) * 4], Y[rank * 4:(rank + 1) * 4]
+
+    def train(model, opt, inputs, targets, steps=3):
+        for _ in range(steps):
+            opt.zero_grad()
+            loss = ((model(inputs) - targets) ** 2).mean()
+            loss.backward()
+            opt.step()
+
+    # Distributed: per-rank shard + averaged gradients.
+    model = make_model()
+    opt = hvt.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    hvt.broadcast_parameters(model.state_dict(), root_rank=0)
+    train(model, opt, xs, ys)
+
+    # Serial baseline on the full batch (equal shards → full-batch grad ==
+    # average of shard grads).
+    serial = make_model()
+    train(serial, torch.optim.SGD(serial.parameters(), lr=0.1), X, Y)
+
+    for (name, p), (_, q) in zip(model.named_parameters(),
+                                 serial.named_parameters()):
+        np.testing.assert_allclose(p.detach().numpy(), q.detach().numpy(),
+                                   rtol=1e-4, atol=1e-6,
+                                   err_msg=f"param {name} diverged")
+
+    # Replicas must agree bit-for-bit with each other.
+    for name, p in model.named_parameters():
+        flat = p.detach().flatten().unsqueeze(0)
+        gathered = hvt.allgather(flat, name=f"agree.{name}")
+        for r in range(size):
+            np.testing.assert_array_equal(gathered[r].numpy(),
+                                          flat[0].numpy())
+
+    # Grouped + fp16-compressed + backward_passes_per_step variant runs.
+    model2 = make_model()
+    opt2 = hvt.DistributedOptimizer(
+        torch.optim.SGD(model2.parameters(), lr=0.05),
+        named_parameters=model2.named_parameters(),
+        compression=hvt.Compression.fp16, backward_passes_per_step=2,
+        groups=2)
+    hvt.broadcast_parameters(model2.state_dict(), root_rank=0)
+    for _ in range(2):  # 2 backward passes per step
+        loss = ((model2(xs) - ys) ** 2).mean()
+        loss.backward()
+    opt2.step()
+    opt2.zero_grad()
+
+    # Optimizer-state broadcast: momentum buffers diverge (per-rank data),
+    # then broadcast must reconcile them to rank 0's.
+    m3 = make_model()
+    opt3 = torch.optim.SGD(m3.parameters(), lr=0.1, momentum=0.9)
+    loss = ((m3(xs) - ys) ** 2).mean()
+    loss.backward()
+    opt3.step()
+    hvt.broadcast_optimizer_state(opt3, root_rank=0)
+    for sid, s in sorted(opt3.state_dict()["state"].items()):
+        for k, v in sorted(s.items()):
+            if isinstance(v, torch.Tensor):
+                flat = v.detach().flatten().unsqueeze(0)
+                gathered = hvt.allgather(flat, name=f"opt3.{sid}.{k}")
+                for r in range(size):
+                    np.testing.assert_array_equal(gathered[r].numpy(),
+                                                  gathered[0].numpy())
+
+
+def battery_syncbn(hvd, rank, size):
+    """SyncBatchNorm forward/backward == single-process BN on the full
+    batch (reference: torch/sync_batch_norm.py semantics)."""
+    import torch
+    import horovod_tpu.torch as hvt
+
+    g = torch.Generator().manual_seed(3)
+    X = torch.randn(2 * size, 5, 4, 4, generator=g)
+    xs = X[rank * 2:(rank + 1) * 2].clone().requires_grad_(True)
+
+    bn = hvt.SyncBatchNorm(5)
+    bn.train()
+    out = bn(xs)
+    loss = (out ** 2).mean() * size  # scale: serial mean is over size× rows
+    loss.backward()
+
+    ref_x = X.clone().requires_grad_(True)
+    ref_bn = torch.nn.BatchNorm2d(5)
+    ref_bn.train()
+    ref_out = ref_bn(ref_x)
+    ref_loss = (ref_out ** 2).mean()
+    ref_loss.backward()
+
+    np.testing.assert_allclose(
+        out.detach().numpy(),
+        ref_out[rank * 2:(rank + 1) * 2].detach().numpy(),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        xs.grad.numpy(), ref_x.grad[rank * 2:(rank + 1) * 2].numpy(),
+        rtol=1e-3, atol=1e-6)
+    np.testing.assert_allclose(bn.running_mean.numpy(),
+                               ref_bn.running_mean.numpy(),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(bn.running_var.numpy(),
+                               ref_bn.running_var.numpy(),
+                               rtol=1e-3, atol=1e-5)
+
+
 BATTERIES = {
     "collectives": battery_collectives,
     "errors": battery_errors,
     "join": battery_join,
     "adasum": battery_adasum,
+    "torch": battery_torch,
+    "syncbn": battery_syncbn,
 }
 
 

@@ -65,3 +65,12 @@ def test_join_uneven_data():
 @pytest.mark.parametrize("size", [2, 4])
 def test_adasum(size):
     _run_world(size, "adasum")
+
+
+@pytest.mark.parametrize("size", [2, 4])
+def test_torch_distributed_optimizer(size):
+    _run_world(size, "torch", timeout=120.0)
+
+
+def test_torch_sync_batch_norm():
+    _run_world(2, "syncbn", timeout=120.0)

@@ -1,0 +1,143 @@
+"""Launcher unit + integration tests.
+
+Mirrors the reference's test/single/test_run.py (arg parsing, host
+parsing, env construction) and test/integration/test_static_run.py
+(real end-to-end localhost launch).
+"""
+import io
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.runner import launch
+from horovod_tpu.runner.hosts import (HostInfo, get_host_assignments,
+                                      parse_hosts)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestHosts:
+    def test_parse_hosts(self):
+        hosts = parse_hosts("a:2,b:4")
+        assert hosts == [HostInfo("a", 2), HostInfo("b", 4)]
+        assert parse_hosts("justhost") == [HostInfo("justhost", 1)]
+
+    def test_assignments_homogeneous(self):
+        slots = get_host_assignments(parse_hosts("a:2,b:2"), 4)
+        assert [s.rank for s in slots] == [0, 1, 2, 3]
+        assert [s.hostname for s in slots] == ["a", "a", "b", "b"]
+        assert [s.local_rank for s in slots] == [0, 1, 0, 1]
+        assert [s.cross_rank for s in slots] == [0, 0, 1, 1]
+        assert all(s.size == 4 and s.local_size == 2 and s.cross_size == 2
+                   for s in slots)
+
+    def test_assignments_heterogeneous_cross_rank(self):
+        # host b's local_rank-1 slot is the only one → cross_size 1,
+        # cross_rank 0 (reference: hosts.py get_host_assignments).
+        slots = get_host_assignments(parse_hosts("a:1,b:2"), 3)
+        b1 = [s for s in slots if s.hostname == "b" and s.local_rank == 1][0]
+        assert b1.cross_size == 1 and b1.cross_rank == 0
+        a0 = [s for s in slots if s.hostname == "a"][0]
+        assert a0.cross_size == 2 and a0.cross_rank == 0
+
+    def test_max_np_truncates(self):
+        slots = get_host_assignments(parse_hosts("a:4"), 2, 2)
+        assert len(slots) == 2
+
+    def test_insufficient_slots(self):
+        with pytest.raises(ValueError, match="only 2 slots"):
+            get_host_assignments(parse_hosts("a:2"), 3)
+
+    def test_hostfile(self, tmp_path):
+        f = tmp_path / "hostfile"
+        f.write_text("# comment\nhost1 slots=2\nhost2 slots=4\n")
+        from horovod_tpu.runner.hosts import parse_host_files
+        assert parse_host_files(str(f)) == "host1:2,host2:4"
+
+    def test_slot_env(self):
+        slot = get_host_assignments(parse_hosts("h:2"), 2)[1]
+        env = slot.to_env()
+        assert env["HOROVOD_RANK"] == "1"
+        assert env["HOROVOD_LOCAL_RANK"] == "1"
+        assert env["HOROVOD_SIZE"] == "2"
+
+
+class TestArgs:
+    def test_tuning_flags_to_env(self):
+        args = launch.parse_args(
+            ["-np", "2", "--fusion-threshold-mb", "32",
+             "--cycle-time-ms", "5", "--timeline-filename", "/tmp/t.json",
+             "--no-stall-check", "--log-level", "debug", "ls"])
+        env = launch.args_to_env(args)
+        assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+        assert env["HOROVOD_CYCLE_TIME"] == "5.0"
+        assert env["HOROVOD_TIMELINE"] == "/tmp/t.json"
+        assert env["HOROVOD_STALL_CHECK_DISABLE"] == "1"
+        assert env["HOROVOD_LOG_LEVEL"] == "debug"
+
+    def test_config_file(self, tmp_path):
+        cfg = tmp_path / "cfg.yaml"
+        cfg.write_text(textwrap.dedent("""\
+            fusion-threshold-mb: 16
+            start-timeout: 60
+            log-level: info
+        """))
+        args = launch.parse_args(
+            ["-np", "2", "--config-file", str(cfg),
+             "--log-level", "error", "ls"])
+        assert args.fusion_threshold_mb == 16
+        assert args.start_timeout == 60       # default overridden by file
+        assert args.log_level == "error"      # CLI wins over file
+
+    def test_check_build_output(self):
+        out = io.StringIO()
+        launch.check_build(out)
+        text = out.getvalue()
+        assert "[X] PyTorch" in text
+        assert "[X] JAX" in text
+        assert "[X] XLA/TPU data plane" in text
+        assert "[ ] NCCL" in text
+
+
+class TestStaticRun:
+    def test_end_to_end_localhost(self, tmp_path):
+        """Real launch: 2 local workers allreduce through the CLI-started
+        rendezvous (reference: test/integration/test_static_run.py)."""
+        script = tmp_path / "train.py"
+        script.write_text(textwrap.dedent("""\
+            import numpy as np
+            import horovod_tpu as hvd
+            hvd.init()
+            out = hvd.allreduce(np.ones(4, dtype=np.float32), op=hvd.Sum,
+                                name="e2e")
+            assert out.tolist() == [hvd.size()] * 4, out
+            print(f"rank {hvd.rank()} OK")
+            hvd.shutdown()
+        """))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        for k in list(env):
+            if k.startswith("HOROVOD_"):
+                del env[k]
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner.launch",
+             "-np", "2", sys.executable, str(script)],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "rank 0 OK" in proc.stdout
+        assert "rank 1 OK" in proc.stdout
+
+    def test_failure_propagates(self, tmp_path):
+        script = tmp_path / "fail.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner.launch",
+             "-np", "2", sys.executable, str(script)],
+            env=env, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1
+        assert "ranks failed" in proc.stderr
