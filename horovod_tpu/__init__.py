@@ -249,6 +249,24 @@ def broadcast_object(obj: Any, root_rank: int = 0,
     return pickle.loads(payload.tobytes()) if rank() != root_rank else obj
 
 
+def allgather_object(obj: Any, name: str | None = None) -> list:
+    """Gather one arbitrary picklable object per rank; every rank receives
+    the full list ordered by rank (reference: torch/mpi_ops.py
+    allgather_object)."""
+    import pickle
+    name = _auto_name("allgather_object", name)
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    sizes = allgather(np.array([payload.size], dtype=np.int64),
+                      name=f"{name}.size")
+    data = allgather(payload, name=f"{name}.data")
+    data = np.asarray(data)
+    objs, offset = [], 0
+    for sz in np.asarray(sizes).reshape(-1):
+        objs.append(pickle.loads(data[offset:offset + int(sz)].tobytes()))
+        offset += int(sz)
+    return objs
+
+
 # Build-variant introspection (reference: horovod/common/util.py:137-186)
 def xla_built() -> bool:
     try:

@@ -1,0 +1,115 @@
+"""Elastic launch: wires the rendezvous server, the RPC service and the
+elastic driver to per-slot worker processes.
+
+Reference: horovod/runner/gloo_run.py:287-323 launch_gloo_elastic.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from collections import OrderedDict
+
+from ..common.logging import logger
+from ..runner.hosts import SlotInfo, parse_hosts
+from ..runner.network import RendezvousServer
+from ..runner import safe_shell_exec
+from .discovery import FixedHostDiscovery, HostDiscoveryScript
+from .driver import ElasticDriver
+from .rpc import SECRET_ENV, RpcServer, make_secret
+from .worker import DRIVER_ADDR_ENV, DRIVER_PORT_ENV
+
+LOCAL_HOSTS = {"localhost", "127.0.0.1"}
+
+
+def _make_discovery(args):
+    if getattr(args, "host_discovery_script", None):
+        return HostDiscoveryScript(args.host_discovery_script,
+                                   default_slots=getattr(args, "slots", None)
+                                   or 1)
+    hosts = getattr(args, "hosts", None)
+    if not hosts:
+        raise ValueError(
+            "elastic run requires --host-discovery-script or -H/--hosts")
+    fixed = OrderedDict((h.hostname, h.slots) for h in parse_hosts(hosts))
+    return FixedHostDiscovery(fixed)
+
+
+def _driver_address(discovery) -> str:
+    hosts = discovery.find_available_hosts_and_slots()
+    if all(h in LOCAL_HOSTS for h in hosts):
+        return "127.0.0.1"
+    import socket
+    return socket.getfqdn()
+
+
+def launch_elastic(args, command: list[str]) -> int:
+    discovery = _make_discovery(args)
+    secret = make_secret()
+
+    min_np = args.min_np or args.num_proc or 1
+    max_np = args.max_np
+    driver = ElasticDriver(
+        discovery, min_np=min_np, max_np=max_np,
+        timeout=args.elastic_timeout if getattr(args, "elastic_timeout",
+                                                None) is not None else 600.0,
+        reset_limit=getattr(args, "reset_limit", None), secret=secret,
+        verbose=bool(getattr(args, "verbose", False)))
+
+    rendezvous = RendezvousServer()
+    rendezvous.start()
+    rpc = RpcServer(driver, secret)
+    addr = _driver_address(discovery)
+
+    from ..runner.launch import args_to_env
+    base_env = dict(os.environ)
+    base_env.update(args_to_env(args))
+    base_env.update({
+        "HOROVOD_CONTROLLER": "tcp",
+        "HOROVOD_GLOO_TIMEOUT_SECONDS":
+            str(getattr(args, "start_timeout", None) or 30),
+    })
+
+    def create_worker(slot: SlotInfo) -> int:
+        env = dict(base_env)
+        env.update({
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_HOSTNAME": slot.hostname,
+            "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
+            "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rendezvous.port),
+            DRIVER_ADDR_ENV: addr,
+            DRIVER_PORT_ENV: str(rpc.port),
+            SECRET_ENV: secret,
+        })
+        if slot.hostname in LOCAL_HOSTS:
+            full_command = list(command)
+        else:
+            exports = " ".join(f"{k}={v}" for k, v in env.items()
+                               if k.startswith("HOROVOD_"))
+            remote = " ".join(command)
+            full_command = ["ssh", "-o", "StrictHostKeyChecking=no",
+                            slot.hostname, f"env {exports} {remote}"]
+        return safe_shell_exec.execute(
+            full_command, env=env,
+            index=slot.rank if slot.hostname in LOCAL_HOSTS else None)
+
+    try:
+        driver.start(args.num_proc or min_np, create_worker)
+        driver.join()
+    except (TimeoutError, ValueError) as exc:
+        sys.stderr.write(f"horovodrun-tpu elastic: {exc}\n")
+        return 1
+    finally:
+        driver.shutdown()
+        rpc.close()
+        rendezvous.stop()
+
+    if driver.reset_limit_exceeded:
+        sys.stderr.write("horovodrun-tpu elastic: reset limit exceeded\n")
+        return 1
+    results = driver.get_results()
+    failures = [name for name, (code, _) in results.items() if code != 0]
+    if failures and len(failures) == len(results):
+        logger.error("all workers failed: %s", ", ".join(failures))
+        return 1
+    return 0

@@ -1,0 +1,207 @@
+"""Elastic training state: commit / restore / sync.
+
+Reference: horovod/common/elastic.py — ``State`` checkpoints to host memory
+on ``commit()``, restores after a collective failure, and broadcast-syncs
+from the new rank 0 after every re-rendezvous.  ``ObjectState`` handles plain
+Python attributes; :class:`ArrayState` handles pytrees of jax/numpy arrays
+(the idiomatic JAX analogue of the reference's per-framework tensor states).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable
+
+import numpy as np
+
+from ..common.exceptions import HostsUpdatedInterrupt
+from .discovery import HostUpdateResult
+from .worker import notification_manager
+
+
+class State:
+    """Base elastic state with commit/restore/sync hooks."""
+
+    def __init__(self) -> None:
+        self._reset_callbacks: list[Callable[[], None]] = []
+        notification_manager.register_listener(self)
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        """Callbacks run after every re-rendezvous (world size changed) —
+        e.g. rescale the learning rate or repartition the dataset."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self.reset()
+        for callback in self._reset_callbacks:
+            callback()
+
+    def on_hosts_updated(self, timestamp: int, update_res: int) -> None:
+        # Notification thread context: nothing to do eagerly; the training
+        # thread observes the pending update in check_host_updates().
+        pass
+
+    def commit(self) -> None:
+        """Checkpoint to host memory and surface any pending host updates."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        """Raise :class:`HostsUpdatedInterrupt` when membership changed.
+
+        All ranks must agree to interrupt at the same point, so the locally
+        pending notification timestamp is max-allreduced: if any rank heard
+        from the driver, every rank interrupts together (reference:
+        common/elastic.py:73-96).
+        """
+        from .. import allreduce  # late import: avoid cycle at package init
+
+        if not notification_manager.has_driver:
+            return
+        pending_ts, pending_res = notification_manager.pending_update()
+        # Sum-allreduce [heard?, added?, removed?]: if ANY rank heard from
+        # the driver, every rank interrupts at this same point.
+        local = np.array(
+            [1 if pending_ts > 0 else 0,
+             1 if pending_res & HostUpdateResult.ADDED else 0,
+             1 if pending_res & HostUpdateResult.REMOVED else 0], np.int64)
+        agreed = allreduce(local, average=False,
+                           name="__elastic_host_updates__")
+        if int(agreed[0]) <= 0:
+            return
+        # Only acknowledge what THIS rank actually heard; ranks that had not
+        # yet received the notification clear it at the next rendezvous
+        # (the driver stamps assignments with its notification clock).
+        notification_manager.acknowledge(pending_ts)
+        # Pure additions can keep the current state (no data was lost);
+        # removals force a sync from the survivors' committed state.
+        skip_sync = int(agreed[1]) > 0 and int(agreed[2]) == 0
+        raise HostsUpdatedInterrupt(skip_sync)
+
+    # -- to be provided by subclasses --------------------------------------
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class ObjectState(State):
+    """State holding plain picklable attributes
+    (reference: common/elastic.py ObjectState)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        self._saved_state = kwargs
+        for attr, value in kwargs.items():
+            setattr(self, attr, value)
+        super().__init__()
+
+    def save(self) -> None:
+        new_state = {}
+        for attr in self._saved_state:
+            new_state[attr] = copy.deepcopy(getattr(self, attr))
+        self._saved_state = new_state
+
+    def restore(self) -> None:
+        for attr, value in self._saved_state.items():
+            setattr(self, attr, copy.deepcopy(value))
+
+    def sync(self) -> None:
+        if self._saved_state:
+            from .. import broadcast_object
+            synced = broadcast_object(self._saved_state, root_rank=0,
+                                      name="__elastic_object_state__")
+            self._saved_state = synced
+            self.restore()
+
+
+class ArrayState(State):
+    """State over pytrees of jax / numpy arrays (params, optimizer state,
+    batch stats) plus plain-object extras.
+
+    ``save()`` copies every leaf to host numpy; ``sync()`` broadcasts the
+    committed leaves from rank 0 leaf-by-leaf (fused by the runtime's tensor
+    fusion) so a joining worker adopts the survivors' state.
+    """
+
+    def __init__(self, trees: dict[str, Any] | None = None,
+                 **objects: Any) -> None:
+        self._trees: dict[str, Any] = dict(trees or {})
+        self._objects = ObjectProxy(objects)
+        self._saved_trees: dict[str, list[np.ndarray]] = {}
+        self._treedefs: dict[str, Any] = {}
+        for attr, value in objects.items():
+            setattr(self, attr, value)
+        self._object_names = list(objects)
+        super().__init__()
+
+    def tree(self, name: str) -> Any:
+        return self._trees[name]
+
+    def set_tree(self, name: str, value: Any) -> None:
+        self._trees[name] = value
+
+    def _flatten(self, value):
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(value)
+        return leaves, treedef
+
+    def save(self) -> None:
+        import jax
+        for name, value in self._trees.items():
+            leaves, treedef = self._flatten(value)
+            self._saved_trees[name] = [np.array(leaf) for leaf in leaves]
+            self._treedefs[name] = treedef
+        self._objects.data = {attr: copy.deepcopy(getattr(self, attr))
+                              for attr in self._object_names}
+        del jax
+
+    def restore(self) -> None:
+        import jax
+        for name, host_leaves in self._saved_trees.items():
+            treedef = self._treedefs[name]
+            self._trees[name] = jax.tree_util.tree_unflatten(
+                treedef, [jax.numpy.asarray(leaf) for leaf in host_leaves])
+        for attr, value in self._objects.data.items():
+            setattr(self, attr, copy.deepcopy(value))
+
+    def sync(self) -> None:
+        import jax
+        from .. import broadcast, broadcast_object
+        if not self._saved_trees:
+            self.save()
+        # Structure (treedefs, shapes, plain objects) first, then bulk leaves.
+        meta = broadcast_object(
+            {"objects": self._objects.data,
+             "shapes": {n: [(leaf.shape, str(leaf.dtype))
+                            for leaf in leaves]
+                        for n, leaves in self._saved_trees.items()}},
+            root_rank=0, name="__elastic_array_meta__")
+        self._objects.data = meta["objects"]
+        for name, shape_dtypes in meta["shapes"].items():
+            local = self._saved_trees.get(name, [])
+            synced = []
+            for i, (shape, dtype) in enumerate(shape_dtypes):
+                if i < len(local) and tuple(local[i].shape) == tuple(shape) \
+                        and str(local[i].dtype) == dtype:
+                    leaf = local[i]
+                else:
+                    leaf = np.zeros(shape, dtype)
+                synced.append(np.asarray(
+                    broadcast(leaf, root_rank=0,
+                              name=f"__elastic_leaf__.{name}.{i}")))
+            self._saved_trees[name] = synced
+        self.restore()
+        del jax
+
+
+class ObjectProxy:
+    """Mutable holder so saved plain objects survive deepcopy cycles."""
+
+    def __init__(self, data: dict) -> None:
+        self.data = data

@@ -59,6 +59,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                          help="Script printing 'host:slots' lines; polled "
                          "for membership changes.")
     elastic.add_argument("--reset-limit", type=int, default=None)
+    elastic.add_argument("--slots", type=int, default=None,
+                         help="Default slots per host for discovery-script "
+                         "lines without an explicit :slots suffix.")
+    elastic.add_argument("--elastic-timeout", type=float, default=600.0,
+                         help="Seconds to wait for min-np slots / a new "
+                         "rendezvous round.")
 
     tuning = parser.add_argument_group("tuning")
     tuning.add_argument("--fusion-threshold-mb", type=int, default=None)

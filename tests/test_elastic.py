@@ -1,0 +1,368 @@
+"""Elastic subsystem unit tests.
+
+Modeled on the reference's test/single/test_elastic_driver.py strategy
+(SURVEY §4): fake discovery sources + mock workers drive the ElasticDriver
+state machine fully in-process, no cluster required.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+from horovod_tpu.elastic.discovery import (FixedHostDiscovery, HostDiscovery,
+                                           HostManager, HostUpdateResult)
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.elastic.registration import (FAILURE, READY, SUCCESS,
+                                              WorkerStateRegistry)
+from horovod_tpu.elastic.sampler import ElasticSampler
+
+
+class SequenceDiscovery(HostDiscovery):
+    """Replays a schedule of host dicts; sticks on the last one."""
+
+    def __init__(self, *rounds):
+        self._rounds = list(rounds)
+        self.calls = 0
+
+    def find_available_hosts_and_slots(self):
+        idx = min(self.calls, len(self._rounds) - 1)
+        self.calls += 1
+        return OrderedDict(self._rounds[idx])
+
+
+# ---------------------------------------------------------------------------
+# HostManager / discovery
+# ---------------------------------------------------------------------------
+class TestHostManager:
+    def test_update_added_removed(self):
+        disc = SequenceDiscovery({"a": 2}, {"a": 2, "b": 2}, {"b": 2})
+        mgr = HostManager(disc)
+        assert mgr.update_available_hosts() == HostUpdateResult.ADDED
+        assert mgr.current_hosts == {"a": 2}
+        assert mgr.update_available_hosts() == HostUpdateResult.ADDED
+        assert set(mgr.current_hosts) == {"a", "b"}
+        assert mgr.update_available_hosts() == HostUpdateResult.REMOVED
+        assert set(mgr.current_hosts) == {"b"}
+
+    def test_no_update(self):
+        mgr = HostManager(FixedHostDiscovery(OrderedDict(a=2)))
+        assert mgr.update_available_hosts() == HostUpdateResult.ADDED
+        assert mgr.update_available_hosts() == HostUpdateResult.NO_UPDATE
+
+    def test_blacklist_excludes_host(self):
+        mgr = HostManager(FixedHostDiscovery(OrderedDict(a=2, b=2)))
+        mgr.update_available_hosts()
+        mgr.blacklist("a")
+        assert mgr.is_blacklisted("a")
+        assert set(mgr.current_hosts) == {"b"}
+        # Re-discovery never resurrects a blacklisted host.
+        mgr.update_available_hosts()
+        assert set(mgr.current_hosts) == {"b"}
+
+    def test_slot_count_change_is_update(self):
+        disc = SequenceDiscovery({"a": 2}, {"a": 4})
+        mgr = HostManager(disc)
+        mgr.update_available_hosts()
+        assert mgr.update_available_hosts() == HostUpdateResult.MIXED
+
+
+# ---------------------------------------------------------------------------
+# WorkerStateRegistry
+# ---------------------------------------------------------------------------
+class FakeDriver:
+    def __init__(self):
+        self.stopped = False
+        self.resumed = 0
+        self.limit_exceeded = False
+
+    def finished(self):
+        return self.stopped
+
+    def stop(self):
+        self.stopped = True
+
+    def resume(self):
+        self.resumed += 1
+
+    def set_reset_limit_exceeded(self):
+        self.limit_exceeded = True
+
+
+class TestWorkerStateRegistry:
+    def _registry(self, size, reset_limit=None):
+        driver = FakeDriver()
+        mgr = HostManager(FixedHostDiscovery(OrderedDict(a=size)))
+        mgr.update_available_hosts()
+        reg = WorkerStateRegistry(driver, mgr, reset_limit=reset_limit)
+        reg.reset(size)
+        return driver, mgr, reg
+
+    def test_all_success_stops_driver(self):
+        driver, _, reg = self._registry(2)
+        reg.record_success("a", 0)
+        assert not driver.stopped
+        reg.record_success("a", 1)
+        assert driver.stopped
+        assert driver.resumed == 0
+
+    def test_failure_blacklists_and_resumes(self):
+        driver, mgr, reg = self._registry(2)
+        reg.record_failure("a", 0)
+        reg.record_ready("a", 1)
+        assert driver.resumed == 1
+        assert mgr.is_blacklisted("a")
+
+    def test_all_ready_resumes(self):
+        driver, _, reg = self._registry(2)
+        reg.record_ready("a", 0)
+        reg.record_ready("a", 1)
+        assert driver.resumed == 1
+        assert not driver.stopped
+
+    def test_failure_overrides_ready(self):
+        driver, _, reg = self._registry(2)
+        reg.record_ready("a", 0)
+        assert reg.count(READY) == 1
+        reg.record_failure("a", 0)
+        assert reg.count(READY) == 0
+        assert reg.count(FAILURE) == 1
+        # READY never downgrades a terminal state.
+        reg.record_ready("a", 0)
+        assert reg.count(FAILURE) == 1
+
+    def test_reset_limit(self):
+        driver, _, reg = self._registry(2, reset_limit=1)
+        reg.record_failure("a", 0)
+        reg.record_ready("a", 1)
+        assert driver.limit_exceeded
+        assert driver.stopped
+
+
+# ---------------------------------------------------------------------------
+# ElasticDriver state machine (mock workers)
+# ---------------------------------------------------------------------------
+def _idle_worker_fn(stop_events):
+    """create_worker_fn whose processes live until their stop event fires."""
+    def create(slot):
+        ev = threading.Event()
+        stop_events[(slot.hostname, slot.local_rank)] = ev
+        ev.wait(timeout=30)
+        return 0
+    return create
+
+
+class TestElasticDriver:
+    def test_initial_round_assignments(self):
+        disc = FixedHostDiscovery(OrderedDict(a=2, b=2))
+        driver = ElasticDriver(disc, min_np=4, timeout=5)
+        stops = {}
+        driver.start(4, _idle_worker_fn(stops))
+        try:
+            got = {}
+            for host, slot in [("a", 0), ("a", 1), ("b", 0), ("b", 1)]:
+                got[(host, slot)] = driver.get_assignment(host, slot, 0)
+            ranks = sorted(a["rank"] for a in got.values())
+            assert ranks == [0, 1, 2, 3]
+            assert all(a["size"] == 4 for a in got.values())
+            assert all(a["epoch"] == 1 for a in got.values())
+            assert got[("a", 0)]["cross_size"] == 2
+            assert got[("a", 0)]["local_size"] == 2
+        finally:
+            driver.stop()
+            for ev in stops.values():
+                ev.set()
+
+    def test_host_added_new_round_preserves_ranks(self):
+        disc = SequenceDiscovery({"a": 2}, {"a": 2, "b": 2})
+        driver = ElasticDriver(disc, min_np=2, max_np=4, timeout=5)
+        stops = {}
+        driver.start(2, _idle_worker_fn(stops))
+        try:
+            first = {(h, s): driver.get_assignment(h, s, 0)
+                     for h, s in [("a", 0), ("a", 1)]}
+            assert first[("a", 0)]["rank"] == 0
+            assert first[("a", 1)]["rank"] == 1
+
+            # Discovery thread picks up host b; existing workers request the
+            # next epoch (their READY records), and the new round forms once
+            # both report.
+            results = {}
+
+            def request(h, s):
+                results[(h, s)] = driver.get_assignment(h, s, 2)
+
+            threads = [threading.Thread(target=request, args=hs)
+                       for hs in [("a", 0), ("a", 1)]]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=15)
+            assert all(not t.is_alive() for t in threads)
+            assert results[("a", 0)]["rank"] == 0
+            assert results[("a", 0)]["size"] == 4
+            # New host's slots were spawned and can fetch the same epoch.
+            b0 = driver.get_assignment("b", 0, 2)
+            assert b0["size"] == 4
+            assert b0["epoch"] == results[("a", 0)]["epoch"]
+        finally:
+            driver.stop()
+            for ev in stops.values():
+                ev.set()
+
+    def test_worker_failure_blacklists_host_and_reforms(self):
+        disc = FixedHostDiscovery(OrderedDict(a=2, b=2))
+        driver = ElasticDriver(disc, min_np=2, max_np=4, timeout=5)
+        stops = {}
+        fail_b = threading.Event()
+
+        def create(slot):
+            if slot.hostname == "b":
+                fail_b.wait(timeout=30)
+                return 1          # both b workers die
+            ev = threading.Event()
+            stops[(slot.hostname, slot.local_rank)] = ev
+            ev.wait(timeout=30)
+            return 0
+
+        driver.start(4, create)
+        try:
+            assert driver.get_assignment("a", 0, 0)["size"] == 4
+            fail_b.set()
+            # Survivors request the next epoch; with b blacklisted the new
+            # round has only a's two slots.
+            results = {}
+
+            def request(h, s):
+                results[(h, s)] = driver.get_assignment(h, s, 2)
+
+            threads = [threading.Thread(target=request, args=hs)
+                       for hs in [("a", 0), ("a", 1)]]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=15)
+            assert all(not t.is_alive() for t in threads)
+            assert results[("a", 0)]["size"] == 2
+            assert results[("a", 0)]["rank"] == 0
+            assert results[("a", 1)]["rank"] == 1
+        finally:
+            driver.stop()
+            for ev in stops.values():
+                ev.set()
+
+    def test_dropped_slot_gets_none(self):
+        disc = SequenceDiscovery({"a": 1, "b": 1}, {"a": 1})
+        driver = ElasticDriver(disc, min_np=1, max_np=2, timeout=5)
+        stops = {}
+        driver.start(2, _idle_worker_fn(stops))
+        try:
+            assert driver.get_assignment("b", 0, 0)["size"] == 2
+            results = {}
+
+            def request(h, s):
+                results[(h, s)] = driver.get_assignment(h, s, 2)
+
+            threads = [threading.Thread(target=request, args=hs)
+                       for hs in [("a", 0), ("b", 0)]]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=15)
+            assert all(not t.is_alive() for t in threads)
+            assert results[("a", 0)]["size"] == 1
+            assert results[("b", 0)] is None   # b left the world
+        finally:
+            driver.stop()
+            for ev in stops.values():
+                ev.set()
+
+    def test_all_success_finishes_job(self):
+        disc = FixedHostDiscovery(OrderedDict(a=2))
+        driver = ElasticDriver(disc, min_np=2, timeout=5)
+
+        def create(slot):
+            driver.get_assignment(slot.hostname, slot.local_rank, 0)
+            return 0
+
+        driver.start(2, create)
+        assert driver.join(timeout=10)
+        assert driver.finished()
+        results = driver.get_results()
+        assert all(code == 0 for code, _ in results.values())
+
+
+# ---------------------------------------------------------------------------
+# ElasticSampler
+# ---------------------------------------------------------------------------
+class TestElasticSampler:
+    def test_partitions_evenly(self):
+        data = list(range(10))
+        s = ElasticSampler(data, shuffle=False)
+        assert sorted(s.indices) == data
+
+    def test_reshard_after_processing(self):
+        data = list(range(8))
+        s = ElasticSampler(data, shuffle=False)
+        s.record_indices([0, 1, 2])
+        s.reset()
+        assert set(s.indices) == {3, 4, 5, 6, 7}
+        # Next epoch restores the full dataset.
+        s.set_epoch(1)
+        assert sorted(set(s.indices)) == data
+
+    def test_state_roundtrip(self):
+        s = ElasticSampler(list(range(6)), shuffle=True, seed=3)
+        s.record_indices([1, 5])
+        s.reset()
+        state = s.state_dict()
+        s2 = ElasticSampler(list(range(6)), shuffle=True, seed=3)
+        s2.load_state_dict(state)
+        assert set(s2.indices) == set(s.indices)
+        assert s2.processed_indices == {1, 5}
+
+
+# ---------------------------------------------------------------------------
+# State commit/restore (single process, no driver)
+# ---------------------------------------------------------------------------
+class TestStates:
+    def test_object_state_commit_restore(self):
+        import horovod_tpu as hvd
+        from horovod_tpu.elastic import ObjectState
+        hvd.init()
+        try:
+            state = ObjectState(epoch=0, batch=0)
+            state.epoch = 5
+            state.commit()
+            state.epoch = 9
+            state.restore()
+            assert state.epoch == 5
+            state.sync()     # size-1 world: round-trips through broadcast
+            assert state.epoch == 5
+        finally:
+            hvd.shutdown()
+
+    def test_array_state_commit_restore_sync(self):
+        import jax.numpy as jnp
+        import horovod_tpu as hvd
+        from horovod_tpu.elastic import ArrayState
+        hvd.init()
+        try:
+            params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+            state = ArrayState(trees={"params": params}, epoch=1)
+            state.commit()
+            state.set_tree("params",
+                           {"w": jnp.full((4, 4), 7.0),
+                            "b": jnp.full((4,), 7.0)})
+            state.restore()
+            np.testing.assert_allclose(
+                np.asarray(state.tree("params")["w"]), np.ones((4, 4)))
+            state.sync()
+            np.testing.assert_allclose(
+                np.asarray(state.tree("params")["b"]), np.zeros((4,)))
+            assert state.epoch == 1
+        finally:
+            hvd.shutdown()
