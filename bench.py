@@ -23,11 +23,18 @@ BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # reference, P100
 
 def main() -> int:
     parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet50",
+                        choices=["resnet50", "gpt"],
+                        help="resnet50: headline images/sec benchmark; "
+                        "gpt: transformer tokens/sec (flash attention)")
     parser.add_argument("--batch-size", type=int, default=128)
     parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--seq-len", type=int, default=2048)
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--iters", type=int, default=20)
     args = parser.parse_args()
+    if args.model == "gpt":
+        return bench_gpt(args)
 
     import jax
     import optax
@@ -40,17 +47,20 @@ def main() -> int:
     mesh = build_mesh(MeshSpec(dp=n_dev), devices=devices)
 
     model = models.ResNet50(num_classes=1000)  # bf16 compute by default
+    # bf16 wire on TPU; fp16 elsewhere (XLA CPU crashes promoting bf16
+    # all-reduces — same guard as __graft_entry__.dryrun_multichip).
+    wire = "bf16" if jax.default_backend() == "tpu" else "fp16"
     trainer = training.Trainer(
         model, optax.sgd(0.1, momentum=0.9), mesh,
         sync=GradSyncConfig(axes=("dp",), op="average",
-                            compression="bf16"))
+                            compression=wire))
 
     global_batch = args.batch_size * n_dev
     batch = training.synthetic_image_batch(global_batch,
                                            image_size=args.image_size)
     state = trainer.init(jax.random.key(0), batch)
 
-    for _ in range(args.warmup):
+    for _ in range(max(args.warmup, 1)):   # >=1: excludes compile from timing
         state, metrics = trainer.step(state, batch)
     jax.block_until_ready(metrics)
 
@@ -67,6 +77,57 @@ def main() -> int:
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+    }))
+    return 0
+
+
+def bench_gpt(args) -> int:
+    """Transformer LM throughput (tokens/sec/chip) with the Pallas flash
+    attention kernel; secondary benchmark covering the long-context path."""
+    import jax
+    import optax
+
+    from horovod_tpu import models, training
+    from horovod_tpu.parallel import GradSyncConfig, MeshSpec, build_mesh
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    mesh = build_mesh(MeshSpec(dp=n_dev), devices=devices)
+    on_tpu = jax.default_backend() == "tpu"
+
+    import jax.numpy as jnp
+    cfg = models.gpt_small(
+        max_seq_len=args.seq_len,
+        attention="flash" if on_tpu else "dense", remat=True,
+        # XLA CPU crashes promoting 16-bit all-reduces; bf16 is TPU-only.
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    model = models.TransformerLM(cfg)
+    trainer = training.Trainer(
+        model, optax.adamw(3e-4), mesh,
+        sync=GradSyncConfig(axes=("dp",), op="average",
+                            compression="bf16" if on_tpu else "fp16"))
+
+    batch_size = max(args.batch_size // 16, 1) * n_dev
+    batch = training.synthetic_text_batch(batch_size, seq_len=args.seq_len,
+                                          vocab_size=cfg.vocab_size)
+    state = trainer.init(jax.random.key(0), batch)
+    for _ in range(max(args.warmup, 1)):   # >=1: excludes compile from timing
+        state, metrics = trainer.step(state, batch)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        state, metrics = trainer.step(state, batch)
+    jax.block_until_ready(metrics)
+    elapsed = time.perf_counter() - t0
+
+    tok_per_sec = batch_size * args.seq_len * args.iters / elapsed
+    per_chip = tok_per_sec / n_dev
+    print(json.dumps({
+        "metric": "gpt_small_tokens_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,   # no reference LM baseline exists
     }))
     return 0
 

@@ -1,0 +1,49 @@
+"""Elastic training example (reference: examples/elastic/pytorch_*.py).
+
+Run with host discovery so workers can come and go:
+
+    horovodrun-tpu --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover_hosts.sh \
+        python examples/elastic_train.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.elastic import ObjectState
+from horovod_tpu.elastic.run import run as elastic_run
+
+EPOCHS = 20
+
+
+@elastic_run
+def train(state):
+    while state.epoch < EPOCHS:
+        # One "epoch" of synthetic work; every live rank must agree.
+        grad = np.ones(1024, np.float32) * (state.epoch + 1)
+        avg = hvd.allreduce(grad, average=True,
+                            name=f"grad")
+        state.weights = state.weights - 0.01 * np.asarray(avg)
+        state.epoch += 1
+        state.commit()   # checkpoint + surface membership changes
+        if hvd.rank() == 0:
+            print(f"epoch {state.epoch}/{EPOCHS} on {hvd.size()} workers",
+                  flush=True)
+    return state.weights
+
+
+def main() -> int:
+    state = ObjectState(epoch=0, weights=np.zeros(1024, np.float32))
+    result = train(state)
+    if result is not None and hvd.rank() == 0:
+        print(f"done: |w| = {np.linalg.norm(result):.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

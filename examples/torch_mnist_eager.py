@@ -1,0 +1,70 @@
+"""Eager multi-process training with the Horovod-compatible torch API.
+
+The analogue of the reference's examples/pytorch/pytorch_mnist.py, on
+synthetic MNIST-shaped data (no dataset download).  Launch with:
+
+    horovodrun-tpu -np 2 python examples/torch_mnist_eager.py
+    # or: python -m horovod_tpu.runner.launch -np 2 python examples/torch_mnist_eager.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+        self.fc1 = nn.Linear(320, 50)
+        self.fc2 = nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2(x), 2))
+        x = x.flatten(1)
+        x = F.relu(self.fc1(x))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def main() -> int:
+    hvd.init()
+    torch.manual_seed(42 + hvd.rank())
+
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01, momentum=0.5)
+
+    # The horovod workflow: broadcast initial state, wrap the optimizer.
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    rng = np.random.default_rng(hvd.rank())
+    for epoch in range(2):
+        for step in range(10):
+            data = torch.tensor(
+                rng.standard_normal((32, 1, 28, 28), dtype=np.float32))
+            target = torch.tensor(rng.integers(0, 10, 32))
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(data), target)
+            loss.backward()
+            optimizer.step()
+        avg = hvd.allreduce(loss.detach(), name="epoch_loss")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {avg.item():.4f}")
+    hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

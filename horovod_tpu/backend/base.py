@@ -78,14 +78,21 @@ class CollectiveBackend(ABC):
                 return np.zeros(response.tensor_sizes[0], dtype=np_dtype)
             return np.ascontiguousarray(
                 np.asarray(e.tensor, dtype=np_dtype).reshape(-1))
-        parts = []
+        parts: list[np.ndarray | None] = []
         for i, e in enumerate(entries):
             if e.tensor is None:   # joined-rank zero stand-in
-                parts.append(np.zeros(response.tensor_sizes[i],
-                                      dtype=np_dtype))
+                parts.append(None)
             else:
-                parts.append(np.asarray(e.tensor, dtype=np_dtype).reshape(-1))
-        return np.concatenate(parts)
+                parts.append(np.ascontiguousarray(
+                    np.asarray(e.tensor, dtype=np_dtype)).reshape(-1))
+        from .. import native
+        fused = native.pack(parts, list(response.tensor_sizes), np_dtype)
+        if fused is not None:
+            return fused
+        return np.concatenate([
+            p if p is not None else np.zeros(response.tensor_sizes[i],
+                                             dtype=np_dtype)
+            for i, p in enumerate(parts)])
 
     @staticmethod
     def unpack_fusion_buffer(buf: np.ndarray, response: Response,

@@ -76,6 +76,15 @@ class TcpCollectives:
         bounds = np.cumsum([0] + sizes)
         nxt, prv = (rank + 1) % size, (rank - 1) % size
 
+        # Native C++ ring (same schedule, GIL released, SIMD adds); falls
+        # through to the Python ring for unsupported dtypes/toolchains.
+        from .. import native
+        acc = np.ascontiguousarray(acc)
+        if native.ring_allreduce(self.mesh._socks[nxt].fileno(),
+                                 self.mesh._socks[prv].fileno(),
+                                 acc, rank, size):
+            return acc.astype(buf.dtype, copy=False)
+
         # Reduce-scatter: after step s, rank owns-partial chunk
         # (rank - s) % size.  Send the chunk we just accumulated.
         for step in range(size - 1):

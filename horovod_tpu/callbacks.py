@@ -1,0 +1,224 @@
+"""Training-loop callbacks (reference: horovod/keras/callbacks.py 22-151 and
+horovod/_keras/callbacks.py).
+
+The reference ships four Keras callbacks; these are their framework-neutral
+equivalents for the :class:`horovod_tpu.training.Trainer` fit loop (and any
+hand-written loop): metric averaging across ranks, learning-rate warmup /
+size-scaled schedules, and rank-0-gated best-model checkpointing.  The
+elastic commit callback mirrors horovod/_keras/elastic.py CommitStateCallback.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable
+
+import numpy as np
+
+
+class Callback:
+    """Lifecycle hooks around the Trainer fit loop."""
+
+    def set_trainer(self, trainer) -> None:
+        self.trainer = trainer
+
+    def on_train_begin(self, logs: dict | None = None) -> None: ...
+
+    def on_train_end(self, logs: dict | None = None) -> None: ...
+
+    def on_epoch_begin(self, epoch: int,
+                       logs: dict | None = None) -> None: ...
+
+    def on_epoch_end(self, epoch: int, logs: dict | None = None) -> None: ...
+
+    def on_batch_begin(self, batch: int,
+                       logs: dict | None = None) -> None: ...
+
+    def on_batch_end(self, batch: int, logs: dict | None = None) -> None: ...
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over all ranks (reference:
+    _keras/callbacks.py:49-92 MetricAverageCallback).
+
+    The SPMD Trainer already returns mesh-averaged metrics; this callback
+    matters for the eager multi-process API where each process computes
+    local metrics."""
+
+    def on_epoch_end(self, epoch: int, logs: dict | None = None) -> None:
+        if not logs:
+            return
+        import horovod_tpu as hvd
+        if not hvd.is_initialized() or hvd.size() == 1:
+            return
+        keys = sorted(k for k, v in logs.items()
+                      if isinstance(v, (int, float, np.floating)))
+        if not keys:
+            return
+        vec = np.array([float(logs[k]) for k in keys], np.float64)
+        avg = hvd.allreduce(vec, average=True,
+                            name=f"__metric_avg_e{epoch}__")
+        for k, v in zip(keys, np.asarray(avg)):
+            logs[k] = float(v)
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the base LR by ``multiplier(epoch)`` (reference:
+    _keras/callbacks.py LearningRateScheduleCallback).  Works with any
+    optimizer object exposing ``lr`` / ``learning_rate`` or torch-style
+    ``param_groups``."""
+
+    def __init__(self, optimizer, multiplier: Callable[[int], float] | float,
+                 start_epoch: int = 0, end_epoch: int | None = None,
+                 staircase: bool = True, steps_per_epoch: int | None = None
+                 ) -> None:
+        self.optimizer = optimizer
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        self._initial_lrs: list[float] | None = None
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+
+    def _lr_holders(self):
+        opt = self.optimizer
+        if hasattr(opt, "param_groups"):          # torch
+            return opt.param_groups, "lr"
+        for attr in ("learning_rate", "lr"):
+            if hasattr(opt, attr):
+                return [opt], attr
+        raise AttributeError(
+            "optimizer exposes neither param_groups nor lr/learning_rate")
+
+    def _capture_initial(self):
+        holders, attr = self._lr_holders()
+        if self._initial_lrs is None:
+            self._initial_lrs = [
+                (h[attr] if isinstance(h, dict) else getattr(h, attr))
+                for h in holders]
+
+    def _adjust(self, epoch: float) -> None:
+        if epoch < self.start_epoch or \
+                (self.end_epoch is not None and epoch >= self.end_epoch):
+            return
+        self._capture_initial()
+        holders, attr = self._lr_holders()
+        mult = self.multiplier(epoch)
+        for holder, initial in zip(holders, self._initial_lrs):
+            value = initial * mult
+            if isinstance(holder, dict):
+                holder[attr] = value
+            else:
+                setattr(holder, attr, value)
+
+    def on_epoch_begin(self, epoch: int, logs: dict | None = None) -> None:
+        self.current_epoch = epoch
+        if self.staircase:
+            self._adjust(epoch)
+
+    def on_batch_begin(self, batch: int, logs: dict | None = None) -> None:
+        if not self.staircase and self.steps_per_epoch:
+            self._adjust(self.current_epoch + batch / self.steps_per_epoch)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual LR warmup from lr to lr * size over ``warmup_epochs``
+    (reference: _keras/callbacks.py LearningRateWarmupCallback; the
+    "facebook 1-hour ImageNet" recipe)."""
+
+    def __init__(self, optimizer, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: int | None = None, verbose: bool = False,
+                 initial_lr: float | None = None, size: int | None = None
+                 ) -> None:
+        if size is None:
+            import horovod_tpu as hvd
+            size = hvd.size() if hvd.is_initialized() else 1
+        self.size = size
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+        def multiplier(epoch: float) -> float:
+            if warmup_epochs <= 0:
+                return float(size)
+            # epoch/warmup interpolation 1/size → 1, scaled by size.
+            frac = min(epoch / warmup_epochs, 1.0)
+            return (1.0 + frac * (size - 1)) / 1.0
+
+        super().__init__(optimizer, multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs, staircase=False,
+                         steps_per_epoch=steps_per_epoch)
+
+    def on_epoch_end(self, epoch: int, logs: dict | None = None) -> None:
+        if self.verbose and epoch == self.warmup_epochs - 1:
+            print(f"Epoch {epoch}: finished gradual learning rate warmup "
+                  f"to x{self.size}.")
+
+
+class BestModelCheckpoint(Callback):
+    """Save the model when the monitored metric improves; rank-0-gated
+    (reference: keras/callbacks.py:151 BestModelCheckpoint)."""
+
+    def __init__(self, filepath: str, monitor: str = "loss",
+                 mode: str = "min",
+                 save_fn: Callable[[str, Any], None] | None = None) -> None:
+        self.filepath = filepath
+        self.monitor = monitor
+        self.mode = mode
+        self.best = math.inf if mode == "min" else -math.inf
+        self.save_fn = save_fn
+        self._state = None
+
+    def set_state(self, state: Any) -> None:
+        self._state = state
+
+    def _better(self, value: float) -> bool:
+        return value < self.best if self.mode == "min" else value > self.best
+
+    def on_epoch_end(self, epoch: int, logs: dict | None = None) -> None:
+        if not logs or self.monitor not in logs:
+            return
+        import horovod_tpu as hvd
+        if hvd.is_initialized() and hvd.rank() != 0:
+            return
+        value = float(logs[self.monitor])
+        if not self._better(value):
+            return
+        self.best = value
+        path = self.filepath.format(epoch=epoch, **logs)
+        if self.save_fn is not None:
+            self.save_fn(path, self._state)
+        else:
+            from .checkpoint import save_checkpoint
+            save_checkpoint(path, self._state)
+
+
+class CommitStateCallback(Callback):
+    """Commit elastic state every ``batches_per_commit`` batches
+    (reference: _keras/elastic.py CommitStateCallback)."""
+
+    def __init__(self, state, batches_per_commit: int = 1) -> None:
+        self.state = state
+        self.batches_per_commit = batches_per_commit
+
+    def on_batch_end(self, batch: int, logs: dict | None = None) -> None:
+        if (batch + 1) % self.batches_per_commit == 0:
+            self.state.commit()
+
+
+class UpdateBatchStateCallback(Callback):
+    """Track batch progress in elastic state so a restored worker resumes
+    mid-epoch (reference: _keras/elastic.py UpdateBatchStateCallback)."""
+
+    def __init__(self, state) -> None:
+        self.state = state
+
+    def on_batch_end(self, batch: int, logs: dict | None = None) -> None:
+        self.state.batch = batch
+
+    def on_epoch_end(self, epoch: int, logs: dict | None = None) -> None:
+        self.state.epoch = epoch + 1
+        self.state.batch = 0

@@ -1,0 +1,57 @@
+"""Checkpoint save/restore.
+
+The reference has no core checkpoint format (SURVEY §5.4) — it relies on
+``broadcast_parameters`` for start-of-training consistency and rank-0-gated
+framework checkpoints.  The TPU-native equivalent: orbax for sharded-array
+pytrees (params/optimizer state survive any mesh relayout), with the same
+rank-0 gating semantics for the eager multi-process API.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
+    """Write a pytree checkpoint (sharded arrays handled by orbax).
+
+    In multi-process (eager API) worlds only rank 0 writes, matching the
+    reference's rank-0 gating (keras/callbacks.py BestModelCheckpoint).
+    Under single-process SPMD every process calls this once anyway.
+    """
+    from . import core
+    if core.is_initialized() and core.global_state().rank != 0 \
+            and jax.process_count() == 1:
+        return
+    path = os.path.abspath(path)
+    _checkpointer().save(path, state, force=force)
+
+
+def restore_checkpoint(path: str, target: Any | None = None) -> Any:
+    """Restore a pytree checkpoint; ``target`` (a matching pytree of arrays
+    or ShapeDtypeStructs) restores with the target's shardings/dtypes."""
+    path = os.path.abspath(path)
+    ckpt = _checkpointer()
+    if target is None:
+        return ckpt.restore(path)
+    import orbax.checkpoint as ocp
+    try:
+        return ckpt.restore(path, ocp.args.PyTreeRestore(target))
+    except (TypeError, AttributeError):
+        return ckpt.restore(path, item=target)
+
+
+def latest_checkpoint(directory: str) -> str | None:
+    """Newest checkpoint subdirectory by mtime (step-named dirs)."""
+    if not os.path.isdir(directory):
+        return None
+    entries = [os.path.join(directory, e) for e in os.listdir(directory)]
+    dirs = [e for e in entries if os.path.isdir(e)]
+    return max(dirs, key=os.path.getmtime) if dirs else None

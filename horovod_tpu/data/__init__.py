@@ -1,0 +1,12 @@
+"""Data-loading utilities: sharded, prefetching input pipelines.
+
+Reference: horovod/data/data_loader_base.py (AsyncDataLoaderMixin prefetch
+thread) and horovod/spark/data_loaders/pytorch_data_loaders.py.  TPU-native
+additions: device prefetch that overlaps host→HBM transfer with the current
+step, and mesh-aware batch sharding.
+"""
+from .loader import (AsyncDataLoaderMixin, BaseDataLoader, ShardedBatchLoader,
+                     prefetch_to_device)
+
+__all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "ShardedBatchLoader",
+           "prefetch_to_device"]

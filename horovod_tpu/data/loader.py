@@ -1,0 +1,178 @@
+"""Sharded + prefetching data loaders.
+
+Reference: horovod/data/data_loader_base.py — ``BaseDataLoader`` is the
+iterator contract, ``AsyncDataLoaderMixin`` moves batch production onto a
+background thread with a bounded queue.  ``prefetch_to_device`` is the
+TPU-specific piece: it pushes upcoming batches to device HBM (with the
+mesh sharding applied) while the current step runs, hiding host→device
+latency — the role the reference's pinned-memory loaders play for GPUs.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator, Sequence
+
+import numpy as np
+
+
+class BaseDataLoader:
+    """Iterator contract (reference: data_loader_base.py BaseDataLoader)."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def _iterate(self) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[Any]:
+        return self._iterate()
+
+
+class AsyncDataLoaderMixin:
+    """Prefetch batches on a background thread
+    (reference: data_loader_base.py:48-130 AsyncDataLoaderMixin).
+
+    Mix in BEFORE the loader class::
+
+        class AsyncLoader(AsyncDataLoaderMixin, MyLoader): ...
+    """
+
+    def __init__(self, *args, async_loader_queue_size: int = 4,
+                 **kwargs) -> None:
+        self.async_loader_queue_size = async_loader_queue_size
+        super().__init__(*args, **kwargs)
+
+    def _iterate(self) -> Iterator[Any]:
+        if self.async_loader_queue_size <= 0:
+            yield from super()._iterate()
+            return
+        q: "queue.Queue" = queue.Queue(maxsize=self.async_loader_queue_size)
+        done = object()
+        stop = threading.Event()
+        err: list[BaseException] = []
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer() -> None:
+            try:
+                for item in super(AsyncDataLoaderMixin, self)._iterate():
+                    if not _put(item):
+                        return     # consumer abandoned the iterator
+            except BaseException as e:  # noqa: BLE001 - re-raised in consumer
+                err.append(e)
+            finally:
+                _put(done)
+
+        thread = threading.Thread(target=producer, daemon=True,
+                                  name="hvd-data-prefetch")
+        thread.start()
+        try:
+            while True:
+                item = q.get()
+                if item is done:
+                    break
+                yield item
+        finally:
+            # Early exit (break in the consumer loop): unblock and retire
+            # the producer instead of leaking one thread per epoch.
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            thread.join(timeout=5)
+        if err:
+            raise err[0]
+
+
+class ShardedBatchLoader(BaseDataLoader):
+    """Batches a numpy dataset dict, sharded by rank (eager API) or whole
+    (SPMD API where the mesh shards the global batch).
+
+    ``data``: dict of equal-first-dim numpy arrays, e.g. {"image":…,
+    "label":…}.  With ``rank``/``num_replicas`` each process sees its strided
+    shard — the reference's DistributedSampler contract.
+    """
+
+    def __init__(self, data: dict[str, np.ndarray], batch_size: int,
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = True,
+                 rank: int = 0, num_replicas: int = 1) -> None:
+        self.data = data
+        first = next(iter(data.values()))
+        self.n = int(first.shape[0])
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.rank = rank
+        self.num_replicas = num_replicas
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        per_rank = self.n // self.num_replicas
+        if self.drop_last:
+            return per_rank // self.batch_size
+        return (per_rank + self.batch_size - 1) // self.batch_size
+
+    def _iterate(self) -> Iterator[dict[str, np.ndarray]]:
+        idx = np.arange(self.n)
+        if self.shuffle:
+            np.random.default_rng(self.seed + self.epoch).shuffle(idx)
+        idx = idx[self.rank::self.num_replicas]
+        stop = len(idx) - (len(idx) % self.batch_size) if self.drop_last \
+            else len(idx)
+        for start in range(0, stop, self.batch_size):
+            sel = idx[start:start + self.batch_size]
+            yield {k: v[sel] for k, v in self.data.items()}
+
+
+def prefetch_to_device(iterator: Iterable[dict], size: int = 2,
+                       sharding: Any | None = None) -> Iterator[dict]:
+    """Device-prefetch pipeline: keep ``size`` batches in flight on the
+    accelerator so the input pipeline overlaps the training step.
+
+    ``sharding``: optional `jax.sharding.Sharding` (or pytree of shardings)
+    applied on transfer — the global-batch layout over the mesh.
+    """
+    import jax
+
+    buf: "queue.Queue" = queue.Queue()
+    it = iter(iterator)
+
+    def _put(batch: dict) -> None:
+        if sharding is not None:
+            batch = jax.device_put(batch, sharding)
+        else:
+            batch = jax.device_put(batch)
+        buf.put(batch)
+
+    # Prime the pipeline.
+    primed = 0
+    for _ in range(size):
+        try:
+            _put(next(it))
+            primed += 1
+        except StopIteration:
+            break
+
+    while primed:
+        out = buf.get()
+        primed -= 1
+        try:
+            _put(next(it))
+            primed += 1
+        except StopIteration:
+            pass
+        yield out

@@ -138,7 +138,10 @@ class ElasticDriver:
             self._assignments = {(s.hostname, s.local_rank): s
                                  for s in slots}
             self._epoch += 1
-            self.registry.reset(len(slots))
+            self.registry.reset(len(slots),
+                                expected_slots=[
+                                    f"{s.hostname}[{s.local_rank}]"
+                                    for s in slots])
             logger.info("elastic round %d: %d slots on %s", self._epoch,
                         len(slots), ",".join(h.hostname for h in hosts))
             self._round_cond.notify_all()
@@ -216,9 +219,12 @@ class ElasticDriver:
         with self._round_cond:
             current = self._epoch
         if min_epoch > current:
-            # Record READY outside the round lock: the registry may resume()
-            # synchronously, and _form_round re-acquires the lock.
-            self.registry.record_ready(host, slot)
+            # Record READY outside the round lock (the registry may resume()
+            # synchronously, and _form_round re-acquires the lock), but
+            # bound to the round it targets: if the round resolves between
+            # the epoch read and the record, the registry drops it so the
+            # stale READY cannot pre-complete the NEXT round's barrier.
+            self.registry.record_ready(host, slot, round_id=current)
         deadline = time.monotonic() + self._timeout
         with self._round_cond:
             while self._epoch < max(min_epoch, 1) and not self.finished():

@@ -82,16 +82,22 @@ def launch_elastic(args, command: list[str]) -> int:
             SECRET_ENV: secret,
         })
         if slot.hostname in LOCAL_HOSTS:
-            full_command = list(command)
-        else:
-            exports = " ".join(f"{k}={v}" for k, v in env.items()
-                               if k.startswith("HOROVOD_"))
-            remote = " ".join(command)
-            full_command = ["ssh", "-o", "StrictHostKeyChecking=no",
-                            slot.hostname, f"env {exports} {remote}"]
+            return safe_shell_exec.execute(list(command), env=env,
+                                           index=slot.rank)
+        import shlex
+        # The HMAC secret travels over ssh stdin (`read -r`), never argv —
+        # argv is world-readable in the remote host's process list.
+        exports = " ".join(
+            f"{k}={shlex.quote(str(v))}" for k, v in env.items()
+            if k.startswith("HOROVOD_") and k != SECRET_ENV)
+        remote = " ".join(shlex.quote(c) for c in command)
+        script = (f"read -r {SECRET_ENV} && export {SECRET_ENV} && "
+                  f"env {exports} {remote}")
+        full_command = ["ssh", "-o", "StrictHostKeyChecking=no",
+                        slot.hostname, f"/bin/sh -c {shlex.quote(script)}"]
         return safe_shell_exec.execute(
-            full_command, env=env,
-            index=slot.rank if slot.hostname in LOCAL_HOSTS else None)
+            full_command, env=env, index=None,
+            stdin_data=(secret + "\n").encode())
 
     try:
         driver.start(args.num_proc or min_np, create_worker)

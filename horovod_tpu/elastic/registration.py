@@ -29,6 +29,7 @@ class WorkerStateRegistry:
         self._workers: dict[str, set[str]] = defaultdict(set)
         self._rendezvous_id = 0
         self._size = 0
+        self._expected: set[str] | None = None
         self._round_complete = False
 
     @property
@@ -47,14 +48,21 @@ class WorkerStateRegistry:
         with self._lock:
             return len(self._workers.get(state, set()))
 
-    def reset(self, size: int) -> None:
-        """Start a new rendezvous round expecting ``size`` workers."""
+    def reset(self, size: int, expected_slots=None) -> None:
+        """Start a new rendezvous round expecting ``size`` workers.
+
+        ``expected_slots``: optional iterable of "host[slot]" keys; records
+        for any other slot (e.g. a long-dead worker on a host removed in an
+        earlier round) are ignored so they cannot complete the round
+        barrier prematurely."""
         with self._lock:
             logger.debug("registry reset: size=%d round=%d", size,
                          self._rendezvous_id)
             self._states.clear()
             self._workers.clear()
             self._size = size
+            self._expected = set(expected_slots) \
+                if expected_slots is not None else None
             self._rendezvous_id += 1
             self._round_complete = False
 
@@ -65,8 +73,9 @@ class WorkerStateRegistry:
     def last_rendezvous(self) -> int:
         return self._rendezvous_id
 
-    def record_ready(self, host: str, slot: int) -> int:
-        return self._record_state(host, slot, READY)
+    def record_ready(self, host: str, slot: int,
+                     round_id: int | None = None) -> int:
+        return self._record_state(host, slot, READY, round_id)
 
     def record_success(self, host: str, slot: int) -> int:
         return self._record_state(host, slot, SUCCESS)
@@ -74,7 +83,8 @@ class WorkerStateRegistry:
     def record_failure(self, host: str, slot: int) -> int:
         return self._record_state(host, slot, FAILURE)
 
-    def _record_state(self, host: str, slot: int, state: str) -> int:
+    def _record_state(self, host: str, slot: int, state: str,
+                      round_id: int | None = None) -> int:
         if self._driver.finished():
             return self._rendezvous_id
         if state == FAILURE:
@@ -84,6 +94,15 @@ class WorkerStateRegistry:
         key = f"{host}[{slot}]"
         fire = False
         with self._lock:
+            if round_id is not None and round_id != self._rendezvous_id:
+                # The record targeted a round that already resolved (the
+                # caller re-checks the epoch); dropping it prevents a READY
+                # from leaking into the NEXT round's barrier.
+                return self._rendezvous_id
+            if self._expected is not None and key not in self._expected:
+                logger.debug("ignoring %s record for %s: not part of "
+                             "round %d", state, key, self._rendezvous_id)
+                return self._rendezvous_id
             cur = self._states.get(key)
             if cur is None:
                 self._states[key] = state

@@ -108,6 +108,12 @@ class RpcClient:
     def __init__(self, addr: str, port: int, secret: str,
                  timeout: float = 30.0) -> None:
         self._sock = socket.create_connection((addr, port), timeout=timeout)
+        # Calls may legitimately block far longer than the connect timeout:
+        # get_assignment waits server-side for a rendezvous round (up to the
+        # driver's elastic_timeout).  Block until the server answers or the
+        # connection breaks — a short recv timeout here would crash healthy
+        # workers and cascade into host blacklisting.
+        self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._secret = secret
         self._lock = threading.Lock()

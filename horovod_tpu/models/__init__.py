@@ -10,6 +10,9 @@ lands on the MXU.
 """
 from .resnet import (ResNet, ResNet18, ResNet34, ResNet50, ResNet101,
                      ResNet152)
+from .transformer import (TransformerConfig, TransformerLM, gpt_medium,
+                          gpt_small, gpt_tiny)
 
 __all__ = ["ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101",
-           "ResNet152"]
+           "ResNet152", "TransformerConfig", "TransformerLM", "gpt_small",
+           "gpt_medium", "gpt_tiny"]

@@ -1,0 +1,145 @@
+"""Native (C++) data-plane kernels, loaded via ctypes.
+
+The reference ships a ~17k-LoC C++ core loaded through ctypes
+(reference: horovod/common/basics.py:25-31); this package is its TPU-native
+counterpart for the paths that stay on the host CPU: fusion-buffer
+pack/unpack, buffer scaling, the TCP ring allreduce, and Adasum combine
+primitives.  The XLA/Pallas compute path needs no host kernels — these only
+serve the eager multi-process API.
+
+Build model: kernels.cc is compiled once per machine with g++ -O3
+-march=native into a cache directory at first import; every entry point has
+a pure-Python fallback, so a missing/failed toolchain degrades performance,
+never correctness.  Set HOROVOD_TPU_DISABLE_NATIVE=1 to force the fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "kernels.cc")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_DTYPE_CODES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+}
+
+
+def _cache_dir() -> str:
+    root = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    path = os.path.join(root, "horovod_tpu")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def _build() -> str | None:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_cache_dir(), f"hvd_native_{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    tmp = tempfile.mktemp(suffix=".so", dir=_cache_dir())
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           "-pthread", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so_path)   # atomic: concurrent builders race safely
+        return so_path
+    except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("HOROVOD_TPU_DISABLE_NATIVE", "") in ("1", "true"):
+            return None
+        so = _build()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+            lib.hvd_abi_version.restype = ctypes.c_int32
+            if lib.hvd_abi_version() != 1:
+                return None
+            lib.hvd_pack.argtypes = [ctypes.POINTER(ctypes.c_void_p),
+                                     ctypes.POINTER(ctypes.c_int64),
+                                     ctypes.c_int32, ctypes.c_char_p]
+            lib.hvd_unpack.argtypes = [ctypes.c_char_p,
+                                       ctypes.POINTER(ctypes.c_int64),
+                                       ctypes.c_int32,
+                                       ctypes.POINTER(ctypes.c_void_p)]
+            lib.hvd_ring_allreduce.argtypes = [
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32]
+            lib.hvd_ring_allreduce.restype = ctypes.c_int32
+            lib.hvd_scale_f32.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.c_float]
+            lib.hvd_scale_f64.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.c_double]
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def ring_allreduce(send_fd: int, recv_fd: int, buf: np.ndarray,
+                   rank: int, size: int) -> bool:
+    """In-place sum ring allreduce over raw socket fds.  Returns False when
+    the native path cannot handle this dtype (caller falls back)."""
+    lib = _load()
+    code = _DTYPE_CODES.get(buf.dtype)
+    if lib is None or code is None or not buf.flags.c_contiguous:
+        return False
+    rc = lib.hvd_ring_allreduce(
+        send_fd, recv_fd, buf.ctypes.data_as(ctypes.c_void_p),
+        buf.size, code, rank, size)
+    if rc == -1:
+        raise ConnectionError("native ring allreduce: peer socket failed")
+    return rc == 0
+
+
+def pack(parts: list[np.ndarray | None], sizes: list[int],
+         dtype: np.dtype) -> np.ndarray | None:
+    """Concatenate flattened arrays (None → zeros) into one fused buffer."""
+    lib = _load()
+    if lib is None:
+        return None
+    total = sum(sizes)
+    out = np.empty(total, dtype=dtype)
+    n = len(parts)
+    src_ptrs = (ctypes.c_void_p * n)()
+    nbytes = (ctypes.c_int64 * n)()
+    for i, (p, sz) in enumerate(zip(parts, sizes)):
+        nbytes[i] = sz * dtype.itemsize
+        src_ptrs[i] = None if p is None else p.ctypes.data_as(
+            ctypes.c_void_p).value
+    lib.hvd_pack(src_ptrs, nbytes, n,
+                 out.ctypes.data_as(ctypes.c_char_p))
+    return out

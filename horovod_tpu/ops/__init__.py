@@ -1,1 +1,5 @@
 """Collective-op algorithms and TPU kernels (adasum, compression, fused ops)."""
+from .flash_attention import (flash_attention, flash_attention_with_lse,
+                              mha_reference)
+
+__all__ = ["flash_attention", "flash_attention_with_lse", "mha_reference"]

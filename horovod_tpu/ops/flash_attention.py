@@ -1,0 +1,445 @@
+"""Fused flash attention for TPU (Pallas).
+
+The reference framework has no attention code at all (SURVEY §5.7) — long
+context on TPU is a first-class goal of this rebuild, so the hot op is a
+native MXU kernel: blockwise attention with online softmax, FlashAttention-2
+style forward and backward, streaming KV blocks through VMEM so memory is
+O(block) instead of O(seq²).
+
+Layout: [batch*heads, seq, head_dim] inside the kernels; the public API
+takes [batch, seq, heads, head_dim] (BTHD, the framework-wide convention).
+
+On non-TPU backends a numerically identical pure-JAX blockwise path runs
+instead (same online-softmax math, differentiable); the Pallas kernels can
+also be exercised anywhere via ``interpret=True`` (used by the unit tests).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+_LANE = 128   # TPU lane width: last-dim tile alignment
+
+
+def _cdiv(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+def _out_struct(shape, dtype, *like):
+    """ShapeDtypeStruct carrying the union of the inputs' varying mesh axes
+    (vma) — required for pallas_call inside shard_map regions with
+    check_vma=True."""
+    vma: frozenset = frozenset()
+    for x in like:
+        v = getattr(jax.core.get_aval(x), "vma", None)
+        if v:
+            vma |= frozenset(v)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:   # older jax without vma support
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ===========================================================================
+# Pure-JAX reference (also the CPU fallback and the autodiff oracle)
+# ===========================================================================
+def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                  causal: bool = False,
+                  sm_scale: float | None = None) -> jax.Array:
+    """Dense softmax attention. q,k,v: [B, T, H, D] (BTHD)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+# ===========================================================================
+# Pallas forward kernel
+# ===========================================================================
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *,
+                sm_scale: float, causal: bool,
+                block_q: int, block_k: int, n_kv: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: blocks strictly above the diagonal contribute nothing.
+    run = True
+    if causal:
+        run = kj * block_k <= qi * block_q + (block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)              # [bq, d]
+        k = k_ref[0].astype(jnp.float32)              # [bk, d]
+        v = v_ref[0].astype(jnp.float32)              # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # [bq, bk]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]                          # [bq]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)               # [bq]
+        p = jnp.exp(s - m_cur[:, None])               # [bq, bk]
+        l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        m_ref[:, 0] = m_cur
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(l == 0.0, NEG_INF, m_ref[:, 0] + jnp.log(l_safe))
+
+
+def _flash_fwd_pallas(q, k, v, *, sm_scale, causal, block_q, block_k,
+                      interpret):
+    """q,k,v: [BH, T, D] → (o [BH, T, D], lse [BH, T])."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    assert tq % block_q == 0 and tk % block_k == 0, \
+        f"seq lengths ({tq},{tk}) must divide blocks ({block_q},{block_k})"
+    n_q, n_kv = tq // block_q, tk // block_k
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, n_kv=n_kv)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            _out_struct((bh, tq, d), q.dtype, q, k, v),
+            _out_struct((bh, tq), jnp.float32, q, k, v),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ===========================================================================
+# Pallas backward kernels (FlashAttention-2 split: dq, then dk/dv)
+# ===========================================================================
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *,
+                   sm_scale: float, causal: bool,
+                   block_q: int, block_k: int, n_kv: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = kj * block_k <= qi * block_q + (block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                 # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_acc[...] += jax.lax.dot(ds, k,
+                                   preferred_element_type=jnp.float32)
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    sm_scale: float, causal: bool,
+                    block_q: int, block_k: int, n_q: int):
+    from jax.experimental import pallas as pl
+
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = kj * block_k <= qi * block_q + (block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # [bq, bk]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                 # [bq, bk]
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        ds = p * (dp - delta[:, None]) * sm_scale     # [bq, bk]
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, d]
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, *, sm_scale, causal,
+                      block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    n_q, n_kv = tq // block_q, tk // block_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                          # [BH, T]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_kv=n_kv),
+        grid=(bh, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=_out_struct((bh, tq, d), q.dtype, q, k, v, do),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_q=n_q),
+        grid=(bh, n_kv, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            _out_struct((bh, tk, d), k.dtype, q, k, v, do),
+            _out_struct((bh, tk, d), v.dtype, q, k, v, do),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ===========================================================================
+# Blockwise pure-JAX path (CPU fallback; numerically matches the kernel)
+# ===========================================================================
+def _blockwise_jax(q, k, v, *, sm_scale, causal):
+    """[BH, T, D] online-softmax attention with lse, differentiable."""
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        rows = jnp.arange(tq)[:, None]
+        cols = jnp.arange(tk)[None, :]
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)) / l[..., None]
+    lse = m + jnp.log(l)
+    return o.astype(q.dtype), lse
+
+
+# ===========================================================================
+# Public API with custom VJP
+# ===========================================================================
+def _merge_heads(x):
+    """[B, T, H, D] → [B*H, T, D]."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _split_heads(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, _res = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                         interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    if _on_tpu() or interpret:
+        o, lse = _flash_fwd_pallas(q, k, v, sm_scale=sm_scale,
+                                   causal=causal, block_q=block_q,
+                                   block_k=block_k, interpret=interpret)
+    else:
+        o, lse = _blockwise_jax(q, k, v, sm_scale=sm_scale, causal=causal)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o, res = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
+                        interpret)
+    return o, res
+
+
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret,
+                    res, g):
+    q, k, v, o, lse = res
+    if _on_tpu() or interpret:
+        dq, dk, dv = _flash_bwd_pallas(
+            q, k, v, o, lse, g, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret)
+    else:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _blockwise_jax(q_, k_, v_,
+                                              sm_scale=sm_scale,
+                                              causal=causal)[0], q, k, v)
+        dq, dk, dv = vjp(g)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, sm_scale: float | None = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False) -> jax.Array:
+    """Fused multi-head attention. q,k,v: [B, T, H, D] (BTHD). Differentiable
+    (custom VJP with Pallas backward kernels on TPU)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    b, _, h, _ = q.shape
+    out = _flash(_merge_heads(q), _merge_heads(k), _merge_heads(v),
+                 float(sm_scale), bool(causal), int(block_q), int(block_k),
+                 bool(interpret))
+    return _split_heads(out, b, h)
+
+
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                             causal: bool = False,
+                             sm_scale: float | None = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: bool = False
+                             ) -> tuple[jax.Array, jax.Array]:
+    """Like :func:`flash_attention` but also returns the log-sum-exp
+    [B, H, T] — the merge statistic ring attention needs. Differentiation
+    flows through the non-lse output only."""
+    b, _, h, _ = q.shape
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    qm, km, vm = _merge_heads(q), _merge_heads(k), _merge_heads(v)
+    if _on_tpu() or interpret:
+        o, lse = _flash_fwd_pallas(qm, km, vm, sm_scale=float(sm_scale),
+                                   causal=causal, block_q=block_q,
+                                   block_k=block_k, interpret=interpret)
+    else:
+        o, lse = _blockwise_jax(qm, km, vm, sm_scale=float(sm_scale),
+                                causal=causal)
+    t = q.shape[1]
+    return _split_heads(o, b, h), lse.reshape(b, h, t)

@@ -19,8 +19,11 @@ from .collectives import (allreduce, allgather, alltoall, broadcast,
 from .grad_sync import GradSyncConfig, build_grad_sync, sync_gradients
 from .sharding import (ShardingRules, shard_params, named_sharding,
                        constrain, replicated)
+from .ring_attention import local_attention, ring_attention
+from .ulysses import ulysses_attention
 
 __all__ = [
+    "ring_attention", "local_attention", "ulysses_attention",
     "MeshSpec", "build_mesh", "axis_size", "data_axes", "DEFAULT_AXES",
     "allreduce", "allgather", "alltoall", "broadcast", "reduce_scatter",
     "adasum_allreduce", "device_collective",

@@ -1,0 +1,126 @@
+"""Ring attention: exact attention over sequences sharded across the "sp"
+mesh axis.
+
+The reference has no sequence parallelism (SURVEY §5.7) — its only related
+primitive is alltoall.  On TPU the idiomatic transport is the ICI ring:
+each device holds a [B, T/n, H, D] shard of Q, K, V; K/V blocks rotate
+around the ring via ``ppermute`` (neighbor exchange ≈ one ICI hop per step)
+while each device accumulates its queries' attention over every block with
+online-softmax merging.  Compute and transfer overlap naturally: XLA
+schedules the next permute while the current block's matmuls run on the MXU.
+
+Differentiable by construction (lax.scan + ppermute are both transparent to
+autodiff); wrap the per-block attention in ``jax.checkpoint`` upstream if
+the residuals of long rings blow past HBM.
+
+Must run inside ``shard_map`` over a mesh with the given axis, e.g.::
+
+    out = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis="sp", causal=True),
+        mesh, in_specs=(P("dp", "sp"), ...), out_specs=P("dp", "sp"))(...)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _chunk_attention(q, k, v, sm_scale, mask):
+    """Dense attention over one KV chunk.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]; mask: [Tq, Tk] bool or None.
+    Returns unnormalized ``o`` [B, Tq, H, D] f32 (= exp(s - m) @ v), the
+    softmax denominator ``l`` and the log-sum-exp, both [B, H, Tq] f32.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * sm_scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                               # [B,H,Tq]
+    # Fully-masked rows: clamp m so p underflows to 0 instead of becoming
+    # exp(NEG_INF - NEG_INF) = 1, and lse stays ~NEG_INF.
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(s - m_safe[..., None])
+    l = jnp.sum(p, axis=-1)                               # [B,H,Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    lse = jnp.where(l > 0.0, m_safe + jnp.log(jnp.maximum(l, 1e-30)),
+                    NEG_INF)
+    return o, l, lse
+
+
+def _merge(o_acc, lse_acc, o_c, l_c, lse_c):
+    """Online-softmax merge of the running (normalized o, lse) with one
+    chunk's (unnormalized o, l, lse)."""
+    l_safe = jnp.maximum(l_c, 1e-30)
+    o_c = o_c / l_safe.transpose(0, 2, 1)[..., None]      # normalize chunk
+    lse_new = jnp.logaddexp(lse_acc, lse_c)
+    wp = jnp.exp(lse_acc - lse_new).transpose(0, 2, 1)[..., None]
+    wc = jnp.exp(lse_c - lse_new).transpose(0, 2, 1)[..., None]
+    return o_acc * wp + o_c * wc, lse_new
+
+
+def local_attention(q, k, v, causal: bool = False,
+                    sm_scale: float | None = None):
+    """Single-shard dense attention (the ring degenerate case)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    mask = None
+    if causal:
+        t, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(t)[:, None] >= jnp.arange(tk)[None, :]
+    o, l, _ = _chunk_attention(q, k, v, sm_scale, mask)
+    l_safe = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / l_safe).astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis: str = "sp", causal: bool = False,
+                   sm_scale: float | None = None,
+                   axis_size: int | None = None) -> jax.Array:
+    """Exact attention with the sequence sharded over mesh axis ``axis``.
+
+    q, k, v: local shards [B, T_local, H, D] (BTHD); returns the local
+    output shard in q's dtype.  Run inside shard_map.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n = axis_size if axis_size is not None else lax.psum(1, axis)
+    if isinstance(n, jax.Array):
+        raise ValueError(
+            "ring_attention needs the static ring size; pass axis_size= "
+            "or run under shard_map where psum(1, axis) is static")
+    if n == 1:
+        return local_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    my_idx = lax.axis_index(axis)
+    b, t_local, h, d = q.shape
+    perm = [(i, (i - 1) % n) for i in range(n)]   # receive from right
+
+    def ring_step(carry, s):
+        o_acc, lse_acc, k_cur, v_cur = carry
+        # The chunk held at step s originated at ring position
+        # (my_idx + s) mod n.
+        src = (my_idx + s) % n
+        if causal:
+            q_pos = my_idx * t_local + jnp.arange(t_local)[:, None]
+            kv_pos = src * t_local + jnp.arange(t_local)[None, :]
+            mask = q_pos >= kv_pos
+        else:
+            mask = None
+        o_c, l_c, lse_c = _chunk_attention(q, k_cur, v_cur, sm_scale, mask)
+        o_new, lse_new = _merge(o_acc, lse_acc, o_c, l_c, lse_c)
+        k_next = lax.ppermute(k_cur, axis, perm)
+        v_next = lax.ppermute(v_cur, axis, perm)
+        return (o_new, lse_new, k_next, v_next), None
+
+    # Build the initial carry FROM q so it carries q's device-varying axes
+    # (plain constants would be "unvarying" and trip the scan vma check
+    # under shard_map).
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)
+    lse0 = jnp.sum(o0, axis=-1).transpose(0, 2, 1) + NEG_INF  # [B,H,T]
+    (o, _, _, _), _ = lax.scan(ring_step, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype)

@@ -1,0 +1,58 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence reshard.
+
+The reference's ``alltoall`` primitive (operations.cc:1136-1198) is exactly
+the transport a Ulysses SP needs (SURVEY §5.7); here it is the XLA
+``all_to_all`` over the "sp" mesh axis: sequence-sharded activations
+[B, T/n, H, D] reshard to head-sharded [B, T, H/n, D], run *any* full-
+sequence attention locally (dense or the Pallas flash kernel), and reshard
+back.  Two all-to-alls per attention instead of a ring of n permutes —
+cheaper when H >= n and sequence chunks are large.
+
+Run inside shard_map over the "sp" axis (composes with "dp" batch axes).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax import lax
+
+from .ring_attention import local_attention
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis: str = "sp", causal: bool = False,
+                      sm_scale: float | None = None,
+                      attn_fn: Callable | None = None,
+                      axis_size: int | None = None) -> jax.Array:
+    """q, k, v: local shards [B, T_local, H, D]; heads H must be divisible
+    by the axis size.  ``attn_fn(q, k, v, causal=..., sm_scale=...)`` runs
+    full-sequence attention on the head shard (defaults to dense local
+    attention; pass ops.flash_attention for the fused kernel)."""
+    n = axis_size if axis_size is not None else lax.psum(1, axis)
+    if isinstance(n, jax.Array):
+        raise ValueError(
+            "ulysses_attention needs the static axis size; pass axis_size= "
+            "or run under shard_map where psum(1, axis) is static")
+    if attn_fn is None:
+        attn_fn = local_attention
+    if n == 1:
+        return attn_fn(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(f"{h} heads not divisible by sp={n}")
+
+    def seq_to_heads(x):
+        # [B, T/n, H, D] → [B, T, H/n, D]: split the head dim across the
+        # axis, gather the sequence dim.
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    out = attn_fn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
+                  causal=causal, sm_scale=sm_scale)
+    return heads_to_seq(out)

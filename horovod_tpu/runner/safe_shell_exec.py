@@ -32,19 +32,29 @@ def _tail(stream, prefix: str, sink, buffer: list[str] | None) -> None:
 def execute(command, env: dict | None = None, index: int | None = None,
             stdout=None, stderr=None, prefix_output: bool = True,
             capture: list[str] | None = None,
-            events: list[threading.Event] | None = None) -> int:
+            events: list[threading.Event] | None = None,
+            stdin_data: bytes | None = None) -> int:
     """Run `command` (list or shell string); returns its exit code.
 
     `events`: optional termination events — a watcher thread kills the
     child when any is set (used by the elastic driver to stop slots whose
-    host was blacklisted)."""
+    host was blacklisted).
+    `stdin_data`: bytes written to the child's stdin then closed — used to
+    hand secrets to remote shells without exposing them in argv."""
     stdout = stdout or sys.stdout
     stderr = stderr or sys.stderr
     shell = isinstance(command, str)
     proc = subprocess.Popen(
         command, shell=shell, env=env,
+        stdin=subprocess.PIPE if stdin_data is not None else None,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         start_new_session=True)
+    if stdin_data is not None:
+        try:
+            proc.stdin.write(stdin_data)
+            proc.stdin.close()
+        except (BrokenPipeError, OSError):
+            pass
 
     out_prefix = f"[{index}]<stdout>: " if prefix_output and index is not None \
         else ""

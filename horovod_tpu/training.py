@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Any, Callable
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -83,7 +83,7 @@ class Trainer:
 
     # -- initialization ----------------------------------------------------
     def init(self, rng: jax.Array, sample_batch: dict) -> TrainState:
-        images = sample_batch["image"]
+        images = _model_input(sample_batch)
         variables = jax.eval_shape(
             partial(self.model.init, train=False), rng,
             jnp.zeros((1,) + images.shape[1:], images.dtype))
@@ -124,11 +124,11 @@ class Trainer:
         def local_step(state: TrainState, batch: dict):
             def loss_of(params):
                 variables = {"params": params}
-                mutable = []
+                mutable: Any = False
                 if state.batch_stats:
                     variables["batch_stats"] = state.batch_stats
                     mutable = ["batch_stats"]
-                out = self.model.apply(variables, batch["image"],
+                out = self.model.apply(variables, _model_input(batch),
                                        train=True, mutable=mutable)
                 logits, updated = out if mutable else (out, {})
                 loss = self.loss_fn(logits, batch["label"])
@@ -178,6 +178,52 @@ class Trainer:
             self._step_fn = self._build(state)
         return self._step_fn(state, batch)
 
+    # -- fit loop with callbacks ------------------------------------------
+    def fit(self, state: TrainState, data, epochs: int = 1,
+            callbacks: Sequence[Any] = (), steps_per_epoch: int | None = None):
+        """Minimal epoch loop hosting the reference's callback surface
+        (reference: horovod/_keras/callbacks.py): ``data`` is either an
+        iterable of batches (re-iterated per epoch) or a callable
+        ``epoch -> iterable``. Returns (state, history)."""
+        for cb in callbacks:
+            if hasattr(cb, "set_trainer"):
+                cb.set_trainer(self)
+            if hasattr(cb, "set_state"):
+                cb.set_state(state)
+        history: list[dict] = []
+        for cb in callbacks:
+            cb.on_train_begin()
+        for epoch in range(epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            batches = data(epoch) if callable(data) else data
+            sums: dict[str, Any] = {}
+            count = 0
+            for i, batch in enumerate(batches):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                for cb in callbacks:
+                    cb.on_batch_begin(i)
+                state, metrics = self.step(state, batch)
+                # Keep metrics as device arrays through the epoch: float()
+                # here would sync host↔device every step and serialize the
+                # async dispatch pipeline.
+                for cb in callbacks:
+                    cb.on_batch_end(i, metrics)
+                for k, v in metrics.items():
+                    sums[k] = v if k not in sums else sums[k] + v
+                count += 1
+            epoch_logs = {k: float(v) / max(count, 1)
+                          for k, v in sums.items()}
+            for cb in callbacks:
+                if hasattr(cb, "set_state"):
+                    cb.set_state(state)
+                cb.on_epoch_end(epoch, epoch_logs)
+            history.append(epoch_logs)
+        for cb in callbacks:
+            cb.on_train_end()
+        return state, history
+
     # -- evaluation --------------------------------------------------------
     def eval_step(self, state: TrainState, batch: dict):
         @partial(jax.jit, static_argnums=())
@@ -185,7 +231,7 @@ class Trainer:
             variables = {"params": state.params}
             if state.batch_stats:
                 variables["batch_stats"] = state.batch_stats
-            logits = self.model.apply(variables, batch["image"],
+            logits = self.model.apply(variables, _model_input(batch),
                                       train=False)
             loss = self.loss_fn(logits, batch["label"])
             acc = jnp.mean((jnp.argmax(logits, -1)
@@ -209,6 +255,20 @@ def _opt_state_specs(tx: optax.GradientTransformation, params: Any,
         return by_shape.get(getattr(leaf, "shape", ()), P())
 
     return jax.tree_util.tree_map(spec_for, shapes)
+
+
+def _model_input(batch: dict):
+    """The model's input tensor: "image" for vision batches, "input" for
+    token batches."""
+    return batch["image"] if "image" in batch else batch["input"]
+
+
+def synthetic_text_batch(batch_size: int, seq_len: int = 2048,
+                         vocab_size: int = 32000, seed: int = 0) -> dict:
+    """Random next-token-prediction batch: label[t] = input[t+1]."""
+    tokens = jax.random.randint(jax.random.key(seed),
+                                (batch_size, seq_len + 1), 0, vocab_size)
+    return {"input": tokens[:, :-1], "label": tokens[:, 1:]}
 
 
 def synthetic_image_batch(batch_size: int, image_size: int = 224,

@@ -1,0 +1,140 @@
+"""Callbacks, checkpointing, and the Trainer.fit loop
+(reference surface: horovod/keras/callbacks.py, horovod/_keras/elastic.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu import callbacks as cb
+from horovod_tpu import checkpoint, training
+from horovod_tpu.models.transformer import TransformerLM, gpt_tiny
+from horovod_tpu.parallel import GradSyncConfig, MeshSpec, build_mesh
+
+
+class _FakeOpt:
+    def __init__(self, lr):
+        self.lr = lr
+
+
+class TestLearningRateCallbacks:
+    def test_schedule_staircase(self):
+        opt = _FakeOpt(0.1)
+        sched = cb.LearningRateScheduleCallback(
+            opt, multiplier=lambda e: 0.1 ** e, start_epoch=0)
+        sched.on_epoch_begin(0)
+        assert opt.lr == pytest.approx(0.1)
+        sched.on_epoch_begin(2)
+        assert opt.lr == pytest.approx(0.1 * 0.01)
+
+    def test_schedule_respects_range(self):
+        opt = _FakeOpt(0.1)
+        sched = cb.LearningRateScheduleCallback(
+            opt, multiplier=2.0, start_epoch=2, end_epoch=4)
+        sched.on_epoch_begin(0)
+        assert opt.lr == pytest.approx(0.1)      # before start: untouched
+        sched.on_epoch_begin(3)
+        assert opt.lr == pytest.approx(0.2)
+        sched.on_epoch_begin(5)
+        assert opt.lr == pytest.approx(0.2)      # after end: frozen
+
+    def test_warmup_ramps_to_size(self):
+        opt = _FakeOpt(0.1)
+        warm = cb.LearningRateWarmupCallback(opt, warmup_epochs=5,
+                                             steps_per_epoch=10, size=8)
+        warm.on_epoch_begin(0)
+        warm.on_batch_begin(0)
+        assert opt.lr == pytest.approx(0.1)      # start: base lr
+        warm.current_epoch = 4
+        warm.on_batch_begin(9)
+        # end of warmup: ~size * base lr
+        assert opt.lr == pytest.approx(0.8, rel=0.05)
+
+    def test_torch_param_groups(self):
+        torch = pytest.importorskip("torch")
+        model = torch.nn.Linear(4, 4)
+        opt = torch.optim.SGD(model.parameters(), lr=0.5)
+        sched = cb.LearningRateScheduleCallback(opt, multiplier=0.1)
+        sched.on_epoch_begin(0)
+        assert opt.param_groups[0]["lr"] == pytest.approx(0.05)
+
+
+class TestMetricAverage:
+    def test_single_process_noop(self):
+        import horovod_tpu as hvd
+        hvd.init()
+        try:
+            logs = {"loss": 1.5, "name": "x"}
+            cb.MetricAverageCallback().on_epoch_end(0, logs)
+            assert logs["loss"] == 1.5
+        finally:
+            hvd.shutdown()
+
+
+class TestFitLoop:
+    def _setup(self):
+        mesh = build_mesh(MeshSpec(dp=8))
+        model = TransformerLM(gpt_tiny(dtype=jnp.float32))
+        trainer = training.Trainer(
+            model, optax.adamw(1e-3), mesh,
+            sync=GradSyncConfig(axes=("dp",), op="average"))
+        batch = training.synthetic_text_batch(8, seq_len=16, vocab_size=256)
+        state = trainer.init(jax.random.key(0), batch)
+        return trainer, state, batch
+
+    def test_fit_runs_callbacks_and_improves(self):
+        trainer, state, batch = self._setup()
+        events = []
+
+        class Recorder(cb.Callback):
+            def on_epoch_begin(self, epoch, logs=None):
+                events.append(("eb", epoch))
+
+            def on_epoch_end(self, epoch, logs=None):
+                events.append(("ee", epoch, logs["loss"]))
+
+            def on_batch_end(self, batch_i, logs=None):
+                events.append(("b", batch_i))
+
+        state, history = trainer.fit(state, [batch, batch], epochs=2,
+                                     callbacks=[Recorder()])
+        assert len(history) == 2
+        assert history[1]["loss"] < history[0]["loss"]
+        assert ("eb", 0) in events and ("eb", 1) in events
+        assert sum(1 for e in events if e[0] == "b") == 4
+
+    def test_best_model_checkpoint(self, tmp_path):
+        trainer, state, batch = self._setup()
+        saved = []
+        best = cb.BestModelCheckpoint(
+            str(tmp_path / "ckpt-{epoch}"), monitor="loss",
+            save_fn=lambda path, st: saved.append(path))
+        state, history = trainer.fit(state, [batch], epochs=2,
+                                     callbacks=[best])
+        # Loss improves each epoch → both saved.
+        assert len(saved) == 2
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        tree = {"w": jnp.arange(12.0).reshape(3, 4),
+                "step": jnp.int32(7)}
+        path = str(tmp_path / "ck")
+        checkpoint.save_checkpoint(path, tree)
+        restored = checkpoint.restore_checkpoint(path)
+        np.testing.assert_allclose(np.asarray(restored["w"]),
+                                   np.asarray(tree["w"]))
+        assert int(restored["step"]) == 7
+
+    def test_latest_checkpoint(self, tmp_path):
+        import os
+        import time
+        a, b = tmp_path / "1", tmp_path / "2"
+        a.mkdir()
+        time.sleep(0.01)
+        b.mkdir()
+        os.utime(b)
+        assert checkpoint.latest_checkpoint(str(tmp_path)).endswith("2")
+        assert checkpoint.latest_checkpoint(str(tmp_path / "nope")) is None

@@ -1,0 +1,84 @@
+"""Data-loader tests: sharding, async prefetch, device prefetch."""
+from __future__ import annotations
+
+import numpy as np
+
+from horovod_tpu.data import (AsyncDataLoaderMixin, ShardedBatchLoader,
+                              prefetch_to_device)
+
+
+def _dataset(n=32):
+    return {"image": np.arange(n * 4, dtype=np.float32).reshape(n, 4),
+            "label": np.arange(n, dtype=np.int32)}
+
+
+class TestShardedBatchLoader:
+    def test_batches_cover_dataset(self):
+        loader = ShardedBatchLoader(_dataset(), batch_size=8, shuffle=False)
+        batches = list(loader)
+        assert len(batches) == len(loader) == 4
+        seen = np.concatenate([b["label"] for b in batches])
+        np.testing.assert_array_equal(np.sort(seen), np.arange(32))
+
+    def test_rank_sharding_is_disjoint_and_complete(self):
+        loaders = [ShardedBatchLoader(_dataset(), batch_size=4,
+                                      shuffle=True, seed=7, rank=r,
+                                      num_replicas=2) for r in range(2)]
+        seen = [np.concatenate([b["label"] for b in ld]) for ld in loaders]
+        assert set(seen[0]) & set(seen[1]) == set()
+        assert set(seen[0]) | set(seen[1]) == set(range(32))
+
+    def test_epoch_changes_shuffle(self):
+        loader = ShardedBatchLoader(_dataset(), batch_size=32, seed=1)
+        first = next(iter(loader))["label"].copy()
+        loader.set_epoch(1)
+        second = next(iter(loader))["label"]
+        assert not np.array_equal(first, second)
+
+    def test_drop_last(self):
+        loader = ShardedBatchLoader(_dataset(30), batch_size=8,
+                                    shuffle=False, drop_last=True)
+        assert len(loader) == 3
+        assert sum(1 for _ in loader) == 3
+
+
+class TestAsyncPrefetch:
+    def test_same_batches_as_sync(self):
+        class AsyncLoader(AsyncDataLoaderMixin, ShardedBatchLoader):
+            pass
+
+        sync = ShardedBatchLoader(_dataset(), batch_size=8, shuffle=False)
+        async_ = AsyncLoader(_dataset(), batch_size=8, shuffle=False,
+                             async_loader_queue_size=2)
+        for a, b in zip(sync, async_):
+            np.testing.assert_array_equal(a["label"], b["label"])
+
+    def test_producer_error_propagates(self, monkeypatch):
+        import pytest
+
+        class AsyncLoader(AsyncDataLoaderMixin, ShardedBatchLoader):
+            pass
+
+        orig = ShardedBatchLoader._iterate
+
+        def failing(self):
+            yield from orig(self)
+            raise RuntimeError("boom")
+
+        monkeypatch.setattr(ShardedBatchLoader, "_iterate", failing)
+        loader = AsyncLoader(_dataset(4), batch_size=2, shuffle=False,
+                             async_loader_queue_size=2)
+        with pytest.raises(RuntimeError, match="boom"):
+            list(loader)
+
+
+def test_prefetch_to_device_roundtrip():
+    import jax
+
+    loader = ShardedBatchLoader(_dataset(), batch_size=8, shuffle=False)
+    batches = list(prefetch_to_device(loader, size=2))
+    assert len(batches) == 4
+    assert all(isinstance(b["image"], jax.Array) for b in batches)
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(b["label"]) for b in batches]),
+        np.arange(32))

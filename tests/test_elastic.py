@@ -134,6 +134,27 @@ class TestWorkerStateRegistry:
         reg.record_ready("a", 0)
         assert reg.count(FAILURE) == 1
 
+    def test_stale_slot_records_ignored(self):
+        """A record from a slot outside the current round's assignment must
+        not count toward the barrier (e.g. a long-dead worker on a host
+        removed rounds ago finally exiting)."""
+        driver, _, reg = self._registry(2)
+        reg.reset(2, expected_slots=["a[0]", "a[1]"])
+        reg.record_failure("zombie", 0)
+        reg.record_ready("a", 0)
+        assert driver.resumed == 0         # only 1/2 expected recorded
+        reg.record_ready("a", 1)
+        assert driver.resumed == 1
+
+    def test_ready_bound_to_round(self):
+        """A READY targeting an already-resolved round is dropped instead
+        of leaking into the next round's barrier."""
+        driver, _, reg = self._registry(2)
+        current = reg.rendezvous_id
+        reg.reset(2)                        # round advances concurrently
+        reg.record_ready("a", 0, round_id=current)
+        assert reg.count(READY) == 0
+
     def test_reset_limit(self):
         driver, _, reg = self._registry(2, reset_limit=1)
         reg.record_failure("a", 0)

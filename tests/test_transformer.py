@@ -1,0 +1,107 @@
+"""Transformer LM family: attention-impl equivalence and SPMD training
+over dp x sp meshes (long-context path end to end)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu import training
+from horovod_tpu.models.transformer import TransformerLM, gpt_tiny
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel import GradSyncConfig, MeshSpec, build_mesh
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    return jax.random.randint(jax.random.key(1), (4, 32), 0, 256)
+
+
+@pytest.fixture(scope="module")
+def dense_params(tokens):
+    return TransformerLM(gpt_tiny(dtype=jnp.float32)).init(
+        jax.random.key(0), tokens)
+
+
+class TestAttentionImpls:
+    def test_ring_matches_dense(self, tokens, dense_params):
+        mesh = build_mesh(MeshSpec(dp=2, sp=4))
+        ref = TransformerLM(gpt_tiny(dtype=jnp.float32)).apply(
+            dense_params, tokens)
+        out = TransformerLM(
+            gpt_tiny(dtype=jnp.float32, attention="ring", mesh=mesh,
+                     batch_spec="dp")).apply(dense_params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4)
+
+    def test_ulysses_matches_dense(self, tokens, dense_params):
+        mesh = build_mesh(MeshSpec(dp=2, sp=4))
+        ref = TransformerLM(gpt_tiny(dtype=jnp.float32)).apply(
+            dense_params, tokens)
+        out = TransformerLM(
+            gpt_tiny(dtype=jnp.float32, attention="ulysses", mesh=mesh,
+                     batch_spec="dp")).apply(dense_params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4)
+
+    def test_flash_matches_dense(self, tokens, dense_params):
+        ref = TransformerLM(gpt_tiny(dtype=jnp.float32)).apply(
+            dense_params, tokens)
+        out = TransformerLM(
+            gpt_tiny(dtype=jnp.float32, attention="flash", block_q=16,
+                     block_k=16, flash_interpret=True)).apply(
+            dense_params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4)
+
+
+class TestTraining:
+    def _train(self, cfg, mesh, steps=3, axes=("dp",), batch_spec=None):
+        model = TransformerLM(cfg)
+        trainer = training.Trainer(
+            model, optax.adamw(1e-3), mesh,
+            sync=GradSyncConfig(axes=axes, op="average"),
+            batch_spec=batch_spec)
+        batch = training.synthetic_text_batch(8, seq_len=32, vocab_size=256)
+        state = trainer.init(jax.random.key(0), batch)
+        losses = []
+        for _ in range(steps):
+            state, metrics = trainer.step(state, batch)
+            losses.append(float(metrics["loss"]))
+        return losses
+
+    def test_dense_lm_trains(self):
+        mesh = build_mesh(MeshSpec(dp=8))
+        losses = self._train(gpt_tiny(dtype=jnp.float32), mesh)
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
+    def test_ring_sp_lm_trains(self):
+        """Full SPMD train step with ring attention inside the jitted step:
+        dp manual-mapped by the Trainer, sp manual-mapped by the model's
+        nested shard_map."""
+        mesh = build_mesh(MeshSpec(dp=2, sp=4))
+        cfg = gpt_tiny(dtype=jnp.float32, attention="ring", mesh=mesh)
+        losses = self._train(cfg, mesh, axes=("dp", "sp"),
+                             batch_spec=P("dp", "sp"))
+        assert losses[-1] < losses[0]
+
+    def test_ring_equals_dense_training(self):
+        """One optimizer step with ring attention produces the same loss
+        trajectory as dense attention."""
+        mesh_d = build_mesh(MeshSpec(dp=8))
+        mesh_r = build_mesh(MeshSpec(dp=2, sp=4))
+        dense = self._train(gpt_tiny(dtype=jnp.float32), mesh_d, steps=2)
+        ring = self._train(
+            gpt_tiny(dtype=jnp.float32, attention="ring", mesh=mesh_r),
+            mesh_r, steps=2, axes=("dp", "sp"),
+            batch_spec=P("dp", "sp"))
+        np.testing.assert_allclose(ring, dense, rtol=2e-4)
+
+    def test_remat_lm_trains(self):
+        mesh = build_mesh(MeshSpec(dp=8))
+        losses = self._train(gpt_tiny(dtype=jnp.float32, remat=True), mesh)
+        assert losses[-1] < losses[0]
