@@ -65,7 +65,7 @@ def main() -> int:
         sync=GradSyncConfig(axes=("dp",), op="average", compression=wire))
     state = trainer.init(jax.random.key(0), batch)
 
-    for _ in range(args.num_warmup):
+    for _ in range(max(args.num_warmup, 1)):  # >=1 keeps compile untimed
         state, metrics = trainer.step(state, batch)
     jax.block_until_ready(metrics)
 

@@ -28,6 +28,15 @@ from .core import (Handle, init, is_initialized, shutdown, rank, size,
                    local_rank, local_size, cross_rank, cross_size,
                    is_homogeneous, start_timeline, stop_timeline)
 
+
+def run(func, args=(), kwargs=None, np=None, hosts=None, env=None,
+        use_gloo=True, start_timeout=120.0):
+    """Programmatic N-worker launch of a function
+    (reference: horovod/runner/__init__.py:92-210 horovod.run)."""
+    from .runner.run_api import run as _run
+    return _run(func, args=args, kwargs=kwargs, np=np, hosts=hosts,
+                env=env, use_gloo=use_gloo, start_timeout=start_timeout)
+
 __version__ = "0.1.0"
 
 

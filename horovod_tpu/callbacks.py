@@ -116,7 +116,9 @@ class LearningRateScheduleCallback(Callback):
 
     def on_epoch_begin(self, epoch: int, logs: dict | None = None) -> None:
         self.current_epoch = epoch
-        if self.staircase:
+        # Smooth schedules without steps_per_epoch still adjust at epoch
+        # granularity — a schedule must never silently no-op.
+        if self.staircase or not self.steps_per_epoch:
             self._adjust(epoch)
 
     def on_batch_begin(self, batch: int, logs: dict | None = None) -> None:

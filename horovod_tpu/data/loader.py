@@ -121,7 +121,10 @@ class ShardedBatchLoader(BaseDataLoader):
         self.epoch = epoch
 
     def __len__(self) -> int:
-        per_rank = self.n // self.num_replicas
+        # Strided shard size: rank r gets ceil((n - r) / num_replicas)
+        # elements — must agree exactly with _iterate's idx[rank::replicas].
+        per_rank = (self.n - self.rank + self.num_replicas - 1) \
+            // self.num_replicas
         if self.drop_last:
             return per_rank // self.batch_size
         return (per_rank + self.batch_size - 1) // self.batch_size

@@ -1,0 +1,59 @@
+"""Keras binding (reference: horovod/keras/__init__.py + callbacks.py).
+
+Gated on tensorflow/keras being importable.  The callback surface
+(`MetricAverageCallback`, `LearningRateWarmupCallback`,
+`BestModelCheckpoint`, …) is shared with the framework-neutral
+implementations in :mod:`horovod_tpu.callbacks`, which also serve the JAX
+Trainer fit loop.
+"""
+from __future__ import annotations
+
+from .. import init, is_initialized, join, local_rank, local_size, rank, \
+    shutdown, size  # noqa: F401  (reference surface re-exports)
+from ..callbacks import (BestModelCheckpoint, LearningRateScheduleCallback,
+                         LearningRateWarmupCallback, MetricAverageCallback)
+
+__all__ = ["init", "shutdown", "rank", "size", "local_rank", "local_size",
+           "join", "is_initialized", "DistributedOptimizer",
+           "MetricAverageCallback", "LearningRateWarmupCallback",
+           "LearningRateScheduleCallback", "BestModelCheckpoint",
+           "broadcast_global_variables"]
+
+
+def _require_keras():
+    try:
+        import tensorflow as tf  # noqa: F401
+        return tf.keras
+    except ImportError as exc:
+        raise ImportError(
+            "horovod_tpu.keras requires tensorflow/keras, which is not "
+            "installed in this environment. Use horovod_tpu.callbacks with "
+            "the JAX Trainer, or horovod_tpu.torch for PyTorch.") from exc
+
+
+def DistributedOptimizer(optimizer, name: str | None = None, **kwargs):
+    """Wrap a keras optimizer so apply_gradients allreduces first
+    (reference: keras/__init__.py DistributedOptimizer)."""
+    keras = _require_keras()
+    from ..tensorflow import allreduce
+
+    base = optimizer.__class__
+
+    class _Distributed(base):
+        def apply_gradients(self, grads_and_vars, **apply_kwargs):
+            grads_and_vars = [
+                (g if g is None else allreduce(g, name=f"grad.{i}"), v)
+                for i, (g, v) in enumerate(grads_and_vars)]
+            return super().apply_gradients(grads_and_vars, **apply_kwargs)
+
+    cfg = optimizer.get_config()
+    dist = _Distributed(**cfg)
+    del keras
+    return dist
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    _require_keras()
+    import tensorflow as tf
+    from ..tensorflow import broadcast_variables
+    broadcast_variables(tf.compat.v1.global_variables(), root_rank)

@@ -1,0 +1,43 @@
+"""MXNet binding surface (reference: horovod/mxnet/__init__.py).
+
+MXNet reached end-of-life upstream and is not part of this image; the
+module exists so reference imports fail with actionable guidance instead of
+a bare ModuleNotFoundError.  The collective semantics MXNet users need
+(DistributedOptimizer-style gradient averaging) are available through
+:mod:`horovod_tpu.torch` or the JAX Trainer.
+"""
+from __future__ import annotations
+
+from .. import init, is_initialized, local_rank, local_size, rank, \
+    shutdown, size  # noqa: F401
+
+__all__ = ["init", "shutdown", "rank", "size", "local_rank", "local_size",
+           "is_initialized", "DistributedOptimizer", "DistributedTrainer",
+           "broadcast_parameters"]
+
+_MSG = ("horovod_tpu.mxnet requires mxnet, which is end-of-life and not "
+        "installed in this environment. Use horovod_tpu.torch "
+        "(DistributedOptimizer) or the JAX-native Trainer instead.")
+
+
+def _require_mxnet():
+    try:
+        import mxnet  # noqa: F401
+        return mxnet
+    except ImportError as exc:
+        raise ImportError(_MSG) from exc
+
+
+def DistributedOptimizer(optimizer, *args, **kwargs):
+    _require_mxnet()
+    raise NotImplementedError(_MSG)
+
+
+def DistributedTrainer(params, optimizer, *args, **kwargs):
+    _require_mxnet()
+    raise NotImplementedError(_MSG)
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    _require_mxnet()
+    raise NotImplementedError(_MSG)

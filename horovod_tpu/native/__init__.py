@@ -44,10 +44,27 @@ def _cache_dir() -> str:
     return path
 
 
+def _cpu_tag() -> str:
+    """CPU-generation fingerprint: -march=native code must never be loaded
+    on a different microarchitecture (shared NFS caches across
+    heterogeneous hosts would SIGILL otherwise)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("model name", "flags")):
+                    return hashlib.sha256(
+                        line.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    import platform
+    return hashlib.sha256(platform.processor().encode()).hexdigest()[:8]
+
+
 def _build() -> str | None:
     with open(_SRC, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    so_path = os.path.join(_cache_dir(), f"hvd_native_{digest}.so")
+    so_path = os.path.join(_cache_dir(),
+                           f"hvd_native_{digest}_{_cpu_tag()}.so")
     if os.path.exists(so_path):
         return so_path
     tmp = tempfile.mktemp(suffix=".so", dir=_cache_dir())

@@ -1,0 +1,109 @@
+"""Ray integration (reference: horovod/ray/runner.py RayExecutor).
+
+Gated on ray being importable.  The executor places one worker actor per
+slot, computes the same HOROVOD_RANK/LOCAL_RANK/CROSS_RANK env contract as
+the CLI launcher from actor hostnames, starts an in-driver rendezvous
+server, and runs the user function on every actor.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable
+
+from ..runner.hosts import HostInfo, get_host_assignments
+
+__all__ = ["RayExecutor"]
+
+
+def _require_ray():
+    try:
+        import ray
+        return ray
+    except ImportError as exc:
+        raise ImportError(
+            "horovod_tpu.ray requires ray, which is not installed in this "
+            "environment. Use horovod_tpu.run() or the horovodrun-tpu CLI "
+            "for local/ssh launches.") from exc
+
+
+class RayExecutor:
+    """Run a function on a Ray cluster with the eager runtime initialized
+    (reference: ray/runner.py:41-535)."""
+
+    def __init__(self, num_workers: int, cpus_per_worker: int = 1,
+                 use_gpu: bool = False, settings: Any = None) -> None:
+        _require_ray()
+        self.num_workers = num_workers
+        self.cpus_per_worker = cpus_per_worker
+        self.use_gpu = use_gpu
+        self._workers: list = []
+        self._server = None
+
+    def start(self) -> None:
+        ray = _require_ray()
+
+        @ray.remote
+        class _Worker:
+            def hostname(self):
+                import socket
+                return socket.gethostname()
+
+            def set_env(self, env: dict):
+                import os
+                os.environ.update(env)
+
+            def run(self, fn, args, kwargs):
+                return fn(*args, **kwargs)
+
+        worker_cls = _Worker.options(num_cpus=self.cpus_per_worker,
+                                     num_gpus=1 if self.use_gpu else 0)
+        self._workers = [worker_cls.remote()
+                         for _ in range(self.num_workers)]
+
+        # Coordinator: group actors by host, compute the rank contract
+        # (reference: ray/runner.py Coordinator.establish_rendezvous).
+        hostnames = ray.get([w.hostname.remote() for w in self._workers])
+        by_host: "OrderedDict[str, int]" = OrderedDict()
+        for h in hostnames:
+            by_host[h] = by_host.get(h, 0) + 1
+        hosts = [HostInfo(hostname=h, slots=n) for h, n in by_host.items()]
+        slots = get_host_assignments(hosts, self.num_workers)
+
+        from ..runner.network import RendezvousServer
+        import socket as pysocket
+        self._server = RendezvousServer()
+        port = self._server.start()
+        addr = pysocket.getfqdn()
+
+        # Pair actors (in hostname order) with slots (host-major order).
+        pool: dict[str, list[int]] = {}
+        for idx, h in enumerate(hostnames):
+            pool.setdefault(h, []).append(idx)
+        envs: list[dict] = [{} for _ in self._workers]
+        for slot in slots:
+            actor_idx = pool[slot.hostname].pop(0)
+            env = slot.to_env()
+            env.update({
+                "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
+                "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
+                "HOROVOD_CONTROLLER": "tcp",
+            })
+            envs[actor_idx] = env
+        ray.get([w.set_env.remote(envs[i])
+                 for i, w in enumerate(self._workers)])
+
+    def run(self, fn: Callable, args: tuple = (), kwargs: dict | None = None
+            ) -> list:
+        ray = _require_ray()
+        kwargs = kwargs or {}
+        return ray.get([w.run.remote(fn, args, kwargs)
+                        for w in self._workers])
+
+    def shutdown(self) -> None:
+        ray = _require_ray()
+        for w in self._workers:
+            ray.kill(w)
+        self._workers = []
+        if self._server is not None:
+            self._server.stop()
+            self._server = None

@@ -1,0 +1,113 @@
+"""TensorFlow binding (reference: horovod/tensorflow/__init__.py).
+
+TensorFlow is optional; when it is importable this module exposes the
+Horovod-compatible TF surface over the shared eager runtime: collectives on
+TF tensors (via numpy interop), ``DistributedGradientTape``, and
+``broadcast_variables``.  The native TPU path for new code is the JAX SPMD
+Trainer — this binding exists so reference TF scripts keep a migration
+path.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+try:
+    import tensorflow as tf  # noqa: F401
+    _TF_AVAILABLE = True
+except ImportError:
+    _TF_AVAILABLE = False
+
+from .. import (Adasum, Average, Sum, allgather as _allgather_np,
+                allreduce as _allreduce_np, alltoall as _alltoall_np,
+                broadcast as _broadcast_np, broadcast_object, init,
+                is_initialized, join, local_rank, local_size, rank,
+                shutdown, size)
+
+__all__ = ["init", "shutdown", "rank", "size", "local_rank", "local_size",
+           "allreduce", "allgather", "broadcast", "alltoall", "join",
+           "broadcast_object", "broadcast_variables",
+           "DistributedGradientTape", "Average", "Sum", "Adasum",
+           "is_initialized"]
+
+
+def _require_tf() -> None:
+    if not _TF_AVAILABLE:
+        raise ImportError(
+            "horovod_tpu.tensorflow requires tensorflow, which is not "
+            "installed in this environment. The JAX-native path "
+            "(horovod_tpu.training.Trainer) is the supported TPU surface.")
+
+
+def _to_tf(value, like):
+    import tensorflow as tf
+    return tf.convert_to_tensor(value, dtype=like.dtype)
+
+
+def allreduce(tensor, average: bool | None = None, op=None,
+              name: str | None = None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0):
+    _require_tf()
+    out = _allreduce_np(tensor.numpy(), average=average, op=op, name=name,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor)
+    return _to_tf(out, tensor)
+
+
+def allgather(tensor, name: str | None = None):
+    _require_tf()
+    return _to_tf(_allgather_np(tensor.numpy(), name=name), tensor)
+
+
+def broadcast(tensor, root_rank: int = 0, name: str | None = None):
+    _require_tf()
+    return _to_tf(_broadcast_np(tensor.numpy(), root_rank, name=name),
+                  tensor)
+
+
+def alltoall(tensor, splits=None, name: str | None = None):
+    _require_tf()
+    result = _alltoall_np(tensor.numpy(),
+                          None if splits is None else splits.numpy(),
+                          name=name)
+    if splits is None:
+        return _to_tf(result, tensor)
+    out, recv_splits = result
+    import tensorflow as tf
+    return _to_tf(out, tensor), tf.convert_to_tensor(recv_splits)
+
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Assign every variable its root-rank value
+    (reference: tensorflow/__init__.py broadcast_global_variables)."""
+    _require_tf()
+    for i, var in enumerate(variables):
+        var.assign(_to_tf(_broadcast_np(var.numpy(), root_rank,
+                                        name=f"bcast_var.{i}"), var))
+
+
+class DistributedGradientTape:
+    """Wrap tf.GradientTape so gradient() allreduces the grads
+    (reference: tensorflow/__init__.py:726-816)."""
+
+    def __init__(self, tape, op=None, prescale_factor: float = 1.0,
+                 postscale_factor: float = 1.0) -> None:
+        _require_tf()
+        self._tape = tape
+        self._op = op
+        self._pre = prescale_factor
+        self._post = postscale_factor
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        # tf returns a single gradient for a single (non-sequence) source.
+        single = not isinstance(grads, (list, tuple))
+        grad_list = [grads] if single else grads
+        reduced = [None if g is None else
+                   allreduce(g, op=self._op, name=f"grad.{i}",
+                             prescale_factor=self._pre,
+                             postscale_factor=self._post)
+                   for i, g in enumerate(grad_list)]
+        return reduced[0] if single else reduced

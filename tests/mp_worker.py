@@ -221,6 +221,36 @@ def battery_torch(hvd, rank, size):
                                                   gathered[0].numpy())
 
 
+def battery_tensorflow(hvd, rank, size):
+    """TF binding semantics across ranks (reference: test/parallel/
+    test_tensorflow.py core cases): allreduce, broadcast_variables, and
+    DistributedGradientTape gradient averaging."""
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as htf
+
+    x = tf.constant(np.arange(8, dtype=np.float32) * (rank + 1))
+    out = htf.allreduce(x, average=False, name="tf_ar")
+    expected = np.arange(8, dtype=np.float32) * sum(
+        r + 1 for r in range(size))
+    np.testing.assert_allclose(out.numpy(), expected, rtol=1e-6)
+
+    v = tf.Variable(np.full(4, float(rank), np.float32))
+    htf.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), np.zeros(4))
+
+    w = tf.Variable([float(rank + 1)])
+    with tf.GradientTape() as tape:
+        loss = w * w
+    dtape = htf.DistributedGradientTape(tape)
+    (g,) = dtape.gradient(loss, [w])
+    expected_grad = np.mean([2.0 * (r + 1) for r in range(size)])
+    np.testing.assert_allclose(g.numpy(), [expected_grad], rtol=1e-6)
+
+    gathered = htf.allgather(tf.constant([float(rank)]), name="tf_ag")
+    np.testing.assert_allclose(gathered.numpy(),
+                               np.arange(size, dtype=np.float32))
+
+
 def battery_syncbn(hvd, rank, size):
     """SyncBatchNorm forward/backward == single-process BN on the full
     batch (reference: torch/sync_batch_norm.py semantics)."""
@@ -266,6 +296,7 @@ BATTERIES = {
     "adasum": battery_adasum,
     "torch": battery_torch,
     "syncbn": battery_syncbn,
+    "tensorflow": battery_tensorflow,
 }
 
 

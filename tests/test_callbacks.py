@@ -138,3 +138,21 @@ class TestCheckpoint:
         os.utime(b)
         assert checkpoint.latest_checkpoint(str(tmp_path)).endswith("2")
         assert checkpoint.latest_checkpoint(str(tmp_path / "nope")) is None
+
+
+def test_warmup_adjusts_without_steps_per_epoch():
+    """Regression: warmup must never silently no-op when steps_per_epoch
+    is unknown — it falls back to epoch-granular adjustment."""
+    from horovod_tpu import callbacks as cb
+
+    class _Opt:
+        lr = 0.1
+
+    opt = _Opt()
+    warm = cb.LearningRateWarmupCallback(opt, warmup_epochs=4, size=8)
+    warm.on_epoch_begin(2)
+    # halfway through warmup: 1 + (2/4)*(8-1) = 4.5x
+    assert opt.lr == pytest.approx(0.45)
+    warm.on_epoch_begin(4)
+    warm.on_epoch_begin(10)   # past warmup end: frozen at last value
+    assert opt.lr == pytest.approx(0.45)

@@ -82,3 +82,16 @@ def test_prefetch_to_device_roundtrip():
     np.testing.assert_array_equal(
         np.concatenate([np.asarray(b["label"]) for b in batches]),
         np.arange(32))
+
+
+def test_len_matches_iteration_with_uneven_shards():
+    """Regression: __len__ must count the strided shard exactly."""
+    for n, replicas, bs, drop in [(33, 2, 16, False), (33, 2, 16, True),
+                                  (30, 4, 4, False), (31, 3, 5, True)]:
+        data = {"label": np.arange(n)}
+        for rank in range(replicas):
+            loader = ShardedBatchLoader(data, batch_size=bs, shuffle=False,
+                                        drop_last=drop, rank=rank,
+                                        num_replicas=replicas)
+            assert len(loader) == sum(1 for _ in loader), \
+                (n, replicas, bs, drop, rank)

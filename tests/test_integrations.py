@@ -1,0 +1,156 @@
+"""Programmatic run API, mpirun command builder, and the gated framework
+integration surfaces (tensorflow/keras/mxnet/spark/ray)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from horovod_tpu.runner import mpi_run
+
+
+# ---------------------------------------------------------------------------
+# horovod_tpu.run()
+# ---------------------------------------------------------------------------
+def _allreduce_fn(scale):
+    import numpy as np
+
+    import horovod_tpu as hvd
+    hvd.init()
+    out = hvd.allreduce(np.ones(8, np.float32) * scale, average=False,
+                        name="r")
+    result = (hvd.rank(), hvd.size(), float(out[0]))
+    hvd.shutdown()
+    return result
+
+
+def _failing_fn():
+    import horovod_tpu as hvd
+    hvd.init()
+    if hvd.rank() == 1:
+        raise RuntimeError("intentional worker failure")
+    hvd.shutdown()
+    return "ok"
+
+
+class TestRunApi:
+    def test_run_collects_rank_ordered_results(self):
+        import horovod_tpu as hvd
+        results = hvd.run(_allreduce_fn, args=(3.0,), np=2)
+        assert [r[0] for r in results] == [0, 1]
+        assert all(r[1] == 2 for r in results)
+        assert all(r[2] == 6.0 for r in results)
+
+    def test_run_surfaces_worker_failure(self):
+        import horovod_tpu as hvd
+        with pytest.raises(RuntimeError, match="intentional worker"):
+            hvd.run(_failing_fn, np=2)
+
+    def test_run_rejects_remote_hosts(self):
+        import horovod_tpu as hvd
+        with pytest.raises(NotImplementedError):
+            hvd.run(_allreduce_fn, args=(1.0,), np=2, hosts="remote-a:2")
+
+
+# ---------------------------------------------------------------------------
+# mpi_run
+# ---------------------------------------------------------------------------
+class TestMpiRun:
+    @pytest.mark.parametrize("text,expected", [
+        ("mpirun (Open MPI) 4.1.4", "openmpi"),
+        ("IBM Spectrum MPI 10.3", "spectrum"),
+        ("HYDRA build details:", "mpich"),
+        ("Intel(R) MPI Library 2021", "intel"),
+        ("something else", "unknown"),
+    ])
+    def test_flavor_detection(self, text, expected):
+        assert mpi_run.flavor(version_text=text) == expected
+
+    def test_openmpi_command(self):
+        env = {"HOROVOD_FUSION_THRESHOLD": "1024", "PATH": "/usr/bin",
+               "SECRET": "x"}
+        cmd = mpi_run.build_mpi_command(
+            ["python", "train.py"], np=8, hosts="h1:4,h2:4", env=env,
+            mpi_flavor="openmpi", ssh_port=2222)
+        joined = " ".join(cmd)
+        assert joined.startswith("mpirun")
+        assert "-np 8" in joined
+        assert "-H h1:4,h2:4" in joined
+        assert "-bind-to none -map-by slot" in joined
+        assert "-x HOROVOD_FUSION_THRESHOLD" in joined
+        assert "-x PATH" in joined
+        assert "-x SECRET" not in joined
+        assert "plm_rsh_args" in joined and "-p 2222" in joined
+        assert joined.endswith("python train.py")
+
+    def test_mpich_command_uses_genvlist(self):
+        cmd = mpi_run.build_mpi_command(
+            ["python", "t.py"], np=2, env={"HOROVOD_CYCLE_TIME": "5"},
+            mpi_flavor="mpich")
+        joined = " ".join(cmd)
+        assert "-genvlist HOROVOD_CYCLE_TIME" in joined
+        assert "-bind-to" not in joined
+
+    def test_extra_args_appended(self):
+        cmd = mpi_run.build_mpi_command(
+            ["python", "t.py"], np=2, env={}, mpi_flavor="openmpi",
+            extra_mpi_args="--tag-output")
+        assert "--tag-output" in cmd
+
+
+# ---------------------------------------------------------------------------
+# Gated integrations
+# ---------------------------------------------------------------------------
+class TestGatedIntegrations:
+    def test_modules_import_without_deps(self):
+        import horovod_tpu.keras    # noqa: F401
+        import horovod_tpu.mxnet    # noqa: F401
+        import horovod_tpu.ray      # noqa: F401
+        import horovod_tpu.spark    # noqa: F401
+        import horovod_tpu.tensorflow  # noqa: F401
+
+    def test_tensorflow_surface_gated(self):
+        import horovod_tpu.tensorflow as htf
+        if htf._TF_AVAILABLE:
+            pytest.skip("tensorflow installed; gate not applicable")
+        with pytest.raises(ImportError, match="JAX-native"):
+            htf.allreduce(None)
+
+    def test_keras_optimizer_gated(self):
+        import horovod_tpu.keras as hk
+        try:
+            import tensorflow  # noqa: F401
+            pytest.skip("tensorflow installed; gate not applicable")
+        except ImportError:
+            pass
+        with pytest.raises(ImportError, match="callbacks"):
+            hk.DistributedOptimizer(object())
+
+    def test_keras_reexports_callbacks(self):
+        import horovod_tpu.keras as hk
+        from horovod_tpu.callbacks import MetricAverageCallback
+        assert hk.MetricAverageCallback is MetricAverageCallback
+
+    def test_mxnet_gated(self):
+        import horovod_tpu.mxnet as hmx
+        with pytest.raises(ImportError, match="end-of-life"):
+            hmx.DistributedOptimizer(object())
+
+    def test_ray_gated(self):
+        import horovod_tpu.ray as hray
+        try:
+            import ray  # noqa: F401
+            pytest.skip("ray installed; gate not applicable")
+        except ImportError:
+            pass
+        with pytest.raises(ImportError, match="horovodrun-tpu"):
+            hray.RayExecutor(2)
+
+    def test_spark_gated(self):
+        import horovod_tpu.spark as hspark
+        try:
+            import pyspark  # noqa: F401
+            pytest.skip("pyspark installed; gate not applicable")
+        except ImportError:
+            pass
+        with pytest.raises(ImportError, match="horovodrun-tpu"):
+            hspark.run(lambda: None)

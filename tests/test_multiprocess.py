@@ -74,3 +74,8 @@ def test_torch_distributed_optimizer(size):
 
 def test_torch_sync_batch_norm():
     _run_world(2, "syncbn", timeout=120.0)
+
+
+def test_tensorflow_binding():
+    pytest.importorskip("tensorflow")
+    _run_world(2, "tensorflow", timeout=180.0)
