@@ -258,6 +258,26 @@ def broadcast_object(obj: Any, root_rank: int = 0,
     return pickle.loads(payload.tobytes()) if rank() != root_rank else obj
 
 
+def start_profiler(logdir: str) -> None:
+    """Start a device trace (reference analogue: the Horovod Timeline /
+    NVTX ranges, SURVEY §5.1 — on TPU the native tool is the jax profiler;
+    view with tensorboard or xprof)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+
+
+def stop_profiler() -> None:
+    import jax
+    jax.profiler.stop_trace()
+
+
+def profiler_annotation(name: str):
+    """Context manager labelling a region in device traces (the NVTX-range
+    analogue, reference: common/nvtx_op_range.h)."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)
+
+
 def allgather_object(obj: Any, name: str | None = None) -> list:
     """Gather one arbitrary picklable object per rank; every rank receives
     the full list ordered by rank (reference: torch/mpi_ops.py

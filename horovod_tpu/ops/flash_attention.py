@@ -406,6 +406,16 @@ def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret,
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def _fit_block(t: int, block: int) -> int:
+    """Largest block <= requested that divides the sequence length (the
+    kernels assume exact tiling; odd lengths degrade granularity instead of
+    failing)."""
+    block = min(block, t)
+    while t % block:
+        block -= 1
+    return block
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, sm_scale: float | None = None,
                     block_q: int = 128, block_k: int = 128,
@@ -415,6 +425,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     b, _, h, _ = q.shape
+    block_q = _fit_block(q.shape[1], block_q)
+    block_k = _fit_block(k.shape[1], block_k)
     out = _flash(_merge_heads(q), _merge_heads(k), _merge_heads(v),
                  float(sm_scale), bool(causal), int(block_q), int(block_k),
                  bool(interpret))
@@ -433,6 +445,8 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     b, _, h, _ = q.shape
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    block_q = _fit_block(q.shape[1], block_q)
+    block_k = _fit_block(k.shape[1], block_k)
     qm, km, vm = _merge_heads(q), _merge_heads(k), _merge_heads(v)
     if _on_tpu() or interpret:
         o, lse = _flash_fwd_pallas(qm, km, vm, sm_scale=float(sm_scale),

@@ -39,6 +39,14 @@ class GradSyncConfig:
     op: str = "average"                   # sum | average | adasum
     compression: str | None = None        # fp16 | bf16 | None
     fusion_threshold_bytes: int = 64 * 1024 * 1024
+    # Hierarchical two-stage reduction (reference: HOROVOD_HIERARCHICAL_
+    # ALLREDUCE + NCCLHierarchicalAllreduce, nccl_operations.cc:187-398):
+    # reduce-scatter over the LOCAL (ICI, axes[1:]) leg, allreduce the
+    # shards over the CROSS (DCN, axes[0]) leg, all-gather back over local.
+    # With a flat mesh XLA usually derives this itself; the explicit form
+    # pins the decomposition (and the wire dtype per leg) when profiling
+    # says it matters.
+    hierarchical: bool = False
     # Adasum is applied per-tensor (the reference computes per-layer dot
     # products, adasum.h:38-552); sum/average fuse into buckets.
 
@@ -106,7 +114,10 @@ def sync_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig()
                 if len(members) > 1 else leaves[members[0]].reshape(-1)
             if wire is not None and jnp.issubdtype(dtype, jnp.floating):
                 flat = flat.astype(wire)
-            flat = allreduce(flat, config.axes, config.op)
+            if config.hierarchical and len(config.axes) >= 2:
+                flat = _hierarchical_allreduce(flat, config.axes, config.op)
+            else:
+                flat = allreduce(flat, config.axes, config.op)
             flat = flat.astype(dtype)
             offset = 0
             for i in members:
@@ -114,6 +125,38 @@ def sync_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig()
                 out[i] = flat[offset:offset + n].reshape(leaves[i].shape)
                 offset += n
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _hierarchical_allreduce(flat: jax.Array, axes: Sequence[str],
+                            op: str) -> jax.Array:
+    """reduce_scatter(local) → allreduce(cross) → all_gather(local)
+    (reference: NCCLHierarchicalAllreduce's ReduceScatter → cross-node
+    MPI_Allreduce → AllGather split, nccl_operations.cc:250-372, including
+    its remainder handling via padding)."""
+    from jax import lax
+
+    cross, locals_ = axes[0], tuple(axes[1:])
+    local_size = 1
+    for a in locals_:
+        local_size *= lax.psum(1, a)
+    n = flat.shape[0]
+    pad = (-n) % local_size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    # Sum-scatter over the combined local axes, innermost first.
+    shard = flat
+    for a in locals_:
+        shard = lax.psum_scatter(shard, a, scatter_dimension=0, tiled=True)
+    shard = lax.psum(shard, cross)
+    full = shard
+    for a in reversed(locals_):
+        full = lax.all_gather(full, a, axis=0, tiled=True)
+    if pad:
+        full = full[:n]
+    if op == "average":
+        world = lax.psum(1, cross) * local_size
+        full = full / world
+    return full
 
 
 def build_grad_sync(mesh, config: GradSyncConfig = GradSyncConfig()):

@@ -18,7 +18,8 @@ from .mpi_ops import (allgather, allgather_async, allreduce, allreduce_,
                       alltoall_async, broadcast, broadcast_,
                       broadcast_async, broadcast_async_, grouped_allreduce,
                       grouped_allreduce_, grouped_allreduce_async,
-                      grouped_allreduce_async_, poll, synchronize)
+                      grouped_allreduce_async_, poll, sparse_allreduce,
+                      sparse_allreduce_async, synchronize)
 from .optimizer import DistributedOptimizer
 from .sync_batch_norm import SyncBatchNorm
 

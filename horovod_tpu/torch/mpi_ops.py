@@ -165,3 +165,39 @@ def synchronize(handle: Handle):
 
 def poll(handle: Handle) -> bool:
     return handle.done()
+
+
+# -- sparse gradients --------------------------------------------------------
+def sparse_allreduce_async(tensor, name=None, op=None):
+    """Gather-based sparse reduction (reference: torch/mpi_ops.py:512
+    sparse_allreduce_async): allgather every rank's (indices, values), sum
+    duplicates via sparse coalescing.  Returns a callable handle; resolve
+    with `synchronize`-style `handle()`."""
+    from .. import allgather as _allgather_np, size as _size
+
+    t = tensor.coalesce() if tensor.is_sparse else tensor.to_sparse()
+    t = t.coalesce()
+    indices = t.indices().numpy()
+    values = t.values().numpy()
+    base = name or f"sparse.{id(tensor)}"
+
+    # Variable-first-dim allgather: transpose indices to [nnz, ndim].
+    all_idx = _allgather_np(np.ascontiguousarray(indices.T),
+                            name=f"{base}.idx")
+    all_val = _allgather_np(np.ascontiguousarray(values),
+                            name=f"{base}.val")
+
+    def _resolve():
+        idx = torch.from_numpy(np.ascontiguousarray(np.asarray(all_idx).T))
+        val = torch.from_numpy(np.ascontiguousarray(np.asarray(all_val)))
+        out = torch.sparse_coo_tensor(idx, val, size=t.shape).coalesce()
+        from .. import Average
+        if op is None or op is Average:
+            out = out / _size()
+        return out
+
+    return _resolve
+
+
+def sparse_allreduce(tensor, name=None, op=None):
+    return sparse_allreduce_async(tensor, name=name, op=op)()

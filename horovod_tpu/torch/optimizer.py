@@ -141,8 +141,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if grad.is_sparse:
             if not self._sparse_as_dense:
                 raise ValueError(
-                    "Sparse gradients require sparse_as_dense=True (the "
-                    "TPU data plane reduces dense buffers).")
+                    "Sparse gradients inside grouped allreduce require "
+                    "sparse_as_dense=True; the per-parameter path handles "
+                    "them via gather-based sparse_allreduce.")
             grad = grad.to_dense()
         return grad
 
@@ -159,6 +160,16 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def _allreduce_grad_async(self, p):
         name = self._parameter_names.get(p)
+        if p.grad is not None and p.grad.is_sparse and \
+                not self._sparse_as_dense:
+            # Gather-based sparse reduction (reference: optimizer.py
+            # sparse path → mpi_ops.sparse_allreduce_async); synchronous
+            # by nature, so the result installs immediately and
+            # synchronize() has nothing to wait on.
+            from .mpi_ops import sparse_allreduce
+            p.grad = sparse_allreduce(p.grad, name=f"sparse.{name}",
+                                      op=self.op)
+            return None, None
         tensor_compressed, ctx = self._compression.compress(
             self._grad_for_wire(p))
         prescale, postscale, op = self._scale_factors()

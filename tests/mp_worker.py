@@ -221,6 +221,45 @@ def battery_torch(hvd, rank, size):
                                                   gathered[0].numpy())
 
 
+def battery_sparse(hvd, rank, size):
+    """Gather-based sparse gradient reduction (reference: torch sparse
+    path): embedding-style sparse grads with overlapping indices."""
+    import torch
+    import horovod_tpu.torch as hvt
+
+    # Overlapping rows across ranks: row `rank` and row 0.
+    idx = torch.tensor([[0, rank + 1]])
+    val = torch.ones(2, 4) * (rank + 1)
+    sp = torch.sparse_coo_tensor(idx, val, size=(size + 2, 4))
+    out = hvt.sparse_allreduce(sp, name="sp0", op=hvt.Sum)
+    dense = out.to_dense().numpy()
+    np.testing.assert_allclose(dense[0], np.full(4, sum(
+        r + 1 for r in range(size))))
+    for r in range(size):
+        np.testing.assert_allclose(dense[r + 1], np.full(4, float(r + 1)))
+
+    # End-to-end: DistributedOptimizer with a sparse-grad embedding.
+    torch.manual_seed(3)
+    emb = torch.nn.Embedding(8, 4, sparse=True)
+    opt = hvt.DistributedOptimizer(
+        torch.optim.SGD(emb.parameters(), lr=0.1),
+        named_parameters=emb.named_parameters())
+    hvt.broadcast_parameters(emb.state_dict(), root_rank=0)
+    before = emb.weight.detach().clone()
+    tokens = torch.tensor([rank, rank])
+    loss = emb(tokens).sum()
+    opt.zero_grad()
+    loss.backward()
+    opt.step()
+    after = emb.weight.detach()
+    # Every rank must apply the identical averaged sparse update.
+    gathered = hvd.allgather(after.numpy().reshape(1, -1), name="sp_w")
+    for r in range(size):
+        np.testing.assert_allclose(np.asarray(gathered)[r],
+                                   after.numpy().reshape(-1), rtol=1e-6)
+    assert not torch.allclose(before[rank], after[rank])
+
+
 def battery_tensorflow(hvd, rank, size):
     """TF binding semantics across ranks (reference: test/parallel/
     test_tensorflow.py core cases): allreduce, broadcast_variables, and
@@ -297,6 +336,7 @@ BATTERIES = {
     "torch": battery_torch,
     "syncbn": battery_syncbn,
     "tensorflow": battery_tensorflow,
+    "sparse": battery_sparse,
 }
 
 

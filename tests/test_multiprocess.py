@@ -79,3 +79,7 @@ def test_torch_sync_batch_norm():
 def test_tensorflow_binding():
     pytest.importorskip("tensorflow")
     _run_world(2, "tensorflow", timeout=180.0)
+
+
+def test_sparse_allreduce():
+    _run_world(2, "sparse", timeout=120.0)

@@ -1,0 +1,44 @@
+"""Timeline artifact test (reference: test/parallel/test_timeline.py):
+run a real 2-process world with HOROVOD_TIMELINE set and validate the
+chrome-trace JSON the coordinator writes."""
+from __future__ import annotations
+
+import json
+
+
+def _timeline_fn():
+    import numpy as np
+
+    import horovod_tpu as hvd
+    hvd.init()
+    for step in range(3):
+        hvd.allreduce(np.ones(16, np.float32), name=f"grad_{step}")
+    hvd.allgather(np.ones((2, 2), np.float32), name="gather0")
+    hvd.shutdown()
+    return hvd is not None
+
+
+def test_timeline_writes_chrome_trace(tmp_path):
+    import horovod_tpu as hvd
+
+    path = tmp_path / "timeline.json"
+    results = hvd.run(_timeline_fn, np=2,
+                      env={"HOROVOD_TIMELINE": str(path)})
+    assert all(results)
+
+    events = json.loads(path.read_text())
+    assert isinstance(events, list) and events
+    names = {e.get("name", "") for e in events}
+    # Negotiation phase markers and the op activity must both appear.
+    assert any(n.startswith("NEGOTIATE_") for n in names), names
+    assert "ALLREDUCE" in names
+    assert "ALLGATHER" in names
+    # Begin/End events balance per (pid, tid).
+    opens: dict[tuple, int] = {}
+    for e in events:
+        key = (e.get("pid"), e.get("tid"))
+        if e.get("ph") == "B":
+            opens[key] = opens.get(key, 0) + 1
+        elif e.get("ph") == "E":
+            opens[key] = opens.get(key, 0) - 1
+            assert opens[key] >= 0
