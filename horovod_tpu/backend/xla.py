@@ -1,0 +1,200 @@
+"""XLA/ICI data plane for the eager core — the NCCL-ops analogue.
+
+Reference: horovod/common/ops/nccl_operations.cc:61-184 (lazy communicator
+creation + fused-buffer ncclAllReduce) and operations.cc:143-252 (backend
+priority: NCCL beats MPI beats Gloo; here XLA beats TCP beats Basic).
+
+Design: every Horovod rank is one JAX process in a multi-controller SPMD
+world (formed at init by parallel/multihost.py). The fused flat buffer of
+each rank becomes one row of a global array G of shape (size, n) sharded
+over a 1-D "world" mesh spanning all processes; a cached jitted reduction
+over axis 0 makes XLA emit the all-reduce over ICI/DCN. Because the
+controller guarantees every rank executes identical ResponseLists in
+identical order (SURVEY §5.8), all processes enqueue identical XLA programs
+in identical order — the same property that keeps NCCL deadlock-free.
+
+The compiled-program cache keyed by (op, dtype, size) is the analogue of
+the reference's lazy `ncclCommInitRank` keyed by device map.
+"""
+from __future__ import annotations
+
+import threading
+from functools import partial
+
+import numpy as np
+
+from ..common.message import Response, ResponseType
+from ..common.status import Status
+from ..common.tensor_queue import TensorTableEntry
+from .base import CollectiveBackend
+
+
+class XlaCommunicator:
+    """Lazily-built world mesh + compiled collective cache."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._mesh = None
+        self._cache: dict = {}
+
+    def _world_mesh(self):
+        with self._lock:
+            if self._mesh is None:
+                import jax
+                from jax.sharding import Mesh
+
+                rows = []
+                for p in range(jax.process_count()):
+                    rows.append([d for d in jax.devices()
+                                 if d.process_index == p])
+                counts = {len(r) for r in rows}
+                if len(counts) != 1:
+                    raise RuntimeError(
+                        "uneven local device counts across processes: "
+                        f"{rows}")
+                self._mesh = Mesh(np.array(rows), ("world", "local"))
+            return self._mesh
+
+    # -- allreduce -------------------------------------------------------
+    def _reduce_fn(self, np_dtype: np.dtype, size: int):
+        key = ("allreduce", np_dtype.str, size)
+        with self._lock:
+            fn = self._cache.get(key)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = self._world_mesh()
+            out_sharding = NamedSharding(mesh, P())
+            # 16-bit floats accumulate in fp32 (reference:
+            # collective_operations.h ScaleBuffer fp16 path; also the XLA
+            # CPU backend crashes promoting 16-bit all-reduces). Averaging
+            # rides the response's postscale factor, so sum is the only
+            # reduction.
+            widen = np_dtype.kind == "f" and np_dtype.itemsize <= 2
+
+            @partial(jax.jit, out_shardings=out_sharding,
+                     donate_argnums=(0,))
+            def _reduce(g):
+                acc = g.astype(jnp.float32) if widen else g
+                return jnp.sum(acc, axis=0).astype(g.dtype)
+
+            with self._lock:
+                fn = self._cache.setdefault(key, _reduce)
+        return fn
+
+    def allreduce(self, buf: np.ndarray) -> np.ndarray:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._world_mesh()
+        size = mesh.shape["world"]
+        sharding = NamedSharding(mesh, P("world"))
+        g = jax.make_array_from_process_local_data(
+            sharding, buf[None, :], global_shape=(size, buf.size))
+        out = self._reduce_fn(buf.dtype, size)(g)
+        return np.asarray(out)
+
+    # -- broadcast -------------------------------------------------------
+    def _bcast_fn(self, np_dtype: np.dtype, size: int):
+        key = ("broadcast", np_dtype.str, size)
+        with self._lock:
+            fn = self._cache.get(key)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = self._world_mesh()
+            out_sharding = NamedSharding(mesh, P())
+
+            @partial(jax.jit, out_shardings=out_sharding)
+            def _bcast(g, root):
+                # Masked sum == select the root row, stays shard-friendly
+                # (no data-dependent gather across the world axis).
+                rows = jnp.arange(g.shape[0])[:, None]
+                masked = jnp.where(rows == root, g, jnp.zeros_like(g))
+                return masked.sum(axis=0).astype(g.dtype)
+
+            with self._lock:
+                fn = self._cache.setdefault(key, _bcast)
+        return fn
+
+    def broadcast(self, buf: np.ndarray, root: int) -> np.ndarray:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._world_mesh()
+        size = mesh.shape["world"]
+        sharding = NamedSharding(mesh, P("world"))
+        g = jax.make_array_from_process_local_data(
+            sharding, buf[None, :], global_shape=(size, buf.size))
+        out = self._bcast_fn(buf.dtype, size)(g, np.int32(root))
+        return np.asarray(out)
+
+
+class XlaBackend(CollectiveBackend):
+    """Device data plane: fused allreduce/broadcast via XLA collectives.
+
+    Sits ahead of TcpBackend in the op-manager chain; `enabled()` is the
+    Enabled()-priority contract (reference: operations.cc:143-252) — it
+    claims a response only when the JAX world spans the full Horovod world
+    and the op+dtype are supported, otherwise the response falls through
+    to the TCP ring.
+    """
+
+    name = "xla"
+
+    _SUPPORTED = (ResponseType.ALLREDUCE, ResponseType.BROADCAST)
+
+    def __init__(self, comm: XlaCommunicator, world_size: int) -> None:
+        self.comm = comm
+        self.world_size = world_size
+
+    def enabled(self, response: Response,
+                entries: list[TensorTableEntry]) -> bool:
+        if response.response_type not in self._SUPPORTED:
+            return False
+        try:
+            import jax
+            if jax.process_count() != self.world_size:
+                return False
+        except Exception:  # noqa: BLE001
+            return False
+        from ..common.dtypes import to_numpy
+        np_dtype = np.dtype(to_numpy(response.tensor_type))
+        return np_dtype.kind in "fiu"
+
+    def allreduce(self, response: Response,
+                  entries: list[TensorTableEntry]) -> Status:
+        buf = self.pack_fusion_buffer(response, entries)
+        buf = self.scale_buffer(buf, response.prescale_factor)
+        buf = self.comm.allreduce(np.ascontiguousarray(buf))
+        buf = self.scale_buffer(buf, response.postscale_factor)
+        self.unpack_fusion_buffer(buf, response, entries)
+        return Status.ok()
+
+    def broadcast(self, response: Response,
+                  entries: list[TensorTableEntry]) -> Status:
+        from ..common.dtypes import to_numpy
+        dtype = np.dtype(to_numpy(response.tensor_type))
+        for i, e in enumerate(entries):
+            n = response.tensor_sizes[i] if i < len(response.tensor_sizes) \
+                else int(np.asarray(e.tensor).size)
+            if e.tensor is not None:
+                local = np.ascontiguousarray(
+                    np.asarray(e.tensor, dtype=dtype).reshape(-1))
+                shape = np.asarray(e.tensor).shape
+            else:
+                local = np.zeros(n, dtype=dtype)
+                shape = (n,)
+            out = self.comm.broadcast(local, response.root_rank)
+            e.output = out.reshape(shape)
+        return Status.ok()
+
+    def allgather(self, response, entries) -> Status:
+        return Status.unknown_error("xla backend: allgather rides tcp")
+
+    def alltoall(self, response, entries) -> Status:
+        return Status.unknown_error("xla backend: alltoall rides tcp")

@@ -127,9 +127,12 @@ class LearningRateScheduleCallback(Callback):
 
 
 class LearningRateWarmupCallback(LearningRateScheduleCallback):
-    """Gradual LR warmup from lr to lr * size over ``warmup_epochs``
-    (reference: _keras/callbacks.py LearningRateWarmupCallback; the
-    "facebook 1-hour ImageNet" recipe)."""
+    """Gradual LR warmup from ``lr / size`` to ``lr`` over
+    ``warmup_epochs``, matching the reference convention that the
+    configured optimizer LR is already scaled by the world size
+    (reference: _keras/callbacks.py LearningRateWarmupCallback — the
+    multiplier interpolates 1/size → 1; the "facebook 1-hour ImageNet"
+    recipe)."""
 
     def __init__(self, optimizer, warmup_epochs: int = 5,
                  momentum_correction: bool = True,
@@ -145,19 +148,22 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
 
         def multiplier(epoch: float) -> float:
             if warmup_epochs <= 0:
-                return float(size)
-            # epoch/warmup interpolation 1/size → 1, scaled by size.
+                return 1.0
+            # epoch/warmup interpolation 1/size → 1.
             frac = min(epoch / warmup_epochs, 1.0)
-            return (1.0 + frac * (size - 1)) / 1.0
+            return (1.0 + frac * (size - 1)) / size
 
+        # No end_epoch: the multiplier clamps at 1.0, so past the warmup
+        # window the configured LR is applied exactly (an exclusive window
+        # would freeze just short of it at epoch granularity).
         super().__init__(optimizer, multiplier, start_epoch=0,
-                         end_epoch=warmup_epochs, staircase=False,
+                         end_epoch=None, staircase=False,
                          steps_per_epoch=steps_per_epoch)
 
     def on_epoch_end(self, epoch: int, logs: dict | None = None) -> None:
         if self.verbose and epoch == self.warmup_epochs - 1:
             print(f"Epoch {epoch}: finished gradual learning rate warmup "
-                  f"to x{self.size}.")
+                  f"(ramped 1/{self.size} -> 1x of the configured LR).")
 
 
 class BestModelCheckpoint(Callback):

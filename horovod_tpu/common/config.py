@@ -158,3 +158,11 @@ NUM_STREAMS = register(
     "HOROVOD_NUM_STREAMS", 1, int,
     "Parallel dispatch lanes for fused collective programs "
     "(analogue of HOROVOD_NUM_NCCL_STREAMS).")
+JAX_DISTRIBUTED = register(
+    "HOROVOD_JAX_DISTRIBUTED", "auto", str,
+    "Form the multi-process JAX world at init (jax.distributed.initialize "
+    "via the rendezvous KV): 1 | 0 | auto (yes on accelerator backends).")
+XLA_OPERATIONS = register(
+    "HOROVOD_XLA_OPERATIONS", "auto", str,
+    "Eager-core device data plane: 1 (require XLA backend) | 0 (TCP only) "
+    "| auto (use XLA collectives when a device mesh is available).")

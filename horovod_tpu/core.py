@@ -209,6 +209,23 @@ def init(*, rank: int | None = None, size: int | None = None,
 
             timeout = config.GLOO_TIMEOUT_SECONDS.get()
             kv = RendezvousClient(addr, port, timeout)
+            # Form the multi-process JAX world FIRST (before any backend
+            # below touches jax) — the analogue of GlooContext rendezvous
+            # at init (reference: gloo/gloo_context.cc:136-152).
+            from .parallel import multihost
+            if multihost.should_init(size):
+                multihost.init_jax_distributed(
+                    rank, size, kv=kv,
+                    timeout=max(timeout, 120.0))
+            # XLA/ICI data plane (the NCCL-ops slot, reference:
+            # operations.cc:143-252): first in the chain; enabled() falls
+            # through to TCP when the JAX world doesn't span the ranks.
+            xla_mode = config.XLA_OPERATIONS.get().lower()
+            if xla_mode not in ("0", "false", "no", "off"):
+                if multihost.is_initialized() or xla_mode in ("1", "true",
+                                                              "yes", "on"):
+                    from .backend.xla import XlaBackend, XlaCommunicator
+                    backends.append(XlaBackend(XlaCommunicator(), size))
             epoch = os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", "0")
             ctrl_mesh = PeerMesh(rank, size, kv, scope=f"ctrl{epoch}",
                                  timeout=timeout)
@@ -264,6 +281,8 @@ def shutdown() -> None:
         _global.resources.clear()
         _global.initialized = False
         _global.background_thread = None
+    from .parallel import multihost
+    multihost.shutdown_jax_distributed()
 
 
 def is_initialized() -> bool:

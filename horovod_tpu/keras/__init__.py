@@ -33,8 +33,13 @@ def _require_keras():
 
 def DistributedOptimizer(optimizer, name: str | None = None, **kwargs):
     """Wrap a keras optimizer so apply_gradients allreduces first
-    (reference: keras/__init__.py DistributedOptimizer)."""
-    keras = _require_keras()
+    (reference: keras/__init__.py DistributedOptimizer).
+
+    The SAME instance is returned with its class swapped to a dynamic
+    subclass — slot variables, iteration counters and every other piece of
+    optimizer state survive intact (rebuilding from ``get_config()``
+    would silently drop them)."""
+    _require_keras()
     from ..tensorflow import allreduce
 
     base = optimizer.__class__
@@ -46,10 +51,9 @@ def DistributedOptimizer(optimizer, name: str | None = None, **kwargs):
                 for i, (g, v) in enumerate(grads_and_vars)]
             return super().apply_gradients(grads_and_vars, **apply_kwargs)
 
-    cfg = optimizer.get_config()
-    dist = _Distributed(**cfg)
-    del keras
-    return dist
+    _Distributed.__name__ = f"Distributed{base.__name__}"
+    optimizer.__class__ = _Distributed
+    return optimizer
 
 
 def broadcast_global_variables(root_rank: int = 0) -> None:

@@ -148,6 +148,14 @@ def pack(parts: list[np.ndarray | None], sizes: list[int],
     lib = _load()
     if lib is None:
         return None
+    dtype = np.dtype(dtype)
+    # A desync between the response's tensor_sizes and the staged arrays
+    # would read out-of-bounds memory through the raw pointers below (the
+    # numpy fallback raises instead) — validate, fall back on mismatch.
+    for p, sz in zip(parts, sizes):
+        if p is not None and (p.size != sz or p.dtype != dtype
+                              or not p.flags.c_contiguous):
+            return None
     total = sum(sizes)
     out = np.empty(total, dtype=dtype)
     n = len(parts)

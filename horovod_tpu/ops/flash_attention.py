@@ -68,7 +68,7 @@ def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
 # ===========================================================================
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *,
-                sm_scale: float, causal: bool,
+                sm_scale: float, causal: bool, causal_offset: int,
                 block_q: int, block_k: int, n_kv: int):
     from jax.experimental import pallas as pl
 
@@ -84,7 +84,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     # Causal: blocks strictly above the diagonal contribute nothing.
     run = True
     if causal:
-        run = kj * block_k <= qi * block_q + (block_q - 1)
+        run = kj * block_k <= qi * block_q + (block_q - 1) + causal_offset
 
     @pl.when(run)
     def _compute():
@@ -99,7 +99,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 jnp.int32, (block_q, block_k), 0)
             cols = kj * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows + causal_offset >= cols, s, NEG_INF)
 
         m_prev = m_ref[:, 0]                          # [bq]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -134,7 +134,7 @@ def _flash_fwd_pallas(q, k, v, *, sm_scale, causal, block_q, block_k,
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, n_kv=n_kv)
+        causal_offset=tk - tq, block_q=block_q, block_k=block_k, n_kv=n_kv)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_kv),
@@ -166,7 +166,7 @@ def _flash_fwd_pallas(q, k, v, *, sm_scale, causal, block_q, block_k,
 # ===========================================================================
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *,
-                   sm_scale: float, causal: bool,
+                   sm_scale: float, causal: bool, causal_offset: int,
                    block_q: int, block_k: int, n_kv: int):
     from jax.experimental import pallas as pl
 
@@ -179,7 +179,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if causal:
-        run = kj * block_k <= qi * block_q + (block_q - 1)
+        run = kj * block_k <= qi * block_q + (block_q - 1) + causal_offset
 
     @pl.when(run)
     def _compute():
@@ -197,7 +197,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0)
             cols = kj * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows + causal_offset >= cols, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                 # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -213,7 +213,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    sm_scale: float, causal: bool,
+                    sm_scale: float, causal: bool, causal_offset: int,
                     block_q: int, block_k: int, n_q: int):
     from jax.experimental import pallas as pl
 
@@ -227,7 +227,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     run = True
     if causal:
-        run = kj * block_k <= qi * block_q + (block_q - 1)
+        run = kj * block_k <= qi * block_q + (block_q - 1) + causal_offset
 
     @pl.when(run)
     def _compute():
@@ -245,7 +245,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 0)
             cols = kj * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where(rows + causal_offset >= cols, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                 # [bq, bk]
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -280,6 +280,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, sm_scale, causal,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          causal_offset=tk - tq,
                           block_q=block_q, block_k=block_k, n_kv=n_kv),
         grid=(bh, n_q, n_kv),
         in_specs=[
@@ -298,6 +299,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, sm_scale, causal,
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          causal_offset=tk - tq,
                           block_q=block_q, block_k=block_k, n_q=n_q),
         grid=(bh, n_kv, n_q),
         in_specs=[
@@ -337,7 +339,9 @@ def _blockwise_jax(q, k, v, *, sm_scale, causal):
         tq, tk = s.shape[-2], s.shape[-1]
         rows = jnp.arange(tq)[:, None]
         cols = jnp.arange(tk)[None, :]
-        s = jnp.where(rows >= cols, s, NEG_INF)
+        # Bottom-right alignment for tq != tk, matching mha_reference's
+        # tril(k=tk-tq) (cross-attention / decode windows).
+        s = jnp.where(rows + (tk - tq) >= cols, s, NEG_INF)
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
@@ -416,6 +420,18 @@ def _fit_block(t: int, block: int) -> int:
     return block
 
 
+def _check_causal_shapes(causal: bool, tq: int, tk: int) -> None:
+    """Bottom-right causal alignment leaves the first tq-tk query rows with
+    zero valid keys when tq > tk — attention is undefined there (the dense
+    reference degenerates to uniform weights over garbage). Reject loudly
+    instead of silently diverging."""
+    if causal and tq > tk:
+        raise ValueError(
+            f"causal attention requires tq <= tk (got tq={tq}, tk={tk}): "
+            "with bottom-right alignment the leading query rows would "
+            "attend to nothing")
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, sm_scale: float | None = None,
                     block_q: int = 128, block_k: int = 128,
@@ -424,6 +440,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     (custom VJP with Pallas backward kernels on TPU)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    _check_causal_shapes(causal, q.shape[1], k.shape[1])
     b, _, h, _ = q.shape
     block_q = _fit_block(q.shape[1], block_q)
     block_k = _fit_block(k.shape[1], block_k)
@@ -445,6 +462,7 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     b, _, h, _ = q.shape
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    _check_causal_shapes(causal, q.shape[1], k.shape[1])
     block_q = _fit_block(q.shape[1], block_q)
     block_k = _fit_block(k.shape[1], block_k)
     qm, km, vm = _merge_heads(q), _merge_heads(k), _merge_heads(v)

@@ -79,16 +79,26 @@ def build_mesh(spec: MeshSpec | None = None,
     shape = tuple(sizes[a] for a in DEFAULT_AXES)
 
     from jax.experimental import mesh_utils
-    n_proc = len({getattr(d, "process_index", 0) for d in devices})
-    if n_proc > 1:
+    # DCN granule = TPU slice when the runtime reports one (multi-slice
+    # pods), else the owning process (CPU multi-process worlds). A single
+    # multi-host slice is one ICI domain — no DCN split at all.
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None not in slice_ids and len(slice_ids) > 1:
+        n_granules, by_process = len(slice_ids), False
+    else:
+        n_granules = len({getattr(d, "process_index", 0) for d in devices})
+        by_process = True
+        if None not in slice_ids:
+            n_granules = 1   # one slice: pure ICI even across processes
+    if n_granules > 1:
         # Split the outermost non-trivial axis across DCN granules
         # (ICI = "local", DCN = "cross"; reference: common.h:119-136).
-        if len(devices) % n_proc:
+        if len(devices) % n_granules:
             raise ValueError(
                 f"{len(devices)} devices do not divide evenly over "
-                f"{n_proc} hosts")
+                f"{n_granules} DCN granules")
         dcn_shape, ici_shape = [], []
-        remaining_dcn = n_proc
+        remaining_dcn = n_granules
         for dim in shape:
             g = math.gcd(dim, remaining_dcn)
             dcn_shape.append(g)
@@ -96,9 +106,11 @@ def build_mesh(spec: MeshSpec | None = None,
             remaining_dcn //= g
         if remaining_dcn != 1:
             raise ValueError(
-                f"cannot split {n_proc} hosts over mesh shape {shape}")
+                f"cannot split {n_granules} granules over mesh shape "
+                f"{shape}")
         dev_array = mesh_utils.create_hybrid_device_mesh(
-            tuple(ici_shape), tuple(dcn_shape), devices=devices)
+            tuple(ici_shape), tuple(dcn_shape), devices=devices,
+            process_is_granule=by_process)
     else:
         try:
             dev_array = mesh_utils.create_device_mesh(shape,

@@ -1,0 +1,138 @@
+"""Multi-host JAX world formation over the rendezvous control plane.
+
+The TPU analogue of GlooContext initialization (reference:
+horovod/common/gloo/gloo_context.cc:136-152): where the reference reads
+HOROVOD_RANK/SIZE from the launcher's env and connects a Gloo full mesh
+through the rendezvous HTTP store, we negotiate a JAX coordinator address
+through the same KV store and call `jax.distributed.initialize`, after
+which `jax.devices()` spans every process and `build_mesh` can lay a
+hybrid ICI×DCN mesh over the whole pod.
+
+Must run BEFORE any JAX backend initializes in the process (the same
+constraint as NCCL unique-id exchange happening before the first
+collective, reference: ops/nccl_operations.cc:61-94).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Any
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_initialized_here = False
+
+_COORD_SCOPE = "jaxdist"
+
+
+def is_initialized() -> bool:
+    return _initialized_here
+
+
+def init_jax_distributed(rank: int, size: int, kv: Any = None,
+                         coordinator_address: str | None = None,
+                         local_device_ids: list[int] | None = None,
+                         timeout: float = 120.0) -> bool:
+    """Form the multi-process JAX world; returns True if initialized.
+
+    Rank 0 picks a free port and publishes ``host:port`` under the
+    ``jaxdist`` scope of the rendezvous KV store; everyone else blocks on
+    that key, then all processes call ``jax.distributed.initialize``.
+    Pass ``coordinator_address`` explicitly to skip the KV negotiation
+    (e.g. on TPU pods where GCE metadata supplies it).
+    """
+    global _initialized_here
+    with _lock:
+        if _initialized_here or size <= 1:
+            return _initialized_here
+        import jax
+
+        epoch = os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", "0")
+        key = f"coord:{epoch}"
+        if coordinator_address is None:
+            if kv is None:
+                raise ValueError(
+                    "init_jax_distributed needs a rendezvous KV client or "
+                    "an explicit coordinator_address")
+            if rank == 0:
+                from ..runner.network import free_port
+                host = socket.gethostbyname(socket.gethostname())
+                coordinator_address = f"{host}:{free_port()}"
+                kv.put(_COORD_SCOPE, key, coordinator_address.encode())
+            else:
+                coordinator_address = kv.wait(_COORD_SCOPE, key,
+                                              timeout).decode()
+
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            # Cross-process collectives on the CPU backend need the gloo
+            # implementation (the virtual-mesh test path; real deployments
+            # ride ICI/DCN through the TPU runtime instead).
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:  # noqa: BLE001 - older jaxlib: no such knob
+                pass
+
+        logger.debug("jax.distributed.initialize rank=%d size=%d coord=%s",
+                     rank, size, coordinator_address)
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=size, process_id=rank,
+            local_device_ids=local_device_ids,
+            initialization_timeout=int(timeout))
+        _initialized_here = True
+        return True
+
+
+def shutdown_jax_distributed() -> None:
+    global _initialized_here
+    with _lock:
+        if not _initialized_here:
+            return
+        import jax
+        try:
+            jax.distributed.shutdown()
+        except Exception as exc:  # noqa: BLE001 - best-effort teardown
+            logger.warning("jax.distributed.shutdown failed: %s", exc)
+        _initialized_here = False
+
+
+def should_init(size: int) -> bool:
+    """Policy for the `auto` knob: form the JAX world on multi-process
+    launches unless the process is pinned to the CPU backend (tests pin
+    JAX_PLATFORMS=cpu and drive multi-process JAX explicitly)."""
+    from ..common import config
+    mode = config.JAX_DISTRIBUTED.get().lower()
+    if mode in ("1", "true", "yes", "on"):
+        return size > 1
+    if mode in ("0", "false", "no", "off"):
+        return False
+    # auto: a real accelerator backend will be used
+    return size > 1 and os.environ.get("JAX_PLATFORMS", "") != "cpu"
+
+
+def make_global_array(mesh, spec, array):
+    """Build a global `jax.Array` from a process-local view of the full
+    array: each process contributes only the shards the sharding places on
+    its addressable devices. Works identically single- and multi-process
+    (the multi-host data-feed path; the reference never needs this because
+    each rank's framework owns its local batch outright)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+    arr = np.asarray(array)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
+def make_global_batch(mesh, spec, batch: dict) -> dict:
+    """`make_global_array` over a dict of per-example arrays."""
+    import jax
+    return {k: make_global_array(mesh, spec, v) if hasattr(v, "shape")
+            else v for k, v in batch.items()}

@@ -84,6 +84,31 @@ class _KVHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(value)
 
+    def do_POST(self):
+        """Atomic fetch-and-increment counter per (scope, key) — used for
+        per-host slot claims (reference: the spark driver service's
+        task-registration counter, spark/runner.py:47-426). A non-empty
+        body names the logical claimant: re-presenting the same body
+        returns the original index (idempotent under task retries)."""
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        claimant = self.rfile.read(length).decode()
+        ckey = f"{scope}/{key}"
+        with self.server.kv_lock:
+            assigned = self.server.claims.setdefault(ckey, {})
+            if claimant and claimant in assigned:
+                n = assigned[claimant]
+            else:
+                n = self.server.counters.get(ckey, 0)
+                self.server.counters[ckey] = n + 1
+                if claimant:
+                    assigned[claimant] = n
+        body = str(n).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_DELETE(self):
         scope, key = self._split()
         with self.server.kv_lock:
@@ -102,6 +127,8 @@ class RendezvousServer:
     def __init__(self, port: int = 0) -> None:
         self._httpd = ThreadingHTTPServer(("", port), _KVHandler)
         self._httpd.kv = {}
+        self._httpd.counters = {}
+        self._httpd.claims = {}
         self._httpd.kv_lock = threading.Lock()
         self._thread: threading.Thread | None = None
 
@@ -141,6 +168,15 @@ class RendezvousClient:
                                  method="PUT")
         with urlrequest.urlopen(req, timeout=self.timeout):
             pass
+
+    def claim(self, scope: str, key: str, task_key: str = "") -> int:
+        """Atomic fetch-and-increment of the (scope, key) counter.
+        A non-empty ``task_key`` makes the claim idempotent: retries with
+        the same key get the originally assigned index back."""
+        req = urlrequest.Request(f"{self._base}/{scope}/{key}",
+                                 data=task_key.encode(), method="POST")
+        with urlrequest.urlopen(req, timeout=self.timeout) as resp:
+            return int(resp.read())
 
     def get(self, scope: str, key: str) -> bytes | None:
         try:

@@ -16,7 +16,31 @@ from typing import Any, Callable
 
 from ..runner.hosts import HostInfo, get_host_assignments
 
-__all__ = ["run"]
+__all__ = ["run", "claim_slot"]
+
+
+def claim_slot(host: str, rendezvous_addr: str, rendezvous_port: int,
+               pool: dict[str, list], task_key: str = ""):
+    """Atomically claim one distinct slot on ``host`` through the driver's
+    rendezvous counter — never derived from the partition index, which is
+    global and collides when partition placement drifts between the
+    discovery job and the run job (reference: spark tasks register with a
+    driver service for exactly this reason, spark/runner.py:47-426).
+
+    ``task_key`` identifies the logical task (partition id): a retried or
+    speculatively re-executed task re-presents the same key and gets its
+    original slot back instead of stealing a fresh one."""
+    from ..runner.network import RendezvousClient
+
+    client = RendezvousClient(rendezvous_addr, rendezvous_port)
+    local_idx = client.claim("sparkslots", host, task_key=task_key)
+    env_slots = pool.get(host, [])
+    if local_idx >= len(env_slots):
+        raise RuntimeError(
+            f"host {host} claimed slot #{local_idx} but only "
+            f"{len(env_slots)} slots were discovered there — task "
+            "placement drifted between the discovery and run jobs")
+    return env_slots[local_idx]
 
 
 def _require_spark():
@@ -61,9 +85,8 @@ def run(fn: Callable, args: tuple = (), kwargs: dict | None = None,
     def task(index: int):
         import os
         host = socket.gethostname()
-        # Deterministic slot pick per (host, task order on host).
-        env_slots = pool.get(host, [])
-        slot = env_slots[index % max(len(env_slots), 1)]
+        slot = claim_slot(host, addr, port, pool,
+                          task_key=f"partition{index}")
         os.environ.update(slot.to_env())
         os.environ.update({
             "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,

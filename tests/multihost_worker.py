@@ -1,0 +1,85 @@
+"""Worker for the multi-host JAX world test.
+
+Each worker is one "host": 4 virtual CPU devices, its own process. hvd.init
+forms the JAX world via the rendezvous KV (parallel/multihost.py — the
+analogue of GlooContext rendezvous, reference: gloo/gloo_context.cc:136-152),
+after which jax.devices() spans both processes and the Trainer's dp axis
+crosses the process boundary.
+
+Usage: python multihost_worker.py <rank> <size> <rendezvous_port> [n_local]
+Prints the final loss as `LOSS <float>` for the parent to compare. The
+single-process baseline is the same script with size=1 and n_local=8, so
+both runs shard dp=8 identically and losses must match.
+"""
+import os
+import sys
+
+
+def main() -> int:
+    rank, size, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    n_local = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        .replace("--xla_force_host_platform_device_count=8", "")
+        + f" --xla_force_host_platform_device_count={n_local}").strip()
+    os.environ.update({
+        "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(size),
+        "HOROVOD_LOCAL_RANK": "0", "HOROVOD_LOCAL_SIZE": "1",
+        "HOROVOD_CROSS_RANK": str(rank), "HOROVOD_CROSS_SIZE": str(size),
+        "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+        "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
+        "HOROVOD_JAX_DISTRIBUTED": "1",
+        "HOROVOD_GLOO_TIMEOUT_SECONDS": "60",
+    })
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import horovod_tpu as hvd
+    hvd.init()
+    try:
+        if size > 1:
+            assert jax.process_count() == size, jax.process_count()
+        n_global = len(jax.devices())
+        assert n_global == n_local * size, n_global
+
+        import numpy as np
+        import optax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import models, training
+        from horovod_tpu.parallel import (GradSyncConfig, MeshSpec,
+                                          build_mesh, multihost)
+
+        import jax.numpy as jnp
+
+        mesh = build_mesh(MeshSpec(dp=n_global))
+        model = models.ResNet(stage_sizes=(1,),
+                              block_cls=models.resnet.BottleneckBlock,
+                              num_classes=8, num_filters=8,
+                              dtype=jnp.float32)
+        trainer = training.Trainer(
+            model, optax.sgd(0.1, momentum=0.9), mesh,
+            sync=GradSyncConfig(axes=("dp",), op="average"))
+
+        rng = np.random.default_rng(0)
+        batch = {
+            "image": rng.standard_normal(
+                (n_global * 2, 16, 16, 3)).astype(np.float32),
+            "label": rng.integers(0, 8, size=(n_global * 2,)),
+        }
+        global_batch = multihost.make_global_batch(mesh, P("dp"), batch)
+        state = trainer.init(jax.random.key(0), global_batch)
+        for _ in range(3):
+            state, metrics = trainer.step(state, global_batch)
+        print(f"LOSS {float(metrics['loss']):.10f}", flush=True)
+    finally:
+        hvd.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

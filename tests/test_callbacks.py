@@ -40,16 +40,18 @@ class TestLearningRateCallbacks:
         sched.on_epoch_begin(5)
         assert opt.lr == pytest.approx(0.2)      # after end: frozen
 
-    def test_warmup_ramps_to_size(self):
-        opt = _FakeOpt(0.1)
+    def test_warmup_ramps_to_configured_lr(self):
+        # Reference convention (_keras/callbacks.py): the configured LR is
+        # already size-scaled; warmup interpolates lr/size -> lr.
+        opt = _FakeOpt(0.8)
         warm = cb.LearningRateWarmupCallback(opt, warmup_epochs=5,
                                              steps_per_epoch=10, size=8)
         warm.on_epoch_begin(0)
         warm.on_batch_begin(0)
-        assert opt.lr == pytest.approx(0.1)      # start: base lr
+        assert opt.lr == pytest.approx(0.1)      # start: lr / size
         warm.current_epoch = 4
         warm.on_batch_begin(9)
-        # end of warmup: ~size * base lr
+        # end of warmup: the configured (size-scaled) lr
         assert opt.lr == pytest.approx(0.8, rel=0.05)
 
     def test_torch_param_groups(self):
@@ -151,8 +153,8 @@ def test_warmup_adjusts_without_steps_per_epoch():
     opt = _Opt()
     warm = cb.LearningRateWarmupCallback(opt, warmup_epochs=4, size=8)
     warm.on_epoch_begin(2)
-    # halfway through warmup: 1 + (2/4)*(8-1) = 4.5x
-    assert opt.lr == pytest.approx(0.45)
+    # halfway through warmup: (1 + (2/4)*(8-1)) / 8 = 0.5625x
+    assert opt.lr == pytest.approx(0.1 * 0.5625)
     warm.on_epoch_begin(4)
     warm.on_epoch_begin(10)   # past warmup end: frozen at last value
-    assert opt.lr == pytest.approx(0.45)
+    assert opt.lr == pytest.approx(0.1)

@@ -145,6 +145,72 @@ class TestGatedIntegrations:
         with pytest.raises(ImportError, match="horovodrun-tpu"):
             hray.RayExecutor(2)
 
+    def test_spark_slot_claim_is_atomic_per_host(self):
+        """Regression (ADVICE r1): two tasks on one host must claim
+        DISTINCT slots regardless of their global partition indices."""
+        from horovod_tpu.runner.hosts import HostInfo, get_host_assignments
+        from horovod_tpu.runner.network import RendezvousServer
+        from horovod_tpu.spark import claim_slot
+
+        hosts = [HostInfo(hostname="hostA", slots=2),
+                 HostInfo(hostname="hostB", slots=2)]
+        slots = get_host_assignments(hosts, 4)
+        pool: dict[str, list] = {}
+        for s in slots:
+            pool.setdefault(s.hostname, []).append(s)
+
+        server = RendezvousServer()
+        port = server.start()
+        try:
+            # Partitions 1 and 3 both landed on hostA (the collision case:
+            # both have index % 2 == 1 under the old scheme).
+            a1 = claim_slot("hostA", "127.0.0.1", port, pool,
+                            task_key="partition1")
+            a2 = claim_slot("hostA", "127.0.0.1", port, pool,
+                            task_key="partition3")
+            assert {a1.rank, a2.rank} == {s.rank for s in pool["hostA"]}
+            assert a1.local_rank != a2.local_rank
+            # A retried task (same partition) gets its ORIGINAL slot back,
+            # never a duplicate of a live peer's.
+            retry = claim_slot("hostA", "127.0.0.1", port, pool,
+                               task_key="partition1")
+            assert retry.rank == a1.rank
+            # A genuinely new claimant on a full 2-slot host = placement
+            # drift → loud error.
+            with pytest.raises(RuntimeError, match="drift"):
+                claim_slot("hostA", "127.0.0.1", port, pool,
+                           task_key="partition9")
+        finally:
+            server.stop()
+
+    def test_keras_optimizer_preserves_instance_state(self):
+        """Regression (VERDICT r1 weak #4): DistributedOptimizer must keep
+        the optimizer instance (slot variables, iterations) — not rebuild
+        from config."""
+        tf = pytest.importorskip("tensorflow")
+        import horovod_tpu as hvd
+        import horovod_tpu.keras as hk
+
+        hvd.init()
+        try:
+            opt = tf.keras.optimizers.SGD(learning_rate=0.2, momentum=0.9)
+            v = tf.Variable([1.0, 2.0])
+            # Create slot/iteration state before wrapping.
+            opt.apply_gradients([(tf.constant([0.1, 0.1]), v)])
+            iterations_before = int(opt.iterations.numpy())
+            n_vars_before = len(opt.variables)
+            assert iterations_before == 1
+
+            wrapped = hk.DistributedOptimizer(opt)
+            assert wrapped is opt                      # same instance
+            assert int(wrapped.iterations.numpy()) == iterations_before
+            assert len(wrapped.variables) == n_vars_before
+            # Still steps correctly through the allreduce path (size 1).
+            wrapped.apply_gradients([(tf.constant([0.1, 0.1]), v)])
+            assert int(wrapped.iterations.numpy()) == 2
+        finally:
+            hvd.shutdown()
+
     def test_spark_gated(self):
         import horovod_tpu.spark as hspark
         try:

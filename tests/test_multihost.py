@@ -1,0 +1,73 @@
+"""Multi-host JAX world: hvd.init forms jax.distributed across processes
+and the Trainer's dp axis spans the process boundary (VERDICT r1 item 2;
+reference analogue: gloo/gloo_context.cc:136-152 rendezvous at init).
+
+2 processes × 4 virtual CPU devices each = one dp=8 mesh; the loss after 3
+steps must match a single-process dp=8 run bit-for-bit (same shards, same
+math, different transport)."""
+import os
+import re
+import subprocess
+import sys
+
+from horovod_tpu.runner.network import RendezvousServer
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "multihost_worker.py")
+
+
+def _launch(rank: int, size: int, port: int, n_local: int,
+            env: dict) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, _WORKER, str(rank), str(size), str(port),
+         str(n_local)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def _parse_loss(out: bytes, tag: str) -> float:
+    m = re.search(rb"LOSS ([-\d.eE+]+)", out)
+    assert m, f"{tag}: no LOSS line in output:\n{out.decode(errors='replace')}"
+    return float(m.group(1))
+
+
+def test_dp_axis_spans_processes():
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith("HOROVOD_"):
+            env.pop(k)
+
+    # Single-process baseline: dp=8 on one process.
+    p = _launch(0, 1, 0, 8, env)
+    out, _ = p.communicate(timeout=300)
+    assert p.returncode == 0, out.decode(errors="replace")
+    baseline = _parse_loss(out, "baseline")
+
+    # 2-process run: dp=8 across 2 "hosts" of 4 devices.
+    server = RendezvousServer()
+    port = server.start()
+    procs = [_launch(r, 2, port, 4, env) for r in range(2)]
+    outputs, losses, failed = [], [], []
+    try:
+        for r, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+                failed.append((r, "timeout"))
+            outputs.append(f"--- rank {r} (rc={p.returncode}) ---\n"
+                           + out.decode(errors="replace"))
+            if p.returncode != 0:
+                failed.append((r, p.returncode))
+            else:
+                losses.append(_parse_loss(out, f"rank{r}"))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+    assert not failed, "worker failures: %s\n%s" % (failed,
+                                                    "\n".join(outputs))
+    # Every process sees the same replicated loss, equal to the baseline.
+    assert abs(losses[0] - losses[1]) < 1e-9, losses
+    assert abs(losses[0] - baseline) < 1e-6, (losses, baseline)

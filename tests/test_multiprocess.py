@@ -67,6 +67,13 @@ def test_adasum(size):
     _run_world(size, "adasum")
 
 
+@pytest.mark.parametrize("size", [2])
+def test_xla_data_plane(size):
+    """Eager collectives ride XLA device collectives when the JAX world
+    spans the ranks (VERDICT r1 item 3)."""
+    _run_world(size, "xla", timeout=240.0)
+
+
 @pytest.mark.parametrize("size", [2, 4])
 def test_torch_distributed_optimizer(size):
     _run_world(size, "torch", timeout=120.0)
