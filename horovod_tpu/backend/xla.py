@@ -37,6 +37,17 @@ class XlaCommunicator:
         self._mesh = None
         self._cache: dict = {}
 
+    def _cached_program(self, key: tuple, build):
+        """Double-checked compiled-program cache (the lazy-communicator
+        analogue, reference: nccl_operations.cc:61-94)."""
+        with self._lock:
+            fn = self._cache.get(key)
+        if fn is None:
+            built = build()
+            with self._lock:
+                fn = self._cache.setdefault(key, built)
+        return fn
+
     def _world_mesh(self):
         with self._lock:
             if self._mesh is None:
@@ -57,10 +68,7 @@ class XlaCommunicator:
 
     # -- allreduce -------------------------------------------------------
     def _reduce_fn(self, np_dtype: np.dtype, size: int):
-        key = ("allreduce", np_dtype.str, size)
-        with self._lock:
-            fn = self._cache.get(key)
-        if fn is None:
+        def build():
             import jax
             import jax.numpy as jnp
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -80,9 +88,10 @@ class XlaCommunicator:
                 acc = g.astype(jnp.float32) if widen else g
                 return jnp.sum(acc, axis=0).astype(g.dtype)
 
-            with self._lock:
-                fn = self._cache.setdefault(key, _reduce)
-        return fn
+            return _reduce
+
+        return self._cached_program(("allreduce", np_dtype.str, size),
+                                    build)
 
     def allreduce(self, buf: np.ndarray) -> np.ndarray:
         import jax
@@ -98,10 +107,7 @@ class XlaCommunicator:
 
     # -- broadcast -------------------------------------------------------
     def _bcast_fn(self, np_dtype: np.dtype, size: int):
-        key = ("broadcast", np_dtype.str, size)
-        with self._lock:
-            fn = self._cache.get(key)
-        if fn is None:
+        def build():
             import jax
             import jax.numpy as jnp
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -117,9 +123,10 @@ class XlaCommunicator:
                 masked = jnp.where(rows == root, g, jnp.zeros_like(g))
                 return masked.sum(axis=0).astype(g.dtype)
 
-            with self._lock:
-                fn = self._cache.setdefault(key, _bcast)
-        return fn
+            return _bcast
+
+        return self._cached_program(("broadcast", np_dtype.str, size),
+                                    build)
 
     def broadcast(self, buf: np.ndarray, root: int) -> np.ndarray:
         import jax
@@ -164,7 +171,15 @@ class XlaBackend(CollectiveBackend):
             return False
         from ..common.dtypes import to_numpy
         np_dtype = np.dtype(to_numpy(response.tensor_type))
-        return np_dtype.kind in "fiu"
+        if np_dtype.kind not in "fiu":
+            return False
+        if np_dtype.itemsize == 8:
+            # Without jax_enable_x64, device_put silently canonicalizes
+            # 64-bit dtypes to 32-bit — wrapping int64s and truncating
+            # float64s. Decline so they ride the (exact) TCP ring.
+            import jax
+            return bool(jax.config.jax_enable_x64)
+        return True
 
     def allreduce(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:

@@ -158,6 +158,17 @@ NUM_STREAMS = register(
     "HOROVOD_NUM_STREAMS", 1, int,
     "Parallel dispatch lanes for fused collective programs "
     "(analogue of HOROVOD_NUM_NCCL_STREAMS).")
+def parse_tristate(value: str) -> bool | None:
+    """'1'/'true'/... -> True, '0'/'false'/... -> False, else None (auto).
+    Shared by the tri-state knobs (JAX_DISTRIBUTED, XLA_OPERATIONS)."""
+    v = value.strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    return None
+
+
 JAX_DISTRIBUTED = register(
     "HOROVOD_JAX_DISTRIBUTED", "auto", str,
     "Form the multi-process JAX world at init (jax.distributed.initialize "

@@ -220,12 +220,17 @@ def init(*, rank: int | None = None, size: int | None = None,
             # XLA/ICI data plane (the NCCL-ops slot, reference:
             # operations.cc:143-252): first in the chain; enabled() falls
             # through to TCP when the JAX world doesn't span the ranks.
-            xla_mode = config.XLA_OPERATIONS.get().lower()
-            if xla_mode not in ("0", "false", "no", "off"):
-                if multihost.is_initialized() or xla_mode in ("1", "true",
-                                                              "yes", "on"):
-                    from .backend.xla import XlaBackend, XlaCommunicator
-                    backends.append(XlaBackend(XlaCommunicator(), size))
+            xla_mode = config.parse_tristate(config.XLA_OPERATIONS.get())
+            if xla_mode is True and not multihost.is_initialized():
+                # Required mode must fail loudly, not silently degrade to
+                # the TCP ring at a fraction of the bandwidth.
+                raise RuntimeError(
+                    "HOROVOD_XLA_OPERATIONS=1 requires the multi-process "
+                    "JAX world; it did not form (check "
+                    "HOROVOD_JAX_DISTRIBUTED and coordinator logs).")
+            if xla_mode is not False and multihost.is_initialized():
+                from .backend.xla import XlaBackend, XlaCommunicator
+                backends.append(XlaBackend(XlaCommunicator(), size))
             epoch = os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", "0")
             ctrl_mesh = PeerMesh(rank, size, kv, scope=f"ctrl{epoch}",
                                  timeout=timeout)

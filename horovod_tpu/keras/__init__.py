@@ -17,7 +17,18 @@ __all__ = ["init", "shutdown", "rank", "size", "local_rank", "local_size",
            "join", "is_initialized", "DistributedOptimizer",
            "MetricAverageCallback", "LearningRateWarmupCallback",
            "LearningRateScheduleCallback", "BestModelCheckpoint",
-           "broadcast_global_variables"]
+           "broadcast_global_variables",
+           "BroadcastGlobalVariablesCallback", "TensorFlowKerasState"]
+
+
+def __getattr__(item: str):
+    # TF-backed surfaces resolve lazily so importing horovod_tpu.keras
+    # never requires tensorflow.
+    if item in ("BroadcastGlobalVariablesCallback", "TensorFlowKerasState",
+                "SyncBatchNormalization", "Compression"):
+        from .. import tensorflow as htf
+        return getattr(htf, item)
+    raise AttributeError(item)
 
 
 def _require_keras():
@@ -31,29 +42,23 @@ def _require_keras():
             "the JAX Trainer, or horovod_tpu.torch for PyTorch.") from exc
 
 
-def DistributedOptimizer(optimizer, name: str | None = None, **kwargs):
+def DistributedOptimizer(optimizer, name: str | None = None,
+                         compression=None,
+                         backward_passes_per_step: int = 1, **kwargs):
     """Wrap a keras optimizer so apply_gradients allreduces first
-    (reference: keras/__init__.py DistributedOptimizer).
+    (reference: keras/__init__.py DistributedOptimizer — a thin veneer
+    over the tensorflow implementation, as in the reference).
 
     The SAME instance is returned with its class swapped to a dynamic
     subclass — slot variables, iteration counters and every other piece of
     optimizer state survive intact (rebuilding from ``get_config()``
-    would silently drop them)."""
+    would silently drop them). Collectives are graph ops, so compiled
+    ``model.fit`` works."""
     _require_keras()
-    from ..tensorflow import allreduce
-
-    base = optimizer.__class__
-
-    class _Distributed(base):
-        def apply_gradients(self, grads_and_vars, **apply_kwargs):
-            grads_and_vars = [
-                (g if g is None else allreduce(g, name=f"grad.{i}"), v)
-                for i, (g, v) in enumerate(grads_and_vars)]
-            return super().apply_gradients(grads_and_vars, **apply_kwargs)
-
-    _Distributed.__name__ = f"Distributed{base.__name__}"
-    optimizer.__class__ = _Distributed
-    return optimizer
+    from .. import tensorflow as htf
+    return htf.DistributedOptimizer(
+        optimizer, name=name, compression=compression,
+        backward_passes_per_step=backward_passes_per_step, **kwargs)
 
 
 def broadcast_global_variables(root_rank: int = 0) -> None:

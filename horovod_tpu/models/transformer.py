@@ -50,6 +50,11 @@ class TransformerConfig:
     mesh: Any = None
     sp_axis: str = "sp"
     batch_spec: Any = None            # PartitionSpec for the batch dim
+    # Mixture-of-Experts FFN (0 = dense MLP). With a mesh carrying an
+    # "ep" axis > 1, experts shard over it (two all_to_alls per layer).
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    ep_axis: str = "ep"
 
     @property
     def head_dim(self) -> int:
@@ -225,8 +230,16 @@ class Block(nn.Module):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
             RMSNorm(cfg.dtype, cfg.param_dtype, name="attn_norm")(x))
-        x = x + MLP(cfg, name="mlp")(
-            RMSNorm(cfg.dtype, cfg.param_dtype, name="mlp_norm")(x))
+        if cfg.moe_experts > 0:
+            from .moe import MoEMLP
+            ffn = MoEMLP(num_experts=cfg.moe_experts, d_ff=cfg.ff_dim,
+                         capacity_factor=cfg.moe_capacity_factor,
+                         dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                         ep_mesh=cfg.mesh, ep_axis=cfg.ep_axis,
+                         name="moe")
+        else:
+            ffn = MLP(cfg, name="mlp")
+        x = x + ffn(RMSNorm(cfg.dtype, cfg.param_dtype, name="mlp_norm")(x))
         return x
 
 

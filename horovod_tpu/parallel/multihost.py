@@ -106,10 +106,10 @@ def should_init(size: int) -> bool:
     launches unless the process is pinned to the CPU backend (tests pin
     JAX_PLATFORMS=cpu and drive multi-process JAX explicitly)."""
     from ..common import config
-    mode = config.JAX_DISTRIBUTED.get().lower()
-    if mode in ("1", "true", "yes", "on"):
+    mode = config.parse_tristate(config.JAX_DISTRIBUTED.get())
+    if mode is True:
         return size > 1
-    if mode in ("0", "false", "no", "off"):
+    if mode is False:
         return False
     # auto: a real accelerator backend will be used
     return size > 1 and os.environ.get("JAX_PLATFORMS", "") != "cpu"

@@ -75,13 +75,19 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         buf_next = lax.ppermute(h_out, axis, fwd_perm)
         return (outputs, buf_next), None
 
+    def _vary(x):
+        """Mark a replicated literal as axis-varying (vma) for shard_map
+        type checking; API renamed pvary → pcast across jax versions."""
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, (axis,), to="varying")
+        if hasattr(lax, "pvary"):
+            return lax.pvary(x, (axis,))
+        return x
+
     out_shape = jax.eval_shape(stage_fn, stage_params, micro[0])
-    outputs0 = jnp.zeros((m,) + tuple(out_shape.shape), out_shape.dtype)
-    if hasattr(lax, "pvary"):
-        outputs0 = lax.pvary(outputs0, (axis,))
-    buf0 = jnp.zeros_like(micro[0])
-    if hasattr(lax, "pvary"):
-        buf0 = lax.pvary(buf0, (axis,))
+    outputs0 = _vary(jnp.zeros((m,) + tuple(out_shape.shape),
+                               out_shape.dtype))
+    buf0 = _vary(jnp.zeros_like(micro[0]))
 
     (outputs, _), _ = lax.scan(tick, (outputs0, buf0),
                                jnp.arange(total_ticks))

@@ -12,7 +12,16 @@ from typing import Any, Callable
 
 from ..runner.hosts import HostInfo, get_host_assignments
 
-__all__ = ["RayExecutor"]
+__all__ = ["RayExecutor", "RayHostDiscovery", "ElasticRayExecutor"]
+
+
+def __getattr__(item: str):
+    # Elastic surfaces live in .elastic; resolve lazily (no ray needed
+    # until an executor actually starts).
+    if item in ("RayHostDiscovery", "ElasticRayExecutor"):
+        from . import elastic
+        return getattr(elastic, item)
+    raise AttributeError(item)
 
 
 def _require_ray():

@@ -1,0 +1,178 @@
+"""Elastic training on Ray: cluster-state discovery + actor-based workers.
+
+Reference: horovod/ray/elastic.py:38-465 — ``RayHostDiscovery`` feeds the
+ElasticDriver from Ray's live node table instead of a discovery script,
+and ``ElasticRayExecutor`` bridges driver slot lifecycle to Ray actors
+(one per slot, re-created on membership changes). The driver machinery —
+rounds, blacklist, stable rank re-assignment, worker notification — is the
+same stack the CLI elastic path uses (elastic/driver.py).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from collections import OrderedDict
+from typing import Any, Callable
+
+from ..elastic.discovery import HostDiscovery
+from ..elastic.driver import ElasticDriver
+from ..elastic.rpc import RpcServer, make_secret
+from ..elastic.worker import DRIVER_ADDR_ENV, DRIVER_PORT_ENV, SECRET_ENV
+from ..runner.hosts import SlotInfo
+from ..runner.network import RendezvousServer
+
+__all__ = ["RayHostDiscovery", "ElasticRayExecutor"]
+
+
+class RayHostDiscovery(HostDiscovery):
+    """Discover hosts/slots from Ray's live cluster state
+    (reference: ray/elastic.py:38-83 RayHostDiscovery)."""
+
+    def __init__(self, use_gpu: bool = False, cpus_per_slot: int = 1,
+                 gpus_per_slot: int = 1) -> None:
+        self.use_gpu = use_gpu
+        self.cpus_per_slot = cpus_per_slot
+        self.gpus_per_slot = gpus_per_slot
+
+    def find_available_hosts_and_slots(self) -> "OrderedDict[str, int]":
+        import ray
+
+        hosts: "OrderedDict[str, int]" = OrderedDict()
+        for node in ray.nodes():
+            if not node.get("Alive", False):
+                continue
+            resources = node.get("Resources", {})
+            slots = int(resources.get("CPU", 0)) // self.cpus_per_slot
+            if self.use_gpu:
+                gpu_slots = int(resources.get("GPU", 0)) \
+                    // self.gpus_per_slot
+                slots = min(slots, gpu_slots)
+            if slots > 0:
+                hostname = node.get("NodeManagerHostname") \
+                    or node.get("NodeManagerAddress")
+                hosts[hostname] = slots
+        return hosts
+
+
+class ElasticRayExecutor:
+    """Run an elastic training function over Ray actors
+    (reference: ray/elastic.py:86-465 ElasticRayExecutor).
+
+    >>> executor = ElasticRayExecutor(min_np=2, max_np=4)
+    >>> executor.start()
+    >>> results = executor.run(train_fn)
+    """
+
+    def __init__(self, min_np: int = 1, max_np: int | None = None,
+                 cpus_per_slot: int = 1, use_gpu: bool = False,
+                 reset_limit: int | None = None,
+                 elastic_timeout: float = 600.0,
+                 override_discovery: HostDiscovery | None = None) -> None:
+        self.min_np = min_np
+        self.max_np = max_np
+        self.cpus_per_slot = cpus_per_slot
+        self.use_gpu = use_gpu
+        self.reset_limit = reset_limit
+        self.elastic_timeout = elastic_timeout
+        self.discovery = override_discovery or RayHostDiscovery(
+            use_gpu=use_gpu, cpus_per_slot=cpus_per_slot)
+        self.driver: ElasticDriver | None = None
+        self._rendezvous: RendezvousServer | None = None
+        self._rpc: RpcServer | None = None
+        self._secret = make_secret()
+        self._results: list = []
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        self.driver = ElasticDriver(
+            self.discovery, min_np=self.min_np, max_np=self.max_np,
+            timeout=self.elastic_timeout, reset_limit=self.reset_limit,
+            secret=self._secret)
+        self._rendezvous = RendezvousServer()
+        self._rendezvous.start()
+        self._rpc = RpcServer(self.driver, self._secret)
+
+    def _slot_env(self, slot: SlotInfo, addr: str) -> dict:
+        return {
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_CONTROLLER": "tcp",
+            "HOROVOD_HOSTNAME": slot.hostname,
+            "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
+            "HOROVOD_GLOO_RENDEZVOUS_PORT": str(self._rendezvous.port),
+            DRIVER_ADDR_ENV: addr,
+            DRIVER_PORT_ENV: str(self._rpc.port),
+            SECRET_ENV: self._secret,
+        }
+
+    def _make_create_worker(self, fn: Callable, addr: str) -> Callable:
+        """create_worker_fn for the driver: one Ray actor per slot, pinned
+        to the slot's node, blocking until the actor's run completes."""
+        import ray
+
+        executor = self
+
+        def create_worker(slot: SlotInfo) -> int:
+            options: dict = {
+                "num_cpus": executor.cpus_per_slot,
+                "num_gpus": executor.gpus_per_slot
+                if executor.use_gpu else 0,
+                "max_restarts": 0,
+            }
+            if executor._pin_by_node:
+                # Ray's per-node custom resource pins the actor to the
+                # slot's host (reference: ray/elastic.py actor placement).
+                options["resources"] = {f"node:{slot.hostname}": 0.001}
+
+            @ray.remote
+            class _ElasticWorker:
+                def run(self, payload: bytes, env: dict):
+                    import os as _os
+                    _os.environ.update(env)
+                    func = pickle.loads(payload)
+                    return func()
+
+            actor = _ElasticWorker.options(**options).remote()
+            try:
+                result = ray.get(actor.run.remote(
+                    pickle.dumps(fn), executor._slot_env(slot, addr)))
+                executor._results.append((slot.rank, result))
+                return 0
+            except Exception:  # noqa: BLE001 - actor/worker death = retry
+                return 1
+            finally:
+                ray.kill(actor, no_restart=True)
+
+        return create_worker
+
+    _pin_by_node = True
+
+    def run(self, fn: Callable) -> list:
+        """Run ``fn()`` on every slot until the job completes; returns
+        results rank-ordered from the final successful round."""
+        import socket
+
+        assert self.driver is not None, "call start() first"
+        hosts = self.discovery.find_available_hosts_and_slots()
+        local_only = all(h in ("localhost", "127.0.0.1", socket.gethostname())
+                         for h in hosts)
+        addr = "127.0.0.1" if local_only else socket.getfqdn()
+
+        np0 = min(self.max_np or self.min_np, self.min_np)
+        try:
+            self.driver.start(np0, self._make_create_worker(fn, addr))
+            self.driver.join()
+        finally:
+            self.shutdown(stop_driver=False)
+        self._results.sort(key=lambda pair: pair[0])
+        return [value for _rank, value in self._results]
+
+    def shutdown(self, stop_driver: bool = True) -> None:
+        if self.driver is not None and stop_driver:
+            self.driver.shutdown()
+        if self._rpc is not None:
+            self._rpc.close()
+            self._rpc = None
+        if self._rendezvous is not None:
+            self._rendezvous.stop()
+            self._rendezvous = None

@@ -1,12 +1,13 @@
 """Spark integration surface (reference: horovod/spark/runner.py:47-426).
 
-Gated on pyspark being importable.  ``run(fn)`` launches one Spark task per
-slot, each task registers its hostname, the driver computes the
-HOROVOD_RANK/LOCAL/CROSS contract from host hashes, starts a rendezvous
-server, and every task runs ``fn`` with the eager runtime env set — the
-same protocol the reference's spark driver/task services implement.  The
-Estimator API (TorchEstimator/KerasEstimator) is out of scope for this
-build; see horovod_tpu.data for the loader utilities it would sit on.
+``run(fn)`` (gated on pyspark) launches one Spark task per slot, each task
+registers its hostname, the driver computes the HOROVOD_RANK/LOCAL/CROSS
+contract from host hashes, starts a rendezvous server, and every task runs
+``fn`` with the eager runtime env set — the same protocol the reference's
+spark driver/task services implement.  The Estimator API
+(:mod:`.estimator` — TorchEstimator/KerasEstimator over a
+:class:`.store.FilesystemStore`) works on pandas DataFrames without
+pyspark and rides Spark executors when a SparkContext is live.
 """
 from __future__ import annotations
 
@@ -15,8 +16,19 @@ from collections import OrderedDict
 from typing import Any, Callable
 
 from ..runner.hosts import HostInfo, get_host_assignments
+from .store import FilesystemStore, Store  # noqa: F401
 
-__all__ = ["run", "claim_slot"]
+__all__ = ["run", "claim_slot", "Store", "FilesystemStore",
+           "TorchEstimator", "TorchModel", "KerasEstimator", "KerasModel"]
+
+
+def __getattr__(item: str):
+    # Estimators import torch/tf lazily — resolve on first touch.
+    if item in ("TorchEstimator", "TorchModel", "KerasEstimator",
+                "KerasModel"):
+        from . import estimator
+        return getattr(estimator, item)
+    raise AttributeError(item)
 
 
 def claim_slot(host: str, rendezvous_addr: str, rendezvous_port: int,

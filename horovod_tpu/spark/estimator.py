@@ -1,0 +1,323 @@
+"""Spark-ML-style Estimator API: fit(df) -> Model -> transform(df).
+
+Reference: horovod/spark/torch/estimator.py:91-328 (TorchEstimator),
+spark/keras/estimator.py (KerasEstimator), spark/common/estimator.py.
+The reference materializes the DataFrame to parquet via petastorm and
+launches `horovod.spark.run` over the cluster's executors; here the data
+path is numpy shards in a :class:`FilesystemStore` and training runs under
+``horovod_tpu.run`` (local forked workers) — or ``horovod_tpu.spark.run``
+when a live SparkContext is available. Accepts pandas DataFrames directly
+(a Spark DataFrame is converted via ``toPandas()``), so the API works in
+this image where pyspark is absent.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from .store import FilesystemStore, Store
+
+__all__ = ["TorchEstimator", "TorchModel", "KerasEstimator", "KerasModel"]
+
+
+def _to_pandas(df):
+    if hasattr(df, "toPandas"):          # pyspark DataFrame
+        return df.toPandas()
+    return df                            # already pandas
+
+
+def _extract(df, feature_cols: Sequence[str], label_cols: Sequence[str]):
+    pdf = _to_pandas(df)
+    x = np.stack([np.asarray(pdf[c].tolist(), dtype=np.float32)
+                  for c in feature_cols], axis=-1)
+    if x.ndim > 2 and x.shape[-1] == 1:
+        x = x[..., 0]
+    y = np.stack([np.asarray(pdf[c].tolist(), dtype=np.float32)
+                  for c in label_cols], axis=-1)
+    if y.shape[-1] == 1:
+        y = y[..., 0]
+    return x, y
+
+
+def _torch_train_fn(data_path: str, ckpt_path: str, model_bytes: bytes,
+                    opt_factory: Callable, loss_name: str, batch_size: int,
+                    epochs: int) -> dict:
+    """Per-rank training loop (reference: spark/torch/remote.py)."""
+    import io
+
+    import torch
+
+    import horovod_tpu as hvd
+    import horovod_tpu.torch as hvt
+
+    hvd.init()
+    try:
+        rank, world = hvd.rank(), hvd.size()
+        blob = np.load(os.path.join(data_path, "train.npz"))
+        X = torch.from_numpy(blob["x"])
+        Y = torch.from_numpy(blob["y"])
+        # Contiguous shard per rank (reference: petastorm row-group shard).
+        n = X.shape[0]
+        per = (n + world - 1) // world
+        xs, ys = X[rank * per:(rank + 1) * per], Y[rank * per:(rank + 1) * per]
+
+        model = torch.load(io.BytesIO(model_bytes), weights_only=False)
+        loss_fn = {"mse": torch.nn.MSELoss(),
+                   "l1": torch.nn.L1Loss(),
+                   "cross_entropy": torch.nn.CrossEntropyLoss()}[loss_name]
+        opt = hvt.DistributedOptimizer(
+            opt_factory(model.parameters()),
+            named_parameters=model.named_parameters())
+        hvt.broadcast_parameters(model.state_dict(), root_rank=0)
+
+        history = []
+        for _ in range(epochs):
+            epoch_loss = 0.0
+            batches = 0
+            for i in range(0, len(xs), batch_size):
+                xb, yb = xs[i:i + batch_size], ys[i:i + batch_size]
+                if not len(xb):
+                    continue
+                opt.zero_grad()
+                out = model(xb)
+                if out.shape != yb.shape and out.dim() == yb.dim() + 1 \
+                        and out.shape[-1] == 1:
+                    out = out[..., 0]
+                loss = loss_fn(out, yb)
+                loss.backward()
+                opt.step()
+                epoch_loss += float(loss.detach())
+                batches += 1
+            avg = hvd.allreduce(
+                np.array([epoch_loss / max(batches, 1)], np.float32),
+                name="epoch_loss")
+            history.append(float(np.asarray(avg)[0]))
+
+        if rank == 0:
+            buf = io.BytesIO()
+            torch.save(model, buf)
+            with open(os.path.join(ckpt_path, "model.pt"), "wb") as f:
+                f.write(buf.getvalue())
+        return {"rank": rank, "history": history}
+    finally:
+        hvd.shutdown()
+
+
+class TorchEstimator:
+    """fit(df) -> TorchModel (reference: spark/torch/estimator.py:91-328).
+
+    Parameters mirror the reference's Param surface where meaningful:
+    model, optimizer (factory ``params -> torch.optim.Optimizer``), loss
+    ("mse" | "l1" | "cross_entropy"), feature_cols, label_cols,
+    batch_size, epochs, num_proc, store.
+    """
+
+    def __init__(self, model, optimizer: Callable | None = None,
+                 loss: str = "mse",
+                 feature_cols: Sequence[str] = ("features",),
+                 label_cols: Sequence[str] = ("label",),
+                 batch_size: int = 32, epochs: int = 1,
+                 num_proc: int = 1, store: Store | None = None,
+                 run_id: str | None = None) -> None:
+        import functools
+
+        import torch
+
+        self.model = model
+        # Factory must be picklable (it travels to spawned workers):
+        # functools.partial of the optimizer class, not a lambda.
+        self.optimizer = optimizer or functools.partial(torch.optim.SGD,
+                                                        lr=0.1)
+        self.loss = loss
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.num_proc = num_proc
+        self.store = store or FilesystemStore(".horovod_tpu_store")
+        self.run_id = run_id
+
+    def fit(self, df) -> "TorchModel":
+        import io
+
+        import torch
+
+        import horovod_tpu as hvd
+
+        run_id = self.run_id or self.store.new_run_id()
+        data_path = self.store.get_train_data_path(run_id)
+        ckpt_path = self.store.get_checkpoint_path(run_id)
+
+        x, y = _extract(df, self.feature_cols, self.label_cols)
+        np.savez(os.path.join(data_path, "train.npz"), x=x, y=y)
+
+        buf = io.BytesIO()
+        torch.save(self.model, buf)
+
+        args = (data_path, ckpt_path, buf.getvalue(), self.optimizer,
+                self.loss, self.batch_size, self.epochs)
+        try:
+            import pyspark  # noqa: F401
+            from . import run as spark_run
+            results = spark_run(_torch_train_fn, args=args,
+                                num_proc=self.num_proc)
+        except ImportError:
+            results = hvd.run(_torch_train_fn, args=args, np=self.num_proc)
+
+        with open(os.path.join(ckpt_path, "model.pt"), "rb") as f:
+            trained = torch.load(io.BytesIO(f.read()), weights_only=False)
+        history = results[0]["history"] if results else []
+        return TorchModel(trained, feature_cols=self.feature_cols,
+                          label_cols=self.label_cols, run_id=run_id,
+                          history=history)
+
+
+class TorchModel:
+    """transform(df) appends prediction columns
+    (reference: spark/torch/estimator.py TorchModel)."""
+
+    def __init__(self, model, feature_cols: Sequence[str],
+                 label_cols: Sequence[str], run_id: str | None = None,
+                 history: list | None = None) -> None:
+        self.model = model
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.run_id = run_id
+        self.history = history or []
+
+    def transform(self, df):
+        import torch
+
+        pdf = _to_pandas(df).copy()
+        x = np.stack([np.asarray(pdf[c].tolist(), dtype=np.float32)
+                      for c in self.feature_cols], axis=-1)
+        if x.ndim > 2 and x.shape[-1] == 1:
+            x = x[..., 0]
+        with torch.no_grad():
+            pred = self.model(torch.from_numpy(x)).numpy()
+        if pred.ndim == 1 or pred.shape[-1] == 1:
+            pdf[f"{self.label_cols[0]}__output"] = pred.reshape(-1)
+        else:
+            for j in range(pred.shape[-1]):
+                pdf[f"{self.label_cols[0]}__output_{j}"] = pred[:, j]
+        return pdf
+
+
+def _keras_train_fn(data_path: str, ckpt_path: str, model_bytes: bytes,
+                    compile_kwargs: dict, batch_size: int,
+                    epochs: int) -> dict:
+    """Per-rank keras loop (reference: spark/keras/remote.py)."""
+    import horovod_tpu as hvd
+    import horovod_tpu.tensorflow as htf
+
+    hvd.init()
+    try:
+        import tensorflow as tf
+
+        rank, world = hvd.rank(), hvd.size()
+        blob = np.load(os.path.join(data_path, "train.npz"))
+        X, Y = blob["x"], blob["y"]
+        n = X.shape[0]
+        per = (n + world - 1) // world
+        xs, ys = X[rank * per:(rank + 1) * per], Y[rank * per:(rank + 1) * per]
+
+        path = os.path.join(data_path, f"model_in_{rank}.keras")
+        with open(path, "wb") as f:
+            f.write(model_bytes)
+        model = tf.keras.models.load_model(path)
+        opt = htf.DistributedOptimizer(
+            tf.keras.optimizers.get(compile_kwargs.get("optimizer", "sgd")))
+        model.compile(optimizer=opt,
+                      loss=compile_kwargs.get("loss", "mse"))
+        hist = model.fit(
+            xs, ys, batch_size=batch_size, epochs=epochs, verbose=0,
+            shuffle=False,
+            callbacks=[htf.BroadcastGlobalVariablesCallback(0)])
+        if rank == 0:
+            # Weights only: the full model would embed the dynamic
+            # Distributed* optimizer class, which cannot deserialize
+            # outside a worker.
+            model.save_weights(
+                os.path.join(ckpt_path, "model.weights.h5"))
+        return {"rank": rank, "history": hist.history}
+    finally:
+        hvd.shutdown()
+
+
+class KerasEstimator:
+    """fit(df) -> KerasModel (reference: spark/keras/estimator.py)."""
+
+    def __init__(self, model, optimizer: Any = "sgd", loss: str = "mse",
+                 feature_cols: Sequence[str] = ("features",),
+                 label_cols: Sequence[str] = ("label",),
+                 batch_size: int = 32, epochs: int = 1,
+                 num_proc: int = 1, store: Store | None = None) -> None:
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.num_proc = num_proc
+        self.store = store or FilesystemStore(".horovod_tpu_store")
+
+    def fit(self, df) -> "KerasModel":
+        import horovod_tpu as hvd
+
+        run_id = self.store.new_run_id()
+        data_path = self.store.get_train_data_path(run_id)
+        ckpt_path = self.store.get_checkpoint_path(run_id)
+        x, y = _extract(df, self.feature_cols, self.label_cols)
+        np.savez(os.path.join(data_path, "train.npz"), x=x, y=y)
+
+        tmp = os.path.join(data_path, "model_in.keras")
+        self.model.save(tmp)
+        with open(tmp, "rb") as f:
+            model_bytes = f.read()
+
+        compile_kwargs = {"optimizer": self.optimizer, "loss": self.loss}
+        args = (data_path, ckpt_path, model_bytes, compile_kwargs,
+                self.batch_size, self.epochs)
+        try:
+            import pyspark  # noqa: F401
+            from . import run as spark_run
+            results = spark_run(_keras_train_fn, args=args,
+                                num_proc=self.num_proc)
+        except ImportError:
+            results = hvd.run(_keras_train_fn, args=args, np=self.num_proc)
+
+        self.model.load_weights(
+            os.path.join(ckpt_path, "model.weights.h5"))
+        trained = self.model
+        history = results[0]["history"] if results else {}
+        return KerasModel(trained, feature_cols=self.feature_cols,
+                          label_cols=self.label_cols, run_id=run_id,
+                          history=history)
+
+
+class KerasModel:
+    def __init__(self, model, feature_cols: Sequence[str],
+                 label_cols: Sequence[str], run_id: str | None = None,
+                 history: dict | None = None) -> None:
+        self.model = model
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.run_id = run_id
+        self.history = history or {}
+
+    def transform(self, df):
+        pdf = _to_pandas(df).copy()
+        x = np.stack([np.asarray(pdf[c].tolist(), dtype=np.float32)
+                      for c in self.feature_cols], axis=-1)
+        if x.ndim > 2 and x.shape[-1] == 1:
+            x = x[..., 0]
+        pred = self.model.predict(x, verbose=0)
+        if pred.ndim == 1 or pred.shape[-1] == 1:
+            pdf[f"{self.label_cols[0]}__output"] = pred.reshape(-1)
+        else:
+            for j in range(pred.shape[-1]):
+                pdf[f"{self.label_cols[0]}__output_{j}"] = pred[:, j]
+        return pdf

@@ -1,0 +1,78 @@
+"""Training artifact store for the Estimator API.
+
+Reference: horovod/spark/common/store.py:36-533 — a `Store` abstracts
+where intermediate training data, checkpoints and logs live
+(FilesystemStore/HDFSStore/DBFSLocalStore). Scoped here to the local
+filesystem (petastorm/HDFS are out of scope for the TPU build; the data
+path is numpy shards, not parquet row groups).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import uuid
+from typing import Any
+
+
+class Store:
+    """Base interface (reference: store.py Store)."""
+
+    def get_run_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_train_data_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def save_object(self, path: str, obj: Any) -> None:
+        raise NotImplementedError
+
+    def load_object(self, path: str) -> Any:
+        raise NotImplementedError
+
+    @staticmethod
+    def create(prefix_path: str) -> "FilesystemStore":
+        return FilesystemStore(prefix_path)
+
+
+class FilesystemStore(Store):
+    """Local/NFS directory store (reference: store.py FilesystemStore)."""
+
+    def __init__(self, prefix_path: str) -> None:
+        self.prefix_path = os.path.abspath(prefix_path)
+        os.makedirs(self.prefix_path, exist_ok=True)
+
+    def new_run_id(self) -> str:
+        return uuid.uuid4().hex[:12]
+
+    def get_run_path(self, run_id: str) -> str:
+        path = os.path.join(self.prefix_path, "runs", run_id)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        path = os.path.join(self.get_run_path(run_id), "checkpoints")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def get_train_data_path(self, run_id: str) -> str:
+        path = os.path.join(self.get_run_path(run_id), "data")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def save_object(self, path: str, obj: Any) -> None:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(obj, f)
+        os.replace(tmp, path)
+
+    def load_object(self, path: str) -> Any:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def cleanup_run(self, run_id: str) -> None:
+        shutil.rmtree(os.path.join(self.prefix_path, "runs", run_id),
+                      ignore_errors=True)

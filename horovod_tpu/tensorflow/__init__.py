@@ -1,14 +1,20 @@
 """TensorFlow binding (reference: horovod/tensorflow/__init__.py).
 
-TensorFlow is optional; when it is importable this module exposes the
-Horovod-compatible TF surface over the shared eager runtime: collectives on
-TF tensors (via numpy interop), ``DistributedGradientTape``, and
-``broadcast_variables``.  The native TPU path for new code is the JAX SPMD
-Trainer — this binding exists so reference TF scripts keep a migration
-path.
+TensorFlow is optional; when importable this module exposes the
+Horovod-compatible TF surface over the shared eager runtime. Collectives
+are built from ``tf.py_function`` bridges wrapped in ``tf.custom_gradient``
+so they survive ``tf.function`` tracing and compiled ``model.fit`` loops —
+the role the reference's AsyncOpKernels + RegisterGradient play
+(reference: tensorflow/mpi_ops.cc:422-921, tensorflow/mpi_ops.py:125-334).
+IndexedSlices (sparse) gradients fall back to an allgather of values and
+indices, mirroring reference __init__.py:54-155.
+
+The native TPU path for new code is the JAX SPMD Trainer — this binding
+exists so reference TF scripts keep a migration path.
 """
 from __future__ import annotations
 
+import itertools
 from typing import Any
 
 try:
@@ -26,8 +32,11 @@ from .. import (Adasum, Average, Sum, allgather as _allgather_np,
 __all__ = ["init", "shutdown", "rank", "size", "local_rank", "local_size",
            "allreduce", "allgather", "broadcast", "alltoall", "join",
            "broadcast_object", "broadcast_variables",
-           "DistributedGradientTape", "Average", "Sum", "Adasum",
-           "is_initialized"]
+           "DistributedGradientTape", "DistributedOptimizer",
+           "BroadcastGlobalVariablesCallback", "Average", "Sum", "Adasum",
+           "Compression", "SyncBatchNormalization", "is_initialized"]
+
+_name_counter = itertools.count()
 
 
 def _require_tf() -> None:
@@ -38,42 +47,136 @@ def _require_tf() -> None:
             "(horovod_tpu.training.Trainer) is the supported TPU surface.")
 
 
-def _to_tf(value, like):
-    import tensorflow as tf
-    return tf.convert_to_tensor(value, dtype=like.dtype)
+def _auto_name(prefix: str, name: str | None) -> str:
+    """Stable per-trace name: ranks trace identical programs in identical
+    order, so the counter assigns every collective the same name on every
+    rank (the negotiation key, reference: controller.cc ConstructResponse)."""
+    return name or f"{prefix}.{next(_name_counter)}"
 
 
+def _py_collective(fn, inp, out_dtype, out_shape=None):
+    """Run a numpy collective inside the TF graph via tf.py_function."""
+    out = tf.py_function(func=fn, inp=inp, Tout=out_dtype)
+    if out_shape is not None:
+        out.set_shape(out_shape)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Collectives (graph-safe, differentiable)
+# ---------------------------------------------------------------------------
 def allreduce(tensor, average: bool | None = None, op=None,
               name: str | None = None, prescale_factor: float = 1.0,
-              postscale_factor: float = 1.0):
+              postscale_factor: float = 1.0, compression=None):
     _require_tf()
-    out = _allreduce_np(tensor.numpy(), average=average, op=op, name=name,
-                        prescale_factor=prescale_factor,
-                        postscale_factor=postscale_factor)
-    return _to_tf(out, tensor)
+    if isinstance(tensor, tf.IndexedSlices):
+        # Sparse fallback: allgather values+indices; averaging divides by
+        # size (reference: tensorflow/__init__.py:54-155).
+        nm = _auto_name("sparse_ar", name)
+        values = allgather(tensor.values, name=f"{nm}.values")
+        indices = allgather(tensor.indices, name=f"{nm}.indices")
+        if op in (None, Average) and average is not False and op is not Sum:
+            values = values / size()
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+
+    nm = _auto_name("allreduce", name)
+    compressor = compression or Compression.none
+    the_op = op if op is not None else (
+        Sum if average is False else Average)
+
+    @tf.custom_gradient
+    def _allreduce(t):
+        compressed, ctx = compressor.compress(t)
+
+        def _run(x):
+            return _allreduce_np(x.numpy(), op=the_op, name=nm,
+                                 prescale_factor=prescale_factor,
+                                 postscale_factor=postscale_factor)
+
+        out = _py_collective(_run, [compressed], compressed.dtype, t.shape)
+        out = compressor.decompress(out, ctx)
+
+        def grad(dy):
+            # Gradient of an allreduce is an allreduce with the same op
+            # (reference: tensorflow/mpi_ops.py:125-143).
+            return allreduce(dy, op=the_op, name=f"{nm}.grad")
+
+        return out, grad
+
+    return _allreduce(tf.convert_to_tensor(tensor))
 
 
 def allgather(tensor, name: str | None = None):
     _require_tf()
-    return _to_tf(_allgather_np(tensor.numpy(), name=name), tensor)
+    nm = _auto_name("allgather", name)
+
+    @tf.custom_gradient
+    def _allgather(t):
+        def _run(x):
+            return _allgather_np(x.numpy(), name=nm)
+
+        out = _py_collective(_run, [t], t.dtype,
+                             tf.TensorShape([None]).concatenate(
+                                 t.shape[1:]))
+
+        def grad(dy):
+            # d(allgather)/dt = our slice of the summed upstream grad
+            # (reference: tensorflow/mpi_ops.py allgather grad).
+            d0 = tf.shape(t)[0]
+            sizes = allgather(tf.reshape(d0, [1]), name=f"{nm}.gsizes")
+            offset = tf.reduce_sum(sizes[:rank()])
+            summed = allreduce(dy, op=Sum, name=f"{nm}.grad")
+            return summed[offset:offset + d0]
+
+        return out, grad
+
+    return _allgather(tf.convert_to_tensor(tensor))
 
 
 def broadcast(tensor, root_rank: int = 0, name: str | None = None):
     _require_tf()
-    return _to_tf(_broadcast_np(tensor.numpy(), root_rank, name=name),
-                  tensor)
+    nm = _auto_name("broadcast", name)
+
+    @tf.custom_gradient
+    def _broadcast(t):
+        def _run(x):
+            return _broadcast_np(x.numpy(), root_rank, name=nm)
+
+        out = _py_collective(_run, [t], t.dtype, t.shape)
+
+        def grad(dy):
+            # Root accumulates every rank's gradient; others contribute
+            # zero (reference: tensorflow/mpi_ops.py broadcast grad).
+            summed = allreduce(dy, op=Sum, name=f"{nm}.grad")
+            if rank() == root_rank:
+                return summed
+            return tf.zeros_like(dy)
+
+        return out, grad
+
+    return _broadcast(tf.convert_to_tensor(tensor))
 
 
 def alltoall(tensor, splits=None, name: str | None = None):
     _require_tf()
-    result = _alltoall_np(tensor.numpy(),
-                          None if splits is None else splits.numpy(),
-                          name=name)
+    nm = _auto_name("alltoall", name)
     if splits is None:
-        return _to_tf(result, tensor)
-    out, recv_splits = result
-    import tensorflow as tf
-    return _to_tf(out, tensor), tf.convert_to_tensor(recv_splits)
+        def _run_even(x):
+            return _alltoall_np(x.numpy(), None, name=nm)
+        return _py_collective(_run_even, [tensor], tensor.dtype,
+                              tensor.shape)
+
+    def _run(x, s):
+        out, recv = _alltoall_np(x.numpy(), s.numpy(), name=nm)
+        return out, recv.astype("int32") if hasattr(recv, "astype") \
+            else tf.constant(recv, tf.int32)
+
+    out, recv_splits = tf.py_function(
+        func=_run, inp=[tensor, splits], Tout=[tensor.dtype, tf.int32])
+    out.set_shape(tf.TensorShape([None]).concatenate(tensor.shape[1:]))
+    recv_splits.set_shape([None])
+    return out, recv_splits
 
 
 def broadcast_variables(variables, root_rank: int = 0) -> None:
@@ -81,24 +184,40 @@ def broadcast_variables(variables, root_rank: int = 0) -> None:
     (reference: tensorflow/__init__.py broadcast_global_variables)."""
     _require_tf()
     for i, var in enumerate(variables):
-        var.assign(_to_tf(_broadcast_np(var.numpy(), root_rank,
-                                        name=f"bcast_var.{i}"), var))
+        # Index-keyed names: keras-3 variable names ("kernel") are not
+        # unique, and the tensor-queue rejects duplicate in-flight names.
+        var.assign(broadcast(tf.convert_to_tensor(var), root_rank,
+                             name=f"bcast.{i}"))
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    _require_tf()
+    broadcast_variables(tf.compat.v1.global_variables(), root_rank)
 
 
 class DistributedGradientTape:
     """Wrap tf.GradientTape so gradient() allreduces the grads
-    (reference: tensorflow/__init__.py:726-816)."""
+    (reference: tensorflow/__init__.py:726-816). Works inside
+    ``tf.function`` — the collectives are graph ops."""
 
     def __init__(self, tape, op=None, prescale_factor: float = 1.0,
-                 postscale_factor: float = 1.0) -> None:
+                 postscale_factor: float = 1.0, compression=None) -> None:
         _require_tf()
         self._tape = tape
         self._op = op
         self._pre = prescale_factor
         self._post = postscale_factor
+        self._compression = compression
 
     def __getattr__(self, item: str) -> Any:
         return getattr(self._tape, item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
 
     def gradient(self, target, sources, output_gradients=None):
         grads = self._tape.gradient(target, sources, output_gradients)
@@ -108,6 +227,81 @@ class DistributedGradientTape:
         reduced = [None if g is None else
                    allreduce(g, op=self._op, name=f"grad.{i}",
                              prescale_factor=self._pre,
-                             postscale_factor=self._post)
+                             postscale_factor=self._post,
+                             compression=self._compression)
                    for i, g in enumerate(grad_list)]
         return reduced[0] if single else reduced
+
+
+def DistributedOptimizer(optimizer, name: str | None = None,
+                         compression=None,
+                         backward_passes_per_step: int = 1,
+                         op=None, **kwargs):
+    """Wrap a keras optimizer: gradients are locally aggregated for
+    ``backward_passes_per_step`` steps, then allreduced before apply
+    (reference: tensorflow/__init__.py:427-502 + gradient_aggregation.py).
+
+    The SAME instance is returned with its class swapped, preserving slot
+    variables and iteration counters."""
+    _require_tf()
+    from .gradient_aggregation import LocalGradientAggregationHelper
+
+    base = optimizer.__class__
+    helper = LocalGradientAggregationHelper(
+        backward_passes_per_step=backward_passes_per_step,
+        allreduce_func=lambda g, i: allreduce(
+            g, op=op, name=f"opt_grad.{i}", compression=compression),
+    )
+
+    class _Distributed(base):
+        def apply_gradients(self, grads_and_vars, **apply_kwargs):
+            grads_and_vars = list(grads_and_vars)
+            grads = [g for g, _ in grads_and_vars]
+            variables = [v for _, v in grads_and_vars]
+            return self._hvd_helper.apply_gradients(
+                grads, variables,
+                lambda gv: super(_Distributed, self).apply_gradients(
+                    gv, **apply_kwargs))
+
+    _Distributed.__name__ = f"Distributed{base.__name__}"
+    optimizer.__class__ = _Distributed
+    optimizer._hvd_helper = helper
+    return optimizer
+
+
+if _TF_AVAILABLE:
+    from .compression import Compression  # noqa: E402
+    from .elastic import TensorFlowKerasState, TensorFlowState  # noqa: E402,F401
+    from .sync_batch_norm import SyncBatchNormalization  # noqa: E402
+
+    class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
+        """Keras callback: broadcast initial variables from root on the
+        first batch — after the optimizer has created its slots
+        (reference: tensorflow/__init__.py BroadcastGlobalVariablesHook /
+        _keras/callbacks.py BroadcastGlobalVariablesCallback)."""
+
+        def __init__(self, root_rank: int = 0) -> None:
+            super().__init__()
+            self.root_rank = root_rank
+            self._done = False
+
+        def on_train_batch_begin(self, batch, logs=None) -> None:
+            if self._done or self.model is None:
+                return
+            variables = list(self.model.variables)
+            opt = getattr(self.model, "optimizer", None)
+            if opt is not None:
+                variables += list(opt.variables)
+            broadcast_variables(variables, self.root_rank)
+            self._done = True
+else:  # gated stubs so `import horovod_tpu.tensorflow` always works
+    class Compression:  # type: ignore[no-redef]
+        none = None
+        fp16 = None
+
+    def SyncBatchNormalization(*_a, **_k):  # type: ignore[no-redef]
+        _require_tf()
+
+    class BroadcastGlobalVariablesCallback:  # type: ignore[no-redef]
+        def __init__(self, *_a, **_k) -> None:
+            _require_tf()

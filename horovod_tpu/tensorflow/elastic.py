@@ -1,0 +1,100 @@
+"""Elastic state for TensorFlow/Keras models.
+
+Reference: horovod/tensorflow/elastic.py:1-221 — ``TensorFlowKerasState``
+snapshots model + optimizer weights in host memory on ``commit()``,
+restores them after a ``HorovodInternalError``, and ``sync()`` broadcasts
+rank 0's weights to the re-formed world.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+from ..elastic.state import ObjectState
+
+
+class _VariablesHandler:
+    """Snapshot/restore/broadcast a list of tf.Variables by value."""
+
+    def __init__(self, variables) -> None:
+        self.variables = list(variables)
+        self._saved = None
+        self.save()
+
+    def save(self) -> None:
+        self._saved = [v.numpy().copy() for v in self.variables]
+
+    def restore(self) -> None:
+        for var, value in zip(self.variables, self._saved):
+            var.assign(value)
+
+    def sync(self) -> None:
+        from . import broadcast_variables
+        broadcast_variables(self.variables, root_rank=0)
+        self.save()
+
+
+class TensorFlowState(ObjectState):
+    """Elastic state over explicit tf.Variables
+    (reference: tensorflow/elastic.py TensorFlowState)."""
+
+    def __init__(self, variables=None, **kwargs: Any) -> None:
+        import tensorflow as tf
+        self._handler = _VariablesHandler(
+            variables if variables is not None
+            else tf.compat.v1.global_variables())
+        super().__init__(**kwargs)
+
+    def save(self) -> None:
+        self._handler.save()
+        super().save()
+
+    def restore(self) -> None:
+        self._handler.restore()
+        super().restore()
+
+    def sync(self) -> None:
+        self._handler.sync()
+        super().sync()
+
+
+class TensorFlowKerasState(ObjectState):
+    """Elastic state for a keras model + optimizer
+    (reference: tensorflow/elastic.py TensorFlowKerasState)."""
+
+    def __init__(self, model, optimizer=None, **kwargs: Any) -> None:
+        self.model = model
+        self.optimizer = optimizer or getattr(model, "optimizer", None)
+        self._model_weights = copy.deepcopy(model.get_weights())
+        self._opt_weights = self._get_opt_weights()
+        super().__init__(**kwargs)
+
+    def _get_opt_weights(self):
+        if self.optimizer is None:
+            return None
+        return [v.numpy().copy() for v in self.optimizer.variables]
+
+    def _set_opt_weights(self, weights) -> None:
+        if self.optimizer is None or weights is None:
+            return
+        for var, value in zip(self.optimizer.variables, weights):
+            var.assign(value)
+
+    def save(self) -> None:
+        self._model_weights = copy.deepcopy(self.model.get_weights())
+        self._opt_weights = self._get_opt_weights()
+        super().save()
+
+    def restore(self) -> None:
+        self.model.set_weights(self._model_weights)
+        self._set_opt_weights(self._opt_weights)
+        super().restore()
+
+    def sync(self) -> None:
+        from . import broadcast_variables
+        variables = list(self.model.variables)
+        if self.optimizer is not None:
+            variables += list(self.optimizer.variables)
+        broadcast_variables(variables, root_rank=0)
+        self.save()
+        super().sync()

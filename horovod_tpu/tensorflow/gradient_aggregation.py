@@ -1,0 +1,81 @@
+"""Local gradient aggregation for ``backward_passes_per_step``.
+
+Reference: horovod/tensorflow/gradient_aggregation.py (graph, 274 LoC) +
+gradient_aggregation_eager.py (155 LoC). One implementation here serves
+both eager and ``tf.function`` callers: tf.Variable accumulators + a step
+counter, ``tf.cond`` on the counter so the traced graph contains both the
+accumulate-only and the allreduce-and-apply branches.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import tensorflow as tf
+
+
+class LocalGradientAggregationHelper:
+    """Accumulate gradients locally for N backward passes, then allreduce
+    once and apply — cutting allreduce traffic N× for small-batch regimes
+    (reference: gradient_aggregation.py LocalGradientAggregationHelper)."""
+
+    def __init__(self, backward_passes_per_step: int,
+                 allreduce_func: Callable[[tf.Tensor, int], tf.Tensor],
+                 average_aggregated_gradients: bool = True) -> None:
+        if backward_passes_per_step < 1:
+            raise ValueError("backward_passes_per_step must be >= 1")
+        self.backward_passes_per_step = backward_passes_per_step
+        self.allreduce_func = allreduce_func
+        self.average_aggregated_gradients = average_aggregated_gradients
+        self.counter: tf.Variable | None = None
+        self._accum: list[tf.Variable] = []
+
+    def _init_state(self, grads: Sequence[tf.Tensor]) -> None:
+        if self.counter is None:
+            self.counter = tf.Variable(0, dtype=tf.int32, trainable=False,
+                                       name="hvd_agg_counter")
+        if not self._accum:
+            self._accum = [
+                tf.Variable(tf.zeros_like(g), trainable=False,
+                            name=f"hvd_agg_{i}")
+                for i, g in enumerate(grads)]
+
+    def apply_gradients(self, grads: Sequence[tf.Tensor],
+                        variables: Sequence[tf.Variable],
+                        apply_fn: Callable[[list], object]):
+        """Accumulate; on the Nth pass allreduce the sums and run
+        ``apply_fn(grads_and_vars)``. Returns apply_fn's result on apply
+        steps (None on accumulate-only steps in eager mode)."""
+        n = self.backward_passes_per_step
+        if n == 1:
+            reduced = [g if g is None else self.allreduce_func(g, i)
+                       for i, g in enumerate(grads)]
+            return apply_fn(list(zip(reduced, variables)))
+
+        dense_grads = [g if g is not None else tf.zeros_like(v)
+                       for g, v in zip(grads, variables)]
+        self._init_state(dense_grads)
+        for acc, g in zip(self._accum, dense_grads):
+            acc.assign_add(g)
+        self.counter.assign_add(1)
+
+        def _apply():
+            scale = float(n) if self.average_aggregated_gradients else 1.0
+            reduced = [self.allreduce_func(acc / scale, i)
+                       for i, acc in enumerate(self._accum)]
+            result = apply_fn(list(zip(reduced, variables)))
+            for acc in self._accum:
+                acc.assign(tf.zeros_like(acc))
+            self.counter.assign(0)
+            return result
+
+        if tf.executing_eagerly():
+            if int(self.counter.numpy()) >= n:
+                return _apply()
+            return None
+        # Graph mode: both branches live in the trace; tf.cond picks one
+        # at run time (branch outputs must match, so apply's result is
+        # dropped and a did-apply flag returned instead).
+        return tf.cond(
+            self.counter >= n,
+            lambda: (_apply(), tf.constant(True))[1],
+            lambda: tf.constant(False))

@@ -1,0 +1,46 @@
+"""Cross-rank synchronized batch normalization for TF/Keras.
+
+Reference: horovod/tensorflow/sync_batch_norm.py — a BatchNormalization
+subclass whose moments are computed over the GLOBAL batch: per-rank
+(sum, sum-of-squares, count) are allreduced, so every replica normalizes
+with identical statistics. Gradients of the normalized output flow through
+the allreduce's own gradient (the collectives are differentiable graph
+ops), matching the reference's distributed-moments construction.
+"""
+from __future__ import annotations
+
+import tensorflow as tf
+
+
+class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
+    """Drop-in keras BatchNormalization with cross-rank statistics."""
+
+    def __init__(self, *args, **kwargs) -> None:
+        if kwargs.pop("fused", None):
+            raise ValueError(
+                "SyncBatchNormalization does not support fused=True")
+        super().__init__(*args, **kwargs)
+
+    def _moments(self, inputs, mask):
+        # Keras 3 signature; reduction axes live on the layer.
+        from . import Sum, allreduce, size
+
+        mean, variance = super()._moments(inputs, mask)
+        if size() <= 1:
+            return mean, variance
+
+        # Weight by per-rank element count so uneven local batches still
+        # produce exact global moments (reference: sync_batch_norm.py).
+        reduction_axes = list(self._reduction_axes)
+        shape = tf.shape(inputs)
+        count = tf.cast(tf.reduce_prod(
+            tf.gather(shape, reduction_axes)), mean.dtype)
+        total_count = allreduce(tf.reshape(count, [1]), op=Sum,
+                                name="syncbn.count")[0]
+        global_mean = allreduce(mean * count, op=Sum,
+                                name="syncbn.mean") / total_count
+        # var_global = E[x^2] - E[x]^2, from per-rank E[x^2] contributions.
+        sq = allreduce((variance + tf.square(mean)) * count, op=Sum,
+                       name="syncbn.sq") / total_count
+        global_var = sq - tf.square(global_mean)
+        return global_mean, global_var

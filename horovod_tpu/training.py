@@ -165,6 +165,20 @@ class Trainer:
                 state, step=state.step + 1, params=params,
                 opt_state=opt_state, batch_stats=new_stats), metrics
 
+        if not manual_axes:
+            # Pure-GSPMD mode (sync.axes == ()): no manual axes at all —
+            # XLA derives every collective (incl. gradient reductions)
+            # from the arrays' shardings. Required when the model embeds
+            # its own shard_map regions (e.g. MoE expert-parallel over
+            # "ep"), which cannot nest inside a manual region.
+            def gspmd_step(state, batch):
+                batch = {k: jax.lax.with_sharding_constraint(
+                    v, NamedSharding(self.mesh, self.batch_spec))
+                    for k, v in batch.items()}
+                return local_step(state, batch)
+
+            return jax.jit(gspmd_step, donate_argnums=(0,))
+
         mapped = shard_map(
             local_step, mesh=self.mesh,
             in_specs=(state_specs, self.batch_spec),

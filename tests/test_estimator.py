@@ -1,0 +1,84 @@
+"""Spark-ML-style Estimator facade (VERDICT r1 item 7).
+
+Reference: horovod/spark/torch/estimator.py:91-328 + spark/common/store.py.
+Runs on pandas DataFrames (pyspark absent in this image) over real forked
+workers via horovod_tpu.run — fit() must train distributed (2 ranks),
+persist the model through the FilesystemStore, and transform() must append
+prediction columns.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from horovod_tpu.spark import FilesystemStore
+
+
+def _linear_df(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 3)).astype(np.float32)
+    w = np.array([1.5, -2.0, 0.5], np.float32)
+    y = x @ w + 0.1
+    return pd.DataFrame({
+        "f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2], "label": y})
+
+
+def test_store_layout(tmp_path):
+    store = FilesystemStore(str(tmp_path / "store"))
+    run_id = store.new_run_id()
+    ckpt = store.get_checkpoint_path(run_id)
+    data = store.get_train_data_path(run_id)
+    assert ckpt.startswith(store.get_run_path(run_id))
+    assert data != ckpt
+    store.save_object(f"{ckpt}/meta.pkl", {"epoch": 3})
+    assert store.load_object(f"{ckpt}/meta.pkl") == {"epoch": 3}
+    store.cleanup_run(run_id)
+    import os
+    assert not os.path.exists(store.get_run_path(run_id) + "/checkpoints")
+
+
+def test_torch_estimator_fit_transform(tmp_path):
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark import TorchEstimator
+
+    torch.manual_seed(0)
+    model = torch.nn.Linear(3, 1)
+    df = _linear_df()
+    import functools
+    est = TorchEstimator(
+        model=model,
+        optimizer=functools.partial(torch.optim.SGD, lr=0.2),
+        loss="mse", feature_cols=["f0", "f1", "f2"],
+        label_cols=["label"], batch_size=16, epochs=20, num_proc=2,
+        store=FilesystemStore(str(tmp_path / "store")))
+    trained = est.fit(df)
+
+    # Distributed training converged on the linear target.
+    assert trained.history[-1] < trained.history[0]
+    assert trained.history[-1] < 0.05
+
+    out = trained.transform(df)
+    assert "label__output" in out.columns
+    err = np.mean((out["label__output"].to_numpy()
+                   - df["label"].to_numpy()) ** 2)
+    assert err < 0.05
+
+
+def test_keras_estimator_fit_transform(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    from horovod_tpu.spark import KerasEstimator
+
+    tf.keras.utils.set_random_seed(1)
+    model = tf.keras.Sequential([tf.keras.layers.Input(shape=(3,)),
+                                 tf.keras.layers.Dense(1)])
+    df = _linear_df()
+    est = KerasEstimator(
+        model=model, optimizer="sgd", loss="mse",
+        feature_cols=["f0", "f1", "f2"], label_cols=["label"],
+        batch_size=16, epochs=15, num_proc=2,
+        store=FilesystemStore(str(tmp_path / "store")))
+    trained = est.fit(df)
+    losses = trained.history.get("loss", [])
+    assert losses and losses[-1] < losses[0]
+
+    out = trained.transform(df)
+    assert "label__output" in out.columns
