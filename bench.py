@@ -10,11 +10,18 @@ on 16 P100 GPUs for ResNet-101 tf_cnn_benchmarks (docs/benchmarks.rst:32-43)
 = 103.55 images/sec/device. vs_baseline = our images/sec/chip / 103.55.
 
 Robustness contract (the driver records rc + the one JSON line):
-- The TPU backend is probed in a SUBPROCESS with a timeout first — the
-  experimental axon tunnel can wedge backend discovery indefinitely, which
-  would hang this process unrecoverably. Probe failures retry with backoff,
-  then fall back to the CPU backend so a structured JSON line is always
-  printed (rc 0), with "backend" recording what actually ran.
+- Every accelerator run happens in an INNER SUBPROCESS with a hard
+  timeout: the experimental axon tunnel can wedge backend discovery or
+  die mid-step (`remote_compile: read body`, the BENCH_r02 failure), and
+  a dead PJRT client poisons the whole process. The parent retries the
+  inner run with backoff, then falls back to a CPU inner run, so a
+  structured JSON line is always printed with rc 0 — "backend" records
+  what actually ran.
+- A persistent JAX compilation cache (JAX_COMPILATION_CACHE_DIR) makes
+  retry attempts skip recompilation, shrinking first-compile exposure to
+  the flaky tunnel.
+- Inside the inner run the backend is additionally probed in a
+  sub-subprocess first (a wedged tunnel hangs jax.devices() forever).
 - "mfu" reports achieved_flops/peak_flops from XLA cost analysis when the
   chip's peak is known (null otherwise) so "fast" is measurable, not just
   "faster than 2017 P100s".
@@ -89,6 +96,12 @@ def _init_backend(retries: int = 2, timeout: float = 150.0) -> dict:
         if attempt + 1 < retries:
             time.sleep(10.0)
     if probed is None:
+        if os.environ.get("HVD_BENCH_REQUIRE_ACCEL"):
+            # Orchestrator attempt run: fail fast so the parent's retry
+            # loop re-probes — do NOT burn minutes on a CPU benchmark
+            # whose payload the parent would discard anyway.
+            raise RuntimeError("accelerator probe failed "
+                               "(HVD_BENCH_REQUIRE_ACCEL set)")
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -123,6 +136,79 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload))
 
 
+_CACHE_DIR = "/tmp/horovod_tpu_jax_cache"
+
+
+def _spawn_inner(args, extra_env: dict, timeout: float
+                 ) -> tuple[int, dict | None, str]:
+    """Run one benchmark attempt in a subprocess; return (rc, parsed JSON
+    payload or None, stderr tail)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--inner",
+           "--model", args.model,
+           "--batch-size", str(args.batch_size),
+           "--image-size", str(args.image_size),
+           "--seq-len", str(args.seq_len),
+           "--warmup", str(args.warmup),
+           "--iters", str(args.iters)]
+    env = {**os.environ, **extra_env,
+           "JAX_COMPILATION_CACHE_DIR": _CACHE_DIR}
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return -1, None, f"inner run timed out after {timeout:.0f}s"
+    payload = None
+    for line in reversed(out.stdout.strip().splitlines()):
+        try:
+            cand = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(cand, dict) and "metric" in cand:
+            payload = cand
+            break
+    return out.returncode, payload, out.stderr[-2000:]
+
+
+def _orchestrate(args) -> int:
+    """Retry-with-backoff wrapper around the inner accelerator run; CPU
+    fallback keeps the robustness contract (structured line, rc 0) when
+    the accelerator tunnel is down for the whole window."""
+    attempts = 3
+    for attempt in range(attempts):
+        # Attempt runs fail fast on probe failure (HVD_BENCH_REQUIRE_ACCEL)
+        # instead of silently completing a CPU benchmark the retry loop
+        # would discard; CPU execution happens only in the final explicit
+        # fallback below.
+        rc, payload, err = _spawn_inner(
+            args, {"HVD_BENCH_REQUIRE_ACCEL": "1"}, timeout=900.0)
+        if rc == 0 and payload and \
+                not str(payload.get("metric", "")).endswith("_failed") and \
+                payload.get("backend") != "cpu-fallback":
+            payload["attempts"] = attempt + 1
+            _emit(payload)
+            return 0
+        print(f"bench: attempt {attempt + 1}/{attempts} failed "
+              f"(rc={rc}): {err}", file=sys.stderr)
+        if attempt + 1 < attempts:
+            time.sleep(15.0 * (attempt + 1))
+    print("bench: accelerator attempts exhausted; falling back to CPU",
+          file=sys.stderr)
+    rc, payload, err = _spawn_inner(args, {"JAX_PLATFORMS": "cpu"},
+                                    timeout=900.0)
+    if rc == 0 and payload:
+        payload["backend"] = "cpu-fallback"
+        payload["attempts"] = attempts + 1
+        payload["note"] = ("accelerator unavailable after "
+                          f"{attempts} attempts; numbers are CPU-only")
+        _emit(payload)
+        return 0
+    # Even CPU died — still one structured line, rc 0 per the contract.
+    _emit({"metric": f"{args.model}_failed", "value": 0.0, "unit": "error",
+           "vs_baseline": 0.0, "backend": "none",
+           "error": f"all attempts failed; last: rc={rc} {err[-500:]}"})
+    return 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="resnet50",
@@ -135,10 +221,22 @@ def main() -> int:
     parser.add_argument("--seq-len", type=int, default=2048)
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--inner", action="store_true",
+                        help="internal: run one attempt in-process")
     args = parser.parse_args()
-    try:
-        if args.model == "eager":
+    if args.model == "eager":   # CPU/localhost only — no tunnel exposure
+        try:
             return bench_eager(args)
+        except Exception as exc:
+            import traceback
+            traceback.print_exc()
+            _emit({"metric": "eager_failed", "value": 0.0, "unit": "error",
+                   "vs_baseline": 0.0,
+                   "error": f"{type(exc).__name__}: {exc}"})
+            return 1
+    if not args.inner:
+        return _orchestrate(args)
+    try:
         info = _init_backend()
         if args.model == "gpt":
             return bench_gpt(args, info)

@@ -202,8 +202,34 @@ def grouped_allreduce(tensors: Sequence[Any], average: bool | None = None,
             for t, e in zip(tensors, handle.entries)]
 
 
+def reducescatter_async(tensor, name: str | None = None, op=None,
+                        prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0) -> Handle:
+    # op=None averages, matching upstream Horovod's reducescatter default
+    # (and this package's allreduce _op_kind mapping).
+    if op in (None, Average):
+        op_name = "average"
+    elif op is Sum:
+        op_name = "sum"
+    else:
+        raise ValueError(f"Unknown reducescatter op: {op}")
+    _, handle = core.enqueue_reducescatter(
+        _auto_name("reducescatter", name), tensor, op=op_name,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+    handle.wrap_refs = [tensor]
+    return handle
+
+
 def allgather(tensor, name: str | None = None):
     return _result(allgather_async(tensor, name), tensor)
+
+
+def reducescatter(tensor, name: str | None = None, op=None,
+                  prescale_factor: float = 1.0,
+                  postscale_factor: float = 1.0):
+    """Reduce over all ranks and return this rank's dim-0 slice."""
+    return _result(reducescatter_async(tensor, name, op, prescale_factor,
+                                       postscale_factor), tensor)
 
 
 def broadcast(tensor, root_rank: int, name: str | None = None):

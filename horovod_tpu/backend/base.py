@@ -18,10 +18,42 @@ from ..common.status import Status
 from ..common.tensor_queue import TensorTableEntry
 
 
+class FusionBufferManager:
+    """Persistent fusion staging buffers — the analogue of the reference's
+    one-per-(device, framework) buffer (fusion_buffer_manager.cc): lazily
+    allocated, grown geometrically, reused every cycle so steady-state
+    fused responses pay zero allocations."""
+
+    def __init__(self) -> None:
+        self._buffers: dict[tuple[str, str], np.ndarray] = {}
+
+    def get(self, tag: str, dtype, n: int) -> np.ndarray:
+        key = (tag, np.dtype(dtype).str)
+        buf = self._buffers.get(key)
+        if buf is None or buf.size < n:
+            cap = max(n, 0 if buf is None else 2 * buf.size)
+            buf = np.empty(cap, dtype=dtype)
+            self._buffers[key] = buf
+        return buf[:n]
+
+    def owns(self, arr: np.ndarray) -> bool:
+        """True if ``arr`` is (a view of) a managed buffer — such results
+        must be copied out before the next cycle clobbers them."""
+        return any(arr is b or arr.base is b
+                   for b in self._buffers.values())
+
+
 class CollectiveBackend(ABC):
     """One data-plane implementation of the collective ops."""
 
     name = "abstract"
+
+    @property
+    def fusion_buffers(self) -> FusionBufferManager:
+        fb = getattr(self, "_fusion_buffers", None)
+        if fb is None:
+            fb = self._fusion_buffers = FusionBufferManager()
+        return fb
 
     @abstractmethod
     def enabled(self, response: Response, entries: list[TensorTableEntry]) -> bool:
@@ -67,10 +99,11 @@ class CollectiveBackend(ABC):
     # Fusion-buffer staging helpers (reference:
     # collective_operations.h:89-125 MemcpyInFusionBuffer / ScaleBuffer).
     # ------------------------------------------------------------------
-    @staticmethod
-    def pack_fusion_buffer(response: Response,
+    def pack_fusion_buffer(self, response: Response,
                            entries: list[TensorTableEntry]) -> np.ndarray:
-        """Concatenate flattened entry payloads into one fused buffer."""
+        """Concatenate flattened entry payloads into the backend's
+        persistent staging buffer (single entries pass through without a
+        copy — the data plane stages them itself)."""
         np_dtype = to_numpy(response.tensor_type)
         if len(entries) == 1:
             e = entries[0]
@@ -85,20 +118,29 @@ class CollectiveBackend(ABC):
             else:
                 parts.append(np.ascontiguousarray(
                     np.asarray(e.tensor, dtype=np_dtype)).reshape(-1))
+        sizes = list(response.tensor_sizes)
+        fused = self.fusion_buffers.get("pack", np_dtype, sum(sizes))
         from .. import native
-        fused = native.pack(parts, list(response.tensor_sizes), np_dtype)
-        if fused is not None:
+        if native.pack(parts, sizes, np_dtype, out=fused) is not None:
             return fused
-        return np.concatenate([
-            p if p is not None else np.zeros(response.tensor_sizes[i],
-                                             dtype=np_dtype)
-            for i, p in enumerate(parts)])
+        offset = 0
+        for i, p in enumerate(parts):
+            n = sizes[i]
+            view = fused[offset:offset + n]
+            if p is None:
+                view[:] = 0
+            else:
+                view[:] = p
+            offset += n
+        return fused
 
-    @staticmethod
-    def unpack_fusion_buffer(buf: np.ndarray, response: Response,
+    def unpack_fusion_buffer(self, buf: np.ndarray, response: Response,
                              entries: list[TensorTableEntry]) -> None:
         """Slice the fused result back into per-entry outputs, restoring
-        original shapes."""
+        original shapes.  Results living in a persistent buffer are copied
+        out (the next cycle reuses the buffer); fresh backend results are
+        sliced zero-copy."""
+        owned = self.fusion_buffers.owns(buf)
         offset = 0
         for i, e in enumerate(entries):
             n = response.tensor_sizes[i]
@@ -106,9 +148,23 @@ class CollectiveBackend(ABC):
             offset += n
             if e.tensor is not None:
                 shape = np.asarray(e.tensor).shape
-                e.output = chunk.reshape(shape)
+                out = chunk.reshape(shape)
             else:
-                e.output = chunk
+                out = chunk
+            e.output = out.copy() if owned else out
+
+    @staticmethod
+    def resolve_alltoall_splits(entry: TensorTableEntry, dim0: int,
+                                world_size: int) -> list[int] | Status:
+        """Explicit splits, or an even division of dim 0; a Status error
+        when neither applies (shared by the XLA and TCP planes)."""
+        if entry.splits:
+            return list(entry.splits)
+        if dim0 % world_size != 0:
+            return Status.invalid_argument(
+                "alltoall first dimension must be divisible by the "
+                "world size when splits are not given")
+        return [dim0 // world_size] * world_size
 
     @staticmethod
     def scale_buffer(buf: np.ndarray, factor: float) -> np.ndarray:

@@ -239,13 +239,10 @@ class TcpBackend(CollectiveBackend):
                  entries: list[TensorTableEntry]) -> Status:
         for e in entries:
             local = np.asarray(e.tensor, dtype=to_numpy(response.tensor_type))
-            splits = list(e.splits) if e.splits else None
-            if splits is None:
-                if local.shape[0] % self.coll.size != 0:
-                    return Status.invalid_argument(
-                        "alltoall first dimension must be divisible by the "
-                        "world size when splits are not given")
-                splits = [local.shape[0] // self.coll.size] * self.coll.size
+            splits = self.resolve_alltoall_splits(e, local.shape[0],
+                                                  self.coll.size)
+            if isinstance(splits, Status):
+                return splits
             e.output, e.received_splits = self.coll.alltoallv(local, splits)
         return Status.ok()
 

@@ -140,6 +140,163 @@ class XlaCommunicator:
         out = self._bcast_fn(buf.dtype, size)(g, np.int32(root))
         return np.asarray(out)
 
+    # -- allgather(v) ----------------------------------------------------
+    def _gather_fn(self, np_dtype: np.dtype, size: int, n: int):
+        def build():
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = self._world_mesh()
+            out_sharding = NamedSharding(mesh, P())
+
+            # Identity with a replicated out-sharding: XLA inserts the
+            # all-gather over the world axis (the device analogue of
+            # NCCLAllgather, reference: nccl_operations.cc:434-559).
+            @partial(jax.jit, out_shardings=out_sharding)
+            def _gather(g):
+                return g
+
+            return _gather
+
+        return self._cached_program(("allgather", np_dtype.str, size, n),
+                                    build)
+
+    def allgatherv(self, local: np.ndarray,
+                   first_dims: list[int]) -> np.ndarray:
+        """Ragged allgather: per-rank blocks differ in dim 0.  Blocks are
+        padded to the max first dim so one dense XLA all-gather moves the
+        data; padding is stripped host-side."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._world_mesh()
+        size = mesh.shape["world"]
+        rest = tuple(local.shape[1:])
+        rest_elems = int(np.prod(rest)) if rest else 1
+        maxd = max(first_dims)
+        padded = np.zeros(maxd * rest_elems, dtype=local.dtype)
+        padded[:local.size] = local.reshape(-1)
+        sharding = NamedSharding(mesh, P("world"))
+        g = jax.make_array_from_process_local_data(
+            sharding, padded[None, :],
+            global_shape=(size, maxd * rest_elems))
+        full = np.asarray(self._gather_fn(local.dtype, size,
+                                          maxd * rest_elems)(g))
+        blocks = [full[r, :first_dims[r] * rest_elems]
+                  .reshape((first_dims[r],) + rest) for r in range(size)]
+        return np.concatenate(blocks, axis=0)
+
+    # -- alltoall(v) -----------------------------------------------------
+    def _a2a_fn(self, np_dtype: np.dtype, size: int, blk: int):
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = self._world_mesh()
+            out_sharding = NamedSharding(mesh, P("world"))
+
+            # Sharded transpose of the (sender, receiver, payload) cube:
+            # XLA lowers the resharding to an all-to-all over the world
+            # axis (reference: nccl_operations.cc:567-619 grouped
+            # ncclSend/ncclRecv).
+            @partial(jax.jit, out_shardings=out_sharding)
+            def _a2a(g):
+                return jnp.swapaxes(g, 0, 1)
+
+            return _a2a
+
+        return self._cached_program(("alltoall", np_dtype.str, size, blk),
+                                    build)
+
+    def alltoallv(self, local: np.ndarray, splits: list[int]
+                  ) -> tuple[np.ndarray, list[int]]:
+        """Send splits[j] dim-0 rows to rank j; return (received rows in
+        rank order, per-rank received splits).  Ragged splits are padded to
+        the global max block so the exchange is one dense device
+        all-to-all."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._world_mesh()
+        size = mesh.shape["world"]
+        my_rank = jax.process_index()
+        rest = tuple(local.shape[1:])
+        rest_elems = int(np.prod(rest)) if rest else 1
+
+        # Every rank needs the full splits matrix (row r = rank r's
+        # splits): received splits + pad bound both come from it.
+        matrix = self.allgatherv(
+            np.asarray(splits, dtype=np.int64).reshape(size, 1),
+            [size] * size).reshape(size, size)
+        received_splits = [int(x) for x in matrix[:, my_rank]]
+        maxblk = int(matrix.max()) * rest_elems
+        if maxblk == 0:
+            empty = np.zeros((0,) + rest, dtype=local.dtype)
+            return empty, received_splits
+
+        bounds = np.cumsum([0] + list(splits))
+        send = np.zeros((size, maxblk), dtype=local.dtype)
+        for j in range(size):
+            blk = local[bounds[j]:bounds[j + 1]]
+            send[j, :blk.size] = blk.reshape(-1)
+        sharding = NamedSharding(mesh, P("world"))
+        g = jax.make_array_from_process_local_data(
+            sharding, send[None], global_shape=(size, size, maxblk))
+        out = self._a2a_fn(local.dtype, size, maxblk)(g)
+        shard = np.asarray(out.addressable_shards[0].data)[0]  # [size, blk]
+        blocks = [shard[r, :received_splits[r] * rest_elems]
+                  .reshape((received_splits[r],) + rest)
+                  for r in range(size)]
+        return np.concatenate(blocks, axis=0), received_splits
+
+    # -- reducescatter ---------------------------------------------------
+    def _rs_fn(self, np_dtype: np.dtype, size: int, dim0: int,
+               rest_elems: int):
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = self._world_mesh()
+            out_sharding = NamedSharding(mesh, P("world"))
+            widen = np_dtype.kind == "f" and np_dtype.itemsize <= 2
+
+            # Sum over the world axis with a world-sharded output: XLA
+            # emits a true reduce-scatter (half the bytes of
+            # allreduce+slice; reference: nccl ReduceScatter leg of
+            # NCCLHierarchicalAllreduce, nccl_operations.cc:187-398).
+            @partial(jax.jit, out_shardings=out_sharding,
+                     donate_argnums=(0,))
+            def _rs(g):
+                acc = g.astype(jnp.float32) if widen else g
+                red = jnp.sum(acc, axis=0).astype(g.dtype)
+                return red.reshape(dim0, rest_elems)
+
+            return _rs
+
+        return self._cached_program(
+            ("reducescatter", np_dtype.str, size, dim0, rest_elems), build)
+
+    def reducescatter(self, local: np.ndarray) -> np.ndarray:
+        """Reduce over ranks, scatter dim-0 slices; local: [dim0, ...] with
+        dim0 divisible by the world size.  Returns this rank's slice."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._world_mesh()
+        size = mesh.shape["world"]
+        dim0 = local.shape[0]
+        rest = tuple(local.shape[1:])
+        rest_elems = int(np.prod(rest)) if rest else 1
+        sharding = NamedSharding(mesh, P("world"))
+        g = jax.make_array_from_process_local_data(
+            sharding, local.reshape(1, -1),
+            global_shape=(size, dim0 * rest_elems))
+        out = self._rs_fn(local.dtype, size, dim0, rest_elems)(g)
+        shard = np.asarray(out.addressable_shards[0].data)
+        return shard.reshape((dim0 // size,) + rest)
+
 
 class XlaBackend(CollectiveBackend):
     """Device data plane: fused allreduce/broadcast via XLA collectives.
@@ -153,7 +310,9 @@ class XlaBackend(CollectiveBackend):
 
     name = "xla"
 
-    _SUPPORTED = (ResponseType.ALLREDUCE, ResponseType.BROADCAST)
+    _SUPPORTED = (ResponseType.ALLREDUCE, ResponseType.BROADCAST,
+                  ResponseType.ALLGATHER, ResponseType.ALLTOALL,
+                  ResponseType.REDUCESCATTER)
 
     def __init__(self, comm: XlaCommunicator, world_size: int) -> None:
         self.comm = comm
@@ -178,7 +337,24 @@ class XlaBackend(CollectiveBackend):
             # 64-bit dtypes to 32-bit — wrapping int64s and truncating
             # float64s. Decline so they ride the (exact) TCP ring.
             import jax
-            return bool(jax.config.jax_enable_x64)
+            if not jax.config.jax_enable_x64:
+                return False
+        if response.response_type == ResponseType.ALLGATHER:
+            # Degenerate all-empty gathers fall through to the TCP plane
+            # (a zero-size device program buys nothing).
+            return bool(response.tensor_sizes) and \
+                max(response.tensor_sizes) > 0
+        if response.response_type == ResponseType.REDUCESCATTER:
+            # The device reduce-scatter shards dim 0 evenly over the
+            # world; ragged splits ride the TCP plane.
+            for e in entries:
+                if e.tensor is None:
+                    return False
+                if np.asarray(e.tensor).shape[0] % self.world_size:
+                    return False
+        if response.response_type == ResponseType.ALLTOALL:
+            if any(e.tensor is None for e in entries):
+                return False
         return True
 
     def allreduce(self, response: Response,
@@ -208,8 +384,40 @@ class XlaBackend(CollectiveBackend):
             e.output = out.reshape(shape)
         return Status.ok()
 
-    def allgather(self, response, entries) -> Status:
-        return Status.unknown_error("xla backend: allgather rides tcp")
+    def allgather(self, response: Response,
+                  entries: list[TensorTableEntry]) -> Status:
+        from ..common.dtypes import to_numpy
+        dtype = np.dtype(to_numpy(response.tensor_type))
+        first_dims = list(response.tensor_sizes)
+        for e in entries:
+            local = np.ascontiguousarray(np.asarray(e.tensor, dtype=dtype))
+            e.output = self.comm.allgatherv(local, first_dims)
+        return Status.ok()
 
-    def alltoall(self, response, entries) -> Status:
-        return Status.unknown_error("xla backend: alltoall rides tcp")
+    def alltoall(self, response: Response,
+                 entries: list[TensorTableEntry]) -> Status:
+        from ..common.dtypes import to_numpy
+        dtype = np.dtype(to_numpy(response.tensor_type))
+        for e in entries:
+            local = np.ascontiguousarray(np.asarray(e.tensor, dtype=dtype))
+            splits = self.resolve_alltoall_splits(e, local.shape[0],
+                                                  self.world_size)
+            if isinstance(splits, Status):
+                return splits
+            e.output, e.received_splits = self.comm.alltoallv(local, splits)
+        return Status.ok()
+
+    def reducescatter(self, response: Response,
+                      entries: list[TensorTableEntry]) -> Status:
+        from ..common.dtypes import to_numpy
+        dtype = np.dtype(to_numpy(response.tensor_type))
+        prescale = response.prescale_factor
+        postscale = response.postscale_factor
+        for e in entries:
+            local = np.ascontiguousarray(np.asarray(e.tensor, dtype=dtype))
+            buf = self.scale_buffer(local.reshape(-1),
+                                    prescale).reshape(local.shape)
+            out = self.comm.reducescatter(buf)
+            e.output = self.scale_buffer(out.reshape(-1),
+                                         postscale).reshape(out.shape)
+        return Status.ok()

@@ -504,6 +504,29 @@ def enqueue_grouped_allreduce(names: Sequence[str], tensors: Sequence[Any], *,
     return _enqueue(entries, requests)
 
 
+def enqueue_reducescatter(name: str, tensor, *, op: str = "sum",
+                          prescale_factor: float = 1.0,
+                          postscale_factor: float = 1.0
+                          ) -> tuple[int, Handle]:
+    """Reduce over all ranks, scatter dim-0 slices back (the eager analogue
+    of upstream Horovod's reducescatter; rides the XLA device plane when
+    dim 0 divides evenly, the TCP plane otherwise)."""
+    st = _require_init()
+    if op == "average":
+        postscale_factor = postscale_factor / st.size
+    elif op != "sum":
+        raise ValueError(f"Unknown reducescatter op: {op}")
+    arr = _as_array(tensor)
+    entry = TensorTableEntry(tensor_name=name, tensor=arr)
+    request = Request(request_rank=st.rank,
+                      request_type=RequestType.REDUCESCATTER,
+                      tensor_type=from_any(arr.dtype), tensor_name=name,
+                      tensor_shape=tuple(arr.shape),
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor)
+    return _enqueue([entry], [request])
+
+
 def enqueue_allgather(name: str, tensor) -> tuple[int, Handle]:
     st = _require_init()
     arr = _as_array(tensor)

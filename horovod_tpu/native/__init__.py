@@ -143,8 +143,11 @@ def ring_allreduce(send_fd: int, recv_fd: int, buf: np.ndarray,
 
 
 def pack(parts: list[np.ndarray | None], sizes: list[int],
-         dtype: np.dtype) -> np.ndarray | None:
-    """Concatenate flattened arrays (None → zeros) into one fused buffer."""
+         dtype: np.dtype, out: np.ndarray | None = None
+         ) -> np.ndarray | None:
+    """Concatenate flattened arrays (None → zeros) into one fused buffer.
+    ``out``, when given, is the persistent staging buffer to fill
+    (reference: fusion_buffer_manager.cc reuse)."""
     lib = _load()
     if lib is None:
         return None
@@ -157,7 +160,11 @@ def pack(parts: list[np.ndarray | None], sizes: list[int],
                               or not p.flags.c_contiguous):
             return None
     total = sum(sizes)
-    out = np.empty(total, dtype=dtype)
+    if out is None:
+        out = np.empty(total, dtype=dtype)
+    elif (out.size != total or out.dtype != dtype
+          or not out.flags.c_contiguous):
+        return None
     n = len(parts)
     src_ptrs = (ctypes.c_void_p * n)()
     nbytes = (ctypes.c_int64 * n)()

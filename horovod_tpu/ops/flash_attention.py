@@ -115,12 +115,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_ref[:, 0]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = jnp.where(l == 0.0, NEG_INF, m_ref[:, 0] + jnp.log(l_safe))
+        # lse is per-row but stored lane-broadcast at [bq, _LANE]: TPU
+        # blocks need their last two dims (8, 128)-tileable, so a bare
+        # [1, bq] output is unmappable (same layout as the upstream jax
+        # flash kernel's l/m outputs).
+        lse = jnp.where(l == 0.0, NEG_INF, m_ref[:, 0] + jnp.log(l_safe))
+        lse_ref[0] = jax.lax.broadcast_in_dim(lse, (block_q, _LANE), (0,))
 
 
 def _flash_fwd_pallas(q, k, v, *, sm_scale, causal, block_q, block_k,
                       interpret):
-    """q,k,v: [BH, T, D] → (o [BH, T, D], lse [BH, T])."""
+    """q,k,v: [BH, T, D] → (o [BH, T, D], lse [BH, T, _LANE] lane-bcast)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -145,11 +150,11 @@ def _flash_fwd_pallas(q, k, v, *, sm_scale, causal, block_q, block_k,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             _out_struct((bh, tq, d), q.dtype, q, k, v),
-            _out_struct((bh, tq), jnp.float32, q, k, v),
+            _out_struct((bh, tq, _LANE), jnp.float32, q, k, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -187,8 +192,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, :, 0]                        # lane-bcast → [bq]
+        delta = delta_ref[0, :, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -235,8 +240,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0, :, 0]                        # lane-bcast → [bq]
+        delta = delta_ref[0, :, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale   # [bq, bk]
@@ -275,8 +280,13 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, sm_scale, causal,
     block_k = min(block_k, tk)
     n_q, n_kv = tq // block_q, tk // block_k
 
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                          # [BH, T]
+    # lse and delta ride lane-broadcast at [BH, T, _LANE] so their blocks
+    # satisfy the (8, 128) tiling rule (materialized only for the span of
+    # the two backward kernels).
+    lse = jnp.broadcast_to(lse[:, :, None], (bh, tq, _LANE))
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1)[:, :, None], (bh, tq, _LANE))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
@@ -288,8 +298,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, sm_scale, causal,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=_out_struct((bh, tq, d), q.dtype, q, k, v, do),
@@ -307,8 +317,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, sm_scale, causal,
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -376,10 +386,14 @@ def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    # The residual keeps lse at [BH, T]: holding the kernels' lane-
+    # broadcast [BH, T, _LANE] layout across fwd→bwd would pin 128× the
+    # HBM for the whole backward span; the backward re-broadcasts it.
     if _on_tpu() or interpret:
         o, lse = _flash_fwd_pallas(q, k, v, sm_scale=sm_scale,
                                    causal=causal, block_q=block_q,
                                    block_k=block_k, interpret=interpret)
+        lse = lse[:, :, 0]
     else:
         o, lse = _blockwise_jax(q, k, v, sm_scale=sm_scale, causal=causal)
     return o, (q, k, v, o, lse)
@@ -470,6 +484,7 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
         o, lse = _flash_fwd_pallas(qm, km, vm, sm_scale=float(sm_scale),
                                    causal=causal, block_q=block_q,
                                    block_k=block_k, interpret=interpret)
+        lse = lse[:, :, 0]   # un-broadcast the lane dim
     else:
         o, lse = _blockwise_jax(qm, km, vm, sm_scale=float(sm_scale),
                                 causal=causal)

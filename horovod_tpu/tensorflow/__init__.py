@@ -233,6 +233,77 @@ class DistributedGradientTape:
         return reduced[0] if single else reduced
 
 
+def _make_adasum_optimizer(optimizer, compression,
+                           backward_passes_per_step: int):
+    """Adasum delta-optimizer, TF2/Keras idiom (reference:
+    tensorflow/__init__.py:504-598 _DistributedAdasumOptimizer).
+
+    Every apply runs the wrapped optimizer locally; every
+    ``backward_passes_per_step``-th apply ships the parameter delta since
+    the last communication through a scale-adaptive Adasum allreduce and
+    resets the variables to start + combined delta.  State lives in
+    per-variable ``delta_start`` slots plus a step counter, created
+    lazily on first apply (keras slot-variable style)."""
+    base = optimizer.__class__
+    comp = compression or Compression.none
+    state = {"starts": None, "step": None, "initialized": None,
+             "bps": int(backward_passes_per_step)}
+
+    class _DistributedAdasum(base):
+        def apply_gradients(self, grads_and_vars, **apply_kwargs):
+            gv = list(grads_and_vars)
+            variables = [v for _, v in gv]
+            st = self._hvd_adasum
+            if st["starts"] is None:
+                st["starts"] = {}
+                with tf.init_scope():
+                    st["step"] = tf.Variable(0, dtype=tf.int64,
+                                             trainable=False)
+                    st["initialized"] = tf.Variable(False, trainable=False)
+            # delta_start slots key by VARIABLE REF, not call position: a
+            # loop that filters None grads or reorders grads_and_vars
+            # between steps must still pair each var with its own slot.
+            for v in variables:
+                if v.ref() not in st["starts"]:
+                    with tf.init_scope():
+                        st["starts"][v.ref()] = tf.Variable(
+                            tf.zeros_like(v), trainable=False,
+                            name=f"delta_start_{len(st['starts'])}")
+            starts = [st["starts"][v.ref()] for v in variables]
+
+            def _init_starts():
+                for s, v in zip(starts, variables):
+                    s.assign(v)
+                return tf.constant(True)
+
+            tf.cond(st["initialized"], lambda: tf.constant(True),
+                    _init_starts)
+            st["initialized"].assign(True)
+
+            result = super(_DistributedAdasum, self).apply_gradients(
+                gv, **apply_kwargs)
+            st["step"].assign_add(1)
+
+            def _communicate():
+                for i, (s, v) in enumerate(zip(starts, variables)):
+                    combined = allreduce(v - s, op=Adasum,
+                                         compression=comp,
+                                         name=f"adasum_delta.{i}")
+                    s.assign_add(combined)
+                    v.assign(s)
+                return tf.constant(True)
+
+            tf.cond(
+                tf.equal(st["step"] % st["bps"], 0),
+                _communicate, lambda: tf.constant(False))
+            return result
+
+    _DistributedAdasum.__name__ = f"DistributedAdasum{base.__name__}"
+    optimizer.__class__ = _DistributedAdasum
+    optimizer._hvd_adasum = state
+    return optimizer
+
+
 def DistributedOptimizer(optimizer, name: str | None = None,
                          compression=None,
                          backward_passes_per_step: int = 1,
@@ -240,10 +311,15 @@ def DistributedOptimizer(optimizer, name: str | None = None,
     """Wrap a keras optimizer: gradients are locally aggregated for
     ``backward_passes_per_step`` steps, then allreduced before apply
     (reference: tensorflow/__init__.py:427-502 + gradient_aggregation.py).
+    ``op=Adasum`` returns the delta-optimizer variant (reference:
+    tensorflow/__init__.py:504-598).
 
     The SAME instance is returned with its class swapped, preserving slot
     variables and iteration counters."""
     _require_tf()
+    if op is Adasum:
+        return _make_adasum_optimizer(optimizer, compression,
+                                      backward_passes_per_step)
     from .gradient_aggregation import LocalGradientAggregationHelper
 
     base = optimizer.__class__

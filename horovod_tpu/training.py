@@ -179,11 +179,17 @@ class Trainer:
 
             return jax.jit(gspmd_step, donate_argnums=(0,))
 
+        # Manual over ALL mesh axes, not just the sync axes: Mosaic
+        # (Pallas) custom calls reject partial-manual lowering — a
+        # shard_map manual over {"dp"} inside a mesh that also carries
+        # size-1 tp/pp/sp axes would raise "cannot be automatically
+        # partitioned" on TPU. Models that embed their own shard_map
+        # regions use the pure-GSPMD mode above instead.
         mapped = shard_map(
             local_step, mesh=self.mesh,
             in_specs=(state_specs, self.batch_spec),
             out_specs=(state_specs, P()),
-            axis_names=manual_axes,
+            axis_names=frozenset(self.mesh.axis_names),
             check_vma=False)
         return jax.jit(mapped, donate_argnums=(0,))
 

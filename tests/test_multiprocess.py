@@ -54,6 +54,13 @@ def test_collectives(size):
     _run_world(size, "collectives")
 
 
+@pytest.mark.parametrize("size", [2, 3])
+def test_semantic_matrix(size):
+    """Reference-scale dtype x op sweep (VERDICT r2 item 6; size 3 also
+    exercises the non-power-of-2 ring schedule)."""
+    _run_world(size, "matrix", timeout=180.0)
+
+
 def test_error_handling():
     _run_world(2, "errors")
 
