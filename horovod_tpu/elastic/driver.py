@@ -107,6 +107,15 @@ class ElasticDriver:
     def join(self, timeout: float | None = None) -> bool:
         return self._finished.wait(timeout)
 
+    def wait_for_workers_exit(self, timeout: float = 30.0) -> None:
+        """Drain live worker processes after the job finishes.  The
+        registry marks the job complete on the workers' SUCCESS RPC, which
+        arrives BEFORE their processes exit — collecting results without
+        draining would miss the successful exit codes."""
+        deadline = time.time() + timeout
+        while self._running and time.time() < deadline:
+            time.sleep(0.05)
+
     def shutdown(self) -> None:
         self.stop()
         self._shutdown.set()

@@ -18,7 +18,7 @@ from .driver import ElasticDriver
 from .rpc import SECRET_ENV, RpcServer, make_secret
 from .worker import DRIVER_ADDR_ENV, DRIVER_PORT_ENV
 
-LOCAL_HOSTS = {"localhost", "127.0.0.1"}
+from ..runner.hosts import is_local_host as _is_local  # noqa: E402
 
 
 def _make_discovery(args):
@@ -36,7 +36,7 @@ def _make_discovery(args):
 
 def _driver_address(discovery) -> str:
     hosts = discovery.find_available_hosts_and_slots()
-    if all(h in LOCAL_HOSTS for h in hosts):
+    if all(_is_local(h) for h in hosts):
         return "127.0.0.1"
     import socket
     return socket.getfqdn()
@@ -81,10 +81,12 @@ def launch_elastic(args, command: list[str]) -> int:
             DRIVER_PORT_ENV: str(rpc.port),
             SECRET_ENV: secret,
         })
-        if slot.hostname in LOCAL_HOSTS:
+        if _is_local(slot.hostname):
             return safe_shell_exec.execute(list(command), env=env,
                                            index=slot.rank)
         import shlex
+
+        from ..runner.hosts import ssh_argv
         # The HMAC secret travels over ssh stdin (`read -r`), never argv —
         # argv is world-readable in the remote host's process list.
         exports = " ".join(
@@ -93,15 +95,14 @@ def launch_elastic(args, command: list[str]) -> int:
         remote = " ".join(shlex.quote(c) for c in command)
         script = (f"read -r {SECRET_ENV} && export {SECRET_ENV} && "
                   f"env {exports} {remote}")
-        full_command = ["ssh", "-o", "StrictHostKeyChecking=no",
-                        slot.hostname, f"/bin/sh -c {shlex.quote(script)}"]
         return safe_shell_exec.execute(
-            full_command, env=env, index=None,
+            ssh_argv(slot.hostname, script), env=env, index=None,
             stdin_data=(secret + "\n").encode())
 
     try:
         driver.start(args.num_proc or min_np, create_worker)
         driver.join()
+        driver.wait_for_workers_exit()
     except (TimeoutError, ValueError) as exc:
         sys.stderr.write(f"horovodrun-tpu elastic: {exc}\n")
         return 1

@@ -77,12 +77,24 @@ def init_jax_distributed(rank: int, size: int, kv: Any = None,
             except Exception:  # noqa: BLE001 - older jaxlib: no such knob
                 pass
 
+        # Elastic worlds must SURVIVE peer death: without recoverability
+        # the coordination service FATALs the surviving processes when the
+        # shutdown barrier fails (absl fatal, not an exception), killing
+        # the elastic retry loop before it can re-rendezvous.
+        if os.environ.get("HOROVOD_ELASTIC"):
+            try:
+                jax.config.update("jax_enable_recoverability", True)
+            except Exception:  # noqa: BLE001 - older jax: knob absent
+                pass
+        heartbeat = int(os.environ.get(
+            "HOROVOD_JAX_HEARTBEAT_TIMEOUT_SECONDS", "100"))
         logger.debug("jax.distributed.initialize rank=%d size=%d coord=%s",
                      rank, size, coordinator_address)
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=size, process_id=rank,
             local_device_ids=local_device_ids,
+            heartbeat_timeout_seconds=heartbeat,
             initialization_timeout=int(timeout))
         _initialized_here = True
         return True
@@ -98,6 +110,18 @@ def shutdown_jax_distributed() -> None:
             jax.distributed.shutdown()
         except Exception as exc:  # noqa: BLE001 - best-effort teardown
             logger.warning("jax.distributed.shutdown failed: %s", exc)
+        # Evict the live backends: device lists from the old world would
+        # otherwise survive the shutdown, and the next
+        # jax.distributed.initialize (elastic re-rendezvous, SURVEY §7
+        # "elastic re-init on TPU") could not re-form the client.
+        # Validated in-process: see tests/test_elastic_integration.py
+        # (elastic XLA world) — shutdown → clear → initialize works on the
+        # gloo CPU plane.
+        try:
+            import jax.extend.backend as _xb
+            _xb.clear_backends()
+        except Exception as exc:  # noqa: BLE001
+            logger.warning("clear_backends failed: %s", exc)
         _initialized_here = False
 
 

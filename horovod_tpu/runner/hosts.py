@@ -106,3 +106,22 @@ def get_host_assignments(hosts: list[HostInfo], min_np: int,
             cross_rank=peers.index(hostname), size=size,
             local_size=local_sizes[hostname], cross_size=len(peers)))
     return assignments
+
+
+def is_local_host(hostname: str) -> bool:
+    """True for localhost and any 127/8 loopback alias.  Loopback aliases
+    count as local everywhere (launcher AND programmatic run) so the
+    multi-host-without-a-cluster trick (SURVEY §4: distinct loopback IPs
+    act as distinct "hosts" with their own host hashes) behaves the same
+    from every entry point."""
+    import re
+    return hostname in ("localhost", "127.0.0.1") or \
+        bool(re.fullmatch(r"127(\.\d{1,3}){3}", hostname))
+
+
+def ssh_argv(hostname: str, script: str) -> list[str]:
+    """The shared remote-exec command shape (one place to keep ssh options
+    in sync across the launcher and hvd.run)."""
+    import shlex
+    return ["ssh", "-o", "StrictHostKeyChecking=no", hostname,
+            f"/bin/sh -c {shlex.quote(script)}"]

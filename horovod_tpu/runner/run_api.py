@@ -2,20 +2,30 @@
 
 Reference: horovod/runner/__init__.py:92-210 — run a Python function on N
 worker processes (instead of shelling out to a training script) and return
-the per-rank results.  Workers are forked locally (or ssh'd for remote
-hosts via the same slot plumbing as the CLI), the function and its results
-travel as pickles.
+the per-rank results.  Local slots fork worker processes; remote slots run
+the same pickled function over ssh through the
+:mod:`horovod_tpu.runner.run_worker` bootstrap, with results returning via
+the rendezvous KV store (the reference's run_func KV server,
+runner/launch.py:528-618).
 """
 from __future__ import annotations
 
 import multiprocessing as mp
 import os
+import shlex
+import socket
+import subprocess
 import sys
+import threading
 import traceback
 from typing import Any, Callable, Sequence
 
-from .hosts import get_host_assignments, parse_hosts
-from .network import RendezvousServer
+from .hosts import (get_host_assignments, is_local_host, parse_hosts,
+                    ssh_argv)
+from .network import RendezvousClient, RendezvousServer
+
+# Module alias so tests can substitute a local shell for the ssh binary.
+_ssh_argv = ssh_argv
 
 
 def _worker_main(fn_payload, slot_env: dict, conn) -> None:
@@ -31,18 +41,33 @@ def _worker_main(fn_payload, slot_env: dict, conn) -> None:
         conn.close()
 
 
+def _launch_remote(slot_env: dict, hostname: str, payload: bytes,
+                   procs: dict, rank: int) -> int:
+    """Run the bootstrap on a remote host: env rides the command line,
+    the pickled function rides stdin.  The Popen registers in ``procs``
+    so the caller can kill it on error paths."""
+    exports = " ".join(f"{k}={shlex.quote(str(v))}"
+                       for k, v in slot_env.items())
+    script = (f"env {exports} {shlex.quote(sys.executable)} "
+              f"-m horovod_tpu.runner.run_worker")
+    proc = subprocess.Popen(_ssh_argv(hostname, script),
+                            stdin=subprocess.PIPE,
+                            stdout=sys.stdout.fileno(),
+                            stderr=sys.stderr.fileno())
+    procs[rank] = proc
+    proc.communicate(payload)
+    return proc.returncode
+
+
 def run(func: Callable, args: Sequence = (), kwargs: dict | None = None,
         np: int | None = None, hosts: str | None = None,
         env: dict | None = None, use_gloo: bool = True,
         start_timeout: float = 120.0) -> list[Any]:
-    """Run ``func(*args, **kwargs)`` on ``np`` local worker processes with
-    the full eager runtime initialized (rendezvous, controller, data
-    plane); returns results ordered by rank.
-
-    The reference's remote-host path (ssh per slot) applies only to its CLI
-    here; programmatic multi-host launches should use the CLI or the
-    elastic driver.
-    """
+    """Run ``func(*args, **kwargs)`` on every slot of ``hosts`` (default:
+    ``np`` local processes) with the full eager runtime initialized
+    (rendezvous, controller, data plane); returns results ordered by rank.
+    Remote hosts need this package importable and ssh reachability, the
+    same contract as the reference's ``horovod.run``."""
     import pickle
 
     kwargs = kwargs or {}
@@ -51,38 +76,60 @@ def run(func: Callable, args: Sequence = (), kwargs: dict | None = None,
     if host_list is None:
         host_list = parse_hosts(f"localhost:{world}")
     slots = get_host_assignments(host_list, world)
-    if any(s.hostname not in ("localhost", "127.0.0.1") for s in slots):
-        raise NotImplementedError(
-            "horovod_tpu.run() launches local workers; use the "
-            "horovodrun-tpu CLI for multi-host jobs")
+    any_remote = any(not is_local_host(s.hostname) for s in slots)
 
     server = RendezvousServer()
     port = server.start()
+    # Remote workers must reach the rendezvous/KV server over the network;
+    # local-only runs stay on loopback.
+    addr = socket.gethostbyname(socket.gethostname()) if any_remote \
+        else "127.0.0.1"
     payload = pickle.dumps((func, tuple(args), dict(kwargs)))
 
     ctx = mp.get_context("spawn")
-    procs, conns = [], []
+    procs, conns = [], []          # local slots
+    remote_threads, remote_rcs = [], {}
+    remote_procs: dict[int, subprocess.Popen] = {}
+    remote_ranks: list[int] = []
     try:
         for slot in slots:
-            parent, child = ctx.Pipe()
             slot_env = dict(env or {})
             slot_env.update(slot.to_env())
             slot_env.update({
-                "HOROVOD_GLOO_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
                 "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
                 "HOROVOD_CONTROLLER": "tcp",
                 "HOROVOD_GLOO_TIMEOUT_SECONDS": str(start_timeout),
             })
-            p = ctx.Process(target=_worker_main,
-                            args=(payload, slot_env, child), daemon=True)
-            p.start()
-            child.close()
-            procs.append(p)
-            conns.append(parent)
+            if is_local_host(slot.hostname):
+                parent, child = ctx.Pipe()
+                p = ctx.Process(target=_worker_main,
+                                args=(payload, slot_env, child),
+                                daemon=True)
+                p.start()
+                child.close()
+                procs.append((slot.rank, p))
+                conns.append((slot.rank, parent))
+            else:
+                remote_ranks.append(slot.rank)
+
+                def _remote(slot_env=slot_env, hostname=slot.hostname,
+                            rank=slot.rank):
+                    try:
+                        remote_rcs[rank] = _launch_remote(
+                            slot_env, hostname, payload, remote_procs,
+                            rank)
+                    except Exception:  # noqa: BLE001
+                        remote_rcs[rank] = -1
+                        traceback.print_exc()
+
+                t = threading.Thread(target=_remote, daemon=True)
+                t.start()
+                remote_threads.append(t)
 
         results: list[Any] = [None] * len(slots)
         errors: list[str] = []
-        for rank, (p, conn) in enumerate(zip(procs, conns)):
+        for rank, conn in conns:
             if conn.poll(start_timeout + 600):
                 ok, value = conn.recv()
                 if ok:
@@ -91,14 +138,46 @@ def run(func: Callable, args: Sequence = (), kwargs: dict | None = None,
                     errors.append(f"rank {rank}:\n{value}")
             else:
                 errors.append(f"rank {rank}: no result (timeout)")
-        for p in procs:
+        kv = RendezvousClient("127.0.0.1", port, timeout=30.0) \
+            if remote_ranks else None
+        for rank in remote_ranks:
+            # Poll the KV for the result, but fail FAST when the remote
+            # launch already died without posting one (ssh exit 255, bad
+            # python, import failure before the bootstrap's try block).
+            import time as _time
+            deadline = _time.time() + start_timeout + 600
+            blob = None
+            while _time.time() < deadline:
+                blob = kv.get("runfunc", str(rank))
+                if blob is not None:
+                    break
+                rc = remote_rcs.get(rank)
+                if rc is not None and rc != 0:
+                    errors.append(f"rank {rank} (remote): launch exited "
+                                  f"rc={rc} with no result")
+                    break
+                _time.sleep(0.25)
+            else:
+                errors.append(f"rank {rank} (remote): no result (timeout)")
+            if blob is not None:
+                ok, value = pickle.loads(blob)
+                if ok:
+                    results[rank] = value
+                else:
+                    errors.append(f"rank {rank} (remote):\n{value}")
+        for _, p in procs:
             p.join(timeout=30)
+        for t in remote_threads:
+            t.join(timeout=30)
         if errors:
             raise RuntimeError("horovod_tpu.run() worker failures:\n"
                                + "\n".join(errors))
         return results
     finally:
-        for p in procs:
+        for _, p in procs:
             if p.is_alive():
                 p.terminate()
+        for proc in remote_procs.values():
+            if proc.poll() is None:
+                proc.terminate()
         server.stop()

@@ -16,16 +16,19 @@ from collections import OrderedDict
 from typing import Any, Callable
 
 from ..runner.hosts import HostInfo, get_host_assignments
-from .store import FilesystemStore, Store  # noqa: F401
+from .store import (FilesystemStore, KVBlobClient,  # noqa: F401
+                    RemoteBlobStore, Store)
 
 __all__ = ["run", "claim_slot", "Store", "FilesystemStore",
-           "TorchEstimator", "TorchModel", "KerasEstimator", "KerasModel"]
+           "RemoteBlobStore", "KVBlobClient",
+           "TorchEstimator", "TorchModel", "KerasEstimator", "KerasModel",
+           "LightningEstimator"]
 
 
 def __getattr__(item: str):
     # Estimators import torch/tf lazily — resolve on first touch.
     if item in ("TorchEstimator", "TorchModel", "KerasEstimator",
-                "KerasModel"):
+                "KerasModel", "LightningEstimator"):
         from . import estimator
         return getattr(estimator, item)
     raise AttributeError(item)

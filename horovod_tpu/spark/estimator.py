@@ -19,7 +19,25 @@ import numpy as np
 
 from .store import FilesystemStore, Store
 
-__all__ = ["TorchEstimator", "TorchModel", "KerasEstimator", "KerasModel"]
+__all__ = ["TorchEstimator", "TorchModel", "KerasEstimator", "KerasModel",
+           "LightningEstimator"]
+
+
+class LightningEstimator:
+    """Intentional scope cut (reference: spark/lightning/estimator.py).
+
+    pytorch-lightning is not part of the TPU image, and its training loop
+    duplicates what :class:`TorchEstimator` already runs over this
+    runtime; see README "Scope cuts" for the rationale.  Constructing one
+    states the migration path instead of silently failing later."""
+
+    def __init__(self, *_args, **_kwargs) -> None:
+        raise ImportError(
+            "LightningEstimator is an intentional scope cut of the TPU "
+            "build (pytorch_lightning is not in the image). Port the "
+            "LightningModule's training_step into a torch.nn.Module and "
+            "use TorchEstimator (same store/num_proc surface), or run "
+            "lightning yourself inside horovod_tpu.run workers.")
 
 
 def _to_pandas(df):
@@ -41,10 +59,12 @@ def _extract(df, feature_cols: Sequence[str], label_cols: Sequence[str]):
     return x, y
 
 
-def _torch_train_fn(data_path: str, ckpt_path: str, model_bytes: bytes,
+def _torch_train_fn(store: Store, run_id: str, model_bytes: bytes,
                     opt_factory: Callable, loss_name: str, batch_size: int,
                     epochs: int) -> dict:
-    """Per-rank training loop (reference: spark/torch/remote.py)."""
+    """Per-rank training loop (reference: spark/torch/remote.py).  All
+    artifact IO goes through the (pickled) store, so remote blob stores
+    work without a shared filesystem."""
     import io
 
     import torch
@@ -55,7 +75,8 @@ def _torch_train_fn(data_path: str, ckpt_path: str, model_bytes: bytes,
     hvd.init()
     try:
         rank, world = hvd.rank(), hvd.size()
-        blob = np.load(os.path.join(data_path, "train.npz"))
+        blob = store.load_npz(
+            store.join(store.get_train_data_path(run_id), "train.npz"))
         X = torch.from_numpy(blob["x"])
         Y = torch.from_numpy(blob["y"])
         # Contiguous shard per rank (reference: petastorm row-group shard).
@@ -98,8 +119,9 @@ def _torch_train_fn(data_path: str, ckpt_path: str, model_bytes: bytes,
         if rank == 0:
             buf = io.BytesIO()
             torch.save(model, buf)
-            with open(os.path.join(ckpt_path, "model.pt"), "wb") as f:
-                f.write(buf.getvalue())
+            store.write_bytes(
+                store.join(store.get_checkpoint_path(run_id), "model.pt"),
+                buf.getvalue())
         return {"rank": rank, "history": history}
     finally:
         hvd.shutdown()
@@ -151,12 +173,13 @@ class TorchEstimator:
         ckpt_path = self.store.get_checkpoint_path(run_id)
 
         x, y = _extract(df, self.feature_cols, self.label_cols)
-        np.savez(os.path.join(data_path, "train.npz"), x=x, y=y)
+        self.store.save_npz(self.store.join(data_path, "train.npz"),
+                            x=x, y=y)
 
         buf = io.BytesIO()
         torch.save(self.model, buf)
 
-        args = (data_path, ckpt_path, buf.getvalue(), self.optimizer,
+        args = (self.store, run_id, buf.getvalue(), self.optimizer,
                 self.loss, self.batch_size, self.epochs)
         try:
             import pyspark  # noqa: F401
@@ -166,8 +189,10 @@ class TorchEstimator:
         except ImportError:
             results = hvd.run(_torch_train_fn, args=args, np=self.num_proc)
 
-        with open(os.path.join(ckpt_path, "model.pt"), "rb") as f:
-            trained = torch.load(io.BytesIO(f.read()), weights_only=False)
+        trained = torch.load(
+            io.BytesIO(self.store.read_bytes(
+                self.store.join(ckpt_path, "model.pt"))),
+            weights_only=False)
         history = results[0]["history"] if results else []
         return TorchModel(trained, feature_cols=self.feature_cols,
                           label_cols=self.label_cols, run_id=run_id,
@@ -205,10 +230,12 @@ class TorchModel:
         return pdf
 
 
-def _keras_train_fn(data_path: str, ckpt_path: str, model_bytes: bytes,
+def _keras_train_fn(store: Store, run_id: str, model_bytes: bytes,
                     compile_kwargs: dict, batch_size: int,
                     epochs: int) -> dict:
     """Per-rank keras loop (reference: spark/keras/remote.py)."""
+    import tempfile
+
     import horovod_tpu as hvd
     import horovod_tpu.tensorflow as htf
 
@@ -217,16 +244,20 @@ def _keras_train_fn(data_path: str, ckpt_path: str, model_bytes: bytes,
         import tensorflow as tf
 
         rank, world = hvd.rank(), hvd.size()
-        blob = np.load(os.path.join(data_path, "train.npz"))
+        blob = store.load_npz(
+            store.join(store.get_train_data_path(run_id), "train.npz"))
         X, Y = blob["x"], blob["y"]
         n = X.shape[0]
         per = (n + world - 1) // world
         xs, ys = X[rank * per:(rank + 1) * per], Y[rank * per:(rank + 1) * per]
 
-        path = os.path.join(data_path, f"model_in_{rank}.keras")
-        with open(path, "wb") as f:
-            f.write(model_bytes)
-        model = tf.keras.models.load_model(path)
+        # keras (de)serializes via real files: stage through local tmp,
+        # ship bytes through the store.
+        with tempfile.TemporaryDirectory() as tmpdir:
+            path = os.path.join(tmpdir, "model_in.keras")
+            with open(path, "wb") as f:
+                f.write(model_bytes)
+            model = tf.keras.models.load_model(path)
         opt = htf.DistributedOptimizer(
             tf.keras.optimizers.get(compile_kwargs.get("optimizer", "sgd")))
         model.compile(optimizer=opt,
@@ -239,8 +270,13 @@ def _keras_train_fn(data_path: str, ckpt_path: str, model_bytes: bytes,
             # Weights only: the full model would embed the dynamic
             # Distributed* optimizer class, which cannot deserialize
             # outside a worker.
-            model.save_weights(
-                os.path.join(ckpt_path, "model.weights.h5"))
+            with tempfile.TemporaryDirectory() as tmpdir:
+                wpath = os.path.join(tmpdir, "model.weights.h5")
+                model.save_weights(wpath)
+                with open(wpath, "rb") as f:
+                    store.write_bytes(
+                        store.join(store.get_checkpoint_path(run_id),
+                                   "model.weights.h5"), f.read())
         return {"rank": rank, "history": hist.history}
     finally:
         hvd.shutdown()
@@ -267,19 +303,23 @@ class KerasEstimator:
     def fit(self, df) -> "KerasModel":
         import horovod_tpu as hvd
 
+        import tempfile
+
         run_id = self.store.new_run_id()
         data_path = self.store.get_train_data_path(run_id)
         ckpt_path = self.store.get_checkpoint_path(run_id)
         x, y = _extract(df, self.feature_cols, self.label_cols)
-        np.savez(os.path.join(data_path, "train.npz"), x=x, y=y)
+        self.store.save_npz(self.store.join(data_path, "train.npz"),
+                            x=x, y=y)
 
-        tmp = os.path.join(data_path, "model_in.keras")
-        self.model.save(tmp)
-        with open(tmp, "rb") as f:
-            model_bytes = f.read()
+        with tempfile.TemporaryDirectory() as tmpdir:
+            tmp = os.path.join(tmpdir, "model_in.keras")
+            self.model.save(tmp)
+            with open(tmp, "rb") as f:
+                model_bytes = f.read()
 
         compile_kwargs = {"optimizer": self.optimizer, "loss": self.loss}
-        args = (data_path, ckpt_path, model_bytes, compile_kwargs,
+        args = (self.store, run_id, model_bytes, compile_kwargs,
                 self.batch_size, self.epochs)
         try:
             import pyspark  # noqa: F401
@@ -289,8 +329,12 @@ class KerasEstimator:
         except ImportError:
             results = hvd.run(_keras_train_fn, args=args, np=self.num_proc)
 
-        self.model.load_weights(
-            os.path.join(ckpt_path, "model.weights.h5"))
+        with tempfile.TemporaryDirectory() as tmpdir:
+            wpath = os.path.join(tmpdir, "model.weights.h5")
+            with open(wpath, "wb") as f:
+                f.write(self.store.read_bytes(
+                    self.store.join(ckpt_path, "model.weights.h5")))
+            self.model.load_weights(wpath)
         trained = self.model
         history = results[0]["history"] if results else {}
         return KerasModel(trained, feature_cols=self.feature_cols,

@@ -11,6 +11,17 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+XLA_WORLD = bool(os.environ.get("TEST_ELASTIC_XLA"))
+if XLA_WORLD:
+    # Elastic x XLA: form a multi-process JAX world each epoch (VERDICT r2
+    # item 5). Pin the CPU backend BEFORE anything touches jax — the axon
+    # sitecustomize may already have imported it.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["HOROVOD_JAX_DISTRIBUTED"] = "1"
+    os.environ["HOROVOD_XLA_OPERATIONS"] = "1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 
 import horovod_tpu as hvd
@@ -35,6 +46,13 @@ def train(state):
         expected = (state.epoch + 1) * hvd.size()
         np.testing.assert_allclose(np.asarray(out), np.full(4, expected),
                                    rtol=1e-6)
+        if XLA_WORLD and hvd.size() > 1:
+            # The collective must have ridden the freshly (re-)formed XLA
+            # device plane, not fallen back to the TCP ring.
+            from horovod_tpu.core import _global
+            backend = _global.op_manager.backends[0]
+            assert backend.name == "xla", backend.name
+            assert backend.comm._cache, "xla plane never executed"
         state.epoch += 1
         state.commit()
     return state.epoch

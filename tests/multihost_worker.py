@@ -7,9 +7,18 @@ after which jax.devices() spans both processes and the Trainer's dp axis
 crosses the process boundary.
 
 Usage: python multihost_worker.py <rank> <size> <rendezvous_port> [n_local]
+      [mode]
+Modes (VERDICT r2 item 8 multi-host depth):
+- ``dp``   flat dp=8 Trainer.step loop (the original test);
+- ``hier`` dp=2 x sp=4 hybrid mesh with HIERARCHICAL grad sync
+           (reduce-scatter local → cross allreduce → all-gather local,
+           the NCCLHierarchicalAllreduce split) — multi-process runs lay
+           dp across the 2-process DCN granule boundary;
+- ``fit``  a short multi-host Trainer.fit (2 epochs x 2 batches).
+
 Prints the final loss as `LOSS <float>` for the parent to compare. The
 single-process baseline is the same script with size=1 and n_local=8, so
-both runs shard dp=8 identically and losses must match.
+both runs shard identically and losses must match.
 """
 import os
 import sys
@@ -18,6 +27,7 @@ import sys
 def main() -> int:
     rank, size, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
     n_local = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+    mode = sys.argv[5] if len(sys.argv) > 5 else "dp"
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
@@ -56,26 +66,48 @@ def main() -> int:
 
         import jax.numpy as jnp
 
-        mesh = build_mesh(MeshSpec(dp=n_global))
+        if mode == "hier":
+            # 2-granule hybrid mesh: dp(=2) rides DCN across the process
+            # boundary, sp(=4) stays on the intra-process "ICI" leg; the
+            # sync does the reference's RS → cross-AR → AG split.
+            mesh = build_mesh(MeshSpec(dp=2, sp=n_global // 2))
+            sync = GradSyncConfig(axes=("dp", "sp"), op="average",
+                                  hierarchical=True)
+            batch_spec = P(("dp", "sp"))
+        else:
+            mesh = build_mesh(MeshSpec(dp=n_global))
+            sync = GradSyncConfig(axes=("dp",), op="average")
+            batch_spec = P("dp")
         model = models.ResNet(stage_sizes=(1,),
                               block_cls=models.resnet.BottleneckBlock,
                               num_classes=8, num_filters=8,
                               dtype=jnp.float32)
         trainer = training.Trainer(
-            model, optax.sgd(0.1, momentum=0.9), mesh,
-            sync=GradSyncConfig(axes=("dp",), op="average"))
+            model, optax.sgd(0.1, momentum=0.9), mesh, sync=sync,
+            batch_spec=batch_spec)
 
         rng = np.random.default_rng(0)
-        batch = {
-            "image": rng.standard_normal(
-                (n_global * 2, 16, 16, 3)).astype(np.float32),
-            "label": rng.integers(0, 8, size=(n_global * 2,)),
-        }
-        global_batch = multihost.make_global_batch(mesh, P("dp"), batch)
-        state = trainer.init(jax.random.key(0), global_batch)
-        for _ in range(3):
-            state, metrics = trainer.step(state, global_batch)
-        print(f"LOSS {float(metrics['loss']):.10f}", flush=True)
+
+        def make_batch(seed: int) -> dict:
+            g = np.random.default_rng(seed)
+            batch = {
+                "image": g.standard_normal(
+                    (n_global * 2, 16, 16, 3)).astype(np.float32),
+                "label": g.integers(0, 8, size=(n_global * 2,)),
+            }
+            return multihost.make_global_batch(mesh, batch_spec, batch)
+
+        if mode == "fit":
+            data = [make_batch(0), make_batch(1)]
+            state = trainer.init(jax.random.key(0), data[0])
+            state, history = trainer.fit(state, data, epochs=2)
+            print(f"LOSS {history[-1]['loss']:.10f}", flush=True)
+        else:
+            global_batch = make_batch(0)
+            state = trainer.init(jax.random.key(0), global_batch)
+            for _ in range(3):
+                state, metrics = trainer.step(state, global_batch)
+            print(f"LOSS {float(metrics['loss']):.10f}", flush=True)
     finally:
         hvd.shutdown()
     return 0

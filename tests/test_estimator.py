@@ -1,16 +1,18 @@
-"""Spark-ML-style Estimator facade (VERDICT r1 item 7).
+"""Spark-ML-style Estimator facade (VERDICT r1 item 7 / r2 item 7).
 
 Reference: horovod/spark/torch/estimator.py:91-328 + spark/common/store.py.
 Runs on pandas DataFrames (pyspark absent in this image) over real forked
 workers via horovod_tpu.run — fit() must train distributed (2 ranks),
-persist the model through the FilesystemStore, and transform() must append
-prediction columns.
+persist the model through the Store (parameterized over the local
+FilesystemStore AND the network RemoteBlobStore, the HDFSStore slot), and
+transform() must append prediction columns.
 """
 import numpy as np
 import pandas as pd
 import pytest
 
-from horovod_tpu.spark import FilesystemStore
+from horovod_tpu.spark import (FilesystemStore, KVBlobClient,
+                               RemoteBlobStore)
 
 
 def _linear_df(n=64, seed=0):
@@ -20,6 +22,23 @@ def _linear_df(n=64, seed=0):
     y = x @ w + 0.1
     return pd.DataFrame({
         "f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2], "label": y})
+
+
+@pytest.fixture(params=["filesystem", "remote_kv"])
+def store(request, tmp_path):
+    """Both store families: every estimator test must pass with artifacts
+    on a local directory AND behind the network blob store (workers then
+    exchange data/checkpoints with no shared filesystem assumption)."""
+    if request.param == "filesystem":
+        yield FilesystemStore(str(tmp_path / "store"))
+        return
+    from horovod_tpu.runner.network import RendezvousServer
+    server = RendezvousServer()
+    port = server.start()
+    try:
+        yield RemoteBlobStore(KVBlobClient("127.0.0.1", port), "est")
+    finally:
+        server.stop()
 
 
 def test_store_layout(tmp_path):
@@ -36,7 +55,37 @@ def test_store_layout(tmp_path):
     assert not os.path.exists(store.get_run_path(run_id) + "/checkpoints")
 
 
-def test_torch_estimator_fit_transform(tmp_path):
+def test_remote_store_roundtrip(store):
+    """Byte/object/npz round-trips through whichever store family."""
+    run_id = store.new_run_id()
+    ckpt = store.get_checkpoint_path(run_id)
+    key = store.join(ckpt, "meta.pkl")
+    store.save_object(key, {"epoch": 3})
+    assert store.load_object(key) == {"epoch": 3}
+    assert store.exists(key)
+    assert not store.exists(store.join(ckpt, "missing"))
+    store.save_npz(store.join(ckpt, "a.npz"), x=np.arange(5))
+    np.testing.assert_array_equal(
+        store.load_npz(store.join(ckpt, "a.npz"))["x"], np.arange(5))
+
+
+def test_store_create_dispatch(tmp_path):
+    from horovod_tpu.spark import Store
+    assert isinstance(Store.create(str(tmp_path / "s")), FilesystemStore)
+    remote = Store.create("kv://127.0.0.1:9/pfx")
+    assert isinstance(remote, RemoteBlobStore)
+    assert remote.prefix == "pfx"
+    with pytest.raises(ValueError, match="hdfs"):
+        Store.create("hdfs://nn:8020/path")
+
+
+def test_lightning_estimator_is_documented_cut():
+    from horovod_tpu.spark import LightningEstimator
+    with pytest.raises(ImportError, match="scope cut"):
+        LightningEstimator(model=None)
+
+
+def test_torch_estimator_fit_transform(store):
     torch = pytest.importorskip("torch")
     from horovod_tpu.spark import TorchEstimator
 
@@ -49,7 +98,7 @@ def test_torch_estimator_fit_transform(tmp_path):
         optimizer=functools.partial(torch.optim.SGD, lr=0.2),
         loss="mse", feature_cols=["f0", "f1", "f2"],
         label_cols=["label"], batch_size=16, epochs=20, num_proc=2,
-        store=FilesystemStore(str(tmp_path / "store")))
+        store=store)
     trained = est.fit(df)
 
     # Distributed training converged on the linear target.
@@ -63,7 +112,7 @@ def test_torch_estimator_fit_transform(tmp_path):
     assert err < 0.05
 
 
-def test_keras_estimator_fit_transform(tmp_path):
+def test_keras_estimator_fit_transform(store):
     tf = pytest.importorskip("tensorflow")
     from horovod_tpu.spark import KerasEstimator
 
@@ -75,7 +124,7 @@ def test_keras_estimator_fit_transform(tmp_path):
         model=model, optimizer="sgd", loss="mse",
         feature_cols=["f0", "f1", "f2"], label_cols=["label"],
         batch_size=16, epochs=15, num_proc=2,
-        store=FilesystemStore(str(tmp_path / "store")))
+        store=store)
     trained = est.fit(df)
     losses = trained.history.get("loss", [])
     assert losses and losses[-1] < losses[0]

@@ -17,10 +17,10 @@ _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _launch(rank: int, size: int, port: int, n_local: int,
-            env: dict) -> subprocess.Popen:
+            env: dict, mode: str = "dp") -> subprocess.Popen:
     return subprocess.Popen(
         [sys.executable, _WORKER, str(rank), str(size), str(port),
-         str(n_local)],
+         str(n_local), mode],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
 
 
@@ -30,22 +30,22 @@ def _parse_loss(out: bytes, tag: str) -> float:
     return float(m.group(1))
 
 
-def test_dp_axis_spans_processes():
+def _run_mode(mode: str) -> None:
     env = dict(os.environ)
     for k in list(env):
         if k.startswith("HOROVOD_"):
             env.pop(k)
 
-    # Single-process baseline: dp=8 on one process.
-    p = _launch(0, 1, 0, 8, env)
+    # Single-process baseline: all 8 devices in one process.
+    p = _launch(0, 1, 0, 8, env, mode)
     out, _ = p.communicate(timeout=300)
     assert p.returncode == 0, out.decode(errors="replace")
     baseline = _parse_loss(out, "baseline")
 
-    # 2-process run: dp=8 across 2 "hosts" of 4 devices.
+    # 2-process run: the same mesh across 2 "hosts" of 4 devices.
     server = RendezvousServer()
     port = server.start()
-    procs = [_launch(r, 2, port, 4, env) for r in range(2)]
+    procs = [_launch(r, 2, port, 4, env, mode) for r in range(2)]
     outputs, losses, failed = [], [], []
     try:
         for r, p in enumerate(procs):
@@ -71,3 +71,21 @@ def test_dp_axis_spans_processes():
     # Every process sees the same replicated loss, equal to the baseline.
     assert abs(losses[0] - losses[1]) < 1e-9, losses
     assert abs(losses[0] - baseline) < 1e-6, (losses, baseline)
+
+
+def test_dp_axis_spans_processes():
+    _run_mode("dp")
+
+
+def test_hierarchical_grad_sync_hybrid_mesh():
+    """Hierarchical RS → cross-AR → AG grad sync over a 2-granule hybrid
+    mesh (dp across the process/DCN boundary, sp on the local leg) matches
+    the single-process flat-mesh loss (VERDICT r2 item 8; reference:
+    nccl_operations.cc:187-398)."""
+    _run_mode("hier")
+
+
+def test_multihost_trainer_fit():
+    """Short multi-host Trainer.fit (2 epochs x 2 batches) with loss
+    parity vs the single-process run (VERDICT r2 item 8)."""
+    _run_mode("fit")
