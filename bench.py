@@ -221,6 +221,11 @@ def main() -> int:
     parser.add_argument("--seq-len", type=int, default=2048)
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--remat", type=int, default=0,
+                        help="gpt: rematerialize each block (saves HBM, "
+                        "costs recompute; default off for throughput)")
+    parser.add_argument("--block-q", type=int, default=256)
+    parser.add_argument("--block-k", type=int, default=512)
     parser.add_argument("--inner", action="store_true",
                         help="internal: run one attempt in-process")
     args = parser.parse_args()
@@ -323,7 +328,8 @@ def bench_gpt(args, info: dict) -> int:
     import jax.numpy as jnp
     cfg = models.gpt_small(
         max_seq_len=args.seq_len,
-        attention="flash" if on_tpu else "dense", remat=True,
+        attention="flash" if on_tpu else "dense", remat=bool(args.remat),
+        block_q=args.block_q, block_k=args.block_k,
         # XLA CPU crashes promoting 16-bit all-reduces; bf16 is TPU-only.
         dtype=jnp.bfloat16 if on_tpu else jnp.float32)
     model = models.TransformerLM(cfg)
@@ -407,7 +413,9 @@ def bench_eager(args) -> int:
 
     Runs entirely on CPU/localhost — measures the controller + transport
     planes, not XLA."""
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Force (not setdefault) the CPU backend: the axon TPU tunnel must
+    # never be probed for a controller/TCP microbenchmark.
+    os.environ["JAX_PLATFORMS"] = "cpu"
     import horovod_tpu
 
     results = horovod_tpu.run(_eager_worker, args=(16, 200), np=2)

@@ -1,0 +1,76 @@
+"""PyTorch synthetic benchmark over the eager data plane.
+
+TPU-native analogue of the reference's
+examples/pytorch/pytorch_synthetic_benchmark.py, same flag surface:
+``--fp16-allreduce``, ``--use-adasum``, ``--batches-per-allreduce``.
+
+Launch:  horovodrun-tpu -np 4 python examples/pytorch_synthetic_benchmark.py
+"""
+import argparse
+import timeit
+
+import numpy as np
+import torch
+
+import horovod_tpu.torch as hvd
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--num-warmup", type=int, default=3)
+    parser.add_argument("--fp16-allreduce", action="store_true")
+    parser.add_argument("--use-adasum", action="store_true")
+    parser.add_argument("--batches-per-allreduce", type=int, default=1)
+    parser.add_argument("--hidden", type=int, default=1024)
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+    torch.set_num_threads(max(torch.get_num_threads() // hvd.local_size(),
+                              1))
+
+    model = torch.nn.Sequential(
+        torch.nn.Linear(1024, args.hidden), torch.nn.ReLU(),
+        torch.nn.Linear(args.hidden, args.hidden), torch.nn.ReLU(),
+        torch.nn.Linear(args.hidden, 128))
+    lr = 0.01 * (1 if args.use_adasum else hvd.size())
+    optimizer = torch.optim.SGD(model.parameters(), lr=lr)
+    compression = hvd.Compression.fp16 if args.fp16_allreduce \
+        else hvd.Compression.none
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression,
+        backward_passes_per_step=args.batches_per_allreduce,
+        op=hvd.Adasum if args.use_adasum else hvd.Average)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    data = torch.randn(args.batch_size, 1024)
+    target = torch.randn(args.batch_size, 128)
+
+    def benchmark_step() -> None:
+        for _ in range(args.batches_per_allreduce):
+            loss = torch.nn.functional.mse_loss(model(data), target)
+            loss.backward()
+        optimizer.step()
+        optimizer.zero_grad()
+
+    for _ in range(args.num_warmup):
+        benchmark_step()
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t = timeit.timeit(benchmark_step, number=1)
+        img_secs.append(args.batch_size * args.batches_per_allreduce / t)
+
+    if hvd.rank() == 0:
+        mean = np.mean(img_secs)
+        print(f"samples/sec per rank: {mean:.1f} +- "
+              f"{1.96 * np.std(img_secs):.1f}")
+        print(f"total samples/sec on {hvd.size()} rank(s): "
+              f"{hvd.size() * mean:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Spark-ML-style estimator example (reference analogue:
+examples/spark/pytorch/pytorch_spark_mnist.py).
+
+Runs on pandas (pyspark optional): fits a torch model over 2 distributed
+workers through the Store, then transforms the frame with predictions.
+
+    python examples/spark_estimator_example.py [--store kv|fs]
+"""
+import argparse
+import functools
+
+import numpy as np
+import pandas as pd
+import torch
+
+from horovod_tpu.spark import FilesystemStore, TorchEstimator
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--store", default="fs", choices=["fs", "kv"],
+                        help="fs: shared-filesystem store; kv: network "
+                        "blob store over a rendezvous KV server")
+    parser.add_argument("--num-proc", type=int, default=2)
+    args = parser.parse_args()
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((256, 4)).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    df = pd.DataFrame({"f0": x[:, 0], "f1": x[:, 1], "f2": x[:, 2],
+                       "f3": x[:, 3], "label": x @ w})
+
+    if args.store == "kv":
+        from horovod_tpu.runner.network import RendezvousServer
+        from horovod_tpu.spark import KVBlobClient, RemoteBlobStore
+        server = RendezvousServer()
+        port = server.start()
+        store = RemoteBlobStore(KVBlobClient("127.0.0.1", port))
+    else:
+        server = None
+        store = FilesystemStore("/tmp/horovod_tpu_example_store")
+
+    torch.manual_seed(0)
+    est = TorchEstimator(
+        model=torch.nn.Linear(4, 1),
+        optimizer=functools.partial(torch.optim.SGD, lr=0.2),
+        loss="mse", feature_cols=["f0", "f1", "f2", "f3"],
+        label_cols=["label"], batch_size=32, epochs=10,
+        num_proc=args.num_proc, store=store)
+    model = est.fit(df)
+    print("loss history:", [round(h, 4) for h in model.history])
+
+    out = model.transform(df.head(5))
+    print(out[["label", "label__output"]])
+    if server is not None:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

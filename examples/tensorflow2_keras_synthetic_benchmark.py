@@ -1,0 +1,69 @@
+"""TF2/Keras synthetic benchmark over the eager data plane.
+
+TPU-native analogue of the reference's
+examples/tensorflow2/tensorflow2_keras_synthetic_benchmark.py.
+
+Launch:  horovodrun-tpu -np 4 python \
+             examples/tensorflow2_keras_synthetic_benchmark.py
+"""
+import argparse
+import timeit
+
+import numpy as np
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--fp16-allreduce", action="store_true")
+    args = parser.parse_args()
+
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    tf.keras.utils.set_random_seed(42)
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input(shape=(1024,)),
+        tf.keras.layers.Dense(1024, activation="relu"),
+        tf.keras.layers.Dense(1024, activation="relu"),
+        tf.keras.layers.Dense(128)])
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.01 * hvd.size()),
+        compression=hvd.Compression.fp16 if args.fp16_allreduce else None)
+    loss_fn = tf.keras.losses.MeanSquaredError()
+
+    data = tf.random.normal((args.batch_size, 1024))
+    target = tf.random.normal((args.batch_size, 128))
+
+    first = [True]
+
+    def benchmark_step() -> None:
+        with tf.GradientTape() as tape:
+            loss = loss_fn(target, model(data, training=True))
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if first[0]:
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+            first[0] = False
+
+    benchmark_step()   # build + broadcast
+    img_secs = []
+    for _ in range(args.num_iters):
+        t = timeit.timeit(benchmark_step, number=1)
+        img_secs.append(args.batch_size / t)
+
+    if hvd.rank() == 0:
+        mean = np.mean(img_secs)
+        print(f"samples/sec per rank: {mean:.1f}")
+        print(f"total samples/sec on {hvd.size()} rank(s): "
+              f"{hvd.size() * mean:.1f}")
+
+
+if __name__ == "__main__":
+    main()

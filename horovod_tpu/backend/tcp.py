@@ -106,6 +106,30 @@ class TcpCollectives:
 
         return acc.astype(buf.dtype, copy=False)
 
+    # -- reduce-scatter --------------------------------------------------
+    def reduce_scatter(self, buf: np.ndarray,
+                       bounds: "np.ndarray") -> np.ndarray:
+        """Ring reduce-scatter with caller-provided chunk bounds
+        (bounds[r]..bounds[r+1] = rank r's output slice): the first half
+        of the ring allreduce only, (N-1)/N · bytes per link — half the
+        traffic of allreduce+slice.  Schedule shifted by one vs the
+        allreduce's reduce-scatter phase so rank r finishes owning chunk
+        r (not r+1)."""
+        rank, size = self.rank, self.size
+        if size == 1:
+            return np.asarray(buf)
+        acc = buf.astype(_accum_dtype(buf.dtype), copy=True)
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        for step in range(size - 1):
+            send_idx = (rank - step - 1) % size
+            recv_idx = (rank - step - 2) % size
+            payload = acc[bounds[send_idx]:bounds[send_idx + 1]].tobytes()
+            data = self._sendrecv(nxt, payload, prv)
+            incoming = np.frombuffer(data, dtype=acc.dtype)
+            acc[bounds[recv_idx]:bounds[recv_idx + 1]] += incoming
+        return acc[bounds[rank]:bounds[rank + 1]].astype(buf.dtype,
+                                                         copy=False)
+
     # -- allgatherv -----------------------------------------------------
     def allgatherv(self, local: np.ndarray,
                    first_dims: list[int]) -> np.ndarray:
@@ -248,7 +272,35 @@ class TcpBackend(CollectiveBackend):
 
     def reducescatter(self, response: Response,
                       entries: list[TensorTableEntry]) -> Status:
-        # Correct but bandwidth-suboptimal: full allreduce then local slice.
+        # True ring reduce-scatter: chunk bounds follow the per-rank dim-0
+        # split (uneven allowed), (N-1)/N bytes per link (reference: the
+        # ReduceScatter leg of nccl_operations.cc:187-398).
+        size = self.coll.size
+        if len(entries) > 1:
+            # Multi-entry responses keep ONE fused ring (2(N-1) rounds on
+            # the whole buffer) instead of a latency-bound ring per
+            # tensor; byte volume doubles but round count stays constant.
+            return self._reducescatter_fused(response, entries)
+        for e in entries:
+            local = np.ascontiguousarray(
+                np.asarray(e.tensor, dtype=to_numpy(response.tensor_type)))
+            shape = local.shape
+            rest = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+            base, rem = divmod(shape[0], size)
+            rows = [r * base + min(r, rem) for r in range(size + 1)]
+            bounds = np.asarray(rows) * rest
+            buf = self.scale_buffer(local.reshape(-1),
+                                    response.prescale_factor)
+            out = self.coll.reduce_scatter(np.ascontiguousarray(buf),
+                                           bounds)
+            out = self.scale_buffer(out, response.postscale_factor)
+            my_rows = rows[self.coll.rank + 1] - rows[self.coll.rank]
+            e.output = out.reshape((my_rows,) + shape[1:])
+        return Status.ok()
+
+    def _reducescatter_fused(self, response: Response,
+                             entries: list[TensorTableEntry]) -> Status:
+        # Allreduce the fused buffer, slice per entry (the pre-r3 path).
         buf = self.pack_fusion_buffer(response, entries)
         buf = self.scale_buffer(buf, response.prescale_factor)
         buf = self.coll.allreduce(buf)
@@ -260,10 +312,13 @@ class TcpBackend(CollectiveBackend):
             offset += n
             shape = np.asarray(e.tensor).shape
             full = chunk.reshape(shape)
-            dim0 = shape[0]
-            base, rem = divmod(dim0, self.coll.size)
-            starts = [r * base + min(r, rem) for r in range(self.coll.size + 1)]
-            e.output = full[starts[self.coll.rank]:starts[self.coll.rank + 1]]
+            base, rem = divmod(shape[0], self.coll.size)
+            starts = [r * base + min(r, rem)
+                      for r in range(self.coll.size + 1)]
+            sliced = full[starts[self.coll.rank]:
+                          starts[self.coll.rank + 1]]
+            e.output = sliced.copy() if self.fusion_buffers.owns(buf) \
+                else sliced
         return Status.ok()
 
     def barrier(self, response, entries) -> Status:

@@ -34,10 +34,15 @@ def _make_discovery(args):
     return FixedHostDiscovery(fixed)
 
 
-def _driver_address(discovery) -> str:
+def _driver_address(discovery, network_interface: str | None = None) -> str:
     hosts = discovery.find_available_hosts_and_slots()
     if all(_is_local(h) for h in hosts):
         return "127.0.0.1"
+    if network_interface:
+        # --network-interface pins the advertised NIC on multi-NIC head
+        # nodes (same contract as the static launch path).
+        from ..runner.driver_service import candidate_addresses
+        return candidate_addresses(network_interface)[0]
     import socket
     return socket.getfqdn()
 
@@ -58,7 +63,8 @@ def launch_elastic(args, command: list[str]) -> int:
     rendezvous = RendezvousServer()
     rendezvous.start()
     rpc = RpcServer(driver, secret)
-    addr = _driver_address(discovery)
+    addr = _driver_address(discovery,
+                           getattr(args, "network_interface", None))
 
     from ..runner.launch import args_to_env
     base_env = dict(os.environ)

@@ -28,6 +28,11 @@ from .network import RendezvousServer, free_port
 LOCAL_HOSTS = ("localhost", "127.0.0.1", "0.0.0.0")
 
 
+def _is_local(hostname: str) -> bool:
+    from .hosts import is_local_host
+    return hostname in LOCAL_HOSTS or is_local_host(hostname)
+
+
 def parse_args(argv=None) -> argparse.Namespace:
     parser = argparse.ArgumentParser(
         prog="horovodrun-tpu",
@@ -207,7 +212,8 @@ def launch_static(args, command: list[str]) -> int:
 
     server = RendezvousServer()
     port = server.start()
-    rendezvous_addr = _advertised_address(hosts)
+    rendezvous_addr = _advertised_address(
+        hosts, getattr(args, "network_interface", None))
 
     base_env = dict(os.environ)
     base_env.update(args_to_env(args))
@@ -227,7 +233,7 @@ def launch_static(args, command: list[str]) -> int:
     def _run_slot(i: int, slot: SlotInfo) -> None:
         env = dict(base_env)
         env.update(slot.to_env())
-        if slot.hostname in LOCAL_HOSTS:
+        if _is_local(slot.hostname):
             exit_codes[i] = safe_shell_exec.execute(
                 command, env=env, index=slot.rank, events=[terminate])
         else:
@@ -267,11 +273,16 @@ def launch_static(args, command: list[str]) -> int:
     return 0
 
 
-def _advertised_address(hosts) -> str:
+def _advertised_address(hosts, network_interface: str | None = None) -> str:
     """Address the workers should dial for rendezvous: loopback for pure
-    local runs, else this host's primary address."""
-    if all(h.hostname in LOCAL_HOSTS for h in hosts):
+    local runs; the pinned NIC's address when ``--network-interface`` is
+    given (reference: driver_service NIC selection); else this host's
+    primary address."""
+    if all(_is_local(h.hostname) for h in hosts):
         return "127.0.0.1"
+    if network_interface:
+        from .driver_service import candidate_addresses
+        return candidate_addresses(network_interface)[0]
     import socket
     return socket.getfqdn()
 

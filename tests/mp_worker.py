@@ -199,6 +199,27 @@ def battery_matrix(hvd, rank, size):
         np.testing.assert_array_equal(
             np.asarray(recv), np.arange(1, size + 1))
 
+    # -- reducescatter: dtypes + the empty-chunk ragged edge --------------
+    for dt in (np.int32, np.float32, np.float64):
+        tag = np.dtype(dt).name
+        x = (np.arange(2 * size * 2).reshape(2 * size, 2)
+             * (rank + 1)).astype(dt)
+        out = hvd.reducescatter(x, op=hvd.Sum, name=f"mx_rs_{tag}")
+        total = (np.arange(2 * size * 2).reshape(2 * size, 2)
+                 .astype(np.float64) * sum(r + 1 for r in range(size)))
+        np.testing.assert_allclose(np.asarray(out, np.float64),
+                                   total[rank * 2:(rank + 1) * 2],
+                                   err_msg=f"reducescatter {tag}")
+    if size > 1:
+        # Fewer rows than ranks: the last rank's chunk is empty.
+        y = np.ones((size - 1, 3), np.float32) * (rank + 1)
+        out = hvd.reducescatter(y, op=hvd.Sum, name="mx_rs_empty")
+        rows = 1 if rank < size - 1 else 0
+        assert out.shape == (rows, 3), out.shape
+        if rows:
+            np.testing.assert_allclose(
+                out, np.ones((1, 3)) * sum(r + 1 for r in range(size)))
+
     # -- grouped mismatch: shape desync inside a group must produce a
     # structured error on every rank, and the world must survive ---------
     shapes = [(4,), (5,) if rank == 0 else (6,)]

@@ -130,6 +130,53 @@ class TestStaticRun:
         assert "rank 0 OK" in proc.stdout
         assert "rank 1 OK" in proc.stdout
 
+    def test_nic_discovery_probe(self):
+        """Driver/task reachability probing (SURVEY §2.4 driver_service):
+        the probe client, run per "host" through a local exec substitute,
+        reports which driver addresses it can reach; unreachable decoys
+        are filtered out of the intersection."""
+        import json as _json
+        import subprocess
+
+        from horovod_tpu.runner import driver_service as ds
+
+        addrs = ds.candidate_addresses()
+        assert addrs, "no IPv4 interfaces found"
+
+        def local_exec(hostname, argv):
+            # Inject a decoy address that nothing listens on: it must be
+            # filtered from the intersection. argv = [..., port,
+            # addresses, timeout] — the address list is argv[-2].
+            argv = list(argv)
+            argv[-2] = argv[-2] + ",192.0.2.1"   # TEST-NET, unroutable
+            out = subprocess.run(argv, capture_output=True, text=True,
+                                 timeout=60)
+            assert out.returncode == 0, out.stderr
+            return out.stdout
+
+        common = ds.discover_common_interfaces(
+            ["hostA", "hostB"], local_exec, timeout=5.0)
+        assert common
+        assert "192.0.2.1" not in common
+        assert set(common) <= set(addrs)
+
+        # The raw probe against a live server sees at least loopback.
+        server = ds.ProbeServer()
+        try:
+            reachable = ds.probe(["127.0.0.1", "192.0.2.1"], server.port,
+                                 timeout=2.0)
+        finally:
+            server.close()
+        assert reachable == ["127.0.0.1"]
+
+    def test_advertised_address_pins_interface(self):
+        from horovod_tpu.runner.hosts import HostInfo
+        from horovod_tpu.runner.launch import _advertised_address
+
+        hosts = [HostInfo("localhost", 2), HostInfo("remote-a", 2)]
+        addr = _advertised_address(hosts, network_interface="lo")
+        assert addr.startswith("127.")
+
     def test_failure_propagates(self, tmp_path):
         script = tmp_path / "fail.py"
         script.write_text("import sys; sys.exit(3)\n")
