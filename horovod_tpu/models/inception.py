@@ -1,0 +1,159 @@
+"""Inception V3, TPU-first.
+
+Inception V3 is the reference's first headline scaling benchmark
+(reference: README.rst:102-109, docs/benchmarks.rst:13-14 — ~90%
+efficiency at 512 GPUs). From-scratch flax implementation of the
+Szegedy et al. 2015 architecture (the tf-slim/torchvision layer plan),
+shaped for the TPU MXU:
+
+- NHWC, bf16 compute / fp32 params; every branch is conv+BN+ReLU so XLA
+  fuses the elementwise tail into the conv;
+- the factorized 1xN/Nx1 and parallel-branch structure produces MANY
+  small-ish gradient tensors — with ResNet's few large ones and VGG's
+  giant dense ones, the three reference benchmarks bracket the tensor-
+  fusion design space;
+- aux classifier omitted (inference parity not affected; the reference
+  benchmarks train the main head only).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: tuple[int, int]
+    strides: tuple[int, int] = (1, 1)
+    padding: str | Sequence = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+def _pool(x, window=(3, 3), strides=(1, 1), kind="avg"):
+    fn = nn.avg_pool if kind == "avg" else nn.max_pool
+    return fn(x, window, strides=strides, padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(64, (1, 1))(x, train)
+        b2 = cbn(64, (5, 5))(cbn(48, (1, 1))(x, train), train)
+        b3 = cbn(96, (3, 3))(
+            cbn(96, (3, 3))(cbn(64, (1, 1))(x, train), train), train)
+        b4 = cbn(self.pool_features, (1, 1))(_pool(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionA(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(384, (3, 3), (2, 2), padding="VALID")(x, train)
+        b2 = cbn(96, (3, 3), (2, 2), padding="VALID")(
+            cbn(96, (3, 3))(cbn(64, (1, 1))(x, train), train), train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Factorized 7x7 block (1x7 / 7x1 pairs)."""
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        c = self.channels_7x7
+        b1 = cbn(192, (1, 1))(x, train)
+        b2 = cbn(192, (7, 1))(
+            cbn(c, (1, 7))(cbn(c, (1, 1))(x, train), train), train)
+        b3 = x
+        for kern, feats in (((1, 1), c), ((7, 1), c), ((1, 7), c),
+                            ((7, 1), c), ((1, 7), 192)):
+            b3 = cbn(feats, kern)(b3, train)
+        b4 = cbn(192, (1, 1))(_pool(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionB(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(320, (3, 3), (2, 2), padding="VALID")(
+            cbn(192, (1, 1))(x, train), train)
+        b2 = cbn(192, (1, 1))(x, train)
+        b2 = cbn(192, (1, 7))(b2, train)
+        b2 = cbn(192, (7, 1))(b2, train)
+        b2 = cbn(192, (3, 3), (2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Expanded-filter-bank output block (split 1x3 / 3x1 branches)."""
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(320, (1, 1))(x, train)
+        b2 = cbn(384, (1, 1))(x, train)
+        b2 = jnp.concatenate([cbn(384, (1, 3))(b2, train),
+                              cbn(384, (3, 1))(b2, train)], axis=-1)
+        b3 = cbn(384, (3, 3))(cbn(448, (1, 1))(x, train), train)
+        b3 = jnp.concatenate([cbn(384, (1, 3))(b3, train),
+                              cbn(384, (3, 1))(b3, train)], axis=-1)
+        b4 = cbn(192, (1, 1))(_pool(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # Stem: 299x299x3 → 35x35x192.
+        x = cbn(32, (3, 3), (2, 2), padding="VALID")(x, train)
+        x = cbn(32, (3, 3), padding="VALID")(x, train)
+        x = cbn(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = cbn(80, (1, 1), padding="VALID")(x, train)
+        x = cbn(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 3x InceptionA → ReductionA → 4x InceptionB → ReductionB →
+        # 2x InceptionC (the V3 layer plan).
+        for pool_features in (32, 64, 64):
+            x = InceptionA(pool_features, dtype=self.dtype)(x, train)
+        x = ReductionA(dtype=self.dtype)(x, train)
+        for c77 in (128, 160, 160, 192):
+            x = InceptionB(c77, dtype=self.dtype)(x, train)
+        x = ReductionB(dtype=self.dtype)(x, train)
+        for _ in range(2):
+            x = InceptionC(dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))              # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x

@@ -1,0 +1,59 @@
+"""VGG family, TPU-first.
+
+VGG-16 is one of the reference's three headline scaling benchmarks
+(reference: docs/benchmarks.rst:13-14 — ~68% efficiency at 512 GPUs; its
+huge dense gradient tensors are the classic tensor-fusion stress test).
+From-scratch flax implementation shaped for the TPU MXU:
+
+- NHWC layout, bf16 compute / fp32 params (conv + the 4096-wide dense
+  layers all hit the MXU);
+- optional batch norm (the "VGG-BN" torchvision variant) — plain VGG's
+  scale drift is hostile to bf16, BN keeps activations tame;
+- the classifier head keeps the two 4096-unit layers: their ~100M dense
+  parameters are WHY VGG is the fusion/communication benchmark.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Stage plan: (convs per stage, filters); 'M' pools between stages.
+_VGG16_STAGES: tuple[tuple[int, int], ...] = (
+    (2, 64), (2, 128), (3, 256), (3, 512), (3, 512))
+_VGG19_STAGES: tuple[tuple[int, int], ...] = (
+    (2, 64), (2, 128), (4, 256), (4, 512), (4, 512))
+
+
+class VGG(nn.Module):
+    stages: Sequence[tuple[int, int]]
+    num_classes: int = 1000
+    batch_norm: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, kernel_size=(3, 3), padding="SAME",
+                       use_bias=not self.batch_norm, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        for n_convs, filters in self.stages:
+            for _ in range(n_convs):
+                x = conv(features=filters)(x)
+                if self.batch_norm:
+                    x = norm()(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for width in (4096, 4096):
+            x = nn.Dense(width, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+VGG16 = partial(VGG, stages=_VGG16_STAGES)
+VGG19 = partial(VGG, stages=_VGG19_STAGES)

@@ -246,6 +246,33 @@ def battery_matrix(hvd, rank, size):
     np.testing.assert_allclose(out, np.full(3, float(size)))
 
 
+def battery_autotune(hvd, rank, size):
+    """Autotuned (fusion threshold, cycle time) propagate from the
+    coordinator to every rank via the ResponseList tuned_* fields
+    (reference: Controller::SynchronizeParameters, controller.cc:39-53)."""
+    from horovod_tpu.core import _global
+
+    # warmup 1 sample x 2 steps + 3 scored samples x 2 steps, plus slack;
+    # every allreduce is one counted cycle.
+    for i in range(30):
+        hvd.allreduce(np.ones(256, dtype=np.float32), op=hvd.Sum,
+                      name=f"tune_{i % 3}")
+    if rank == 0:
+        assert _global.parameter_manager is not None
+        assert _global.parameter_manager._done
+        assert _global.controller.pending_tuned_params is None
+    # The search may legitimately CONVERGE BACK to the default (the
+    # initial setting is one of the scored samples), so assert liveness +
+    # cross-rank consistency, not inequality; the deterministic
+    # propagation check lives in test_controller.py.
+    hvd.barrier()
+    tuned = _global.controller.tensor_fusion_threshold
+    assert (1 << 20) <= tuned <= (1 << 28), tuned
+    gathered = hvd.allgather(np.array([[float(tuned)]]), name="tune_thr")
+    assert np.all(np.asarray(gathered) == float(tuned)), \
+        (rank, tuned, np.asarray(gathered))
+
+
 def battery_errors(hvd, rank, size):
     # Shape mismatch must raise a structured error on every rank, not hang.
     shape = (4,) if rank == 0 else (5,)
@@ -751,6 +778,7 @@ def battery_xla(hvd, rank, size):
 BATTERIES = {
     "collectives": battery_collectives,
     "matrix": battery_matrix,
+    "autotune": battery_autotune,
     "xla": battery_xla,
     "errors": battery_errors,
     "join": battery_join,
@@ -770,7 +798,14 @@ def main() -> int:
     os.environ["HOROVOD_SIZE"] = str(size)
     os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = "127.0.0.1"
     os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(port)
-    os.environ.setdefault("HOROVOD_GLOO_TIMEOUT_SECONDS", "20")
+    # Generous under CI load: a peer may still be importing torch/tf when
+    # this rank reaches rendezvous.
+    os.environ.setdefault("HOROVOD_GLOO_TIMEOUT_SECONDS", "90")
+    if battery == "autotune":
+        os.environ["HOROVOD_AUTOTUNE"] = "1"
+        os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"
+        os.environ["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = "2"
+        os.environ["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = "3"
     if battery == "xla":
         # Form the JAX world + device data plane (CPU multi-process).
         os.environ["HOROVOD_JAX_DISTRIBUTED"] = "1"

@@ -1,0 +1,94 @@
+"""Autotuner stack: Gaussian process, Bayesian optimization, the
+ParameterManager sampling loop, and end-to-end parameter propagation
+across a 2-process world.
+
+Reference: horovod/common/parameter_manager.{cc,h}:42-120 +
+common/optim/{bayesian_optimization,gaussian_process}.cc — the reference
+scores (fusion threshold, cycle time) settings by bytes/sec and
+broadcasts the winner from the coordinator.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.optim.bayesian_optimization import (
+    BayesianOptimization)
+from horovod_tpu.common.optim.gaussian_process import GaussianProcess
+
+
+def test_gaussian_process_interpolates():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 1, size=(12, 1))
+    y = np.sin(3 * x[:, 0])
+    gp = GaussianProcess(alpha=1e-8)
+    gp.fit(x, y)
+    mean, std = gp.predict(x)
+    np.testing.assert_allclose(mean, y, atol=1e-3)
+    assert np.all(std >= -1e-9)
+    # Uncertainty grows away from the data.
+    _, std_far = gp.predict(np.array([[5.0]]))
+    assert std_far[0] > np.max(std) - 1e-9
+
+
+def test_bayesian_optimization_finds_peak():
+    bo = BayesianOptimization([(0.0, 1.0)], alpha=1e-4)
+
+    def objective(x: float) -> float:
+        return -(x - 0.3) ** 2
+
+    for _ in range(20):
+        (x,) = bo.suggest_next()
+        assert 0.0 <= x <= 1.0
+        bo.add_sample([x], objective(x))
+    (best_x,), best_y = bo.best()
+    assert abs(best_x - 0.3) < 0.15, (best_x, best_y)
+
+
+class _FakeController:
+    tensor_fusion_threshold = 64 * 1024 * 1024
+    pending_tuned_params = None
+
+
+def test_parameter_manager_samples_and_converges(monkeypatch, tmp_path):
+    log = tmp_path / "autotune.csv"
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "2")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "3")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_LOG", str(log))
+
+    from horovod_tpu.common.parameter_manager import ParameterManager
+
+    ctrl = _FakeController()
+    pm = ParameterManager(ctrl, active=True)
+    # warmup sample (2 steps) + 3 scored samples (2 steps each)
+    for _ in range(2 * 4):
+        pm.observe(["t"], 1 << 20)
+    assert pm._done
+    threshold, cycle = ctrl.pending_tuned_params
+    assert (1 << 20) <= threshold <= (1 << 28)
+    assert 1.0 <= cycle <= 25.0
+    lines = log.read_text().strip().splitlines()
+    assert lines[0].startswith("timestamp")
+    assert len(lines) == 1 + 3        # header + the scored samples
+
+
+def test_parameter_manager_inactive_never_proposes():
+    from horovod_tpu.common.parameter_manager import ParameterManager
+
+    ctrl = _FakeController()
+    pm = ParameterManager(ctrl, active=False)
+    for _ in range(100):
+        pm.observe(["t"], 1 << 20)
+    assert ctrl.pending_tuned_params is None
+
+
+def test_autotune_propagates_across_ranks():
+    """2-process world with HOROVOD_AUTOTUNE=1: the coordinator's tuned
+    (threshold, cycle) must reach the non-coordinator through the
+    ResponseList tuned_* fields (reference: controller.cc:39-53)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_multiprocess import _run_world
+    _run_world(2, "autotune", timeout=120.0)

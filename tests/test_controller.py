@@ -449,3 +449,39 @@ def test_joined_rank_does_not_block_cached_collectives():
         assert any(resp.response_type == ResponseType.ALLREDUCE and
                    resp.tensor_names == ["t0"] for resp in rl.responses), \
             [r.response_type for r in rl.responses]
+
+
+def test_tuned_params_propagate_to_all_ranks():
+    """Autotuned (fusion threshold, cycle time) stamped by the coordinator
+    ride the broadcast ResponseList and are applied by EVERY rank on the
+    same cycle (reference: Controller::SynchronizeParameters,
+    controller.cc:39-53)."""
+    size = 3
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world) for r in range(size)]
+    defaults = [c.tensor_fusion_threshold for c in controllers]
+    controllers[0].pending_tuned_params = (5 * 1024 * 1024, 7.5)
+
+    def step(rank):
+        ctrl = controllers[rank]
+        ctrl.tensor_queue.push_back_to_queue(
+            _allreduce_req(rank, "tuned_t"))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        assert rl.tuned_fusion_threshold == 5 * 1024 * 1024
+        assert rl.tuned_cycle_time_ms == 7.5
+    for ctrl, default in zip(controllers, defaults):
+        assert ctrl.tensor_fusion_threshold == 5 * 1024 * 1024
+        assert ctrl.tensor_fusion_threshold != default
+    assert controllers[0].pending_tuned_params is None
+
+    # Steady state (cache hits): a NEW proposal still forces one
+    # negotiation cycle so it reaches everyone (controller.cc cache-state
+    # coordination; controller.py:175-178).
+    run_ranks(size, step)   # prime the cache
+    controllers[0].pending_tuned_params = (9 * 1024 * 1024, 3.0)
+    results = run_ranks(size, step)
+    for ctrl in controllers:
+        assert ctrl.tensor_fusion_threshold == 9 * 1024 * 1024

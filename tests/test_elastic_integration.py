@@ -77,7 +77,8 @@ def test_elastic_xla_world_reforms(tmp_path):
     os.environ.update(env)
     try:
         rc = launch_elastic(
-            _args(num_proc=3, min_np=2, max_np=3, start_timeout=90.0,
+            _args(num_proc=3, min_np=2, max_np=3, start_timeout=180.0,
+                  elastic_timeout=180.0,
                   hosts="localhost:1,127.0.0.1:1,127.0.0.2:1"),
             [sys.executable, _WORKER])
     finally:

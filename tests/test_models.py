@@ -44,6 +44,61 @@ def test_param_counts(ctor, n_params_min):
     assert n > n_params_min  # 11.7M / 25.6M in the torchvision models
 
 
+def test_vgg16_forward_and_params():
+    """VGG-16 (reference headline benchmark, docs/benchmarks.rst:13-14):
+    forward shape + the torchvision-scale parameter count (~138M, its
+    giant dense head is the fusion stress case)."""
+    model = models.VGG16(num_classes=1000, dtype=jnp.float32)
+    x = jnp.zeros((2, 64, 64, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 1000)
+    shapes = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, 224, 224, 3)), train=False),
+        jax.random.key(0))
+    n = sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(
+        shapes["params"]))
+    assert n > 130e6
+
+
+def test_inception_v3_forward_and_params():
+    """Inception V3 (reference headline benchmark): forward shape at the
+    canonical 299px (via eval_shape — no FLOPs) and a real forward at
+    96px; ~27M params in the tf-slim model."""
+    model = models.InceptionV3(num_classes=1000, dtype=jnp.float32)
+    x = jnp.zeros((2, 96, 96, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 1000)
+    shapes = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, 299, 299, 3)), train=False),
+        jax.random.key(0))
+    n = sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(
+        shapes["params"]))
+    assert 20e6 < n < 35e6
+
+
+@pytest.mark.parametrize("ctor,image", [
+    (lambda: models.VGG16(num_classes=8, dtype=jnp.float32), 32),
+    (lambda: models.InceptionV3(num_classes=8, dtype=jnp.float32), 96),
+])
+def test_benchmark_models_train_step(ctor, image):
+    """Every reference benchmark family trains under the SPMD Trainer on
+    the dp mesh (fused+compressed gradient sync included)."""
+    mesh = build_mesh(MeshSpec(dp=len(jax.devices())))
+    trainer = training.Trainer(
+        ctor(), optax.sgd(0.01, momentum=0.9), mesh,
+        sync=GradSyncConfig(axes=("dp",), op="average",
+                            compression="fp16"))
+    batch = training.synthetic_image_batch(
+        2 * len(jax.devices()), image_size=image, num_classes=8)
+    state = trainer.init(jax.random.key(0), batch)
+    state, metrics = trainer.step(state, batch)
+    jax.block_until_ready(metrics)
+    assert int(state.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_trainer_loss_decreases():
     mesh = build_mesh(MeshSpec(dp=8))
     model = tiny_resnet()

@@ -71,7 +71,9 @@ def test_join_uneven_data():
 
 @pytest.mark.parametrize("size", [2, 4])
 def test_adasum(size):
-    _run_world(size, "adasum")
+    # Generous timeout: every worker imports torch AND tensorflow for the
+    # delta-optimizer checks, which serializes badly under CI load.
+    _run_world(size, "adasum", timeout=300.0)
 
 
 @pytest.mark.parametrize("size", [2])
