@@ -5,8 +5,10 @@ thread) and horovod/spark/data_loaders/pytorch_data_loaders.py.  TPU-native
 additions: device prefetch that overlaps host→HBM transfer with the current
 step, and mesh-aware batch sharding.
 """
-from .loader import (AsyncDataLoaderMixin, BaseDataLoader, ShardedBatchLoader,
-                     prefetch_to_device)
+from .loader import (AsyncDataLoaderMixin, BaseDataLoader,
+                     ShardedBatchLoader, StoreShardReader,
+                     prefetch_to_device, write_dataset_shards)
 
 __all__ = ["BaseDataLoader", "AsyncDataLoaderMixin", "ShardedBatchLoader",
+           "StoreShardReader", "write_dataset_shards",
            "prefetch_to_device"]

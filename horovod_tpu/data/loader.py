@@ -94,13 +94,44 @@ class AsyncDataLoaderMixin:
             raise err[0]
 
 
+def _replica_indices(n: int, rank: int, num_replicas: int,
+                     shuffle: bool, rng) -> np.ndarray:
+    """This rank's row indices, PADDED so every rank gets exactly
+    ceil(n/num_replicas) rows (indices wrap — the torch
+    DistributedSampler contract).  Equal per-rank row counts are what
+    keep per-rank step counts aligned: a rank with one extra batch would
+    block forever in its collective."""
+    idx = np.arange(n)
+    if shuffle:
+        rng.shuffle(idx)
+    per = -(-n // num_replicas)
+    total = per * num_replicas
+    if total > n:
+        idx = np.concatenate([idx, idx[:total - n]])
+    return idx[rank::num_replicas]
+
+
+def _batch_count(rows: int, batch_size: int, drop_last: bool) -> int:
+    if drop_last:
+        return rows // batch_size
+    return -(-rows // batch_size)
+
+
+def _iter_batches(idx: np.ndarray, batch_size: int,
+                  drop_last: bool) -> Iterator[np.ndarray]:
+    stop = len(idx) - (len(idx) % batch_size) if drop_last else len(idx)
+    for start in range(0, stop, batch_size):
+        yield idx[start:start + batch_size]
+
+
 class ShardedBatchLoader(BaseDataLoader):
     """Batches a numpy dataset dict, sharded by rank (eager API) or whole
     (SPMD API where the mesh shards the global batch).
 
     ``data``: dict of equal-first-dim numpy arrays, e.g. {"image":…,
-    "label":…}.  With ``rank``/``num_replicas`` each process sees its strided
-    shard — the reference's DistributedSampler contract.
+    "label":…}.  With ``rank``/``num_replicas`` each process sees its
+    padded strided shard — the reference's DistributedSampler contract
+    (wrapped indices keep per-rank step counts identical).
     """
 
     def __init__(self, data: dict[str, np.ndarray], batch_size: int,
@@ -121,23 +152,14 @@ class ShardedBatchLoader(BaseDataLoader):
         self.epoch = epoch
 
     def __len__(self) -> int:
-        # Strided shard size: rank r gets ceil((n - r) / num_replicas)
-        # elements — must agree exactly with _iterate's idx[rank::replicas].
-        per_rank = (self.n - self.rank + self.num_replicas - 1) \
-            // self.num_replicas
-        if self.drop_last:
-            return per_rank // self.batch_size
-        return (per_rank + self.batch_size - 1) // self.batch_size
+        per_rank = -(-self.n // self.num_replicas)   # padded: rank-uniform
+        return _batch_count(per_rank, self.batch_size, self.drop_last)
 
     def _iterate(self) -> Iterator[dict[str, np.ndarray]]:
-        idx = np.arange(self.n)
-        if self.shuffle:
-            np.random.default_rng(self.seed + self.epoch).shuffle(idx)
-        idx = idx[self.rank::self.num_replicas]
-        stop = len(idx) - (len(idx) % self.batch_size) if self.drop_last \
-            else len(idx)
-        for start in range(0, stop, self.batch_size):
-            sel = idx[start:start + self.batch_size]
+        rng = np.random.default_rng(self.seed + self.epoch)
+        idx = _replica_indices(self.n, self.rank, self.num_replicas,
+                               self.shuffle, rng)
+        for sel in _iter_batches(idx, self.batch_size, self.drop_last):
             yield {k: v[sel] for k, v in self.data.items()}
 
 
@@ -179,3 +201,92 @@ def prefetch_to_device(iterator: Iterable[dict], size: int = 2,
         except StopIteration:
             pass
         yield out
+
+
+# ---------------------------------------------------------------------------
+# Store-backed shard reader (the petastorm-reader slot)
+# ---------------------------------------------------------------------------
+def write_dataset_shards(store, base_path: str,
+                         data: dict[str, np.ndarray],
+                         num_shards: int = 8) -> list[str]:
+    """Split a dataset dict into ``num_shards`` npz shards behind a Store
+    (reference analogue: materializing the DataFrame to parquet row
+    groups, spark/common/util.py); returns the shard keys in order."""
+    n = int(next(iter(data.values())).shape[0])
+    bounds = np.linspace(0, n, num_shards + 1).astype(int)
+    keys = []
+    for s in range(num_shards):
+        lo, hi = bounds[s], bounds[s + 1]
+        if lo == hi:
+            continue
+        key = store.join(base_path, f"shard_{s:05d}.npz")
+        store.save_npz(key, **{k: v[lo:hi] for k, v in data.items()})
+        keys.append(key)
+    return keys
+
+
+class StoreShardReader(BaseDataLoader):
+    """Streams a dataset living as npz shards behind a :class:`Store`
+    (filesystem or network blob) — the petastorm-backed loader's slot
+    (reference: spark/data_loaders/pytorch_data_loaders.py over
+    spark/common/store.py).
+
+    One shard is resident at a time (the row-group memory contract:
+    O(shard), not O(dataset)); shard ORDER shuffles per epoch, rows
+    within each shard are padded-strided across ranks (the same wrapped
+    DistributedSampler contract as ShardedBatchLoader — every rank gets
+    identical step counts, the collective-lockstep requirement), and rows
+    shuffle within the shard.  ``drop_last`` defaults True so SPMD mesh
+    feeding never sees ragged tail batches.  Compose with
+    ``AsyncDataLoaderMixin`` for background prefetch."""
+
+    def __init__(self, store, shard_keys: Sequence[str], batch_size: int,
+                 shuffle: bool = True, seed: int = 0,
+                 rank: int = 0, num_replicas: int = 1,
+                 drop_last: bool = True,
+                 shard_rows: Sequence[int] | None = None) -> None:
+        self.store = store
+        self.shard_keys = list(shard_keys)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.rank = rank
+        self.num_replicas = num_replicas
+        self.drop_last = drop_last
+        self.epoch = 0
+        self._shard_rows: list[int] | None = \
+            list(shard_rows) if shard_rows is not None else None
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _rows(self) -> list[int]:
+        if self._shard_rows is None:
+            # One pass over the shards (loads each blob once; pass
+            # shard_rows to the constructor to avoid it on remote stores).
+            self._shard_rows = [
+                int(next(iter(blob[k] for k in blob.files)).shape[0])
+                for blob in (self.store.load_npz(key)
+                             for key in self.shard_keys)]
+        return self._shard_rows
+
+    def __len__(self) -> int:
+        return sum(
+            _batch_count(-(-rows // self.num_replicas), self.batch_size,
+                         self.drop_last)
+            for rows in self._rows())
+
+    def _iterate(self) -> Iterator[dict[str, np.ndarray]]:
+        order = np.arange(len(self.shard_keys))
+        rng = np.random.default_rng(self.seed + self.epoch)
+        if self.shuffle:
+            rng.shuffle(order)
+        for si in order:
+            blob = self.store.load_npz(self.shard_keys[si])
+            arrays = {k: blob[k] for k in blob.files}
+            n = int(next(iter(arrays.values())).shape[0])
+            idx = _replica_indices(n, self.rank, self.num_replicas,
+                                   self.shuffle, rng)
+            for sel in _iter_batches(idx, self.batch_size,
+                                     self.drop_last):
+                yield {k: v[sel] for k, v in arrays.items()}
