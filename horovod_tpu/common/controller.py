@@ -156,6 +156,16 @@ class Controller:
                 self.timeline.negotiate_start(req.tensor_name,
                                               req.request_type)
 
+        # Stall check rides the every-cycle heartbeat, not just
+        # negotiation: a one-sided tensor leaves every queue empty after
+        # its single submission, so negotiation never runs again — exactly
+        # the stalled state the inspector exists to catch. The decision
+        # propagates through the cache bit-sync OR (cache on) or the
+        # gathered RequestList (cache off).
+        if self.is_coordinator and self.stall_inspector.should_check():
+            if self.stall_inspector.check_for_stalled_tensors(self.size):
+                shutdown_requested = True
+
         cached_responses: list[Response] = []
 
         for req in message_queue:
@@ -307,9 +317,8 @@ class Controller:
             join_resp = self._maybe_join_response()
             if join_resp is not None:
                 responses.append(join_resp)
-            if self.stall_inspector.should_check():
-                if self.stall_inspector.check_for_stalled_tensors(self.size):
-                    shutdown = True
+            # (Stall check already ran on the compute_response_list
+            # heartbeat; shutdown_requested carries its verdict here.)
             response_list = ResponseList(responses=self.fuse_responses(responses),
                                          shutdown=shutdown)
             if self.pending_tuned_params is not None:

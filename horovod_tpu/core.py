@@ -382,6 +382,10 @@ def _background_loop() -> None:
             st.parameter_manager.observe(tensor_names, total_bytes)
 
         if response_list.shutdown:
+            # Flip the visible flag: ranks that never submitted anything
+            # (e.g. the stalled side of a one-sided collective) must be
+            # able to observe that the world shut down around them.
+            st.shutdown_requested = True
             st.tensor_queue.finalize()
             return
 

@@ -273,6 +273,31 @@ def battery_autotune(hvd, rank, size):
         (rank, tuned, np.asarray(gathered))
 
 
+def battery_stall(hvd, rank, size):
+    """Stall inspector end-to-end (reference: test/integration/
+    test_stall.py + stall_inspector.cc): rank 0 submits a collective that
+    rank 1 never joins; past HOROVOD_STALL_SHUTDOWN_TIME_SECONDS the
+    coordinator aborts the job with a structured error instead of letting
+    the world hang forever."""
+    import time as _time
+
+    if rank == 0:
+        try:
+            hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                          name="lonely")
+        except hvd.HorovodInternalError:
+            return
+        raise AssertionError("stalled collective completed?!")
+    # Other ranks: never submit; the shutdown must arrive on its own.
+    deadline = _time.time() + 20
+    from horovod_tpu.core import _global
+    while _time.time() < deadline:
+        if not _global.initialized or _global.shutdown_requested:
+            return
+        _time.sleep(0.2)
+    raise AssertionError("stall shutdown never propagated to idle rank")
+
+
 def battery_errors(hvd, rank, size):
     # Shape mismatch must raise a structured error on every rank, not hang.
     shape = (4,) if rank == 0 else (5,)
@@ -779,6 +804,7 @@ BATTERIES = {
     "collectives": battery_collectives,
     "matrix": battery_matrix,
     "autotune": battery_autotune,
+    "stall": battery_stall,
     "xla": battery_xla,
     "errors": battery_errors,
     "join": battery_join,
@@ -801,6 +827,9 @@ def main() -> int:
     # Generous under CI load: a peer may still be importing torch/tf when
     # this rank reaches rendezvous.
     os.environ.setdefault("HOROVOD_GLOO_TIMEOUT_SECONDS", "90")
+    if battery == "stall":
+        os.environ["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "1"
+        os.environ["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = "3"
     if battery == "autotune":
         os.environ["HOROVOD_AUTOTUNE"] = "1"
         os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"

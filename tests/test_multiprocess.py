@@ -65,6 +65,12 @@ def test_error_handling():
     _run_world(2, "errors")
 
 
+def test_stall_inspector_aborts_stalled_world():
+    """Reference test/integration/test_stall.py analogue: a one-sided
+    collective must abort with a structured error, not hang."""
+    _run_world(2, "stall", timeout=120.0)
+
+
 def test_join_uneven_data():
     _run_world(2, "join")
 
