@@ -20,21 +20,32 @@ _LEN = struct.Struct(">I")
 
 
 def send_msg(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    if len(payload) < (1 << 16):
+        # Small control messages: one syscall, concat is cheap.
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+    else:
+        # Bulk payloads: never materialize header+payload (a full copy of
+        # a multi-MB gradient buffer per send).
+        sock.sendall(_LEN.pack(len(payload)))
+        sock.sendall(payload)
 
 
-def recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n > 0:
-        chunk = sock.recv(min(n, 1 << 20))
-        if not chunk:
+def recv_exact(sock: socket.socket, n: int) -> bytearray:
+    # Single preallocated buffer + recv_into: no per-chunk allocations,
+    # no final join copy (numpy consumes the bytearray zero-copy via
+    # frombuffer).
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
             raise ConnectionError("socket closed mid-message")
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+        got += r
+    return buf
 
 
-def recv_msg(sock: socket.socket) -> bytes:
+def recv_msg(sock: socket.socket) -> bytearray:
     (length,) = _LEN.unpack(recv_exact(sock, 4))
     return recv_exact(sock, length)
 
@@ -283,7 +294,7 @@ class PeerMesh:
     def send(self, peer: int, payload: bytes) -> None:
         send_msg(self._socks[peer], payload)
 
-    def recv(self, peer: int) -> bytes:
+    def recv(self, peer: int) -> bytearray:
         return recv_msg(self._socks[peer])
 
     def close(self) -> None:
