@@ -36,6 +36,18 @@ class XlaCommunicator:
         self._lock = threading.Lock()
         self._mesh = None
         self._cache: dict = {}
+        self._shardings: dict = {}
+
+    def _world_sharding(self):
+        """Cached NamedSharding(mesh, P("world")) — rebuilding these
+        objects per call adds measurable dispatch latency on the eager
+        hot path."""
+        s = self._shardings.get("world")
+        if s is None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            s = NamedSharding(self._world_mesh(), P("world"))
+            self._shardings["world"] = s
+        return s
 
     def _cached_program(self, key: tuple, build):
         """Double-checked compiled-program cache (the lazy-communicator
@@ -95,11 +107,10 @@ class XlaCommunicator:
 
     def allreduce(self, buf: np.ndarray) -> np.ndarray:
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = self._world_mesh()
         size = mesh.shape["world"]
-        sharding = NamedSharding(mesh, P("world"))
+        sharding = self._world_sharding()
         g = jax.make_array_from_process_local_data(
             sharding, buf[None, :], global_shape=(size, buf.size))
         out = self._reduce_fn(buf.dtype, size)(g)
@@ -130,11 +141,10 @@ class XlaCommunicator:
 
     def broadcast(self, buf: np.ndarray, root: int) -> np.ndarray:
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = self._world_mesh()
         size = mesh.shape["world"]
-        sharding = NamedSharding(mesh, P("world"))
+        sharding = self._world_sharding()
         g = jax.make_array_from_process_local_data(
             sharding, buf[None, :], global_shape=(size, buf.size))
         out = self._bcast_fn(buf.dtype, size)(g, np.int32(root))
@@ -167,7 +177,6 @@ class XlaCommunicator:
         padded to the max first dim so one dense XLA all-gather moves the
         data; padding is stripped host-side."""
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = self._world_mesh()
         size = mesh.shape["world"]
@@ -176,7 +185,7 @@ class XlaCommunicator:
         maxd = max(first_dims)
         padded = np.zeros(maxd * rest_elems, dtype=local.dtype)
         padded[:local.size] = local.reshape(-1)
-        sharding = NamedSharding(mesh, P("world"))
+        sharding = self._world_sharding()
         g = jax.make_array_from_process_local_data(
             sharding, padded[None, :],
             global_shape=(size, maxd * rest_elems))
@@ -216,7 +225,6 @@ class XlaCommunicator:
         the global max block so the exchange is one dense device
         all-to-all."""
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = self._world_mesh()
         size = mesh.shape["world"]
@@ -240,7 +248,7 @@ class XlaCommunicator:
         for j in range(size):
             blk = local[bounds[j]:bounds[j + 1]]
             send[j, :blk.size] = blk.reshape(-1)
-        sharding = NamedSharding(mesh, P("world"))
+        sharding = self._world_sharding()
         g = jax.make_array_from_process_local_data(
             sharding, send[None], global_shape=(size, size, maxblk))
         out = self._a2a_fn(local.dtype, size, maxblk)(g)
@@ -282,14 +290,13 @@ class XlaCommunicator:
         """Reduce over ranks, scatter dim-0 slices; local: [dim0, ...] with
         dim0 divisible by the world size.  Returns this rank's slice."""
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = self._world_mesh()
         size = mesh.shape["world"]
         dim0 = local.shape[0]
         rest = tuple(local.shape[1:])
         rest_elems = int(np.prod(rest)) if rest else 1
-        sharding = NamedSharding(mesh, P("world"))
+        sharding = self._world_sharding()
         g = jax.make_array_from_process_local_data(
             sharding, local.reshape(1, -1),
             global_shape=(size, dim0 * rest_elems))

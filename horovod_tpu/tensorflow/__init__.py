@@ -27,10 +27,11 @@ from .. import (Adasum, Average, Sum, allgather as _allgather_np,
                 allreduce as _allreduce_np, alltoall as _alltoall_np,
                 broadcast as _broadcast_np, broadcast_object, init,
                 is_initialized, join, local_rank, local_size, rank,
-                shutdown, size)
+                reducescatter as _reducescatter_np, shutdown, size)
 
 __all__ = ["init", "shutdown", "rank", "size", "local_rank", "local_size",
-           "allreduce", "allgather", "broadcast", "alltoall", "join",
+           "allreduce", "allgather", "broadcast", "alltoall",
+           "reducescatter", "join",
            "broadcast_object", "broadcast_variables",
            "DistributedGradientTape", "DistributedOptimizer",
            "BroadcastGlobalVariablesCallback", "Average", "Sum", "Adasum",
@@ -132,6 +133,35 @@ def allgather(tensor, name: str | None = None):
         return out, grad
 
     return _allgather(tf.convert_to_tensor(tensor))
+
+
+def reducescatter(tensor, op=None, name: str | None = None):
+    """Reduce across ranks and return this rank's dim-0 slice (op=None
+    averages). Differentiable: the gradient is this rank's slice
+    allgathered back to the full shape."""
+    _require_tf()
+    nm = _auto_name("reducescatter", name)
+
+    @tf.custom_gradient
+    def _reducescatter(t):
+        def _run(x):
+            return _reducescatter_np(x.numpy(), name=nm, op=op)
+
+        out = _py_collective(_run, [t], t.dtype,
+                             tf.TensorShape([None]).concatenate(
+                                 t.shape[1:]))
+
+        def grad(dy):
+            # d(reduce_scatter)/dt: gather the slices back; averaging in
+            # the forward scales the gradient by 1/size.
+            full = allgather(dy, name=f"{nm}.grad")
+            if op in (None, Average):
+                full = full / size()
+            return full
+
+        return out, grad
+
+    return _reducescatter(tf.convert_to_tensor(tensor))
 
 
 def broadcast(tensor, root_rank: int = 0, name: str | None = None):

@@ -18,7 +18,8 @@ from .mpi_ops import (allgather, allgather_async, allreduce, allreduce_,
                       alltoall_async, broadcast, broadcast_,
                       broadcast_async, broadcast_async_, grouped_allreduce,
                       grouped_allreduce_, grouped_allreduce_async,
-                      grouped_allreduce_async_, poll, sparse_allreduce,
+                      grouped_allreduce_async_, poll, reducescatter,
+                      reducescatter_async, sparse_allreduce,
                       sparse_allreduce_async, synchronize)
 from .optimizer import DistributedOptimizer
 from .sync_batch_norm import SyncBatchNorm
@@ -33,6 +34,7 @@ __all__ = [
     "cross_size", "grouped_allreduce", "grouped_allreduce_",
     "grouped_allreduce_async", "grouped_allreduce_async_", "init",
     "is_homogeneous", "is_initialized", "join", "local_rank", "local_size",
-    "poll", "rank", "shutdown", "size", "start_timeline", "stop_timeline",
+    "poll", "rank", "reducescatter", "reducescatter_async", "shutdown",
+    "size", "start_timeline", "stop_timeline",
     "synchronize", "HorovodInternalError", "HostsUpdatedInterrupt",
 ]

@@ -21,7 +21,8 @@ from .. import (allgather_async as _allgather_async,
                 allreduce_async as _allreduce_async,
                 alltoall_async as _alltoall_async,
                 broadcast_async as _broadcast_async,
-                grouped_allreduce_async as _grouped_allreduce_async)
+                grouped_allreduce_async as _grouped_allreduce_async,
+                reducescatter_async as _reducescatter_async)
 from ..core import (Handle, init, is_initialized, shutdown, rank, size,
                     local_rank, local_size, cross_rank, cross_size)
 
@@ -109,6 +110,21 @@ def allgather_async(tensor, name=None) -> Handle:
 
 def allgather(tensor, name=None) -> torch.Tensor:
     return synchronize(allgather_async(tensor, name))
+
+
+def reducescatter_async(tensor, name=None, op=None,
+                        prescale_factor=1.0, postscale_factor=1.0) -> Handle:
+    """Reduce across ranks, return this rank's dim-0 slice (op=None
+    averages, upstream reducescatter semantics)."""
+    return _reducescatter_async(_check_cpu(tensor), name, op,
+                                prescale_factor, postscale_factor)
+
+
+def reducescatter(tensor, name=None, op=None, prescale_factor=1.0,
+                  postscale_factor=1.0) -> torch.Tensor:
+    return synchronize(reducescatter_async(tensor, name, op,
+                                           prescale_factor,
+                                           postscale_factor))
 
 
 def broadcast_async(tensor, root_rank, name=None) -> Handle:

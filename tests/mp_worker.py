@@ -479,6 +479,16 @@ def battery_torch(hvd, rank, size):
             np.testing.assert_array_equal(gathered[r].numpy(),
                                           flat[0].numpy())
 
+    # -- torch reducescatter: summed dim-0 slice --------------------------
+    t = torch.arange(4 * size * 2, dtype=torch.float32).reshape(4 * size, 2) \
+        * (rank + 1)
+    out = hvt.reducescatter(t, op=hvt.Sum, name="t_rs")
+    full = torch.arange(4 * size * 2, dtype=torch.float32) \
+        .reshape(4 * size, 2) * sum(r + 1 for r in range(size))
+    np.testing.assert_allclose(out.numpy(),
+                               full[rank * 4:(rank + 1) * 4].numpy(),
+                               rtol=1e-6)
+
     # Grouped + fp16-compressed + backward_passes_per_step variant runs.
     model2 = make_model()
     opt2 = hvt.DistributedOptimizer(
@@ -578,6 +588,21 @@ def battery_tensorflow(hvd, rank, size):
     gathered = htf.allgather(tf.constant([float(rank)]), name="tf_ag")
     np.testing.assert_allclose(gathered.numpy(),
                                np.arange(size, dtype=np.float32))
+
+    # reducescatter: summed dim-0 slice + gradient round-trip.
+    t = tf.constant(np.arange(2 * size * 3, dtype=np.float32)
+                    .reshape(2 * size, 3) * (rank + 1))
+    with tf.GradientTape() as tape:
+        tape.watch(t)
+        rs = htf.reducescatter(t, op=htf.Sum, name="tf_rs")
+        loss = tf.reduce_sum(rs)
+    full = np.arange(2 * size * 3, dtype=np.float32).reshape(2 * size, 3) \
+        * sum(r + 1 for r in range(size))
+    np.testing.assert_allclose(rs.numpy(),
+                               full[rank * 2:(rank + 1) * 2], rtol=1e-6)
+    g = tape.gradient(loss, t)
+    np.testing.assert_allclose(g.numpy(), np.ones((2 * size, 3)),
+                               rtol=1e-6)
 
 
 def battery_tf_function(hvd, rank, size):

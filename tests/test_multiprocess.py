@@ -82,7 +82,7 @@ def test_adasum(size):
     _run_world(size, "adasum", timeout=300.0)
 
 
-@pytest.mark.parametrize("size", [2])
+@pytest.mark.parametrize("size", [2, 4])
 def test_xla_data_plane(size):
     """Eager collectives ride XLA device collectives when the JAX world
     spans the ranks (VERDICT r1 item 3)."""
