@@ -240,8 +240,11 @@ def main() -> int:
     parser.add_argument("--remat", type=int, default=0,
                         help="gpt: rematerialize each block (saves HBM, "
                         "costs recompute; default off for throughput)")
-    parser.add_argument("--block-q", type=int, default=256)
-    parser.add_argument("--block-k", type=int, default=512)
+    # Defaults from the r3 on-TPU sweep (v5e, gpt-small seq 2048):
+    # 256/512→66.2k tok/s, 512/1024→78.2k, 1024/1024→79.5k (MFU 0.37);
+    # 1024/2048 exceeds the 16M scoped-vmem limit. docs/PERFORMANCE.md.
+    parser.add_argument("--block-q", type=int, default=1024)
+    parser.add_argument("--block-k", type=int, default=1024)
     parser.add_argument("--inner", action="store_true",
                         help="internal: run one attempt in-process")
     args = parser.parse_args()
@@ -348,10 +351,31 @@ def bench_gpt(args, info: dict) -> int:
     on_tpu = jax.default_backend() == "tpu"
 
     import jax.numpy as jnp
+
+    def _divisor_block(block: int, seq: int) -> int:
+        # The flash kernel requires seq % block == 0 and TPU-tile-aligned
+        # blocks; clamp the requested block to the largest 128-multiple
+        # divisor of seq. Fail loudly rather than degrade to a tiny
+        # unaligned block (prime/odd seq would otherwise clamp to 1).
+        for cand in range(min(block, seq) // 128 * 128, 0, -128):
+            if seq % cand == 0:
+                if cand != block:
+                    print(f"bench: flash block {block} -> {cand} "
+                          f"(largest 128-aligned divisor of seq {seq})",
+                          file=sys.stderr)
+                return cand
+        raise ValueError(
+            f"--seq-len {seq} has no 128-aligned divisor <= {block}; "
+            "flash attention needs seq_len to be a multiple of 128.")
+
     cfg = models.gpt_small(
         max_seq_len=args.seq_len,
         attention="flash" if on_tpu else "dense", remat=bool(args.remat),
-        block_q=args.block_q, block_k=args.block_k,
+        # Dense attention (off-TPU) ignores blocks — don't validate there.
+        block_q=(_divisor_block(args.block_q, args.seq_len)
+                 if on_tpu else args.block_q),
+        block_k=(_divisor_block(args.block_k, args.seq_len)
+                 if on_tpu else args.block_k),
         # XLA CPU crashes promoting 16-bit all-reduces; bf16 is TPU-only.
         dtype=jnp.bfloat16 if on_tpu else jnp.float32)
     model = models.TransformerLM(cfg)

@@ -163,6 +163,11 @@ def init(*, rank: int | None = None, size: int | None = None,
         if _global.initialized:
             return
 
+        # Under jsrun (LSF) every rank receives the same environment; rank
+        # identity arrives via JSM/PMIx vars instead of per-slot env.
+        from .runner.js_run import adopt_jsm_env
+        adopt_jsm_env()
+
         def _resolve(kwarg, knob, fallback):
             if kwarg is not None:
                 return kwarg

@@ -184,6 +184,19 @@ def check_build(out=sys.stdout) -> None:
         out.write(f"    [{'X' if ok else ' '}] {name}\n")
 
 
+def rendezvous_env(addr: str, port: int,
+                   start_timeout: float) -> dict[str, str]:
+    """The env block every worker needs to reach the control plane —
+    shared by the ssh and jsrun launch paths so the contract can't
+    drift between them."""
+    return {
+        "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
+        "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
+        "HOROVOD_CONTROLLER": "tcp",
+        "HOROVOD_GLOO_TIMEOUT_SECONDS": str(start_timeout),
+    }
+
+
 def _ssh_command(slot: SlotInfo, command: list[str], env: dict[str, str],
                  args) -> str:
     exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
@@ -202,9 +215,20 @@ def _ssh_command(slot: SlotInfo, command: list[str], env: dict[str, str],
 
 def launch_static(args, command: list[str]) -> int:
     """Static (non-elastic) launch (reference: gloo_run.py launch_gloo)."""
+    from . import js_run
     if args.hostfile:
         args.hosts = parse_host_files(args.hostfile)
+    if args.hosts is None and js_run.using_lsf():
+        # Inside an LSF job the allocation IS the host list (reference:
+        # launch.py _check_all_hosts_ssh_successful / lsf default hosts).
+        args.hosts = js_run.lsf_hosts_string()
     hosts = parse_hosts(args.hosts) if args.hosts else None
+    if hosts is not None and js_run.using_lsf() and \
+            js_run.jsrun_available() and \
+            not all(_is_local(h.hostname) for h in hosts):
+        # jsrun is the process starter on LSF clusters (ssh is usually
+        # disabled between compute nodes there); control plane unchanged.
+        return js_run.launch_jsrun(args, command)
     np = args.num_proc or (sum(h.slots for h in hosts) if hosts else 1)
     if hosts is None:
         hosts = parse_hosts(f"localhost:{np}")
@@ -217,12 +241,8 @@ def launch_static(args, command: list[str]) -> int:
 
     base_env = dict(os.environ)
     base_env.update(args_to_env(args))
-    base_env.update({
-        "HOROVOD_GLOO_RENDEZVOUS_ADDR": rendezvous_addr,
-        "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
-        "HOROVOD_CONTROLLER": "tcp",
-        "HOROVOD_GLOO_TIMEOUT_SECONDS": str(args.start_timeout),
-    })
+    base_env.update(rendezvous_env(rendezvous_addr, port,
+                                   args.start_timeout))
 
     exit_codes = [None] * len(slots)
     # Workers run from launcher threads, so signal forwarding must go

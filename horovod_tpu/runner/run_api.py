@@ -95,12 +95,8 @@ def run(func: Callable, args: Sequence = (), kwargs: dict | None = None,
         for slot in slots:
             slot_env = dict(env or {})
             slot_env.update(slot.to_env())
-            slot_env.update({
-                "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
-                "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
-                "HOROVOD_CONTROLLER": "tcp",
-                "HOROVOD_GLOO_TIMEOUT_SECONDS": str(start_timeout),
-            })
+            from .launch import rendezvous_env
+            slot_env.update(rendezvous_env(addr, port, start_timeout))
             if is_local_host(slot.hostname):
                 parent, child = ctx.Pipe()
                 p = ctx.Process(target=_worker_main,

@@ -188,3 +188,154 @@ class TestStaticRun:
             env=env, capture_output=True, text=True, timeout=60)
         assert proc.returncode == 1
         assert "ranks failed" in proc.stderr
+
+
+class TestJsRun:
+    """jsrun/LSF launcher (reference: test/single/test_jsrun.py analogue)."""
+
+    def test_lsf_detection(self):
+        from horovod_tpu.runner import js_run
+        assert js_run.using_lsf({"LSB_JOBID": "123"})
+        assert not js_run.using_lsf({})
+
+    def test_hosts_from_mcpu_drops_launch_node(self):
+        from horovod_tpu.runner import js_run
+        env = {"LSB_MCPU_HOSTS": "batch1 1 c1 4 c2 4"}
+        assert js_run.lsf_hosts_string(env) == "c1:4,c2:4"
+        # Explicit request keeps the launch node.
+        assert js_run.lsf_hosts_string(
+            env, include_launch_node=True) == "batch1:1,c1:4,c2:4"
+
+    def test_hosts_single_host_kept(self):
+        from horovod_tpu.runner import js_run
+        assert js_run.lsf_hosts_string({"LSB_MCPU_HOSTS": "c1 4"}) == "c1:4"
+        # Uniform single-slot hosts are NOT mistaken for launch nodes.
+        assert js_run.lsf_hosts_string(
+            {"LSB_MCPU_HOSTS": "a 1 b 1"}) == "a:1,b:1"
+
+    def test_hosts_from_hostfile_and_override(self, tmp_path):
+        from horovod_tpu.runner import js_run
+        hf = tmp_path / "djob"
+        hf.write_text("batch1\nc1\nc1\nc2\nc2\n")
+        env = {"LSB_DJOB_HOSTFILE": str(hf)}
+        assert js_run.lsf_hosts_string(env) == "c1:2,c2:2"
+        env[js_run.COMPUTE_HOSTS_ENV] = "x:8"
+        assert js_run.lsf_hosts_string(env) == "x:8"
+
+    def test_rankfile_host_major_disjoint_cpus(self, tmp_path):
+        from horovod_tpu.runner import js_run
+        slots = get_host_assignments(parse_hosts("c1:2,c2:2"), 4)
+        path = js_run.generate_jsrun_rankfile(
+            slots, cores_per_slot=4, path=str(tmp_path / "rf.erf"))
+        text = open(path).read()
+        assert "cpu_index_using: logical" in text
+        assert "rank: 0: { hostname: c1; cpu: {0-3} }" in text
+        assert "rank: 1: { hostname: c1; cpu: {4-7} }" in text
+        assert "rank: 2: { hostname: c2; cpu: {0-3} }" in text
+
+    def test_build_command(self, tmp_path):
+        from horovod_tpu.runner import js_run
+        cmd = js_run.build_jsrun_command(
+            ["python", "train.py"], rankfile="rf.erf",
+            env_overrides={"HOROVOD_GLOO_RENDEZVOUS_PORT": "1234"},
+            output_filename="out.log")
+        assert cmd[:3] == ["jsrun", "--erf_input", "rf.erf"]
+        assert "-E" in cmd and "HOROVOD_GLOO_RENDEZVOUS_PORT=1234" in cmd
+        assert "--stdio_stdout" in cmd
+        assert cmd[-2:] == ["python", "train.py"]
+
+    def test_build_command_resource_set_flags(self):
+        # Default placement mode: no ERF (needs no compute-node core
+        # count); jsrun divides each host's CPUs across resource sets.
+        from horovod_tpu.runner import js_run
+        cmd = js_run.build_jsrun_command(
+            ["python", "t.py"], np=8, rs_per_host=4)
+        assert cmd[:7] == ["jsrun", "--nrs", "8", "--tasks_per_rs", "1",
+                           "--rs_per_host", "4"]
+
+    def test_rankfile_requires_explicit_cores(self, tmp_path,
+                                              monkeypatch):
+        # The launch node's cpu_count says nothing about compute nodes;
+        # guessing would mis-pin every rank.
+        from horovod_tpu.runner import js_run
+        monkeypatch.delenv(js_run.CPU_PER_SLOT_ENV, raising=False)
+        slots = get_host_assignments(parse_hosts("c1:2"), 2)
+        with pytest.raises(ValueError, match="cores per"):
+            js_run.generate_jsrun_rankfile(
+                slots, path=str(tmp_path / "rf.erf"))
+
+    def test_adopt_jsm_env_bare(self):
+        # Bare JSM launch (no exported layout): rank/size/local adopted;
+        # cross left unset — per-rank division math would give hosts with
+        # different slot counts inconsistent cross topologies.
+        from horovod_tpu.runner import js_run
+        env = {"JSM_NAMESPACE_RANK": "5", "JSM_NAMESPACE_SIZE": "8",
+               "JSM_NAMESPACE_LOCAL_RANK": "1",
+               "JSM_NAMESPACE_LOCAL_SIZE": "4"}
+        assert js_run.adopt_jsm_env(env)
+        assert env["HOROVOD_RANK"] == "5" and env["HOROVOD_SIZE"] == "8"
+        assert env["HOROVOD_LOCAL_RANK"] == "1"
+        assert env["HOROVOD_LOCAL_SIZE"] == "4"
+        assert "HOROVOD_CROSS_RANK" not in env
+        assert "HOROVOD_CROSS_SIZE" not in env
+
+    def test_adopt_never_clobbers_launcher_env(self):
+        from horovod_tpu.runner import js_run
+        env = {"HOROVOD_RANK": "0", "JSM_NAMESPACE_RANK": "5",
+               "JSM_NAMESPACE_SIZE": "8"}
+        assert not js_run.adopt_jsm_env(env)
+        assert env["HOROVOD_RANK"] == "0"
+
+    def test_adopt_noop_outside_jsm(self):
+        from horovod_tpu.runner import js_run
+        env = {}
+        assert not js_run.adopt_jsm_env(env)
+        assert env == {}
+
+    def test_hosts_cyclic_distribution_aggregated(self, tmp_path):
+        # Cyclic task placement repeats hostnames non-consecutively; slots
+        # must aggregate per host or the topology is wrong.
+        from horovod_tpu.runner import js_run
+        hf = tmp_path / "djob"
+        hf.write_text("batch1\nc1\nc2\nc1\nc2\n")
+        assert js_run.lsf_hosts_string(
+            {"LSB_DJOB_HOSTFILE": str(hf)}) == "c1:2,c2:2"
+
+    def test_adopt_uses_exported_layout_non_uniform(self):
+        # launch_jsrun exports the host layout; workers must derive
+        # local/cross ranks with get_host_assignments, not uniform math.
+        from horovod_tpu.runner import js_run
+        env = {"JSM_NAMESPACE_RANK": "4", "JSM_NAMESPACE_SIZE": "6",
+               js_run.JSRUN_HOSTS_ENV: "c1:4,c2:2"}
+        assert js_run.adopt_jsm_env(env)
+        assert env["HOROVOD_HOSTNAME"] == "c2"
+        assert env["HOROVOD_LOCAL_RANK"] == "0"
+        assert env["HOROVOD_LOCAL_SIZE"] == "2"
+        assert env["HOROVOD_CROSS_RANK"] == "1"
+        assert env["HOROVOD_CROSS_SIZE"] == "2"
+
+    def test_adopt_ignores_plain_mpirun(self):
+        # Bare OMPI vars without our control-plane env: each process is
+        # an independent size-1 world (plain `mpirun python eval.py`).
+        from horovod_tpu.runner import js_run
+        env = {"OMPI_COMM_WORLD_RANK": "1", "OMPI_COMM_WORLD_SIZE": "4"}
+        assert not js_run.adopt_jsm_env(env)
+        assert "HOROVOD_RANK" not in env
+
+    def test_adopt_accepts_ompi_with_rendezvous(self):
+        # Our mpirun launcher exports the rendezvous env -> adopt.
+        from horovod_tpu.runner import js_run
+        env = {"OMPI_COMM_WORLD_RANK": "1", "OMPI_COMM_WORLD_SIZE": "2",
+               "HOROVOD_GLOO_RENDEZVOUS_ADDR": "10.0.0.1"}
+        assert js_run.adopt_jsm_env(env)
+        assert env["HOROVOD_RANK"] == "1"
+
+    def test_adopt_detects_placement_mismatch(self):
+        # jsrun placed the task off the host-major order the layout
+        # assumes -> loud failure, not silently wrong chip binding.
+        from horovod_tpu.runner import js_run
+        env = {"JSM_NAMESPACE_RANK": "1", "JSM_NAMESPACE_SIZE": "4",
+               "JSM_NAMESPACE_LOCAL_RANK": "0",
+               js_run.JSRUN_HOSTS_ENV: "c1:2,c2:2"}
+        with pytest.raises(RuntimeError, match="placement mismatch"):
+            js_run.adopt_jsm_env(env)
