@@ -357,16 +357,18 @@ def bench_gpt(args, info: dict) -> int:
         # blocks; clamp the requested block to the largest 128-multiple
         # divisor of seq. Fail loudly rather than degrade to a tiny
         # unaligned block (prime/odd seq would otherwise clamp to 1).
-        for cand in range(min(block, seq) // 128 * 128, 0, -128):
+        hi = max(128, min(block, seq) // 128 * 128)  # 128 = TPU tile min
+        for cand in range(hi, 0, -128):
             if seq % cand == 0:
                 if cand != block:
                     print(f"bench: flash block {block} -> {cand} "
-                          f"(largest 128-aligned divisor of seq {seq})",
-                          file=sys.stderr)
+                          "(blocks must be 128-aligned divisors of "
+                          f"seq {seq})", file=sys.stderr)
                 return cand
         raise ValueError(
-            f"--seq-len {seq} has no 128-aligned divisor <= {block}; "
-            "flash attention needs seq_len to be a multiple of 128.")
+            f"flash attention blocks must be 128-aligned divisors of "
+            f"--seq-len; {seq} is not a multiple of 128 (requested "
+            f"block {block}).")
 
     cfg = models.gpt_small(
         max_seq_len=args.seq_len,

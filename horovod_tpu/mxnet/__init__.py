@@ -1,43 +1,233 @@
-"""MXNet binding surface (reference: horovod/mxnet/__init__.py).
+"""MXNet binding (reference: horovod/mxnet/__init__.py:40-215).
 
-MXNet reached end-of-life upstream and is not part of this image; the
-module exists so reference imports fail with actionable guidance instead of
-a bare ModuleNotFoundError.  The collective semantics MXNet users need
-(DistributedOptimizer-style gradient averaging) are available through
-:mod:`horovod_tpu.torch` or the JAX Trainer.
+A complete, import-gated binding: the collective ops and the two training
+wrappers carry the reference's semantics (gradient sum + rescale_grad
+normalization, predivide split, grouped enqueue), staged through the same
+eager core as the torch binding.  MXNet itself is end-of-life upstream and
+not installed in this image, so the wrapper *classes* are built lazily on
+first access (PEP 562) — importing this module, and everything that only
+needs rank/size bookkeeping, works without mxnet; touching
+DistributedOptimizer/DistributedTrainer requires it (the test battery
+substitutes a stub module).
 """
 from __future__ import annotations
 
+from collections import OrderedDict
+
 from .. import init, is_initialized, local_rank, local_size, rank, \
     shutdown, size  # noqa: F401
+from .mpi_ops import (Adasum, Average, Sum, allgather, allreduce,  # noqa: F401
+                      allreduce_, alltoall, broadcast, broadcast_,
+                      grouped_allreduce, grouped_allreduce_)
 
 __all__ = ["init", "shutdown", "rank", "size", "local_rank", "local_size",
-           "is_initialized", "DistributedOptimizer", "DistributedTrainer",
-           "broadcast_parameters"]
+           "is_initialized", "allreduce", "allreduce_", "grouped_allreduce",
+           "grouped_allreduce_", "allgather", "broadcast", "broadcast_",
+           "alltoall", "DistributedOptimizer", "DistributedTrainer",
+           "broadcast_parameters", "Average", "Sum", "Adasum"]
 
-_MSG = ("horovod_tpu.mxnet requires mxnet, which is end-of-life and not "
-        "installed in this environment. Use horovod_tpu.torch "
-        "(DistributedOptimizer) or the JAX-native Trainer instead.")
+
+def _split_list(xs, parts: int):
+    """Near-even contiguous split (reference: common/util split_list)."""
+    base, rem = divmod(len(xs), parts)
+    out, start = [], 0
+    for i in range(parts):
+        n = base + (1 if i < rem else 0)
+        if n:
+            out.append(xs[start:start + n])
+        start += n
+    return out
+
+
+def _append_broadcast_init(param, root_rank: int, name: str) -> None:
+    """Deferred-init gluon param: broadcast right after shape inference
+    materializes it (reference: mxnet/__init__.py:183-189)."""
+    init_impl = param._init_impl
+
+    def wrapped(*args, **kwargs):
+        init_impl(*args, **kwargs)
+        broadcast_(param.data(), root_rank, name=name)
+
+    param._init_impl = wrapped
+
+
+def broadcast_parameters(params, root_rank: int = 0, prefix: str = None):
+    """Sync initial parameters from root (reference:
+    mxnet/__init__.py:191-215; accepts a dict or gluon ParameterDict).
+    Deferred-init params are broadcast after their first forward pass
+    infers shapes — skipping them would silently leave each rank training
+    its own random init."""
+    prefix = prefix or ""
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    else:
+        raise ValueError("invalid params of type: %s" % type(params))
+    for name, p in items:
+        tag = f"{prefix}param.{name}"
+        if not hasattr(p, "data"):
+            broadcast_(p, root_rank, name=tag)
+            continue
+        try:
+            tensor = p.data()
+        except Exception as exc:
+            if type(exc).__name__ == "DeferredInitializationError":
+                _append_broadcast_init(p, root_rank, tag)
+                continue
+            raise
+        broadcast_(tensor, root_rank, name=tag)
+
+
+def _build_distributed_optimizer():
+    mx = _require_mxnet()
+
+    class DistributedOptimizer(mx.optimizer.Optimizer):
+        """Wrap any mx optimizer: allreduce-sum each gradient at update
+        time, fold the 1/size average into rescale_grad (reference:
+        mxnet/__init__.py:40-93)."""
+
+        def __init__(self, optimizer, gradient_predivide_factor=1.0,
+                     num_groups=0):
+            self._optimizer = optimizer
+            # Average = sum-allreduce + rescale_grad/size, the reference's
+            # preferred split (better than dividing on the wire).
+            self._optimizer.rescale_grad *= \
+                gradient_predivide_factor / size()
+            self._gradient_predivide_factor = gradient_predivide_factor
+            self._num_groups = num_groups
+
+        def __getattr__(self, item):
+            return getattr(self._optimizer, item)
+
+        def create_state_multi_precision(self, index, weight):
+            return self._optimizer.create_state_multi_precision(index,
+                                                                weight)
+
+        def _do_allreduce(self, index, grad):
+            if size() == 1:
+                return
+            pre = 1.0 / self._gradient_predivide_factor
+            if isinstance(index, (tuple, list)):
+                if self._num_groups > 0:
+                    grad_split = _split_list(grad, self._num_groups)
+                    index_split = _split_list(index, self._num_groups)
+                    for grads, indices in zip(grad_split, index_split):
+                        grouped_allreduce_(
+                            tensors=grads, average=False,
+                            name=f"{indices[0]}:{indices[-1]}",
+                            prescale_factor=pre)
+                else:
+                    for i in range(len(index)):
+                        allreduce_(grad[i], average=False,
+                                   name=str(index[i]), prescale_factor=pre)
+            else:
+                allreduce_(grad, average=False, name=str(index),
+                           prescale_factor=pre)
+
+        def update(self, index, weight, grad, state):
+            self._do_allreduce(index, grad)
+            self._optimizer.update(index, weight, grad, state)
+
+        def update_multi_precision(self, index, weight, grad, state):
+            self._do_allreduce(index, grad)
+            self._optimizer.update_multi_precision(index, weight, grad,
+                                                   state)
+
+        def set_learning_rate(self, lr):
+            self._optimizer.set_learning_rate(lr)
+
+        def set_lr_mult(self, args_lr_mult):
+            self._optimizer.set_lr_mult(args_lr_mult)
+
+        def set_wd_mult(self, args_wd_mult):
+            self._optimizer.set_wd_mult(args_wd_mult)
+
+    return DistributedOptimizer
+
+
+def _build_distributed_trainer():
+    mx = _require_mxnet()
+
+    class DistributedTrainer(mx.gluon.Trainer):
+        """gluon Trainer whose gradient reduction rides these collectives
+        instead of kvstore, averaging via the _scale fold (reference:
+        mxnet/__init__.py:102-180)."""
+
+        def __init__(self, params, optimizer, optimizer_params=None,
+                     gradient_predivide_factor=1.0, prefix=None,
+                     num_groups=0):
+            if type(optimizer).__name__ == "DistributedOptimizer":
+                optimizer = optimizer._optimizer
+            if isinstance(params, dict):
+                params = OrderedDict(params)
+            elif isinstance(params, (list, tuple)):
+                # Deterministic cross-rank order; keyed by name because
+                # gluon Parameters define no ordering of their own. The
+                # "" fallback + stable sort keeps unnamed params in the
+                # caller's list order (identical across ranks) rather
+                # than falling back to per-process repr addresses.
+                params = sorted(params,
+                                key=lambda p: getattr(p, "name", ""))
+            super().__init__(params, optimizer,
+                             optimizer_params=optimizer_params,
+                             kvstore=None)
+            self._scale *= gradient_predivide_factor / size()
+            self._gradient_predivide_factor = gradient_predivide_factor
+            self._prefix = prefix if prefix else ""
+            self._num_groups = num_groups
+
+        def _allreduce_grads(self):
+            if size() == 1:
+                return
+            pre = 1.0 / self._gradient_predivide_factor
+            live = [(i, p) for i, p in enumerate(self._params)
+                    if p.grad_req != "null"]
+            if self._num_groups > 0:
+                pairs = [(p.list_grad()[0], self._prefix + str(i))
+                         for i, p in live]
+                for group in _split_list(pairs, self._num_groups):
+                    # Enqueue per dtype within the group (reference:
+                    # __init__.py:160-170).
+                    by_dtype = OrderedDict()
+                    for grad, name in group:
+                        by_dtype.setdefault(str(grad.dtype),
+                                            []).append((grad, name))
+                    for entries in by_dtype.values():
+                        grads, names = zip(*entries)
+                        grouped_allreduce_(
+                            tensors=list(grads), average=False,
+                            name=f"{names[0]}:{names[-1]}",
+                            prescale_factor=pre)
+            else:
+                for i, p in live:
+                    allreduce_(p.list_grad()[0], average=False,
+                               name=self._prefix + str(i),
+                               prescale_factor=pre)
+
+    return DistributedTrainer
 
 
 def _require_mxnet():
     try:
-        import mxnet  # noqa: F401
+        import mxnet
         return mxnet
     except ImportError as exc:
-        raise ImportError(_MSG) from exc
+        raise ImportError(
+            "horovod_tpu.mxnet wrappers require mxnet (end-of-life "
+            "upstream, not installed here). The binding is complete; "
+            "install mxnet, or use horovod_tpu.torch / the JAX Trainer."
+        ) from exc
 
 
-def DistributedOptimizer(optimizer, *args, **kwargs):
-    _require_mxnet()
-    raise NotImplementedError(_MSG)
+_lazy_cache: dict = {}
 
 
-def DistributedTrainer(params, optimizer, *args, **kwargs):
-    _require_mxnet()
-    raise NotImplementedError(_MSG)
-
-
-def broadcast_parameters(params, root_rank: int = 0):
-    _require_mxnet()
-    raise NotImplementedError(_MSG)
+def __getattr__(name: str):
+    """PEP 562: build the mx-subclassing wrappers only when touched."""
+    if name in ("DistributedOptimizer", "DistributedTrainer"):
+        if name not in _lazy_cache:
+            builder = (_build_distributed_optimizer
+                       if name == "DistributedOptimizer"
+                       else _build_distributed_trainer)
+            _lazy_cache[name] = builder()
+        return _lazy_cache[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,119 @@
+"""MXNet collective ops over the horovod_tpu core.
+
+Reference: horovod/mxnet/mpi_ops.py:66-405 — NDArray collectives bound
+through the MXNet engine's async callbacks.  TPU-native redesign: NDArrays
+stage through host numpy into the same core enqueue API the torch binding
+uses (the engine-callback machinery has no analogue here; ops complete
+through Handle futures, and in-place variants copy back on completion).
+``priority`` is accepted for API compatibility and advisory only — the
+controller's response ordering is negotiated, not caller-priority driven.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from .. import (Adasum, Average, Sum)  # noqa: F401
+from .. import (allgather_async as _allgather_async,
+                allreduce_async as _allreduce_async,
+                alltoall_async as _alltoall_async,
+                broadcast_async as _broadcast_async,
+                grouped_allreduce_async as _grouped_allreduce_async)
+from ..core import Handle  # noqa: F401
+
+
+def _mx():
+    try:
+        import mxnet
+        return mxnet
+    except ImportError as exc:
+        raise ImportError(
+            "horovod_tpu.mxnet ops require mxnet (end-of-life upstream and "
+            "not installed in this image). The binding itself is complete; "
+            "install mxnet or use horovod_tpu.torch / the JAX Trainer."
+        ) from exc
+
+
+def _to_np(tensor) -> np.ndarray:
+    return tensor.asnumpy()
+
+
+def _from_np(out: np.ndarray):
+    mx = _mx()
+    return mx.nd.array(out, dtype=out.dtype)
+
+
+def _copy_out(target, out: np.ndarray):
+    target[:] = _from_np(out.astype(np.dtype(target.dtype), copy=False))
+    return target
+
+
+def _wait(handle: Handle) -> list[np.ndarray]:
+    status = handle.wait()
+    status.raise_if_error()
+    return [e.output for e in handle.entries]
+
+
+# -- allreduce ---------------------------------------------------------------
+def allreduce(tensor, average=True, name=None, priority=0,
+              prescale_factor=1.0, postscale_factor=1.0):
+    """Reference: mxnet/mpi_ops.py:66-108 (out-of-place, returns new
+    NDArray)."""
+    handle = _allreduce_async(_to_np(tensor), average, name, None,
+                              prescale_factor, postscale_factor)
+    return _from_np(_wait(handle)[0])
+
+
+def allreduce_(tensor, average=True, name=None, priority=0,
+               prescale_factor=1.0, postscale_factor=1.0):
+    """In-place variant (reference: mpi_ops.py:111-147)."""
+    handle = _allreduce_async(_to_np(tensor), average, name, None,
+                              prescale_factor, postscale_factor)
+    return _copy_out(tensor, _wait(handle)[0])
+
+
+def grouped_allreduce(tensors: Sequence, average=True, name=None,
+                      priority=0, prescale_factor=1.0,
+                      postscale_factor=1.0):
+    handle = _grouped_allreduce_async([_to_np(t) for t in tensors],
+                                      average, name, None, prescale_factor,
+                                      postscale_factor)
+    return [_from_np(o) for o in _wait(handle)]
+
+
+def grouped_allreduce_(tensors: Sequence, average=True, name=None,
+                       priority=0, prescale_factor=1.0,
+                       postscale_factor=1.0):
+    handle = _grouped_allreduce_async([_to_np(t) for t in tensors],
+                                      average, name, None, prescale_factor,
+                                      postscale_factor)
+    return [_copy_out(t, o) for t, o in zip(tensors, _wait(handle))]
+
+
+# -- allgather / broadcast / alltoall ---------------------------------------
+def allgather(tensor, name=None, priority=0):
+    """Concatenate every rank's tensor along dim 0; first dims may differ
+    (reference: mpi_ops.py:242-279)."""
+    handle = _allgather_async(_to_np(tensor), name)
+    return _from_np(_wait(handle)[0])
+
+
+def broadcast(tensor, root_rank, name=None, priority=0):
+    handle = _broadcast_async(_to_np(tensor), root_rank, name)
+    return _from_np(_wait(handle)[0])
+
+
+def broadcast_(tensor, root_rank, name=None, priority=0):
+    handle = _broadcast_async(_to_np(tensor), root_rank, name)
+    return _copy_out(tensor, _wait(handle)[0])
+
+
+def alltoall(tensor, splits=None, name=None, priority=0):
+    """Distribute dim-0 slices to every rank (reference:
+    mpi_ops.py:358-405)."""
+    if splits is not None and not isinstance(splits, np.ndarray):
+        splits = _to_np(splits) if hasattr(splits, "asnumpy") \
+            else np.asarray(splits)
+    handle = _alltoall_async(_to_np(tensor), splits, name)
+    return _from_np(_wait(handle)[0])

@@ -189,11 +189,13 @@ def adopt_jsm_env(env: dict | None = None) -> bool:
     size = env.get("JSM_NAMESPACE_SIZE", env.get("OMPI_COMM_WORLD_SIZE"))
     if rank is None or size is None:
         return False
-    if "JSM_NAMESPACE_RANK" not in env and JSRUN_HOSTS_ENV not in env \
+    if JSRUN_HOSTS_ENV not in env \
             and "HOROVOD_GLOO_RENDEZVOUS_ADDR" not in env:
-        # Bare OMPI/PMIx vars WITHOUT one of our launchers' control-plane
-        # env: a plain `mpirun python eval.py` where each process expects
-        # an independent size-1 world — adopting would break it.
+        # JSM/OMPI/PMIx identity WITHOUT one of our launchers'
+        # control-plane env: a bare `mpirun`/`jsrun` of a script where
+        # each process expects an independent size-1 world — adopting a
+        # multi-rank world with no rendezvous to form it would only turn
+        # working scripts into init-time failures.
         return False
     rank, size = int(rank), int(size)
     hosts_string = env.get(JSRUN_HOSTS_ENV)
@@ -262,27 +264,26 @@ def launch_jsrun(args, command: list[str]) -> int:
     # itself splits each host's CPUs — requires uniform slots per host.
     rankfile = None
     slot_counts = {h.slots for h in hosts}
-    if os.environ.get(CPU_PER_SLOT_ENV):
-        fd, rankfile = tempfile.mkstemp(suffix=".erf")
-        os.close(fd)
-        generate_jsrun_rankfile(slots, path=rankfile)
-        cmd = build_jsrun_command(
-            command, rankfile=rankfile, env_overrides=overrides,
-            output_filename=getattr(args, "output_filename", None))
-    elif len(slot_counts) == 1:
-        cmd = build_jsrun_command(
-            command, np=np, rs_per_host=slot_counts.pop(),
-            env_overrides=overrides,
-            output_filename=getattr(args, "output_filename", None))
-    else:
-        server.stop()
-        raise RuntimeError(
-            "jsrun launch with non-uniform slots per host needs an ERF "
-            f"rankfile: set {CPU_PER_SLOT_ENV} to the compute-node cores "
-            "per slot.")
-    if args.verbose:
-        print(" ".join(cmd))
     try:
+        if os.environ.get(CPU_PER_SLOT_ENV):
+            fd, rankfile = tempfile.mkstemp(suffix=".erf")
+            os.close(fd)
+            generate_jsrun_rankfile(slots, path=rankfile)
+            cmd = build_jsrun_command(
+                command, rankfile=rankfile, env_overrides=overrides,
+                output_filename=getattr(args, "output_filename", None))
+        elif len(slot_counts) == 1:
+            cmd = build_jsrun_command(
+                command, np=np, rs_per_host=slot_counts.pop(),
+                env_overrides=overrides,
+                output_filename=getattr(args, "output_filename", None))
+        else:
+            raise RuntimeError(
+                "jsrun launch with non-uniform slots per host needs an "
+                f"ERF rankfile: set {CPU_PER_SLOT_ENV} to the "
+                "compute-node cores per slot.")
+        if args.verbose:
+            print(" ".join(cmd))
         return safe_shell_exec.execute(cmd, env=dict(os.environ))
     finally:
         server.stop()

@@ -22,6 +22,7 @@ from typing import Any, Callable, Sequence
 
 from .hosts import (get_host_assignments, is_local_host, parse_hosts,
                     ssh_argv)
+from .launch import rendezvous_env
 from .network import RendezvousClient, RendezvousServer
 
 # Module alias so tests can substitute a local shell for the ssh binary.
@@ -95,7 +96,6 @@ def run(func: Callable, args: Sequence = (), kwargs: dict | None = None,
         for slot in slots:
             slot_env = dict(env or {})
             slot_env.update(slot.to_env())
-            from .launch import rendezvous_env
             slot_env.update(rendezvous_env(addr, port, start_timeout))
             if is_local_host(slot.hostname):
                 parent, child = ctx.Pipe()

@@ -825,6 +825,106 @@ def battery_xla(hvd, rank, size):
         np.testing.assert_allclose(out, np.full(4, float(size)))
 
 
+
+def battery_mxnet(hvd, rank, size):
+    """MXNet binding semantics against the stub module (reference:
+    test/parallel/test_mxnet1.py / test_mxnet2.py patterns)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import mxnet_stub
+    mx = mxnet_stub.install()
+    import horovod_tpu.mxnet as hmx
+
+    # -- average allreduce (out-of-place NDArray) -------------------------
+    x = mx.nd.array(np.arange(8, dtype=np.float32) + rank)
+    out = hmx.allreduce(x, average=True, name="mx_avg")
+    np.testing.assert_allclose(
+        out.asnumpy(), np.arange(8, dtype=np.float32) + (size - 1) / 2)
+
+    # -- in-place sum with prescale --------------------------------------
+    y = mx.nd.array(np.ones(4, dtype=np.float32) * (rank + 1))
+    hmx.allreduce_(y, average=False, name="mx_sum", prescale_factor=0.5)
+    np.testing.assert_allclose(
+        y.asnumpy(), np.full(4, 0.5 * sum(range(1, size + 1))))
+
+    # -- allgather (variable first dim) ----------------------------------
+    g = mx.nd.array(np.full((rank + 1, 2), rank, dtype=np.float32))
+    out = hmx.allgather(g, name="mx_ag")
+    assert out.shape == (sum(r + 1 for r in range(size)), 2), out.shape
+
+    # -- broadcast --------------------------------------------------------
+    b = mx.nd.array(np.full(3, rank, dtype=np.float32))
+    out = hmx.broadcast(b, root_rank=0, name="mx_bc")
+    np.testing.assert_allclose(out.asnumpy(), np.zeros(3))
+
+    # -- alltoall (equal splits) -----------------------------------------
+    a = mx.nd.array(np.arange(size * 2, dtype=np.float32) + 100 * rank)
+    out = hmx.alltoall(a, name="mx_a2a")
+    exp = np.concatenate([np.arange(2, dtype=np.float32) + 2 * rank + 100 * r
+                          for r in range(size)])
+    np.testing.assert_allclose(out.asnumpy(), exp)
+
+    # -- grouped in-place -------------------------------------------------
+    gs = [mx.nd.array(np.full(4, rank + i, dtype=np.float32))
+          for i in range(3)]
+    hmx.grouped_allreduce_(gs, average=False, name="mx_gar")
+    for i, t in enumerate(gs):
+        np.testing.assert_allclose(
+            t.asnumpy(), np.full(4, float(sum(r + i for r in range(size)))))
+
+    # -- DistributedTrainer: weights agree and equal mean-gradient SGD ----
+    params = [mx.gluon.Parameter(f"w{i}", np.ones(4, dtype=np.float32)
+                                 * (i + 1)) for i in range(3)]
+    for i, p in enumerate(params):
+        p.list_grad()[0][:] = np.full(4, (rank + 1) * (i + 1),
+                                      dtype=np.float32)
+    trainer = hmx.DistributedTrainer(
+        params, "sgd", optimizer_params={"learning_rate": 0.1})
+    trainer.step(batch_size=1)
+    for i, p in enumerate(params):
+        mean = np.mean([(r + 1) * (i + 1) for r in range(size)])
+        np.testing.assert_allclose(
+            p.data().asnumpy(), np.ones(4) * (i + 1) - 0.1 * mean,
+            rtol=1e-5)
+
+    # -- num_groups grouped path -----------------------------------------
+    params2 = [mx.gluon.Parameter(f"v{i}", np.zeros(2, dtype=np.float32))
+               for i in range(4)]
+    for i, p in enumerate(params2):
+        p.list_grad()[0][:] = np.full(2, float(rank + i), dtype=np.float32)
+    tr2 = hmx.DistributedTrainer(
+        params2, "sgd", optimizer_params={"learning_rate": 1.0},
+        prefix="g2", num_groups=2)
+    tr2.step(batch_size=1)
+    for i, p in enumerate(params2):
+        mean = np.mean([r + i for r in range(size)])
+        np.testing.assert_allclose(p.data().asnumpy(),
+                                   np.full(2, -mean), rtol=1e-5)
+
+    # -- DistributedOptimizer: sum-allreduce + rescale fold ---------------
+    opt = hmx.DistributedOptimizer(
+        mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0))
+    w = mx.nd.array(np.zeros(3, dtype=np.float32))
+    gr = mx.nd.array(np.full(3, float(rank + 1), dtype=np.float32))
+    opt.update(7, w, gr, None)
+    exp_w = -0.5 * (1.0 / size) * sum(range(1, size + 1))
+    np.testing.assert_allclose(w.asnumpy(), np.full(3, exp_w), rtol=1e-5)
+
+    # -- broadcast_parameters --------------------------------------------
+    pd = {f"p{i}": mx.gluon.Parameter(
+        f"p{i}", np.full(2, float(rank * (i + 1)), dtype=np.float32))
+        for i in range(2)}
+    hmx.broadcast_parameters(pd, root_rank=0)
+    for i in range(2):
+        np.testing.assert_allclose(pd[f"p{i}"].data().asnumpy(),
+                                   np.zeros(2))
+
+    # -- deferred-init param: broadcast rides the post-init hook ----------
+    dp = mx.gluon.Parameter("deferred")          # no data yet
+    hmx.broadcast_parameters({"d": dp}, root_rank=0)
+    dp._init_impl(np.full(3, float(rank + 1), dtype=np.float32))
+    np.testing.assert_allclose(dp.data().asnumpy(), np.ones(3))
+
+
 BATTERIES = {
     "collectives": battery_collectives,
     "matrix": battery_matrix,
@@ -839,6 +939,7 @@ BATTERIES = {
     "tensorflow": battery_tensorflow,
     "tf_function": battery_tf_function,
     "sparse": battery_sparse,
+    "mxnet": battery_mxnet,
 }
 
 

@@ -1,0 +1,151 @@
+"""Minimal numpy-backed mxnet emulation for exercising the
+horovod_tpu.mxnet binding without the (EOL, uninstallable) real package —
+the same stub-module pattern as test_ray_elastic's fake ray.
+
+Models the exact API slice the binding touches: NDArray (asnumpy, slice
+assignment, dtype), optimizer.Optimizer/SGD with rescale_grad + update(),
+gluon.Parameter (data/list_grad/grad_req) and gluon.Trainer whose
+``step(batch_size)`` sets ``rescale_grad = _scale / batch_size``, calls
+``_allreduce_grads()`` then updates — mirroring real gluon so the
+DistributedTrainer averaging fold is tested against true semantics.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+
+class NDArray:
+    def __init__(self, data, dtype=None):
+        self._a = np.array(data, dtype=dtype)
+
+    def asnumpy(self) -> np.ndarray:
+        return self._a.copy()
+
+    def __setitem__(self, key, value):
+        self._a[key] = value._a if isinstance(value, NDArray) else value
+
+    def __getitem__(self, key):
+        return NDArray(self._a[key])
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+
+def _nd_array(data, dtype=None, ctx=None):
+    return NDArray(data, dtype=dtype)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.01, rescale_grad=1.0, **kwargs):
+        self.lr = learning_rate
+        self.rescale_grad = rescale_grad
+
+    def create_state_multi_precision(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+
+class SGD(Optimizer):
+    def update(self, index, weight, grad, state):
+        weight[:] = weight.asnumpy() - self.lr * self.rescale_grad \
+            * grad.asnumpy()
+
+
+class DeferredInitializationError(Exception):
+    """Matched by type name in broadcast_parameters (real gluon raises
+    mxnet.gluon.parameter.DeferredInitializationError)."""
+
+
+class Parameter:
+    def __init__(self, name, data=None, grad_req="write"):
+        self.name = name
+        self.grad_req = grad_req
+        if data is None:        # deferred init: shape unknown until the
+            self._data = None   # first forward infers it
+        else:
+            self._data = NDArray(data)
+        self._grad = None if self._data is None else \
+            NDArray(np.zeros_like(self._data.asnumpy()))
+
+    def data(self):
+        if self._data is None:
+            raise DeferredInitializationError(self.name)
+        return self._data
+
+    def list_grad(self):
+        return [self._grad]
+
+    def list_data(self):
+        return [self._data]
+
+    def _init_impl(self, data):
+        """Materialize a deferred param (real gluon calls this once the
+        first forward has inferred shapes)."""
+        self._data = NDArray(data)
+        self._grad = NDArray(np.zeros_like(self._data.asnumpy()))
+
+
+class Trainer:
+    """Mirrors mx.gluon.Trainer's step contract (scale fold then reduce
+    then update); kvstore push/pull is a no-op _allreduce_grads here."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore=None):
+        if hasattr(params, "values"):
+            params = list(params.values())
+        self._params = list(params)
+        if isinstance(optimizer, str):
+            optimizer = {"sgd": SGD}[optimizer](**(optimizer_params or {}))
+        elif optimizer_params:
+            for k, v in optimizer_params.items():
+                setattr(optimizer, k, v)
+        self._optimizer = optimizer
+        self._scale = 1.0
+
+    def _allreduce_grads(self):
+        pass
+
+    def step(self, batch_size):
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update()
+
+    def _update(self):
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                self._optimizer.update(i, p.data(), p.list_grad()[0], None)
+
+
+def install() -> types.ModuleType:
+    """Register the stub as `mxnet` in sys.modules; returns the module."""
+    mx = types.ModuleType("mxnet")
+    mx.nd = types.ModuleType("mxnet.nd")
+    mx.nd.array = _nd_array
+    mx.nd.NDArray = NDArray
+    mx.optimizer = types.ModuleType("mxnet.optimizer")
+    mx.optimizer.Optimizer = Optimizer
+    mx.optimizer.SGD = SGD
+    mx.gluon = types.ModuleType("mxnet.gluon")
+    mx.gluon.Trainer = Trainer
+    mx.gluon.Parameter = Parameter
+    sys.modules["mxnet"] = mx
+    sys.modules["mxnet.nd"] = mx.nd
+    sys.modules["mxnet.optimizer"] = mx.optimizer
+    sys.modules["mxnet.gluon"] = mx.gluon
+    return mx

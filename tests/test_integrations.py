@@ -259,3 +259,43 @@ class TestGatedIntegrations:
             pass
         with pytest.raises(ImportError, match="horovodrun-tpu"):
             hspark.run(lambda: None)
+
+
+class TestMxnetGate:
+    """The mxnet binding is complete but import-gated: module import and
+    op-surface access work without mxnet; touching the mx-subclassing
+    wrappers without mxnet raises with guidance, and with the stub they
+    build real subclasses."""
+
+    def test_import_without_mxnet(self):
+        import horovod_tpu.mxnet as hmx
+        assert callable(hmx.allreduce)
+        assert callable(hmx.broadcast_parameters)
+
+    def test_wrappers_require_mxnet(self, monkeypatch):
+        import sys
+        import horovod_tpu.mxnet as hmx
+        monkeypatch.setattr(hmx, "_lazy_cache", {})
+        monkeypatch.setitem(sys.modules, "mxnet", None)
+        with pytest.raises(ImportError, match="mxnet"):
+            hmx.DistributedOptimizer
+        with pytest.raises(ImportError, match="mxnet"):
+            hmx.DistributedTrainer
+
+    def test_wrappers_build_with_stub(self, monkeypatch):
+        import os
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import mxnet_stub
+        import horovod_tpu.mxnet as hmx
+        monkeypatch.setattr(hmx, "_lazy_cache", {})
+        mx = mxnet_stub.install()
+        try:
+            opt_cls = hmx.DistributedOptimizer
+            tr_cls = hmx.DistributedTrainer
+            assert issubclass(opt_cls, mx.optimizer.Optimizer)
+            assert issubclass(tr_cls, mx.gluon.Trainer)
+        finally:
+            for name in list(sys.modules):
+                if name == "mxnet" or name.startswith("mxnet."):
+                    del sys.modules[name]

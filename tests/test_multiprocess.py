@@ -112,3 +112,9 @@ def test_tensorflow_graph_mode():
 
 def test_sparse_allreduce():
     _run_world(2, "sparse", timeout=120.0)
+
+
+def test_mxnet_binding():
+    """MXNet surface over the eager core with the stub module
+    (reference: test/parallel/test_mxnet1.py patterns)."""
+    _run_world(2, "mxnet")

@@ -265,19 +265,30 @@ class TestJsRun:
                 slots, path=str(tmp_path / "rf.erf"))
 
     def test_adopt_jsm_env_bare(self):
-        # Bare JSM launch (no exported layout): rank/size/local adopted;
-        # cross left unset — per-rank division math would give hosts with
-        # different slot counts inconsistent cross topologies.
+        # JSM identity + our control plane but no exported layout:
+        # rank/size/local adopted; cross left unset — per-rank division
+        # math would give hosts with different slot counts inconsistent
+        # cross topologies.
         from horovod_tpu.runner import js_run
         env = {"JSM_NAMESPACE_RANK": "5", "JSM_NAMESPACE_SIZE": "8",
                "JSM_NAMESPACE_LOCAL_RANK": "1",
-               "JSM_NAMESPACE_LOCAL_SIZE": "4"}
+               "JSM_NAMESPACE_LOCAL_SIZE": "4",
+               "HOROVOD_GLOO_RENDEZVOUS_ADDR": "10.0.0.1"}
         assert js_run.adopt_jsm_env(env)
         assert env["HOROVOD_RANK"] == "5" and env["HOROVOD_SIZE"] == "8"
         assert env["HOROVOD_LOCAL_RANK"] == "1"
         assert env["HOROVOD_LOCAL_SIZE"] == "4"
         assert "HOROVOD_CROSS_RANK" not in env
         assert "HOROVOD_CROSS_SIZE" not in env
+
+    def test_adopt_ignores_bare_jsrun(self):
+        # Bare `jsrun -n N python eval.py` (no launcher control plane):
+        # each process keeps its independent size-1 world, same as the
+        # bare-mpirun case.
+        from horovod_tpu.runner import js_run
+        env = {"JSM_NAMESPACE_RANK": "2", "JSM_NAMESPACE_SIZE": "4"}
+        assert not js_run.adopt_jsm_env(env)
+        assert "HOROVOD_RANK" not in env
 
     def test_adopt_never_clobbers_launcher_env(self):
         from horovod_tpu.runner import js_run
