@@ -23,21 +23,132 @@ __all__ = ["TorchEstimator", "TorchModel", "KerasEstimator", "KerasModel",
            "LightningEstimator"]
 
 
+def _unpack_configure_optimizers(ret):
+    """Normalize every configure_optimizers() return shape PL documents
+    to (optimizer, [(scheduler, interval)]): a bare Optimizer,
+    [optimizer], ([optimizer], [schedulers]), or {"optimizer": ...,
+    "lr_scheduler": ...}; scheduler entries may themselves be
+    {"scheduler": s, "interval": "epoch"|"step", ...} config dicts.
+    Exactly one optimizer is supported — multi-optimizer (GAN-style)
+    setups raise rather than silently dropping optimizers whose
+    parameters would then never step."""
+    def _sched(s):
+        if isinstance(s, dict):
+            return s["scheduler"], s.get("interval", "epoch")
+        return s, "epoch"
+
+    def _single(opts):
+        if len(opts) != 1:
+            raise NotImplementedError(
+                f"configure_optimizers() returned {len(opts)} optimizers; "
+                "this estimator supports exactly one (multi-optimizer "
+                "modules would silently leave parameters untrained).")
+        return opts[0]
+
+    if isinstance(ret, dict):
+        sched = ret.get("lr_scheduler")
+        return ret["optimizer"], ([_sched(sched)] if sched is not None
+                                  else [])
+    if isinstance(ret, (tuple, list)):
+        if len(ret) == 2 and isinstance(ret[0], (tuple, list)):
+            opts, scheds = ret
+            return _single(list(opts)), [_sched(s) for s in scheds]
+        return _single(list(ret)), []
+    return ret, []
+
+
+def _lightning_train_fn(store: Store, run_id: str, model_bytes: bytes,
+                        batch_size: int, epochs: int) -> dict:
+    """Per-rank loop driving the LightningModule protocol
+    (reference: spark/lightning/remote.py).  This runtime IS the
+    strategy: the module's own training_step/configure_optimizers run
+    inside our distributed loop, with the gradient allreduce supplied by
+    the torch DistributedOptimizer wrapper."""
+    import io
+
+    import torch
+
+    import horovod_tpu as hvd
+    import horovod_tpu.torch as hvt
+
+    hvd.init()
+    try:
+        rank, world = hvd.rank(), hvd.size()
+        xs, ys = _load_equal_shard(store, run_id, rank, world)
+        xs, ys = torch.from_numpy(xs), torch.from_numpy(ys)
+
+        model = torch.load(io.BytesIO(model_bytes), weights_only=False)
+        opt, schedulers = _unpack_configure_optimizers(
+            model.configure_optimizers())
+        opt = hvt.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters())
+        # The wrapper copies param_groups (its load_state_dict makes
+        # fresh dicts), so schedulers created against the raw optimizer
+        # must be rebound or their lr writes land in dicts the training
+        # optimizer never reads.
+        for sched, _interval in schedulers:
+            sched.optimizer = opt
+        epoch_scheds = [s for s, iv in schedulers if iv != "step"]
+        step_scheds = [s for s, iv in schedulers if iv == "step"]
+
+        def step(xb, yb, idx):
+            out = model.training_step((xb, yb), idx)
+            return out["loss"] if isinstance(out, dict) else out
+
+        def batch_end():
+            for sched in step_scheds:
+                sched.step()
+
+        def epoch_end():
+            for sched in epoch_scheds:
+                sched.step()
+            if hasattr(model, "on_train_epoch_end"):
+                model.on_train_epoch_end()
+
+        history = _train_loop(xs, ys, batch_size, epochs, opt, step,
+                              epoch_end=epoch_end, batch_end=batch_end,
+                              loss_name="pl_epoch_loss")
+        _save_model_if_root(store, run_id, model, rank)
+        return {"rank": rank, "history": history}
+    finally:
+        hvd.shutdown()
+
+
 class LightningEstimator:
-    """Intentional scope cut (reference: spark/lightning/estimator.py).
+    """fit(df) -> TorchModel for LightningModule-style models
+    (reference: spark/lightning/estimator.py:118-420).
 
-    pytorch-lightning is not part of the TPU image, and its training loop
-    duplicates what :class:`TorchEstimator` already runs over this
-    runtime; see README "Scope cuts" for the rationale.  Constructing one
-    states the migration path instead of silently failing later."""
+    TPU-native design: the LightningModule *protocol* —
+    ``training_step(batch, idx)`` + ``configure_optimizers()`` (+
+    optional ``on_train_epoch_end``) — is duck-typed on any
+    torch.nn.Module, so no pytorch_lightning import is required at all;
+    a real LightningModule satisfies it as-is, and this runtime plays
+    the role PL's Trainer/strategy stack plays in the reference."""
 
-    def __init__(self, *_args, **_kwargs) -> None:
-        raise ImportError(
-            "LightningEstimator is an intentional scope cut of the TPU "
-            "build (pytorch_lightning is not in the image). Port the "
-            "LightningModule's training_step into a torch.nn.Module and "
-            "use TorchEstimator (same store/num_proc surface), or run "
-            "lightning yourself inside horovod_tpu.run workers.")
+    def __init__(self, model,
+                 feature_cols: Sequence[str] = ("features",),
+                 label_cols: Sequence[str] = ("label",),
+                 batch_size: int = 32, epochs: int = 1,
+                 num_proc: int = 1, store: Store | None = None,
+                 run_id: str | None = None) -> None:
+        for method in ("training_step", "configure_optimizers"):
+            if not callable(getattr(model, method, None)):
+                raise ValueError(
+                    f"LightningEstimator needs a model with {method}() "
+                    "(the LightningModule protocol); plain nn.Modules "
+                    "belong with TorchEstimator.")
+        self.model = model
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.num_proc = num_proc
+        self.store = store or FilesystemStore(".horovod_tpu_store")
+        self.run_id = run_id
+
+    def fit(self, df) -> "TorchModel":
+        return _fit_distributed(self, df, _lightning_train_fn,
+                                (self.batch_size, self.epochs))
 
 
 def _to_pandas(df):
@@ -59,6 +170,114 @@ def _extract(df, feature_cols: Sequence[str], label_cols: Sequence[str]):
     return x, y
 
 
+def _load_equal_shard(store: Store, run_id: str, rank: int, world: int):
+    """Load the run's training blob and take this rank's shard: strided
+    assignment with wrap-around padding (the DistributedSampler contract,
+    elastic/sampler.py) so every rank holds exactly ceil(n/world) samples.
+    Equal counts are a correctness requirement, not an optimization —
+    ranks with different batch counts enqueue different numbers of
+    gradient collectives and deadlock the negotiation."""
+    blob = store.load_npz(
+        store.join(store.get_train_data_path(run_id), "train.npz"))
+    X, Y = blob["x"], blob["y"]
+    n = X.shape[0]
+    if n == 0:
+        raise ValueError("estimator fit() received an empty DataFrame")
+    per = (n + world - 1) // world
+    idx = np.array([(rank + k * world) % n for k in range(per)])
+    return X[idx], Y[idx]
+
+
+def _save_model_if_root(store: Store, run_id: str, model, rank: int) -> None:
+    import io
+
+    import torch
+
+    if rank == 0:
+        buf = io.BytesIO()
+        torch.save(model, buf)
+        store.write_bytes(
+            store.join(store.get_checkpoint_path(run_id), "model.pt"),
+            buf.getvalue())
+
+
+def _train_loop(xs, ys, batch_size: int, epochs: int, opt,
+                step: Callable, epoch_end: Callable | None = None,
+                batch_end: Callable | None = None,
+                loss_name: str = "epoch_loss") -> list[float]:
+    """Shared epoch loop: per-batch `step(xb, yb, idx) -> loss`, backward,
+    optimizer step, cross-rank epoch-loss average.  Shards are equalized
+    (_load_equal_shard) so every rank runs the same batch count."""
+    import horovod_tpu as hvd
+
+    history = []
+    for _ in range(epochs):
+        epoch_loss, batches = 0.0, 0
+        for idx, start in enumerate(range(0, len(xs), batch_size)):
+            xb = xs[start:start + batch_size]
+            yb = ys[start:start + batch_size]
+            opt.zero_grad()
+            loss = step(xb, yb, idx)
+            loss.backward()
+            opt.step()
+            if batch_end is not None:
+                batch_end()
+            epoch_loss += float(loss.detach())
+            batches += 1
+        if epoch_end is not None:
+            epoch_end()
+        avg = hvd.allreduce(
+            np.array([epoch_loss / max(batches, 1)], np.float32),
+            name=loss_name)
+        history.append(float(np.asarray(avg)[0]))
+    return history
+
+
+def _fit_distributed(est, df, train_fn: Callable, args_tail: tuple):
+    """Shared fit plumbing for the torch-family estimators: persist data
+    + model through the store, run train_fn over the workers (Spark
+    executors when pyspark is importable, local forked workers
+    otherwise), reload the rank-0 checkpoint."""
+    import io
+
+    import torch
+
+    import horovod_tpu as hvd
+
+    run_id = est.run_id or est.store.new_run_id()
+    x, y = _extract(df, est.feature_cols, est.label_cols)
+    est.store.save_npz(
+        est.store.join(est.store.get_train_data_path(run_id), "train.npz"),
+        x=x, y=y)
+    buf = io.BytesIO()
+    torch.save(est.model, buf)
+    args = (est.store, run_id, buf.getvalue()) + args_tail
+
+    # Only the availability probe sits in the try: an ImportError raised
+    # BY the spark run itself is a real configuration error and must
+    # surface, not silently retrain on local forks.
+    try:
+        import pyspark  # noqa: F401
+        has_spark = True
+    except ImportError:
+        has_spark = False
+    if has_spark:
+        from . import run as spark_run
+        results = spark_run(train_fn, args=args, num_proc=est.num_proc)
+    else:
+        results = hvd.run(train_fn, args=args, np=est.num_proc)
+
+    trained = torch.load(
+        io.BytesIO(est.store.read_bytes(
+            est.store.join(est.store.get_checkpoint_path(run_id),
+                           "model.pt"))),
+        weights_only=False)
+    history = results[0]["history"] if results else []
+    return TorchModel(trained, feature_cols=est.feature_cols,
+                      label_cols=est.label_cols, run_id=run_id,
+                      history=history)
+
+
 def _torch_train_fn(store: Store, run_id: str, model_bytes: bytes,
                     opt_factory: Callable, loss_name: str, batch_size: int,
                     epochs: int) -> dict:
@@ -75,14 +294,8 @@ def _torch_train_fn(store: Store, run_id: str, model_bytes: bytes,
     hvd.init()
     try:
         rank, world = hvd.rank(), hvd.size()
-        blob = store.load_npz(
-            store.join(store.get_train_data_path(run_id), "train.npz"))
-        X = torch.from_numpy(blob["x"])
-        Y = torch.from_numpy(blob["y"])
-        # Contiguous shard per rank (reference: petastorm row-group shard).
-        n = X.shape[0]
-        per = (n + world - 1) // world
-        xs, ys = X[rank * per:(rank + 1) * per], Y[rank * per:(rank + 1) * per]
+        xs, ys = _load_equal_shard(store, run_id, rank, world)
+        xs, ys = torch.from_numpy(xs), torch.from_numpy(ys)
 
         model = torch.load(io.BytesIO(model_bytes), weights_only=False)
         loss_fn = {"mse": torch.nn.MSELoss(),
@@ -93,35 +306,15 @@ def _torch_train_fn(store: Store, run_id: str, model_bytes: bytes,
             named_parameters=model.named_parameters())
         hvt.broadcast_parameters(model.state_dict(), root_rank=0)
 
-        history = []
-        for _ in range(epochs):
-            epoch_loss = 0.0
-            batches = 0
-            for i in range(0, len(xs), batch_size):
-                xb, yb = xs[i:i + batch_size], ys[i:i + batch_size]
-                if not len(xb):
-                    continue
-                opt.zero_grad()
-                out = model(xb)
-                if out.shape != yb.shape and out.dim() == yb.dim() + 1 \
-                        and out.shape[-1] == 1:
-                    out = out[..., 0]
-                loss = loss_fn(out, yb)
-                loss.backward()
-                opt.step()
-                epoch_loss += float(loss.detach())
-                batches += 1
-            avg = hvd.allreduce(
-                np.array([epoch_loss / max(batches, 1)], np.float32),
-                name="epoch_loss")
-            history.append(float(np.asarray(avg)[0]))
+        def step(xb, yb, _idx):
+            out = model(xb)
+            if out.shape != yb.shape and out.dim() == yb.dim() + 1 \
+                    and out.shape[-1] == 1:
+                out = out[..., 0]
+            return loss_fn(out, yb)
 
-        if rank == 0:
-            buf = io.BytesIO()
-            torch.save(model, buf)
-            store.write_bytes(
-                store.join(store.get_checkpoint_path(run_id), "model.pt"),
-                buf.getvalue())
+        history = _train_loop(xs, ys, batch_size, epochs, opt, step)
+        _save_model_if_root(store, run_id, model, rank)
         return {"rank": rank, "history": history}
     finally:
         hvd.shutdown()
@@ -162,41 +355,9 @@ class TorchEstimator:
         self.run_id = run_id
 
     def fit(self, df) -> "TorchModel":
-        import io
-
-        import torch
-
-        import horovod_tpu as hvd
-
-        run_id = self.run_id or self.store.new_run_id()
-        data_path = self.store.get_train_data_path(run_id)
-        ckpt_path = self.store.get_checkpoint_path(run_id)
-
-        x, y = _extract(df, self.feature_cols, self.label_cols)
-        self.store.save_npz(self.store.join(data_path, "train.npz"),
-                            x=x, y=y)
-
-        buf = io.BytesIO()
-        torch.save(self.model, buf)
-
-        args = (self.store, run_id, buf.getvalue(), self.optimizer,
-                self.loss, self.batch_size, self.epochs)
-        try:
-            import pyspark  # noqa: F401
-            from . import run as spark_run
-            results = spark_run(_torch_train_fn, args=args,
-                                num_proc=self.num_proc)
-        except ImportError:
-            results = hvd.run(_torch_train_fn, args=args, np=self.num_proc)
-
-        trained = torch.load(
-            io.BytesIO(self.store.read_bytes(
-                self.store.join(ckpt_path, "model.pt"))),
-            weights_only=False)
-        history = results[0]["history"] if results else []
-        return TorchModel(trained, feature_cols=self.feature_cols,
-                          label_cols=self.label_cols, run_id=run_id,
-                          history=history)
+        return _fit_distributed(self, df, _torch_train_fn,
+                                (self.optimizer, self.loss,
+                                 self.batch_size, self.epochs))
 
 
 class TorchModel:
@@ -244,12 +405,7 @@ def _keras_train_fn(store: Store, run_id: str, model_bytes: bytes,
         import tensorflow as tf
 
         rank, world = hvd.rank(), hvd.size()
-        blob = store.load_npz(
-            store.join(store.get_train_data_path(run_id), "train.npz"))
-        X, Y = blob["x"], blob["y"]
-        n = X.shape[0]
-        per = (n + world - 1) // world
-        xs, ys = X[rank * per:(rank + 1) * per], Y[rank * per:(rank + 1) * per]
+        xs, ys = _load_equal_shard(store, run_id, rank, world)
 
         # keras (de)serializes via real files: stage through local tmp,
         # ship bytes through the store.
@@ -321,13 +477,21 @@ class KerasEstimator:
         compile_kwargs = {"optimizer": self.optimizer, "loss": self.loss}
         args = (self.store, run_id, model_bytes, compile_kwargs,
                 self.batch_size, self.epochs)
+        # Probe-only try (same pattern as _fit_distributed): an
+        # ImportError raised BY the spark run is a real configuration
+        # error and must surface, not silently retrain on local forks.
         try:
             import pyspark  # noqa: F401
+            has_spark = True
+        except ImportError:
+            has_spark = False
+        if has_spark:
             from . import run as spark_run
             results = spark_run(_keras_train_fn, args=args,
                                 num_proc=self.num_proc)
-        except ImportError:
-            results = hvd.run(_keras_train_fn, args=args, np=self.num_proc)
+        else:
+            results = hvd.run(_keras_train_fn, args=args,
+                              np=self.num_proc)
 
         with tempfile.TemporaryDirectory() as tmpdir:
             wpath = os.path.join(tmpdir, "model.weights.h5")

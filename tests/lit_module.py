@@ -1,0 +1,60 @@
+"""LightningModule-protocol model for LightningEstimator tests.
+
+Module-level (not defined inside the test) because torch.save pickles the
+class by reference and the spawned estimator workers must import it.
+Deliberately torch-only: the point of the estimator's design is that the
+protocol — training_step / configure_optimizers / on_train_epoch_end —
+needs no pytorch_lightning import; a real LightningModule provides the
+same surface.
+"""
+import torch
+
+
+class LinearLit(torch.nn.Module):
+    def __init__(self, in_features: int = 3):
+        super().__init__()
+        self.net = torch.nn.Linear(in_features, 1)
+        self.epochs_ended = 0
+
+    def forward(self, x):
+        return self.net(x)[..., 0]
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        loss = torch.nn.functional.mse_loss(self(x), y)
+        return {"loss": loss}
+
+    def configure_optimizers(self):
+        # The ([optimizers], [schedulers]) return shape PL also allows.
+        opt = torch.optim.SGD(self.parameters(), lr=0.2)
+        sched = torch.optim.lr_scheduler.StepLR(opt, step_size=10,
+                                                gamma=0.5)
+        return [opt], [sched]
+
+    def on_train_epoch_end(self):
+        self.epochs_ended += 1
+
+
+class DictLit(LinearLit):
+    """PL's most common configure_optimizers shape: a config dict with a
+    {"scheduler": ...} entry."""
+
+    def configure_optimizers(self):
+        opt = torch.optim.SGD(self.parameters(), lr=0.2)
+        sched = torch.optim.lr_scheduler.StepLR(opt, step_size=10,
+                                                gamma=0.5)
+        return {"optimizer": opt,
+                "lr_scheduler": {"scheduler": sched, "interval": "epoch"}}
+
+
+class FreezeAfterOneLit(LinearLit):
+    """Scheduler zeroes the LR after the first epoch — training must
+    visibly STOP, proving the scheduler drives the optimizer that
+    actually steps (schedulers bound to the pre-wrap optimizer are
+    silently inert)."""
+
+    def configure_optimizers(self):
+        opt = torch.optim.SGD(self.parameters(), lr=0.2)
+        sched = torch.optim.lr_scheduler.LambdaLR(
+            opt, lr_lambda=lambda epoch: 0.0 if epoch >= 1 else 1.0)
+        return [opt], [sched]
