@@ -1,0 +1,62 @@
+"""LightningEstimator example (reference analogue:
+examples/spark/pytorch/pytorch_lightning_spark_mnist.py).
+
+The estimator drives the LightningModule *protocol* — training_step,
+configure_optimizers (any documented return shape), on_train_epoch_end —
+inside horovod_tpu's distributed loop; no pytorch_lightning install is
+needed, and a real LightningModule works unchanged.
+
+Run: python examples/lightning_estimator_example.py
+"""
+import numpy as np
+import pandas as pd
+import torch
+
+from horovod_tpu.spark import FilesystemStore, LightningEstimator
+
+
+class LitRegressor(torch.nn.Module):
+    """Any nn.Module with the protocol methods qualifies; subclassing
+    pl.LightningModule (when installed) gives exactly this surface."""
+
+    def __init__(self, n_in: int = 4):
+        super().__init__()
+        self.net = torch.nn.Sequential(
+            torch.nn.Linear(n_in, 16), torch.nn.ReLU(),
+            torch.nn.Linear(16, 1))
+
+    def forward(self, x):
+        return self.net(x)[..., 0]
+
+    def training_step(self, batch, batch_idx):
+        x, y = batch
+        return {"loss": torch.nn.functional.mse_loss(self(x), y)}
+
+    def configure_optimizers(self):
+        opt = torch.optim.Adam(self.parameters(), lr=1e-2)
+        sched = torch.optim.lr_scheduler.StepLR(opt, step_size=5,
+                                                gamma=0.7)
+        return {"optimizer": opt,
+                "lr_scheduler": {"scheduler": sched, "interval": "epoch"}}
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((256, 4)).astype(np.float32)
+    y = np.sin(x[:, 0]) + 0.5 * x[:, 1]
+    df = pd.DataFrame({f"f{i}": x[:, i] for i in range(4)} | {"label": y})
+
+    est = LightningEstimator(
+        model=LitRegressor(4),
+        feature_cols=[f"f{i}" for i in range(4)], label_cols=["label"],
+        batch_size=32, epochs=15, num_proc=2,
+        store=FilesystemStore("/tmp/hvd_tpu_lit_store"))
+    model = est.fit(df)
+    print("epoch losses:", [round(h, 4) for h in model.history])
+    out = model.transform(df)
+    mse = float(np.mean((out["label__output"] - df["label"]) ** 2))
+    print(f"transform mse: {mse:.4f}")
+
+
+if __name__ == "__main__":
+    main()

