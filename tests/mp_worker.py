@@ -925,6 +925,25 @@ def battery_mxnet(hvd, rank, size):
     np.testing.assert_allclose(dp.data().asnumpy(), np.ones(3))
 
 
+
+def battery_peerdeath(hvd, rank, size):
+    """Hard peer death mid-run (SURVEY §5.3 failure detection): the last
+    rank os._exit()s between collectives; every survivor's next
+    collective must raise HorovodInternalError within the transport
+    timeout — a hang here is the failure mode this battery guards."""
+    small = np.ones(4, np.float32)
+    hvd.allreduce(small, op=hvd.Sum, name="warm")   # world fully formed
+    if rank == size - 1:
+        os._exit(37)
+    try:
+        for i in range(1000):
+            hvd.allreduce(small, op=hvd.Sum, name=f"after{i}")
+    except hvd.HorovodInternalError:
+        print("peer death surfaced as HorovodInternalError")
+        return
+    raise AssertionError("collectives kept succeeding after peer death")
+
+
 BATTERIES = {
     "collectives": battery_collectives,
     "matrix": battery_matrix,
@@ -940,6 +959,7 @@ BATTERIES = {
     "tf_function": battery_tf_function,
     "sparse": battery_sparse,
     "mxnet": battery_mxnet,
+    "peerdeath": battery_peerdeath,
 }
 
 

@@ -13,7 +13,12 @@ _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "mp_worker.py")
 
 
-def _run_world(size: int, battery: str, timeout: float = 90.0) -> None:
+def _run_world(size: int, battery: str, timeout: float = 90.0,
+               expected_rcs: dict | None = None) -> list[str]:
+    """Spawn `size` workers against one rendezvous server; assert each
+    rank's exit code (0 by default; override per rank via expected_rcs,
+    e.g. {1: 37} for a fault-injection battery). Returns per-rank
+    output."""
     server = RendezvousServer()
     port = server.start()
     env = dict(os.environ)
@@ -39,7 +44,7 @@ def _run_world(size: int, battery: str, timeout: float = 90.0) -> None:
                 failed.append((r, "timeout"))
             outputs.append(f"--- rank {r} (rc={p.returncode}) ---\n"
                            + out.decode(errors="replace"))
-            if p.returncode != 0:
+            if p.returncode != (expected_rcs or {}).get(r, 0):
                 failed.append((r, p.returncode))
     finally:
         for p in procs:
@@ -47,6 +52,7 @@ def _run_world(size: int, battery: str, timeout: float = 90.0) -> None:
                 p.kill()
         server.stop()
     assert not failed, "worker failures: %s\n%s" % (failed, "\n".join(outputs))
+    return outputs
 
 
 @pytest.mark.parametrize("size", [2, 3])
@@ -118,3 +124,14 @@ def test_mxnet_binding():
     """MXNet surface over the eager core with the stub module
     (reference: test/parallel/test_mxnet1.py patterns)."""
     _run_world(2, "mxnet")
+
+
+def test_peer_death_surfaces_not_hangs():
+    """A rank dying mid-run (os._exit) must surface as
+    HorovodInternalError on the survivor within the timeout — the
+    verify-skill probe as a regression test (SURVEY §5.3). Timeout is
+    2x the worker transport timeout so a legitimate slow detection
+    reports through the assertion path, not a raw TimeoutExpired."""
+    outputs = _run_world(2, "peerdeath", timeout=180.0,
+                         expected_rcs={1: 37})
+    assert "HorovodInternalError" in outputs[0]
