@@ -30,12 +30,19 @@ from .core import (Handle, init, is_initialized, shutdown, rank, size,
 
 
 def run(func, args=(), kwargs=None, np=None, hosts=None, env=None,
-        use_gloo=True, start_timeout=120.0):
+        use_gloo=True, start_timeout=120.0, min_np=None, max_np=None,
+        host_discovery_script=None, reset_limit=None,
+        elastic_timeout=None, slots=None):
     """Programmatic N-worker launch of a function
-    (reference: horovod/runner/__init__.py:92-210 horovod.run)."""
+    (reference: horovod/runner/__init__.py:92-210 horovod.run).
+    min_np/max_np/host_discovery_script switch to the elastic driver."""
     from .runner.run_api import run as _run
     return _run(func, args=args, kwargs=kwargs, np=np, hosts=hosts,
-                env=env, use_gloo=use_gloo, start_timeout=start_timeout)
+                env=env, use_gloo=use_gloo, start_timeout=start_timeout,
+                min_np=min_np, max_np=max_np,
+                host_discovery_script=host_discovery_script,
+                reset_limit=reset_limit, elastic_timeout=elastic_timeout,
+                slots=slots)
 
 __version__ = "0.1.0"
 

@@ -125,6 +125,10 @@ class ElasticDriver:
     def get_results(self) -> dict[str, tuple[int, float]]:
         return dict(self._results)
 
+    def world_size(self) -> int:
+        """Size of the most recently formed world (0 before any round)."""
+        return len(self._assignments)
+
     # ------------------------------------------------------------------
     # Round formation / rank assignment
     # ------------------------------------------------------------------

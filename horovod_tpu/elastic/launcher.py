@@ -47,7 +47,19 @@ def _driver_address(discovery, network_interface: str | None = None) -> str:
     return socket.getfqdn()
 
 
-def launch_elastic(args, command: list[str]) -> int:
+def launch_elastic(args, command: list[str], *,
+                   payload: bytes | None = None,
+                   collect_results: bool = False,
+                   extra_env: dict | None = None):
+    """Drive an elastic world of `command` workers.
+
+    With ``payload``/``collect_results`` (the programmatic
+    ``run(func, min_np=...)`` path), the pickled function is seeded into
+    the rendezvous KV for elastic_run_worker bootstraps to fetch, and the
+    per-final-rank outcomes are read back before teardown; returns
+    ``(rc, results, final_world_size)`` then, plain ``rc`` otherwise.
+    ``extra_env`` adds user variables to every worker (the static path's
+    ``env=`` contract)."""
     discovery = _make_discovery(args)
     secret = make_secret()
 
@@ -62,12 +74,16 @@ def launch_elastic(args, command: list[str]) -> int:
 
     rendezvous = RendezvousServer()
     rendezvous.start()
+    if payload is not None:
+        from ..runner.elastic_run_worker import PAYLOAD_SCOPE
+        rendezvous.put(PAYLOAD_SCOPE, "blob", payload)
     rpc = RpcServer(driver, secret)
     addr = _driver_address(discovery,
                            getattr(args, "network_interface", None))
 
     from ..runner.launch import args_to_env
     base_env = dict(os.environ)
+    base_env.update(extra_env or {})
     base_env.update(args_to_env(args))
     base_env.update({
         "HOROVOD_CONTROLLER": "tcp",
@@ -105,24 +121,42 @@ def launch_elastic(args, command: list[str]) -> int:
             ssh_argv(slot.hostname, script), env=env, index=None,
             stdin_data=(secret + "\n").encode())
 
-    try:
-        driver.start(args.num_proc or min_np, create_worker)
-        driver.join()
-        driver.wait_for_workers_exit()
-    except (TimeoutError, ValueError) as exc:
-        sys.stderr.write(f"horovodrun-tpu elastic: {exc}\n")
-        return 1
-    finally:
-        driver.shutdown()
-        rpc.close()
-        rendezvous.stop()
+    def _done(rc: int):
+        if not collect_results:
+            return rc
+        # Read per-final-rank outcomes BEFORE the rendezvous stops.
+        from ..runner.elastic_run_worker import RESULT_SCOPE
+        world = driver.world_size()
+        fn_results = {}
+        for rank in range(world):
+            blob = rendezvous.get(RESULT_SCOPE, str(rank))
+            if blob is not None:
+                import pickle
+                fn_results[rank] = pickle.loads(blob)
+        return rc, fn_results, world
 
-    if driver.reset_limit_exceeded:
-        sys.stderr.write("horovodrun-tpu elastic: reset limit exceeded\n")
-        return 1
-    results = driver.get_results()
-    failures = [name for name, (code, _) in results.items() if code != 0]
-    if failures and len(failures) == len(results):
-        logger.error("all workers failed: %s", ", ".join(failures))
-        return 1
-    return 0
+    try:
+        try:
+            driver.start(args.num_proc or min_np, create_worker)
+            driver.join()
+            driver.wait_for_workers_exit()
+        except (TimeoutError, ValueError) as exc:
+            sys.stderr.write(f"horovodrun-tpu elastic: {exc}\n")
+            return _done(1)
+        finally:
+            driver.shutdown()
+            rpc.close()
+
+        if driver.reset_limit_exceeded:
+            sys.stderr.write(
+                "horovodrun-tpu elastic: reset limit exceeded\n")
+            return _done(1)
+        results = driver.get_results()
+        failures = [name for name, (code, _) in results.items()
+                    if code != 0]
+        if failures and len(failures) == len(results):
+            logger.error("all workers failed: %s", ", ".join(failures))
+            return _done(1)
+        return _done(0)
+    finally:
+        rendezvous.stop()

@@ -1,0 +1,67 @@
+"""Worker bootstrap for programmatic *elastic* :func:`horovod_tpu.run`.
+
+Reference: horovod/runner/__init__.py:92-210 — `horovod.run(func,
+min_np=..., max_np=...)` launches the elastic driver over a pickled
+function.  Unlike the static bootstrap (run_worker.py, payload over
+stdin), elastic workers are (re)spawned by the driver on membership
+changes — possibly on hosts that did not exist at submit time — so the
+payload is fetched from the rendezvous KV store every worker can already
+reach via the exported env.
+
+The function runs once per worker lifetime; on success its result is
+published under the worker's FINAL rank (elastic rounds may have
+re-ranked it).  The function itself decides how to use
+``hvd.elastic.run`` / State for mid-run fault tolerance, exactly as with
+the CLI launcher.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import traceback
+
+PAYLOAD_SCOPE = "elastic_runfunc"
+RESULT_SCOPE = "elastic_runfunc_result"
+
+
+def main() -> int:
+    from ..elastic.run import _apply_assignment
+    from ..elastic.worker import notification_manager
+    from .network import RendezvousClient
+
+    kv = RendezvousClient(
+        os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"],
+        int(os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]))
+    try:
+        # Pull this worker's rank assignment from the elastic driver (the
+        # role hvd.elastic.run's _rendezvous plays for CLI workers): the
+        # launcher hands out only hostname+local_rank; global rank/size
+        # come from the driver's round formation.
+        notification_manager.init()
+        if notification_manager.has_driver:
+            try:
+                # Elastic epochs are integers; a stale string scope from
+                # an enclosing static launch means "no prior round".
+                epoch = int(os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", 0))
+            except ValueError:
+                epoch = 0
+            assignment = notification_manager.get_assignment(epoch)
+            if assignment is None:
+                return 0   # dropped from the new world; exit quietly
+            _apply_assignment(assignment)
+        payload = kv.wait(PAYLOAD_SCOPE, "blob", timeout=60.0)
+        func, args, kwargs = pickle.loads(payload)
+        result = func(*args, **kwargs)
+        outcome, rc = (True, result), 0
+    except BaseException:  # noqa: BLE001 — ship the traceback to the parent
+        outcome, rc = (False, traceback.format_exc()), 1
+    # HOROVOD_RANK reflects the latest elastic assignment (elastic/run.py
+    # _apply_assignment re-exports it each round).
+    kv.put(RESULT_SCOPE, os.environ.get("HOROVOD_RANK", "0"),
+           pickle.dumps(outcome))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

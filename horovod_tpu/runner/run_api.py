@@ -29,6 +29,57 @@ from .network import RendezvousClient, RendezvousServer
 _ssh_argv = ssh_argv
 
 
+def _run_elastic(func: Callable, args: Sequence, kwargs: dict, *,
+                 np: int | None, hosts: str | None, env: dict | None,
+                 min_np: int | None,
+                 max_np: int | None, host_discovery_script: str | None,
+                 reset_limit: int | None, elastic_timeout: float,
+                 start_timeout: float, slots: int | None
+                 ) -> dict[int, Any]:
+    """Programmatic elastic launch: the pickled fn is seeded into the
+    rendezvous KV; elastic_run_worker bootstraps fetch + execute it under
+    the driver (reference: runner/__init__.py elastic branch)."""
+    import pickle
+
+    from ..elastic.launcher import launch_elastic
+    from .launch import parse_args
+
+    # Full CLI-default namespace (args_to_env reads every tuning attr),
+    # then overlay the programmatic params.
+    launch_args = parse_args(["placeholder-command"])
+    for attr, value in (("num_proc", np), ("hosts", hosts),
+                        ("min_np", min_np), ("max_np", max_np),
+                        ("host_discovery_script", host_discovery_script),
+                        ("reset_limit", reset_limit),
+                        ("elastic_timeout", elastic_timeout),
+                        ("start_timeout", start_timeout),
+                        ("slots", slots)):
+        setattr(launch_args, attr, value)
+    command = [sys.executable, "-m",
+               "horovod_tpu.runner.elastic_run_worker"]
+    payload = pickle.dumps((func, tuple(args), dict(kwargs)))
+    rc, outcomes, world = launch_elastic(
+        launch_args, command, payload=payload, collect_results=True,
+        extra_env=env)
+    failures = {rank: value for rank, (ok, value) in outcomes.items()
+                if not ok}
+    if failures:
+        raise RuntimeError(
+            "elastic run(func) worker failures:\n" + "\n".join(
+                f"[rank {r}] {tb}" for r, tb in sorted(failures.items())))
+    if rc != 0:
+        raise RuntimeError(f"elastic run(func) failed with rc={rc}")
+    missing = sorted(set(range(world)) - set(outcomes))
+    if missing:
+        # A worker that died without publishing (e.g. SIGKILL) must not
+        # silently vanish from the result dict.
+        raise RuntimeError(
+            f"elastic run(func): ranks {missing} of the final "
+            f"{world}-rank world returned no result (worker died before "
+            "publishing?)")
+    return {rank: value for rank, (ok, value) in outcomes.items()}
+
+
 def _worker_main(fn_payload, slot_env: dict, conn) -> None:
     try:
         import pickle
@@ -63,15 +114,43 @@ def _launch_remote(slot_env: dict, hostname: str, payload: bytes,
 def run(func: Callable, args: Sequence = (), kwargs: dict | None = None,
         np: int | None = None, hosts: str | None = None,
         env: dict | None = None, use_gloo: bool = True,
-        start_timeout: float = 120.0) -> list[Any]:
+        start_timeout: float = 120.0,
+        min_np: int | None = None, max_np: int | None = None,
+        host_discovery_script: str | None = None,
+        reset_limit: int | None = None,
+        elastic_timeout: float | None = None,
+        slots: int | None = None) -> list[Any] | dict[int, Any]:
     """Run ``func(*args, **kwargs)`` on every slot of ``hosts`` (default:
     ``np`` local processes) with the full eager runtime initialized
     (rendezvous, controller, data plane); returns results ordered by rank.
     Remote hosts need this package importable and ssh reachability, the
-    same contract as the reference's ``horovod.run``."""
+    same contract as the reference's ``horovod.run``.
+
+    Elastic mode (reference: runner/__init__.py:92-210): pass ``min_np``/
+    ``max_np``/``host_discovery_script`` to run under the elastic driver —
+    workers are respawned across membership changes and ``func`` decides
+    its own fault-tolerance via ``hvd.elastic.run``. Returns
+    {final_rank: result} (the world can end a different size than it
+    started)."""
     import pickle
 
     kwargs = kwargs or {}
+    if min_np is not None or max_np is not None \
+            or host_discovery_script is not None:
+        return _run_elastic(func, args, kwargs, np=np, hosts=hosts,
+                            env=env, min_np=min_np, max_np=max_np,
+                            host_discovery_script=host_discovery_script,
+                            reset_limit=reset_limit,
+                            elastic_timeout=elastic_timeout or 600.0,
+                            start_timeout=start_timeout, slots=slots)
+    stray = {name: value for name, value in
+             (("reset_limit", reset_limit),
+              ("elastic_timeout", elastic_timeout),
+              ("slots", slots)) if value is not None}
+    if stray:
+        raise ValueError(
+            f"{sorted(stray)} only apply to elastic mode — also pass "
+            "min_np/max_np or host_discovery_script, or drop them.")
     host_list = parse_hosts(hosts) if hosts else None
     world = np or (sum(h.slots for h in host_list) if host_list else 1)
     if host_list is None:

@@ -1,0 +1,19 @@
+"""Module-level function for the programmatic elastic run() test
+(pickled by reference into elastic_run_worker bootstraps)."""
+import numpy as np
+
+
+def allreduce_identity(scale: float):
+    import os
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    try:
+        out = hvd.allreduce(np.ones(4, np.float32) * scale, op=hvd.Sum,
+                            name="elastic_fn")
+        return {"rank": hvd.rank(), "sum": float(np.asarray(out)[0]),
+                "size": hvd.size(),
+                "marker": os.environ.get("TEST_ELASTIC_RUN_MARKER")}
+    finally:
+        hvd.shutdown()

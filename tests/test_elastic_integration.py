@@ -121,3 +121,35 @@ def test_elastic_node_failure_recovers(tmp_path):
     assert epochs == "5"
     assert size == "1"
     assert rank == "0"
+
+
+def test_programmatic_elastic_run(monkeypatch):
+    """Reference parity: horovod.run(func, min_np=...) launches the
+    elastic driver over a pickled fn (runner/__init__.py:92-210); results
+    come back keyed by final rank."""
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        tests_dir + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    monkeypatch.delenv("HOROVOD_RENDEZVOUS_EPOCH", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    from elastic_fn import allreduce_identity
+
+    import horovod_tpu as hvd
+
+    results = hvd.run(allreduce_identity, args=(3.0,),
+                      hosts="localhost:2", min_np=2, max_np=2,
+                      elastic_timeout=60.0,
+                      env={"TEST_ELASTIC_RUN_MARKER": "propagated"})
+    assert set(results) == {0, 1}
+    for rank, value in results.items():
+        assert value["rank"] == rank
+        assert value["size"] == 2
+        assert value["sum"] == 6.0
+        assert value["marker"] == "propagated"   # env= reaches workers
+
+
+def test_elastic_only_params_rejected_on_static_path():
+    import horovod_tpu as hvd
+    with pytest.raises(ValueError, match="elastic mode"):
+        hvd.run(len, args=([1],), np=1, reset_limit=3)
