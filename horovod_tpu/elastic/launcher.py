@@ -83,6 +83,12 @@ def launch_elastic(args, command: list[str], *,
 
     from ..runner.launch import args_to_env
     base_env = dict(os.environ)
+    # Inherited world/round state (e.g. launching from inside a prior
+    # worker) would make fresh workers wait for an epoch that never
+    # forms or adopt a stale rank.
+    for stale in ("HOROVOD_RENDEZVOUS_EPOCH", "HOROVOD_RANK",
+                  "HOROVOD_SIZE"):
+        base_env.pop(stale, None)
     base_env.update(extra_env or {})
     base_env.update(args_to_env(args))
     base_env.update({

@@ -33,23 +33,20 @@ def main() -> int:
     kv = RendezvousClient(
         os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"],
         int(os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]))
+    assigned = False
     try:
         # Pull this worker's rank assignment from the elastic driver (the
         # role hvd.elastic.run's _rendezvous plays for CLI workers): the
         # launcher hands out only hostname+local_rank; global rank/size
-        # come from the driver's round formation.
+        # come from the driver's round formation. The launcher strips any
+        # inherited epoch/rank env, so round formation starts at 0.
         notification_manager.init()
         if notification_manager.has_driver:
-            try:
-                # Elastic epochs are integers; a stale string scope from
-                # an enclosing static launch means "no prior round".
-                epoch = int(os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", 0))
-            except ValueError:
-                epoch = 0
-            assignment = notification_manager.get_assignment(epoch)
+            assignment = notification_manager.get_assignment(0)
             if assignment is None:
                 return 0   # dropped from the new world; exit quietly
             _apply_assignment(assignment)
+            assigned = True
         payload = kv.wait(PAYLOAD_SCOPE, "blob", timeout=60.0)
         func, args, kwargs = pickle.loads(payload)
         result = func(*args, **kwargs)
@@ -57,9 +54,13 @@ def main() -> int:
     except BaseException:  # noqa: BLE001 — ship the traceback to the parent
         outcome, rc = (False, traceback.format_exc()), 1
     # HOROVOD_RANK reflects the latest elastic assignment (elastic/run.py
-    # _apply_assignment re-exports it each round).
-    kv.put(RESULT_SCOPE, os.environ.get("HOROVOD_RANK", "0"),
-           pickle.dumps(outcome))
+    # _apply_assignment re-exports it each round). A worker that failed
+    # BEFORE receiving any assignment must not publish — a fallback key
+    # would clobber/misattribute the real rank 0's outcome; its nonzero
+    # exit reaches the driver's results instead.
+    if assigned or "HOROVOD_RANK" in os.environ:
+        kv.put(RESULT_SCOPE, os.environ["HOROVOD_RANK"],
+               pickle.dumps(outcome))
     return rc
 
 

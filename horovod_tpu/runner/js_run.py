@@ -184,9 +184,17 @@ def adopt_jsm_env(env: dict | None = None) -> bool:
     env = env if env is not None else os.environ
     if "HOROVOD_RANK" in env:
         return False
-    rank = env.get("JSM_NAMESPACE_RANK", env.get("OMPI_COMM_WORLD_RANK",
-                                                 env.get("PMIX_RANK")))
-    size = env.get("JSM_NAMESPACE_SIZE", env.get("OMPI_COMM_WORLD_SIZE"))
+    def _first(*names):
+        for name in names:
+            if name in env:
+                return env[name]
+        return None
+
+    # JSM (jsrun), OpenMPI, PMIx, and Hydra/PMI (MPICH, Intel MPI).
+    rank = _first("JSM_NAMESPACE_RANK", "OMPI_COMM_WORLD_RANK",
+                  "PMIX_RANK", "PMI_RANK")
+    size = _first("JSM_NAMESPACE_SIZE", "OMPI_COMM_WORLD_SIZE",
+                  "PMI_SIZE")
     if rank is None or size is None:
         return False
     if JSRUN_HOSTS_ENV not in env \
@@ -245,7 +253,7 @@ def launch_jsrun(args, command: list[str]) -> int:
 
     from . import safe_shell_exec
     from .hosts import get_host_assignments, parse_hosts
-    from .launch import _advertised_address, args_to_env, rendezvous_env
+    from .launch import control_plane_env
     from .network import RendezvousServer
 
     hosts = parse_hosts(args.hosts)
@@ -254,11 +262,7 @@ def launch_jsrun(args, command: list[str]) -> int:
 
     server = RendezvousServer()
     port = server.start()
-    overrides = args_to_env(args)
-    overrides.update(rendezvous_env(
-        _advertised_address(hosts, getattr(args, "network_interface", None)),
-        port, args.start_timeout))
-    overrides[JSRUN_HOSTS_ENV] = args.hosts
+    overrides = control_plane_env(args, hosts, port, layout=args.hosts)
     # Placement: ERF pinning only when the compute-node core count is
     # known (the env knob); otherwise resource-set flags, where jsrun
     # itself splits each host's CPUs — requires uniform slots per host.

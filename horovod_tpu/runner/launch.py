@@ -50,6 +50,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "HOROVOD_GLOO_IFACE).")
     parser.add_argument("--ssh-port", type=int, default=None)
     parser.add_argument("--ssh-identity-file", default=None)
+    starter = parser.add_mutually_exclusive_group()
+    starter.add_argument("--use-gloo", action="store_true",
+                         help="Force the rendezvous/ssh process starter "
+                         "(the default; disables jsrun auto-detection).")
+    starter.add_argument("--use-mpi", action="store_true",
+                         help="Start workers through mpirun; ranks adopt "
+                         "their identity from the OMPI/PMIx env.")
+    starter.add_argument("--use-jsrun", action="store_true",
+                         help="Start workers through jsrun (LSF).")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("--disable-cache", action="store_true",
                         help="Disable the response cache.")
@@ -223,8 +232,16 @@ def launch_static(args, command: list[str]) -> int:
         # launch.py _check_all_hosts_ssh_successful / lsf default hosts).
         args.hosts = js_run.lsf_hosts_string()
     hosts = parse_hosts(args.hosts) if args.hosts else None
-    if hosts is not None and js_run.using_lsf() and \
-            js_run.jsrun_available() and \
+    if getattr(args, "use_mpi", False):
+        return launch_mpi(args, command)
+    if getattr(args, "use_jsrun", False):
+        if hosts is None:
+            sys.stderr.write("horovodrun-tpu: --use-jsrun needs -H or an "
+                             "LSF allocation\n")
+            return 2
+        return js_run.launch_jsrun(args, command)
+    if hosts is not None and not getattr(args, "use_gloo", False) and \
+            js_run.using_lsf() and js_run.jsrun_available() and \
             not all(_is_local(h.hostname) for h in hosts):
         # jsrun is the process starter on LSF clusters (ssh is usually
         # disabled between compute nodes there); control plane unchanged.
@@ -291,6 +308,56 @@ def launch_static(args, command: list[str]) -> int:
         sys.stderr.write(f"horovodrun-tpu: ranks failed: {failures}\n")
         return 1
     return 0
+
+
+def control_plane_env(args, hosts, port: int,
+                      layout: str | None = None) -> dict[str, str]:
+    """Worker env block for starters that launch all ranks in one shot
+    (mpirun, jsrun): tuning knobs + rendezvous coordinates, plus the host
+    layout for rank adoption when the starter cannot hand out per-rank
+    env. One definition so the contract can't drift between starters."""
+    env = args_to_env(args)
+    env.update(rendezvous_env(
+        _advertised_address(hosts, getattr(args, "network_interface",
+                                           None)),
+        port, args.start_timeout))
+    if layout:
+        from .js_run import JSRUN_HOSTS_ENV
+        env[JSRUN_HOSTS_ENV] = layout
+    return env
+
+
+def launch_mpi(args, command: list[str]) -> int:
+    """Static launch through mpirun (reference: mpi_run.py / launch.py
+    --use-mpi): ONE mpirun invocation starts every rank; mpirun cannot
+    hand out per-rank env, so workers adopt their identity from the
+    OMPI/PMIx vars plus the exported host layout (the same adoption path
+    jsrun uses, runner/js_run.py adopt_jsm_env) and dial back to the
+    rendezvous server started here. MPI is the process starter only —
+    the control plane stays TCP and the data plane XLA."""
+    from . import safe_shell_exec
+    from .mpi_run import build_mpi_command, mpi_available
+
+    if not mpi_available():
+        sys.stderr.write("horovodrun-tpu: --use-mpi but mpirun is not on "
+                         "PATH\n")
+        return 2
+    hosts_str = args.hosts or f"localhost:{args.num_proc or 1}"
+    hosts = parse_hosts(hosts_str)
+    np = args.num_proc or sum(h.slots for h in hosts)
+
+    server = RendezvousServer()
+    port = server.start()
+    env = dict(os.environ)
+    env.update(control_plane_env(args, hosts, port, layout=hosts_str))
+    cmd = build_mpi_command(command, np=np, hosts=hosts_str, env=env,
+                            ssh_port=args.ssh_port)
+    if args.verbose:
+        print(" ".join(cmd))
+    try:
+        return safe_shell_exec.execute(cmd, env=env)
+    finally:
+        server.stop()
 
 
 def _advertised_address(hosts, network_interface: str | None = None) -> str:

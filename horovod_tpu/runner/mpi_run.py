@@ -86,7 +86,8 @@ def build_mpi_command(command: list[str], *, np: int,
     else:
         cmd += impl_flags
         exported = [n for n in sorted(env)
-                    if n.startswith("HOROVOD_")]
+                    if n.startswith("HOROVOD_")
+                    or n in ("PATH", "PYTHONPATH", "LD_LIBRARY_PATH")]
         if exported:
             cmd += ["-genvlist", ",".join(exported)]
     if extra_mpi_args:

@@ -141,7 +141,8 @@ def run(func: Callable, args: Sequence = (), kwargs: dict | None = None,
                             env=env, min_np=min_np, max_np=max_np,
                             host_discovery_script=host_discovery_script,
                             reset_limit=reset_limit,
-                            elastic_timeout=elastic_timeout or 600.0,
+                            elastic_timeout=(600.0 if elastic_timeout
+                                             is None else elastic_timeout),
                             start_timeout=start_timeout, slots=slots)
     stray = {name: value for name, value in
              (("reset_limit", reset_limit),
@@ -155,8 +156,8 @@ def run(func: Callable, args: Sequence = (), kwargs: dict | None = None,
     world = np or (sum(h.slots for h in host_list) if host_list else 1)
     if host_list is None:
         host_list = parse_hosts(f"localhost:{world}")
-    slots = get_host_assignments(host_list, world)
-    any_remote = any(not is_local_host(s.hostname) for s in slots)
+    slot_infos = get_host_assignments(host_list, world)
+    any_remote = any(not is_local_host(s.hostname) for s in slot_infos)
 
     server = RendezvousServer()
     port = server.start()
@@ -172,7 +173,7 @@ def run(func: Callable, args: Sequence = (), kwargs: dict | None = None,
     remote_procs: dict[int, subprocess.Popen] = {}
     remote_ranks: list[int] = []
     try:
-        for slot in slots:
+        for slot in slot_infos:
             slot_env = dict(env or {})
             slot_env.update(slot.to_env())
             slot_env.update(rendezvous_env(addr, port, start_timeout))
@@ -202,7 +203,7 @@ def run(func: Callable, args: Sequence = (), kwargs: dict | None = None,
                 t.start()
                 remote_threads.append(t)
 
-        results: list[Any] = [None] * len(slots)
+        results: list[Any] = [None] * len(slot_infos)
         errors: list[str] = []
         for rank, conn in conns:
             if conn.poll(start_timeout + 600):

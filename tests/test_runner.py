@@ -350,3 +350,68 @@ class TestJsRun:
                js_run.JSRUN_HOSTS_ENV: "c1:2,c2:2"}
         with pytest.raises(RuntimeError, match="placement mismatch"):
             js_run.adopt_jsm_env(env)
+
+
+class TestMpiLauncher:
+    def test_use_mpi_end_to_end(self, tmp_path, monkeypatch):
+        """--use-mpi drives one mpirun (stubbed: spawns N local copies
+        with OMPI_COMM_WORLD_* env) and workers adopt rank identity from
+        the OMPI vars + exported layout, then allreduce correctly."""
+        stub = tmp_path / "mpirun"
+        stub.write_text(textwrap.dedent("""\
+            #!/usr/bin/env python3
+            import os, subprocess, sys
+            argv = sys.argv[1:]
+            if argv and argv[0] == "--version":
+                print("Open MPI 4.1.0"); sys.exit(0)
+            arity = {"-np": 1, "-H": 1, "-bind-to": 1, "-map-by": 1,
+                     "-mca": 2, "-x": 1, "--allow-run-as-root": 0}
+            np = 1; i = 0
+            while i < len(argv):
+                if argv[i] in arity:
+                    if argv[i] == "-np":
+                        np = int(argv[i + 1])
+                    i += 1 + arity[argv[i]]
+                else:
+                    break
+            cmd = argv[i:]
+            procs = []
+            for r in range(np):
+                env = dict(os.environ)
+                env["OMPI_COMM_WORLD_RANK"] = str(r)
+                env["OMPI_COMM_WORLD_SIZE"] = str(np)
+                procs.append(subprocess.Popen(cmd, env=env))
+            sys.exit(max(p.wait() for p in procs))
+        """))
+        stub.chmod(0o755)
+        worker = tmp_path / "train.py"
+        worker.write_text(textwrap.dedent("""\
+            import numpy as np
+            import horovod_tpu as hvd
+            hvd.init()
+            out = hvd.allreduce(np.ones(3, np.float32), op=hvd.Sum,
+                                name="mpi_e2e")
+            assert hvd.size() == 2 and out[0] == 2.0, (hvd.size(), out)
+            print(f"MPI_RANK{hvd.rank()}_OK")
+            hvd.shutdown()
+        """))
+        env = dict(os.environ)
+        env["PATH"] = str(tmp_path) + os.pathsep + env["PATH"]
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["HOROVOD_RENDEZVOUS_EPOCH"] = "mpi-e2e"
+        for k in list(env):
+            if k.startswith("HOROVOD_") and k != "HOROVOD_RENDEZVOUS_EPOCH":
+                del env[k]
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner.launch",
+             "--use-mpi", "-np", "2", sys.executable, str(worker)],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "MPI_RANK0_OK" in proc.stdout
+        assert "MPI_RANK1_OK" in proc.stdout
+
+    def test_use_mpi_without_mpirun_errors(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PATH", str(tmp_path))   # no mpirun here
+        from horovod_tpu.runner import launch
+        rc = launch.main(["--use-mpi", "-np", "2", "true"])
+        assert rc == 2
