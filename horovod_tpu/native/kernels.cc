@@ -163,24 +163,49 @@ static int ring_allreduce_t(int send_fd, int recv_fd, T* buf, int64_t n,
           (unsigned char)(send_bytes >> 24), (unsigned char)(send_bytes >> 16),
           (unsigned char)(send_bytes >> 8), (unsigned char)send_bytes};
 
-      std::atomic<int> send_rc{0};
-      std::thread sender([&] {
+      // Small chunks: sequential send-then-recv. Every rank's send fits
+      // the kernel socket buffer (64 KB is under even Linux's default
+      // ~208 KB wmem, in case PeerMesh's 4 MB SO_SNDBUF request failed,
+      // and at most one chunk is in flight per step), so sendall cannot
+      // block — and skipping the per-step std::thread saves ~0.5 ms/op,
+      // which dominates small-tensor (cached-cycle) latency.  Large
+      // chunks keep the concurrent sender thread so the ring cannot
+      // deadlock on filled buffers.
+      constexpr size_t kInlineSendMax = 64 * 1024;
+      auto do_send = [&]() -> int {
         int rc = send_exact(send_fd, (const char*)send_hdr, 4);
         if (rc == 0) rc = send_exact(send_fd, send_ptr, send_bytes);
-        send_rc = rc;
-      });
-      unsigned char recv_hdr[4];
-      int recv_rc = recv_exact(recv_fd, (char*)recv_hdr, 4);
-      if (recv_rc == 0) {
-        size_t framed = ((size_t)recv_hdr[0] << 24) |
-                        ((size_t)recv_hdr[1] << 16) |
-                        ((size_t)recv_hdr[2] << 8) | (size_t)recv_hdr[3];
-        recv_rc = framed == recv_bytes
-                      ? recv_exact(recv_fd, (char*)incoming.data(), recv_bytes)
-                      : -1;  // peer desync: fail loudly, never misparse
+        return rc;
+      };
+      int send_rc_val = 0, recv_rc = -1;
+      bool threaded = send_bytes > kInlineSendMax;
+      std::thread sender;
+      std::atomic<int> send_rc{0};
+      if (threaded) {
+        sender = std::thread([&] { send_rc = do_send(); });
+      } else {
+        send_rc_val = do_send();
       }
-      sender.join();
-      if (send_rc != 0 || recv_rc != 0) return -1;
+      // Inline path: a dead link already failed the send — skip the recv
+      // (its own 60 s poll timeout would double time-to-error).
+      if (threaded || send_rc_val == 0) {
+        unsigned char recv_hdr[4];
+        recv_rc = recv_exact(recv_fd, (char*)recv_hdr, 4);
+        if (recv_rc == 0) {
+          size_t framed = ((size_t)recv_hdr[0] << 24) |
+                          ((size_t)recv_hdr[1] << 16) |
+                          ((size_t)recv_hdr[2] << 8) | (size_t)recv_hdr[3];
+          recv_rc =
+              framed == recv_bytes
+                  ? recv_exact(recv_fd, (char*)incoming.data(), recv_bytes)
+                  : -1;  // peer desync: fail loudly, never misparse
+        }
+      }
+      if (threaded) {
+        sender.join();
+        send_rc_val = send_rc.load();
+      }
+      if (send_rc_val != 0 || recv_rc != 0) return -1;
 
       if (phase == 0) {
         add_into(buf + bounds[recv_idx], incoming.data(), recv_elems);
