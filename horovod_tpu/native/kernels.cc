@@ -15,7 +15,6 @@
 //
 // Exposed as a plain C ABI for ctypes (the reference loads its core the same
 // way: horovod/common/basics.py ctypes.CDLL).
-#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
@@ -180,9 +179,9 @@ static int ring_allreduce_t(int send_fd, int recv_fd, T* buf, int64_t n,
       int send_rc_val = 0, recv_rc = -1;
       bool threaded = send_bytes > kInlineSendMax;
       std::thread sender;
-      std::atomic<int> send_rc{0};
       if (threaded) {
-        sender = std::thread([&] { send_rc = do_send(); });
+        // join() below synchronizes the plain write.
+        sender = std::thread([&] { send_rc_val = do_send(); });
       } else {
         send_rc_val = do_send();
       }
@@ -201,10 +200,7 @@ static int ring_allreduce_t(int send_fd, int recv_fd, T* buf, int64_t n,
                   : -1;  // peer desync: fail loudly, never misparse
         }
       }
-      if (threaded) {
-        sender.join();
-        send_rc_val = send_rc.load();
-      }
+      if (threaded) sender.join();
       if (send_rc_val != 0 || recv_rc != 0) return -1;
 
       if (phase == 0) {

@@ -27,17 +27,33 @@ from ..core import (Handle, init, is_initialized, shutdown, rank, size,
                     local_rank, local_size, cross_rank, cross_size)
 
 
-def _check_cpu(tensor: torch.Tensor) -> torch.Tensor:
+def _check_cpu(tensor: torch.Tensor):
     if tensor.device.type != "cpu":
         raise ValueError(
             "horovod_tpu.torch stages through host memory; move the "
             "tensor to CPU (TPU-resident training should use the JAX "
             "path, horovod_tpu.training.Trainer).")
-    return tensor.detach().contiguous()
+    tensor = tensor.detach().contiguous()
+    if tensor.dtype == torch.bfloat16:
+        # torch cannot export bf16 through the buffer protocol; the
+        # int16 view shares memory, and the ml_dtypes view re-types it
+        # for the core (which already treats bf16 wires fp32-accumulated)
+        # — still zero-copy.
+        import ml_dtypes
+        return tensor.view(torch.int16).numpy().view(ml_dtypes.bfloat16)
+    return tensor
+
+
+def _from_np(out: np.ndarray) -> torch.Tensor:
+    """np array (possibly ml_dtypes.bfloat16) -> torch tensor."""
+    out = np.ascontiguousarray(out)
+    if out.dtype.name == "bfloat16":
+        return torch.from_numpy(out.view(np.int16)).view(torch.bfloat16)
+    return torch.from_numpy(out)
 
 
 def _copy_out(target: torch.Tensor, out: np.ndarray) -> torch.Tensor:
-    src = torch.from_numpy(np.ascontiguousarray(out))
+    src = _from_np(out)
     with torch.no_grad():
         if target.shape != src.shape:
             target.resize_(src.shape)
@@ -168,10 +184,7 @@ def synchronize(handle: Handle):
         outs = [_copy_out(t, e.output)
                 for t, e in zip(targets, handle.entries)]
         return outs[0] if len(outs) == 1 else outs
-    outs = []
-    for e in handle.entries:
-        out = torch.from_numpy(np.ascontiguousarray(e.output))
-        outs.append(out)
+    outs = [_from_np(e.output) for e in handle.entries]
     if getattr(handle, "wants_recv_splits", False):
         recv = torch.from_numpy(np.asarray(handle.entries[0].received_splits,
                                            dtype=np.int32))

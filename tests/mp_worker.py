@@ -944,6 +944,85 @@ def battery_peerdeath(hvd, rank, size):
     raise AssertionError("collectives kept succeeding after peer death")
 
 
+
+def battery_torch_grid(hvd, rank, size):
+    """Torch-binding semantic grid (modeled on the dtype x op x variant
+    sweep of /root/reference/test/parallel/test_torch.py): every wire
+    dtype through the torch surface, in-place variants, async handles
+    with poll/synchronize, scales, and splits-alltoall with received
+    splits."""
+    import torch
+    import horovod_tpu.torch as hvt
+
+    int_dtypes = [torch.uint8, torch.int8, torch.int32, torch.int64]
+    float_dtypes = [torch.float16, torch.bfloat16, torch.float32,
+                    torch.float64]
+
+    # -- allreduce out-of-place + in-place, every dtype -------------------
+    for dt in int_dtypes + float_dtypes:
+        tag = str(dt).split(".")[-1]
+        base = torch.arange(17) % 4 + rank + 1
+        expected = sum((np.arange(17) % 4 + r + 1).astype(np.float64)
+                       for r in range(size))
+        rtol = 1e-2 if dt in (torch.float16, torch.bfloat16) else 1e-6
+        out = hvt.allreduce(base.to(dt), op=hvt.Sum, name=f"tg_ar_{tag}")
+        assert out.dtype == dt, (tag, out.dtype)
+        np.testing.assert_allclose(out.to(torch.float64).numpy(),
+                                   expected, rtol=rtol, err_msg=tag)
+        t2 = base.to(dt).clone()
+        ret = hvt.allreduce_(t2, op=hvt.Sum, name=f"tg_ari_{tag}")
+        assert ret is t2   # in-place returns the same tensor
+        np.testing.assert_allclose(t2.to(torch.float64).numpy(),
+                                   expected, rtol=rtol,
+                                   err_msg=f"inplace {tag}")
+
+    # -- prescale/postscale through the torch surface ---------------------
+    out = hvt.allreduce(torch.ones(9), op=hvt.Sum, name="tg_scale",
+                        prescale_factor=2.0, postscale_factor=0.25)
+    np.testing.assert_allclose(out.numpy(), np.full(9, size / 2.0),
+                               rtol=1e-6)
+
+    # -- async handles: enqueue several, poll, synchronize out of order --
+    handles = [hvt.allreduce_async(torch.ones(4) * (rank + i),
+                                   op=hvt.Sum, name=f"tg_async_{i}")
+               for i in range(3)]
+    for i in reversed(range(3)):
+        out = hvt.synchronize(handles[i])
+        assert hvt.poll(handles[i])
+        np.testing.assert_allclose(
+            out.numpy(), np.full(4, float(sum(r + i for r in range(size)))),
+            rtol=1e-6, err_msg=f"async {i}")
+
+    # -- grouped in-place per dtype ---------------------------------------
+    for dt in (torch.int32, torch.float32, torch.float64):
+        tag = str(dt).split(".")[-1]
+        ts = [torch.full((5 + i,), float(rank + i)).to(dt)
+              for i in range(3)]
+        hvt.grouped_allreduce_(ts, op=hvt.Sum, name=f"tg_gar_{tag}")
+        for i, t in enumerate(ts):
+            np.testing.assert_allclose(
+                t.to(torch.float64).numpy(),
+                np.full(5 + i, float(sum(r + i for r in range(size)))),
+                err_msg=f"grouped {tag}[{i}]")
+
+    # -- broadcast_ in place ----------------------------------------------
+    t = torch.full((3,), float(rank))
+    hvt.broadcast_(t, root_rank=size - 1, name="tg_bc")
+    np.testing.assert_allclose(t.numpy(), np.full(3, float(size - 1)))
+
+    # -- alltoall with uneven splits + received splits ---------------------
+    # Sender r sends (d+1) rows to destination d, all rows carrying r.
+    rows = sum(d + 1 for d in range(size))
+    t = torch.full((rows, 2), float(rank))
+    splits = torch.tensor([d + 1 for d in range(size)], dtype=torch.int32)
+    out, recv = hvt.alltoall(t, splits=splits, name="tg_a2a")
+    np.testing.assert_array_equal(recv.numpy(),
+                                  np.full(size, rank + 1, np.int32))
+    expected_rows = np.concatenate(
+        [np.full(((rank + 1), 2), float(r)) for r in range(size)])
+    np.testing.assert_allclose(out.numpy(), expected_rows)
+
+
 BATTERIES = {
     "collectives": battery_collectives,
     "matrix": battery_matrix,
@@ -954,6 +1033,7 @@ BATTERIES = {
     "join": battery_join,
     "adasum": battery_adasum,
     "torch": battery_torch,
+    "torch_grid": battery_torch_grid,
     "syncbn": battery_syncbn,
     "tensorflow": battery_tensorflow,
     "tf_function": battery_tf_function,

@@ -135,3 +135,10 @@ def test_peer_death_surfaces_not_hangs():
     outputs = _run_world(2, "peerdeath", timeout=180.0,
                          expected_rcs={1: 37})
     assert "HorovodInternalError" in outputs[0]
+
+
+@pytest.mark.parametrize("size", [2, 3])
+def test_torch_binding_grid(size):
+    """Torch surface dtype x variant sweep (reference:
+    test/parallel/test_torch.py grid)."""
+    _run_world(size, "torch_grid", timeout=180.0)
