@@ -1023,6 +1023,56 @@ def battery_torch_grid(hvd, rank, size):
     np.testing.assert_allclose(out.numpy(), expected_rows)
 
 
+
+def battery_tf_grid(hvd, rank, size):
+    """TF-surface dtype grid (reference: test/parallel/test_tensorflow.py
+    dtype sweep): every wire dtype through the tf binding, scales, and
+    uneven-splits alltoall."""
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as htf
+
+    dtypes = [tf.uint8, tf.int8, tf.int32, tf.int64, tf.float16,
+              tf.bfloat16, tf.float32, tf.float64]
+    for dt in dtypes:
+        tag = dt.name
+        base = tf.cast(tf.range(17) % 4 + rank + 1, dt)
+        expected = sum((np.arange(17) % 4 + r + 1).astype(np.float64)
+                      for r in range(size))
+        rtol = 1e-2 if dt in (tf.float16, tf.bfloat16) else 1e-6
+        out = htf.allreduce(base, average=False, name=f"tfg_ar_{tag}")
+        assert out.dtype == dt, (tag, out.dtype)
+        np.testing.assert_allclose(
+            tf.cast(out, tf.float64).numpy(), expected, rtol=rtol,
+            err_msg=tag)
+
+    # prescale/postscale
+    out = htf.allreduce(tf.ones(9), average=False, name="tfg_scale",
+                        prescale_factor=2.0, postscale_factor=0.25)
+    np.testing.assert_allclose(out.numpy(), np.full(9, size / 2.0),
+                               rtol=1e-6)
+
+    # allgather variable first dim per dtype
+    for dt in (tf.int64, tf.float16, tf.float64):
+        local = tf.cast(tf.fill((rank + 1, 2), rank + 1), dt)
+        out = htf.allgather(local, name=f"tfg_ag_{dt.name}")
+        assert out.shape == (sum(r + 1 for r in range(size)), 2)
+
+    # broadcast from the last rank
+    out = htf.broadcast(tf.fill((3,), float(rank)), root_rank=size - 1,
+                        name="tfg_bc")
+    np.testing.assert_allclose(out.numpy(), np.full(3, float(size - 1)))
+
+    # alltoall with uneven splits: sender r sends (d+1) rows to dest d
+    rows = sum(d + 1 for d in range(size))
+    t = tf.fill((rows, 2), float(rank))
+    out = htf.alltoall(t, splits=[d + 1 for d in range(size)],
+                       name="tfg_a2a")
+    got = out[0] if isinstance(out, (tuple, list)) else out
+    expected_rows = np.concatenate(
+        [np.full(((rank + 1), 2), float(r)) for r in range(size)])
+    np.testing.assert_allclose(np.asarray(got), expected_rows)
+
+
 BATTERIES = {
     "collectives": battery_collectives,
     "matrix": battery_matrix,
@@ -1036,6 +1086,7 @@ BATTERIES = {
     "torch_grid": battery_torch_grid,
     "syncbn": battery_syncbn,
     "tensorflow": battery_tensorflow,
+    "tf_grid": battery_tf_grid,
     "tf_function": battery_tf_function,
     "sparse": battery_sparse,
     "mxnet": battery_mxnet,

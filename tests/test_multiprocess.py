@@ -142,3 +142,9 @@ def test_torch_binding_grid(size):
     """Torch surface dtype x variant sweep (reference:
     test/parallel/test_torch.py grid)."""
     _run_world(size, "torch_grid", timeout=180.0)
+
+
+def test_tensorflow_binding_grid():
+    """TF surface dtype sweep (reference: test_tensorflow.py grid)."""
+    pytest.importorskip("tensorflow")
+    _run_world(2, "tf_grid", timeout=180.0)
