@@ -243,6 +243,44 @@ def init(*, rank: int | None = None, size: int | None = None,
                                  timeout=timeout)
             _global.resources.extend([ctrl_mesh, data_mesh])
             transport = TcpTransport(ctrl_mesh)
+            # Two-level eager path (reference: NCCLHierarchicalAllreduce,
+            # nccl_operations.cc:187-398): refine the TCP plane with
+            # local/cross sub-meshes when the knobs are on and the rank
+            # layout is the launcher's homogeneous host-major assignment.
+            hier_ar = config.HIERARCHICAL_ALLREDUCE.get()
+            hier_ag = config.HIERARCHICAL_ALLGATHER.get()
+            if (hier_ar or hier_ag) and local_size > 1 and cross_size > 1:
+                # Every rank must make the SAME build-or-skip decision: a
+                # rank skipping while peers form the sub-meshes would hang
+                # their rendezvous.  Publish each rank's layout verdict to
+                # the KV store and proceed only on unanimity.
+                layout_ok = (local_size * cross_size == size and
+                             rank == cross_rank * local_size + local_rank)
+                kv.put(f"hier{epoch}", f"ok:{rank}",
+                       b"1" if layout_ok else b"0")
+                all_ok = all(
+                    kv.wait(f"hier{epoch}", f"ok:{r}", timeout) == b"1"
+                    for r in range(size))
+                if not all_ok:
+                    logger.warning(
+                        "hierarchical collectives requested but the rank "
+                        "layout is not homogeneous host-major on every "
+                        "rank (here: rank=%d local=%d/%d cross=%d/%d); "
+                        "using the flat path", rank, local_rank,
+                        local_size, cross_rank, cross_size)
+                else:
+                    from .backend.hierarchical import HierarchicalTcpBackend
+                    local_mesh = PeerMesh(
+                        local_rank, local_size, kv,
+                        scope=f"hloc{epoch}.{cross_rank}", timeout=timeout)
+                    cross_mesh = PeerMesh(
+                        cross_rank, cross_size, kv,
+                        scope=f"hcross{epoch}.{local_rank}", timeout=timeout)
+                    _global.resources.extend([local_mesh, cross_mesh])
+                    backends.append(HierarchicalTcpBackend(
+                        TcpCollectives(local_mesh),
+                        TcpCollectives(cross_mesh),
+                        allreduce_on=hier_ar, allgather_on=hier_ag))
             backends.append(TcpBackend(TcpCollectives(data_mesh)))
         else:
             transport = LocalTransport()

@@ -81,6 +81,13 @@ def test_join_uneven_data():
     _run_world(2, "join")
 
 
+def test_hierarchical_collectives():
+    """Eager two-level allreduce/allgather over local/cross sub-meshes:
+    4 ranks as 2 hosts x 2 slots (VERDICT r3 item 3; reference:
+    nccl_operations.cc:187-398)."""
+    _run_world(4, "hierarchical", timeout=120.0)
+
+
 @pytest.mark.parametrize("size", [2, 4])
 def test_adasum(size):
     # Generous timeout: every worker imports torch AND tensorflow for the
