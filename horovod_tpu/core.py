@@ -249,12 +249,16 @@ def init(*, rank: int | None = None, size: int | None = None,
             # layout is the launcher's homogeneous host-major assignment.
             hier_ar = config.HIERARCHICAL_ALLREDUCE.get()
             hier_ag = config.HIERARCHICAL_ALLGATHER.get()
-            if (hier_ar or hier_ag) and local_size > 1 and cross_size > 1:
+            if hier_ar or hier_ag:
                 # Every rank must make the SAME build-or-skip decision: a
                 # rank skipping while peers form the sub-meshes would hang
-                # their rendezvous.  Publish each rank's layout verdict to
-                # the KV store and proceed only on unanimity.
-                layout_ok = (local_size * cross_size == size and
+                # their rendezvous.  The knob env is launcher-set (uniform),
+                # and EVERY rank publishes a layout verdict — the verdict
+                # itself carries per-rank eligibility (topology must be
+                # two-level homogeneous host-major on every rank), so
+                # heterogeneous slot counts unanimously fall back flat.
+                layout_ok = (local_size > 1 and cross_size > 1 and
+                             local_size * cross_size == size and
                              rank == cross_rank * local_size + local_rank)
                 kv.put(f"hier{epoch}", f"ok:{rank}",
                        b"1" if layout_ok else b"0")

@@ -58,6 +58,7 @@ class ElasticDriver:
         self._finished = threading.Event()
         self._shutdown = threading.Event()
         self._reset_limit_exceeded = False
+        self._resume_failed = False
         self._create_worker_fn: Callable[[SlotInfo], int] | None = None
         self._discovery_thread: threading.Thread | None = None
 
@@ -103,6 +104,13 @@ class ElasticDriver:
     @property
     def reset_limit_exceeded(self) -> bool:
         return self._reset_limit_exceeded
+
+    @property
+    def resume_failed(self) -> bool:
+        """True when a mid-job resume could not re-form a round (e.g. too
+        few surviving slots) — the job ended abnormally even if some
+        workers exited 0."""
+        return self._resume_failed
 
     def join(self, timeout: float | None = None) -> bool:
         return self._finished.wait(timeout)
@@ -173,6 +181,7 @@ class ElasticDriver:
             self._form_round()
         except (TimeoutError, ValueError) as exc:
             logger.error("cannot resume elastic job: %s", exc)
+            self._resume_failed = True
             self.stop()
 
     def _launch_worker(self, slot: SlotInfo) -> None:

@@ -157,6 +157,11 @@ def launch_elastic(args, command: list[str], *,
             sys.stderr.write(
                 "horovodrun-tpu elastic: reset limit exceeded\n")
             return _done(1)
+        if driver.resume_failed:
+            sys.stderr.write(
+                "horovodrun-tpu elastic: job could not resume after "
+                "failure (insufficient surviving slots)\n")
+            return _done(1)
         results = driver.get_results()
         failures = [name for name, (code, _) in results.items()
                     if code != 0]

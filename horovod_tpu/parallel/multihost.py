@@ -25,6 +25,8 @@ logger = logging.getLogger(__name__)
 
 _lock = threading.Lock()
 _initialized_here = False
+# (rank, size, kv, epoch) of the live world; drives ordered teardown.
+_world: tuple | None = None
 
 _COORD_SCOPE = "jaxdist"
 
@@ -96,33 +98,130 @@ def init_jax_distributed(rank: int, size: int, kv: Any = None,
             local_device_ids=local_device_ids,
             heartbeat_timeout_seconds=heartbeat,
             initialization_timeout=int(timeout))
+        global _world
+        _world = (rank, size, kv, epoch)
         _initialized_here = True
         return True
 
 
 def shutdown_jax_distributed() -> None:
-    global _initialized_here
+    global _initialized_here, _world
     with _lock:
         if not _initialized_here:
             return
         import jax
+
+        # ORDERED teardown under elastic.  With recoverability on, the
+        # coordination service's shutdown barrier no longer blocks, so the
+        # coordinator can tear the service down while peers are still
+        # connected; a client that outlives the service is killed by
+        # jaxlib's error-polling thread (LOG(FATAL), client.h:80 — the
+        # callback that could soften it isn't reachable from Python, and
+        # jaxlib 0.9's binding for it aborts on std::bad_cast).  A FATALed
+        # survivor exits nonzero, gets its healthy host blacklisted, and
+        # can sink the elastic job.  So: non-coordinator ranks disconnect
+        # FIRST (service still up -> clean ShutdownTask, poll thread
+        # stops), publishing a 'bye' marker to the rendezvous KV; the
+        # coordinator waits for the markers (bounded grace — a dead peer
+        # never writes one, and its agent is gone so it cannot FATAL)
+        # before taking the service down.
+        rank_size_kv = _world
+        _world = None
+        if rank_size_kv is not None and os.environ.get("HOROVOD_ELASTIC"):
+            rank, size, kv, epoch = rank_size_kv
+            if kv is not None and size > 1:
+                import time
+                if rank == 0:
+                    # Dead peers never write a marker, so a plain
+                    # wait-for-all would stall the full grace on every
+                    # failure-triggered re-form.  Settle heuristic: live
+                    # peers disconnect within moments of each other, so
+                    # stop once no NEW marker has arrived for settle_s
+                    # (grace remains the hard cap for starved hosts).
+                    grace = float(os.environ.get(
+                        "HOROVOD_JAX_TEARDOWN_GRACE_SECONDS", "30"))
+                    settle = min(grace, float(os.environ.get(
+                        "HOROVOD_JAX_TEARDOWN_SETTLE_SECONDS", "10")))
+                    deadline = time.monotonic() + grace
+                    last_progress = time.monotonic()
+                    pending = set(range(1, size))
+                    while pending:
+                        now = time.monotonic()
+                        if now > deadline or now > last_progress + settle:
+                            break
+                        for r in list(pending):
+                            try:
+                                if kv.get(_COORD_SCOPE,
+                                          f"bye:{epoch}:{r}") is not None:
+                                    pending.discard(r)
+                                    last_progress = time.monotonic()
+                            except Exception:  # noqa: BLE001 - kv gone
+                                pending.clear()
+                                break
+                        if pending:
+                            time.sleep(0.05)
+                    if pending:
+                        logger.warning(
+                            "proceeding with coordination-service "
+                            "teardown; ranks %s never disconnected "
+                            "(dead peers cannot, live ones may FATAL)",
+                            sorted(pending))
+                else:
+                    try:
+                        jax.distributed.shutdown()
+                    except Exception as exc:  # noqa: BLE001
+                        logger.warning("jax.distributed.shutdown failed: "
+                                       "%s", exc)
+                        _force_clear_distributed_state()
+                    try:
+                        kv.put(_COORD_SCOPE, f"bye:{epoch}:{rank}", b"1")
+                    except Exception:  # noqa: BLE001 - launcher gone
+                        pass
+                    _clear_backends()
+                    _initialized_here = False
+                    return
         try:
             jax.distributed.shutdown()
         except Exception as exc:  # noqa: BLE001 - best-effort teardown
             logger.warning("jax.distributed.shutdown failed: %s", exc)
-        # Evict the live backends: device lists from the old world would
-        # otherwise survive the shutdown, and the next
-        # jax.distributed.initialize (elastic re-rendezvous, SURVEY §7
-        # "elastic re-init on TPU") could not re-form the client.
-        # Validated in-process: see tests/test_elastic_integration.py
-        # (elastic XLA world) — shutdown → clear → initialize works on the
-        # gloo CPU plane.
-        try:
-            import jax.extend.backend as _xb
-            _xb.clear_backends()
-        except Exception as exc:  # noqa: BLE001
-            logger.warning("clear_backends failed: %s", exc)
+            _force_clear_distributed_state()
+        _clear_backends()
         _initialized_here = False
+
+
+def _force_clear_distributed_state() -> None:
+    """A failed disconnect (e.g. the coordinator tore down first after a
+    peer death) leaves jax's global State partially populated, and the
+    next initialize() would raise "should only be called once".  Finish
+    the teardown field by field."""
+    try:
+        from jax._src import distributed as _dist_mod
+        gs = _dist_mod.global_state
+        for attr in ("preemption_sync_manager", "client", "service"):
+            obj = getattr(gs, attr, None)
+            if obj is not None:
+                try:
+                    obj.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+                setattr(gs, attr, None)
+        gs.coordinator_address = None
+    except Exception as exc:  # noqa: BLE001
+        logger.warning("forced distributed-state cleanup failed: %s", exc)
+
+
+def _clear_backends() -> None:
+    """Evict the live backends: device lists from the old world would
+    otherwise survive the shutdown, and the next jax.distributed.initialize
+    (elastic re-rendezvous, SURVEY §7 "elastic re-init on TPU") could not
+    re-form the client.  Validated in-process: see
+    tests/test_elastic_integration.py (elastic XLA world) — shutdown →
+    clear → initialize works on the gloo CPU plane."""
+    try:
+        import jax.extend.backend as _xb
+        _xb.clear_backends()
+    except Exception as exc:  # noqa: BLE001
+        logger.warning("clear_backends failed: %s", exc)
 
 
 def should_init(size: int) -> bool:
