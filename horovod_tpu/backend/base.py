@@ -18,6 +18,28 @@ from ..common.status import Status
 from ..common.tensor_queue import TensorTableEntry
 
 
+def accum_dtype(dtype: np.dtype) -> np.dtype:
+    """Accumulation dtype for reductions: 16-bit floats widen to fp32,
+    everything else reduces in place (the numerics contract shared by the
+    TCP, shm and hierarchical planes; reference: common/half.cc fp16 sum).
+    NOTE: ml_dtypes.bfloat16 reports dtype.kind 'V', so the float test
+    goes through finfo, not kind."""
+    dtype = np.dtype(dtype)
+    if dtype.itemsize <= 2:
+        try:
+            return np.dtype(np.float32) if np.finfo(dtype).bits <= 16 \
+                else dtype
+        except ValueError:
+            pass   # int/bool — or bf16, which np.finfo rejects too
+        try:
+            import ml_dtypes
+            if ml_dtypes.finfo(dtype).bits <= 16:
+                return np.dtype(np.float32)
+        except (ImportError, ValueError, TypeError):
+            pass
+    return dtype
+
+
 class FusionBufferManager:
     """Persistent fusion staging buffers — the analogue of the reference's
     one-per-(device, framework) buffer (fusion_buffer_manager.cc): lazily
@@ -172,7 +194,7 @@ class CollectiveBackend(ABC):
             return buf
         # fp16/bf16 buffers scale in fp32 to avoid precision loss
         # (reference: collective_operations.h:89-125 ScaleBuffer fp16 path).
-        if buf.dtype.itemsize <= 2 and buf.dtype.kind == "f":
+        if accum_dtype(buf.dtype) != buf.dtype:
             return (buf.astype(np.float32) * factor).astype(buf.dtype)
         if buf.dtype.kind in "iu":
             return (buf * factor).astype(buf.dtype)

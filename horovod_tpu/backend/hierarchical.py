@@ -65,7 +65,7 @@ class HierarchicalTcpBackend(CollectiveBackend):
     # -- allreduce: RS(local) -> AR(cross) -> AG(local) -------------------
     def allreduce(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:
-        from .tcp import _accum_dtype
+        from .base import accum_dtype as _accum_dtype
 
         buf = self.pack_fusion_buffer(response, entries)
         buf = self.scale_buffer(buf, response.prescale_factor)

@@ -1,0 +1,376 @@
+"""Shared-memory data plane for same-host worlds.
+
+The eager analogue of the reference's intra-node shared-memory paths —
+Gloo's shm transport and MPIHierarchicalAllgather's node-shared window
+(reference: horovod/common/ops/mpi_operations.cc) — rebuilt for the
+multi-process-per-host layout of TPU VM hosts: ranks that share a machine
+exchange bulk payloads through mmap'd /dev/shm regions instead of the TCP
+loopback ring, cutting per-byte work from ~6 copies (user→kernel→user each
+way plus staging) to ~3 (pack, reduce, copy-out) and roughly tripling
+effective allreduce bandwidth on localhost worlds.
+
+Protocol (per collective, lockstep across ranks — the identical-response-
+order invariant guarantees every rank runs the same op sequence):
+
+  wait all seq >= 3t      (peers finished reading my previous result)
+  pack payload into my region;            publish seq = 3t+1
+  wait all seq >= 3t+1    (everyone's payload visible)
+  reduce chunk `rank` across all regions; publish seq = 3t+2
+  wait all seq >= 3t+2    (all chunks reduced)
+  gather chunks from owners, unpack;      publish seq = 3t+3
+
+Sequence counters are 8-byte aligned words in each rank's region header;
+aligned word stores/loads are atomic on the host ISAs we target and mmap
+shared mappings are cache-coherent.  Liveness: each rank publishes its PID
+at formation and waiters poll peer PIDs, so a dead peer surfaces as a
+structured error in ~liveness-interval, not a transport timeout (SURVEY
+§5.2 "mismatch → structured error, not hang").
+"""
+from __future__ import annotations
+
+import mmap
+import os
+import time
+
+import numpy as np
+
+from ..common.dtypes import element_size, to_numpy
+from ..common.message import Response, ResponseType
+from ..common.status import Status
+from ..common.tensor_queue import TensorTableEntry
+from .base import CollectiveBackend, accum_dtype as _accum_dtype
+
+_HEADER = 4096          # one page: seq word + padding
+_SEQ_OFFSET = 0
+
+
+def _boot_fingerprint() -> str:
+    """Same-memory-domain fingerprint.  Hostname alone lies inside
+    containers sharing a hostname on one box; the kernel boot id pins the
+    machine, and the mount/IPC namespace inodes pin the /dev/shm tmpfs —
+    two containers on one host share a boot id but NOT a mount ns, and a
+    private /dev/shm must disqualify formation up front (the attach
+    verdict round below is the backstop)."""
+    parts = []
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            parts.append(f.read().strip())
+    except OSError:
+        parts.append("noboot")
+    for ns in ("mnt", "ipc"):
+        try:
+            parts.append(str(os.stat(f"/proc/self/ns/{ns}").st_ino))
+        except OSError:
+            parts.append("nons")
+    import socket
+    return socket.gethostname() + "." + ".".join(parts)
+
+
+def _tune_malloc() -> None:
+    """Keep multi-MB result buffers on the heap: glibc mmap()s allocations
+    above the default threshold and munmap()s them on free, so every
+    allreduce output repays ~4k page faults.  Raising the mmap/trim
+    thresholds lets freed gradient-sized buffers be reused fault-free
+    (measured: 16 MB op 17.9 ms -> 13.8 ms on one core).  Trade-off is
+    retained RSS up to the threshold — right for bulk-data workers."""
+    try:
+        import ctypes
+        libc = ctypes.CDLL("libc.so.6")
+        libc.mallopt(-3, 256 << 20)   # M_MMAP_THRESHOLD
+        libc.mallopt(-1, 256 << 20)   # M_TRIM_THRESHOLD
+    except Exception:  # noqa: BLE001 - musl/macOS: no mallopt
+        pass
+
+
+def _shm_dir() -> str | None:
+    for cand in ("/dev/shm", os.environ.get("TMPDIR", "/tmp")):
+        if cand and os.path.isdir(cand) and os.access(cand, os.W_OK):
+            return cand
+    return None
+
+
+class ShmWorld:
+    """mmap'd per-rank regions + sequence-word lockstep for one world.
+
+    Formation is collective through the rendezvous KV store and
+    UNANIMOUS: every rank publishes (fingerprint, shm-usable, pid) and
+    the world forms only if all ranks share one memory domain — so the
+    backend chain stays rank-symmetric without extra negotiation.
+    """
+
+    def __init__(self, rank: int, size: int, kv, scope: str,
+                 capacity: int, timeout: float = 30.0) -> None:
+        self.rank = rank
+        self.size = size
+        self.capacity = capacity
+        self.timeout = timeout
+        # Inter-op barrier deadline is deliberately MUCH larger than the
+        # formation timeout: a live-but-slow peer (rank-0 checkpointing,
+        # evaluation, CPU starvation) must not kill training — the 0.5 s
+        # PID-liveness poll is the fail-fast path for actual death, and
+        # one-sided submissions are the stall inspector's job upstream.
+        self.barrier_timeout = float(os.environ.get(
+            "HOROVOD_SHM_BARRIER_TIMEOUT_SECONDS", "600"))
+        self._maps: list[mmap.mmap | None] = [None] * size
+        self._seqs: list[np.ndarray | None] = [None] * size
+        self._datas: list[np.ndarray | None] = [None] * size
+        self._pids: list[int] = [0] * size
+        self._paths: list[str] = [""] * size
+        self.formed = False
+        self._t = 0
+
+        # Phase 1 — advertise (memory-domain fingerprint, capacity,
+        # usability, pid); unanimity on domain AND capacity is required:
+        # heterogeneous capacities would mmap past a smaller peer file
+        # (SIGBUS on first touch) or desync enabled() across ranks.
+        shm_dir = _shm_dir()
+        usable = shm_dir is not None
+        me = f"{_boot_fingerprint()}|{capacity}|{int(usable)}|{os.getpid()}"
+        kv.put(scope, f"peer:{rank}", me.encode())
+        peers = []
+        for r in range(size):
+            raw = kv.wait(scope, f"peer:{r}", timeout).decode()
+            fp, cap, ok, pid = raw.rsplit("|", 3)
+            peers.append((fp, int(cap), ok == "1", int(pid)))
+        if not all(ok for _, _, ok, _ in peers) or \
+                len({fp for fp, _, _, _ in peers}) != 1 or \
+                len({cap for _, cap, _, _ in peers}) != 1:
+            return   # not one memory domain: every rank skips unanimously
+
+        # Phase 2 — create + attach, crash-proof: every rank ALWAYS
+        # publishes a path (or "!") and then an attach verdict, so a
+        # filesystem surprise on one rank degrades the whole world to the
+        # TCP plane unanimously instead of crashing init or hanging peers.
+        self._pids = [pid for _, _, _, pid in peers]
+        attached = False
+        try:
+            path = os.path.join(shm_dir,
+                                f"hvd_{scope}_{rank}_{os.getpid()}")
+            try:   # stale region from a crashed same-pid predecessor
+                os.unlink(path)
+            except OSError:
+                pass
+            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                os.ftruncate(fd, _HEADER + capacity)
+                mm = mmap.mmap(fd, _HEADER + capacity)
+            finally:
+                os.close(fd)
+            self._own_path = path
+            self._attach(rank, mm, path)
+            kv.put(scope, f"path:{rank}", path.encode())
+        except OSError:
+            kv.put(scope, f"path:{rank}", b"!")
+        else:
+            try:
+                for r in range(size):
+                    if r == rank:
+                        continue
+                    rpath = kv.wait(scope, f"path:{r}", timeout).decode()
+                    if rpath == "!":
+                        raise OSError("peer region unavailable")
+                    fd = os.open(rpath, os.O_RDWR)
+                    try:
+                        mm = mmap.mmap(fd, _HEADER + capacity)
+                    finally:
+                        os.close(fd)
+                    self._attach(r, mm, rpath)
+                attached = True
+            except OSError:
+                attached = False
+
+        # Phase 3 — unanimous attach verdict.
+        kv.put(scope, f"att:{rank}", b"1" if attached else b"0")
+        all_attached = all(
+            kv.wait(scope, f"att:{r}", timeout) == b"1"
+            for r in range(size))
+        if not all_attached:
+            self.close()
+            return
+        _tune_malloc()
+        self.formed = True
+
+    def _attach(self, r: int, mm: mmap.mmap, path: str) -> None:
+        self._maps[r] = mm
+        self._paths[r] = path
+        self._seqs[r] = np.frombuffer(mm, dtype=np.uint64, count=1,
+                                      offset=_SEQ_OFFSET)
+        self._datas[r] = np.frombuffer(mm, dtype=np.uint8,
+                                       count=self.capacity, offset=_HEADER)
+
+    # -- lockstep ------------------------------------------------------
+    def publish(self, value: int) -> None:
+        self._seqs[self.rank][0] = value
+
+    def wait_all(self, target: int) -> None:
+        start = time.monotonic()
+        deadline = start + self.barrier_timeout
+        next_liveness = start + 0.5
+        while True:
+            if all(int(s[0]) >= target for s in self._seqs):  # type: ignore
+                return
+            now = time.monotonic()
+            if now >= next_liveness:
+                next_liveness = now + 0.5
+                for r, pid in enumerate(self._pids):
+                    if r == self.rank:
+                        continue
+                    try:
+                        os.kill(pid, 0)
+                    except OSError:
+                        raise ConnectionError(
+                            f"shm peer rank {r} (pid {pid}) died")
+                if now > deadline:
+                    raise TimeoutError(
+                        f"shm barrier target {target} not reached within "
+                        f"{self.barrier_timeout}s")
+            # Small-op barriers resolve within a scheduling quantum:
+            # yield-spin briefly.  Past that, the peer is mid-copy on a
+            # core we may share — REALLY sleep (escalating to 1 ms) so it
+            # gets whole quanta instead of alternating with our spin.
+            waited = now - start
+            if waited < 0.0003:
+                time.sleep(0)
+            else:
+                time.sleep(min(max(waited / 4, 0.0004), 0.001))
+
+    def data(self, r: int) -> np.ndarray:
+        return self._datas[r]   # type: ignore[return-value]
+
+    def close(self) -> None:
+        self._seqs = [None] * self.size
+        self._datas = [None] * self.size
+        for mm in self._maps:
+            if mm is not None:
+                try:
+                    mm.close()
+                except BufferError:   # outstanding views: leak, don't crash
+                    pass
+        self._maps = [None] * self.size
+        own = getattr(self, "_own_path", None)
+        if own:
+            try:
+                os.unlink(own)
+            except OSError:
+                pass
+
+
+class ShmBackend(CollectiveBackend):
+    """Same-host allreduce over a ShmWorld; everything else falls through
+    to the TCP/XLA planes via ``enabled()``."""
+
+    name = "shm"
+
+    def __init__(self, world: ShmWorld) -> None:
+        self.world = world
+        self.ops_executed = 0   # observability for tests/PERFORMANCE.md
+
+    def enabled(self, response: Response,
+                entries: list[TensorTableEntry]) -> bool:
+        if response.response_type != ResponseType.ALLREDUCE:
+            return False
+        nbytes = sum(response.tensor_sizes) * \
+            element_size(response.tensor_type)
+        return self.world.formed and nbytes <= self.world.capacity
+
+    def allreduce(self, response: Response,
+                  entries: list[TensorTableEntry]) -> Status:
+        w = self.world
+        rank, size = w.rank, w.size
+        np_dtype = to_numpy(response.tensor_type)
+        n = sum(response.tensor_sizes)
+        t = w._t
+        w._t += 1
+
+        # Peers must be done READING my previous result before I repack.
+        w.wait_all(3 * t)
+        my_region = w.data(rank)[:n * np_dtype.itemsize].view(np_dtype)
+        packed = self.pack_fusion_buffer(response, entries)
+        packed = self.scale_buffer(packed, response.prescale_factor)
+        my_region[:] = packed
+        w.publish(3 * t + 1)
+        nbytes = n * np_dtype.itemsize
+
+        if size == 2:
+            # Two ranks: one fused full-sum pass per rank beats the
+            # chunked reduce+gather (2 barriers instead of 3, 2n touched
+            # instead of 2.5n).
+            w.wait_all(3 * t + 1)
+            peer = w.data(1 - rank)[:nbytes].view(np_dtype)
+            out = self._full_sum(my_region, peer, np_dtype)
+            # 3t+2 and 3t+3 both published: peers wait on 3(t+1) before
+            # repacking, so the skipped middle barrier stays consistent
+            # with the general protocol.
+            w.publish(3 * t + 3)
+            out = self.scale_buffer(out, response.postscale_factor)
+            self.unpack_fusion_buffer(out, response, entries)
+            self.ops_executed += 1
+            return Status.ok()
+
+        # Reduce chunk `rank` across every rank's region (fp32 widening
+        # for 16-bit wire dtypes, one rounding at the end — the flat-ring
+        # numerics contract).
+        base, rem = divmod(n, size)
+        sizes = [base + (1 if i < rem else 0) for i in range(size)]
+        bounds = np.cumsum([0] + sizes)
+        lo, hi = int(bounds[rank]), int(bounds[rank + 1])
+        w.wait_all(3 * t + 1)
+        if hi > lo:
+            acc_dt = _accum_dtype(np_dtype)
+            mine = my_region[lo:hi]
+            if acc_dt is np_dtype:
+                # In-place accumulation into my chunk: peers only ever
+                # read their OWN chunk index from my region, never mine,
+                # so the read/write sets are disjoint.
+                for r in range(size):
+                    if r != rank:
+                        mine += w.data(r)[lo * np_dtype.itemsize:
+                                          hi * np_dtype.itemsize
+                                          ].view(np_dtype)
+            else:
+                # 16-bit wire dtypes: widen once, round once.
+                acc = mine.astype(acc_dt, copy=True)
+                for r in range(size):
+                    if r != rank:
+                        acc += w.data(r)[lo * np_dtype.itemsize:
+                                         hi * np_dtype.itemsize
+                                         ].view(np_dtype).astype(acc_dt)
+                mine[:] = acc.astype(np_dtype, copy=False)
+        w.publish(3 * t + 2)
+
+        # Gather the reduced chunks straight out of their owners' regions
+        # into a FRESH private array (the regions are recycled next op;
+        # entry outputs alias this array zero-copy and must outlive it).
+        w.wait_all(3 * t + 2)
+        out = np.empty(n, dtype=np_dtype)
+        for r in range(size):
+            rlo, rhi = int(bounds[r]), int(bounds[r + 1])
+            if rhi > rlo:
+                src = w.data(r)[rlo * np_dtype.itemsize:
+                                rhi * np_dtype.itemsize].view(np_dtype)
+                out[rlo:rhi] = src
+        w.publish(3 * t + 3)
+
+        out = self.scale_buffer(out, response.postscale_factor)
+        self.unpack_fusion_buffer(out, response, entries)
+        self.ops_executed += 1
+        return Status.ok()
+
+    @staticmethod
+    def _full_sum(a: np.ndarray, b: np.ndarray,
+                  np_dtype: np.dtype) -> np.ndarray:
+        acc_dt = _accum_dtype(np_dtype)
+        if acc_dt is np_dtype:
+            out = np.empty(a.shape, dtype=np_dtype)
+            np.add(a, b, out=out)
+            return out
+        return (a.astype(acc_dt) + b.astype(acc_dt)).astype(np_dtype)
+
+    def allgather(self, response, entries) -> Status:
+        return Status.unknown_error("shm backend only implements allreduce")
+
+    def broadcast(self, response, entries) -> Status:
+        return Status.unknown_error("shm backend only implements allreduce")
+
+    def alltoall(self, response, entries) -> Status:
+        return Status.unknown_error("shm backend only implements allreduce")

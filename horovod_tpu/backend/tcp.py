@@ -25,13 +25,7 @@ from ..common.status import Status
 from ..common.tensor_queue import TensorTableEntry
 from ..common.dtypes import to_numpy
 from ..runner.network import PeerMesh
-from .base import CollectiveBackend
-
-
-def _accum_dtype(dtype: np.dtype) -> np.dtype:
-    if dtype.kind == "f" and dtype.itemsize <= 2:
-        return np.dtype(np.float32)
-    return dtype
+from .base import CollectiveBackend, accum_dtype as _accum_dtype
 
 
 class TcpCollectives:

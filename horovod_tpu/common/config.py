@@ -69,6 +69,14 @@ HIERARCHICAL_ALLREDUCE = register(
 HIERARCHICAL_ALLGATHER = register(
     "HOROVOD_HIERARCHICAL_ALLGATHER", False, _parse_bool,
     "Two-level allgather over (ICI, DCN) axes.")
+SHM_OPERATIONS = register(
+    "HOROVOD_SHM_OPERATIONS", "auto", str,
+    "Same-host shared-memory data plane for eager allreduce: 1=require, "
+    "0=disable, auto=use when every rank shares one memory domain.")
+SHM_CAPACITY = register(
+    "HOROVOD_SHM_CAPACITY", 0, int,
+    "Per-rank shm region bytes (0 = max(fusion threshold, 64MB)); "
+    "payloads above it fall through to the TCP plane.")
 BATCH_D2D_MEMCOPIES = register(
     "HOROVOD_BATCH_D2D_MEMCOPIES", True, _parse_bool,
     "Fuse gather/scatter staging copies into batched device ops.")

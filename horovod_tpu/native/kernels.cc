@@ -18,6 +18,7 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <thread>
 #include <vector>
 
@@ -139,7 +140,25 @@ static int ring_allreduce_t(int send_fd, int recv_fd, T* buf, int64_t n,
     bounds[i + 1] = bounds[i] + base + (i < rem ? 1 : 0);
 
   int64_t max_chunk = base + (rem ? 1 : 0);
-  std::vector<T> incoming((size_t)max_chunk);
+  // Uninitialized staging (std::vector would memset a chunk-sized block
+  // per op — 32 MB of pure overhead on a 64 MB payload).
+  std::unique_ptr<T[]> incoming(new T[(size_t)max_chunk]);
+
+  // Inline-send ceiling: the lesser of 64 KB and half the smaller actual
+  // kernel buffer (the 4 MB SO_SNDBUF request in PeerMesh may have been
+  // clamped by tcp_wmem); a blocking sendall below this bound cannot
+  // deadlock the ring even when no peer is mid-recv.
+  size_t inline_max = 64 * 1024;
+  {
+    int sb = 0, rb = 0;
+    socklen_t sl = sizeof(sb);
+    if (getsockopt(send_fd, SOL_SOCKET, SO_SNDBUF, &sb, &sl) == 0 &&
+        getsockopt(recv_fd, SOL_SOCKET, SO_RCVBUF, &rb,
+                   (sl = sizeof(rb), &sl)) == 0) {
+      size_t floor_bytes = (size_t)(sb < rb ? sb : rb) / 2;
+      if (floor_bytes < inline_max) inline_max = floor_bytes;
+    }
+  }
 
   // Reduce-scatter, then allgather.  Concurrent send/recv per step so the
   // ring cannot deadlock on filled socket buffers.
@@ -162,22 +181,18 @@ static int ring_allreduce_t(int send_fd, int recv_fd, T* buf, int64_t n,
           (unsigned char)(send_bytes >> 24), (unsigned char)(send_bytes >> 16),
           (unsigned char)(send_bytes >> 8), (unsigned char)send_bytes};
 
-      // Small chunks: sequential send-then-recv. Every rank's send fits
-      // the kernel socket buffer (64 KB is under even Linux's default
-      // ~208 KB wmem, in case PeerMesh's 4 MB SO_SNDBUF request failed,
-      // and at most one chunk is in flight per step), so sendall cannot
-      // block — and skipping the per-step std::thread saves ~0.5 ms/op,
-      // which dominates small-tensor (cached-cycle) latency.  Large
-      // chunks keep the concurrent sender thread so the ring cannot
-      // deadlock on filled buffers.
-      constexpr size_t kInlineSendMax = 64 * 1024;
+      // Small chunks: sequential send-then-recv below the inline ceiling
+      // (skipping the per-step std::thread saves ~0.5 ms/op, which
+      // dominates small-tensor cached-cycle latency).  Large chunks keep
+      // the concurrent sender thread so the ring cannot deadlock on
+      // filled buffers.
       auto do_send = [&]() -> int {
         int rc = send_exact(send_fd, (const char*)send_hdr, 4);
         if (rc == 0) rc = send_exact(send_fd, send_ptr, send_bytes);
         return rc;
       };
       int send_rc_val = 0, recv_rc = -1;
-      bool threaded = send_bytes > kInlineSendMax;
+      bool threaded = send_bytes > inline_max;
       std::thread sender;
       if (threaded) {
         // join() below synchronizes the plain write.
@@ -194,20 +209,40 @@ static int ring_allreduce_t(int send_fd, int recv_fd, T* buf, int64_t n,
           size_t framed = ((size_t)recv_hdr[0] << 24) |
                           ((size_t)recv_hdr[1] << 16) |
                           ((size_t)recv_hdr[2] << 8) | (size_t)recv_hdr[3];
-          recv_rc =
-              framed == recv_bytes
-                  ? recv_exact(recv_fd, (char*)incoming.data(), recv_bytes)
-                  : -1;  // peer desync: fail loudly, never misparse
+          if (framed != recv_bytes) {
+            recv_rc = -1;  // peer desync: fail loudly, never misparse
+          } else if (phase == 0) {
+            // PIPELINED reduce: consume the incoming chunk in ~256 KB
+            // segments, adding each into the accumulator while the NIC
+            // (and the peer's sender) stream the next segment into the
+            // kernel buffer — on a real network the adds ride entirely
+            // inside the transfer time instead of serializing after it.
+            constexpr size_t kSeg = 256 * 1024;
+            T* dst = buf + bounds[recv_idx];
+            size_t done = 0;
+            recv_rc = 0;
+            while (done < recv_bytes && recv_rc == 0) {
+              size_t seg = recv_bytes - done;
+              if (seg > kSeg) seg = kSeg;
+              recv_rc = recv_exact(
+                  recv_fd, (char*)incoming.get() + done, seg);
+              if (recv_rc == 0) {
+                add_into(dst + done / sizeof(T),
+                         (const T*)((const char*)incoming.get() + done),
+                         (int64_t)(seg / sizeof(T)));
+                done += seg;
+              }
+            }
+          } else {
+            // Allgather phase: no compute to overlap; one bulk recv
+            // straight into place (no staging copy).
+            recv_rc = recv_exact(recv_fd, (char*)(buf + bounds[recv_idx]),
+                                 recv_bytes);
+          }
         }
       }
       if (threaded) sender.join();
       if (send_rc_val != 0 || recv_rc != 0) return -1;
-
-      if (phase == 0) {
-        add_into(buf + bounds[recv_idx], incoming.data(), recv_elems);
-      } else {
-        std::memcpy(buf + bounds[recv_idx], incoming.data(), recv_bytes);
-      }
     }
   }
   return 0;

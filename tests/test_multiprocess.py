@@ -81,6 +81,14 @@ def test_join_uneven_data():
     _run_world(2, "join")
 
 
+@pytest.mark.parametrize("size", [2, 3])
+def test_shm_data_plane(size):
+    """Same-host shared-memory allreduce plane: selection, flat-path
+    results, capacity fall-through, mixed-op lockstep (size 3 exercises
+    the chunked reduce, size 2 the fused-sum fast path)."""
+    _run_world(size, "shm", timeout=120.0)
+
+
 def test_hierarchical_collectives():
     """Eager two-level allreduce/allgather over local/cross sub-meshes:
     4 ranks as 2 hosts x 2 slots (VERDICT r3 item 3; reference:
