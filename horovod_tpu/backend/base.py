@@ -69,6 +69,21 @@ class CollectiveBackend(ABC):
     """One data-plane implementation of the collective ops."""
 
     name = "abstract"
+    # Attached by core.init so ops can emit sub-activity spans
+    # (MEMCPY_IN_FUSION_BUFFER / <PLANE>_<OP> / MEMCPY_OUT_FUSION_BUFFER —
+    # reference: timeline activities emitted from inside ops, e.g.
+    # nccl_operations.cc:143).
+    timeline = None
+
+    def _act_start(self, entries, activity: str) -> None:
+        tl = self.timeline
+        if tl is not None and tl.enabled:
+            tl.activity_start_all(entries, activity)
+
+    def _act_end(self, entries) -> None:
+        tl = self.timeline
+        if tl is not None and tl.enabled:
+            tl.activity_end_all(entries)
 
     @property
     def fusion_buffers(self) -> FusionBufferManager:
@@ -141,20 +156,24 @@ class CollectiveBackend(ABC):
                 parts.append(np.ascontiguousarray(
                     np.asarray(e.tensor, dtype=np_dtype)).reshape(-1))
         sizes = list(response.tensor_sizes)
-        fused = self.fusion_buffers.get("pack", np_dtype, sum(sizes))
-        from .. import native
-        if native.pack(parts, sizes, np_dtype, out=fused) is not None:
+        self._act_start(entries, "MEMCPY_IN_FUSION_BUFFER")
+        try:
+            fused = self.fusion_buffers.get("pack", np_dtype, sum(sizes))
+            from .. import native
+            if native.pack(parts, sizes, np_dtype, out=fused) is not None:
+                return fused
+            offset = 0
+            for i, p in enumerate(parts):
+                n = sizes[i]
+                view = fused[offset:offset + n]
+                if p is None:
+                    view[:] = 0
+                else:
+                    view[:] = p
+                offset += n
             return fused
-        offset = 0
-        for i, p in enumerate(parts):
-            n = sizes[i]
-            view = fused[offset:offset + n]
-            if p is None:
-                view[:] = 0
-            else:
-                view[:] = p
-            offset += n
-        return fused
+        finally:
+            self._act_end(entries)
 
     def unpack_fusion_buffer(self, buf: np.ndarray, response: Response,
                              entries: list[TensorTableEntry]) -> None:
@@ -163,6 +182,8 @@ class CollectiveBackend(ABC):
         out (the next cycle reuses the buffer); fresh backend results are
         sliced zero-copy."""
         owned = self.fusion_buffers.owns(buf)
+        if len(entries) > 1:
+            self._act_start(entries, "MEMCPY_OUT_FUSION_BUFFER")
         offset = 0
         for i, e in enumerate(entries):
             n = response.tensor_sizes[i]
@@ -174,6 +195,8 @@ class CollectiveBackend(ABC):
             else:
                 out = chunk
             e.output = out.copy() if owned else out
+        if len(entries) > 1:
+            self._act_end(entries)
 
     @staticmethod
     def resolve_alltoall_splits(entry: TensorTableEntry, dim0: int,

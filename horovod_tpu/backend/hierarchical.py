@@ -85,7 +85,11 @@ class HierarchicalTcpBackend(CollectiveBackend):
 
         # Leg 1: reduce-scatter across the local (intra-host) mesh; this
         # rank ends up owning the fully host-reduced shard local_rank.
-        shard = self.local.reduce_scatter(buf, bounds)
+        self._act_start(entries, "LOCAL_REDUCESCATTER")
+        try:
+            shard = self.local.reduce_scatter(buf, bounds)
+        finally:
+            self._act_end(entries)
         self.leg_ops["local_rs"] += 1
         self.leg_bytes["local_rs"] += nbytes
 
@@ -94,13 +98,21 @@ class HierarchicalTcpBackend(CollectiveBackend):
         # exactly the set of peers sharing this shard).  Only 1/local_size
         # of the payload crosses the slow axis — the point of the schedule.
         if shard.size:
-            shard = self.cross.allreduce(np.ascontiguousarray(shard))
+            self._act_start(entries, "CROSS_ALLREDUCE")
+            try:
+                shard = self.cross.allreduce(np.ascontiguousarray(shard))
+            finally:
+                self._act_end(entries)
         self.leg_ops["cross_ar"] += 1
         self.leg_bytes["cross_ar"] += \
             shard.size * wire_dtype.itemsize  # analytic wire volume
 
         # Leg 3: allgather the reduced shards back across the local mesh.
-        full = self.local.allgatherv(shard.reshape(-1), sizes)
+        self._act_start(entries, "LOCAL_ALLGATHER")
+        try:
+            full = self.local.allgatherv(shard.reshape(-1), sizes)
+        finally:
+            self._act_end(entries)
         self.leg_ops["local_ag"] += 1
         self.leg_bytes["local_ag"] += nbytes
 

@@ -187,6 +187,15 @@ class ShmWorld:
         if not all_attached:
             self.close()
             return
+        # Every peer holds an mmap now: unlink the file immediately so the
+        # region becomes anonymous — a SIGKILLed job cannot leak
+        # capacity-sized tmpfs files (the kernel frees the pages when the
+        # last mapping dies with the processes).
+        try:
+            os.unlink(self._own_path)
+        except OSError:
+            pass
+        self._own_path = ""
         _tune_malloc()
         self.formed = True
 
@@ -275,12 +284,21 @@ class ShmBackend(CollectiveBackend):
 
     def allreduce(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:
+        t = self.world._t
+        self.world._t += 1
+        self._act_start(entries, "SHM_ALLREDUCE")
+        try:
+            return self._allreduce_locked(response, entries, t)
+        finally:
+            self._act_end(entries)
+
+    def _allreduce_locked(self, response: Response,
+                          entries: list[TensorTableEntry],
+                          t: int) -> Status:
         w = self.world
         rank, size = w.rank, w.size
         np_dtype = to_numpy(response.tensor_type)
         n = sum(response.tensor_sizes)
-        t = w._t
-        w._t += 1
 
         # Peers must be done READING my previous result before I repack.
         w.wait_all(3 * t)

@@ -223,46 +223,72 @@ class TcpBackend(CollectiveBackend):
             # per-layer dot products even inside fused buffers
             # (adasum.h:38-552), so a fused response must not mix norms
             # across tensor boundaries — run VHDD per segment.
-            offset, parts = 0, []
-            for n in response.tensor_sizes:
-                parts.append(adasum_tcp(self.coll, buf[offset:offset + n]))
-                offset += n
-            buf = np.concatenate(parts) if len(parts) > 1 else parts[0]
+            self._act_start(entries, "TCP_ADASUM")
+            try:
+                offset, parts = 0, []
+                for n in response.tensor_sizes:
+                    parts.append(adasum_tcp(self.coll,
+                                            buf[offset:offset + n]))
+                    offset += n
+                buf = np.concatenate(parts) if len(parts) > 1 else parts[0]
+            finally:
+                self._act_end(entries)
         else:
-            buf = self.coll.allreduce(buf)
+            self._act_start(entries, "TCP_RING_ALLREDUCE")
+            try:
+                buf = self.coll.allreduce(buf)
+            finally:
+                self._act_end(entries)
         buf = self.scale_buffer(buf, response.postscale_factor)
         self.unpack_fusion_buffer(buf, response, entries)
         return Status.ok()
 
     def allgather(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:
-        for e in entries:
-            local = np.asarray(e.tensor, dtype=to_numpy(response.tensor_type))
-            e.output = self.coll.allgatherv(local, response.tensor_sizes)
-        return Status.ok()
+        self._act_start(entries, "TCP_ALLGATHERV")
+        try:
+            for e in entries:
+                local = np.asarray(e.tensor,
+                                   dtype=to_numpy(response.tensor_type))
+                e.output = self.coll.allgatherv(local,
+                                                response.tensor_sizes)
+            return Status.ok()
+        finally:
+            self._act_end(entries)
 
     def broadcast(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:
         dtype = to_numpy(response.tensor_type)
-        for e in entries:
-            local = None if e.tensor is None else np.asarray(e.tensor,
-                                                             dtype=dtype)
-            shape = local.shape if local is not None else ()
-            e.output = self.coll.broadcast(local, response.root_rank,
-                                           response.tensor_sizes[0]
-                                           * dtype.itemsize, dtype, shape)
-        return Status.ok()
+        self._act_start(entries, "TCP_BCAST")
+        try:
+            for e in entries:
+                local = None if e.tensor is None else \
+                    np.asarray(e.tensor, dtype=dtype)
+                shape = local.shape if local is not None else ()
+                e.output = self.coll.broadcast(local, response.root_rank,
+                                               response.tensor_sizes[0]
+                                               * dtype.itemsize, dtype,
+                                               shape)
+            return Status.ok()
+        finally:
+            self._act_end(entries)
 
     def alltoall(self, response: Response,
                  entries: list[TensorTableEntry]) -> Status:
-        for e in entries:
-            local = np.asarray(e.tensor, dtype=to_numpy(response.tensor_type))
-            splits = self.resolve_alltoall_splits(e, local.shape[0],
-                                                  self.coll.size)
-            if isinstance(splits, Status):
-                return splits
-            e.output, e.received_splits = self.coll.alltoallv(local, splits)
-        return Status.ok()
+        self._act_start(entries, "TCP_ALLTOALLV")
+        try:
+            for e in entries:
+                local = np.asarray(e.tensor,
+                                   dtype=to_numpy(response.tensor_type))
+                splits = self.resolve_alltoall_splits(e, local.shape[0],
+                                                      self.coll.size)
+                if isinstance(splits, Status):
+                    return splits
+                e.output, e.received_splits = self.coll.alltoallv(local,
+                                                                  splits)
+            return Status.ok()
+        finally:
+            self._act_end(entries)
 
     def reducescatter(self, response: Response,
                       entries: list[TensorTableEntry]) -> Status:
@@ -274,7 +300,20 @@ class TcpBackend(CollectiveBackend):
             # Multi-entry responses keep ONE fused ring (2(N-1) rounds on
             # the whole buffer) instead of a latency-bound ring per
             # tensor; byte volume doubles but round count stays constant.
-            return self._reducescatter_fused(response, entries)
+            self._act_start(entries, "TCP_RING_ALLREDUCE")
+            try:
+                return self._reducescatter_fused(response, entries)
+            finally:
+                self._act_end(entries)
+        self._act_start(entries, "TCP_RING_REDUCESCATTER")
+        try:
+            return self._reducescatter_single(response, entries, size)
+        finally:
+            self._act_end(entries)
+
+    def _reducescatter_single(self, response: Response,
+                              entries: list[TensorTableEntry],
+                              size: int) -> Status:
         for e in entries:
             local = np.ascontiguousarray(
                 np.asarray(e.tensor, dtype=to_numpy(response.tensor_type)))

@@ -368,13 +368,24 @@ class XlaBackend(CollectiveBackend):
                   entries: list[TensorTableEntry]) -> Status:
         buf = self.pack_fusion_buffer(response, entries)
         buf = self.scale_buffer(buf, response.prescale_factor)
-        buf = self.comm.allreduce(np.ascontiguousarray(buf))
+        self._act_start(entries, "XLA_ALLREDUCE")
+        try:
+            buf = self.comm.allreduce(np.ascontiguousarray(buf))
+        finally:
+            self._act_end(entries)
         buf = self.scale_buffer(buf, response.postscale_factor)
         self.unpack_fusion_buffer(buf, response, entries)
         return Status.ok()
 
     def broadcast(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:
+        self._act_start(entries, "XLA_BCAST")
+        try:
+            return self._broadcast_traced(response, entries)
+        finally:
+            self._act_end(entries)
+
+    def _broadcast_traced(self, response, entries) -> Status:
         from ..common.dtypes import to_numpy
         dtype = np.dtype(to_numpy(response.tensor_type))
         for i, e in enumerate(entries):
@@ -394,37 +405,53 @@ class XlaBackend(CollectiveBackend):
     def allgather(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:
         from ..common.dtypes import to_numpy
-        dtype = np.dtype(to_numpy(response.tensor_type))
-        first_dims = list(response.tensor_sizes)
-        for e in entries:
-            local = np.ascontiguousarray(np.asarray(e.tensor, dtype=dtype))
-            e.output = self.comm.allgatherv(local, first_dims)
-        return Status.ok()
+        self._act_start(entries, "XLA_ALLGATHER")
+        try:
+            dtype = np.dtype(to_numpy(response.tensor_type))
+            first_dims = list(response.tensor_sizes)
+            for e in entries:
+                local = np.ascontiguousarray(
+                    np.asarray(e.tensor, dtype=dtype))
+                e.output = self.comm.allgatherv(local, first_dims)
+            return Status.ok()
+        finally:
+            self._act_end(entries)
 
     def alltoall(self, response: Response,
                  entries: list[TensorTableEntry]) -> Status:
         from ..common.dtypes import to_numpy
-        dtype = np.dtype(to_numpy(response.tensor_type))
-        for e in entries:
-            local = np.ascontiguousarray(np.asarray(e.tensor, dtype=dtype))
-            splits = self.resolve_alltoall_splits(e, local.shape[0],
-                                                  self.world_size)
-            if isinstance(splits, Status):
-                return splits
-            e.output, e.received_splits = self.comm.alltoallv(local, splits)
-        return Status.ok()
+        self._act_start(entries, "XLA_ALLTOALL")
+        try:
+            dtype = np.dtype(to_numpy(response.tensor_type))
+            for e in entries:
+                local = np.ascontiguousarray(
+                    np.asarray(e.tensor, dtype=dtype))
+                splits = self.resolve_alltoall_splits(e, local.shape[0],
+                                                      self.world_size)
+                if isinstance(splits, Status):
+                    return splits
+                e.output, e.received_splits = self.comm.alltoallv(local,
+                                                                  splits)
+            return Status.ok()
+        finally:
+            self._act_end(entries)
 
     def reducescatter(self, response: Response,
                       entries: list[TensorTableEntry]) -> Status:
         from ..common.dtypes import to_numpy
-        dtype = np.dtype(to_numpy(response.tensor_type))
-        prescale = response.prescale_factor
-        postscale = response.postscale_factor
-        for e in entries:
-            local = np.ascontiguousarray(np.asarray(e.tensor, dtype=dtype))
-            buf = self.scale_buffer(local.reshape(-1),
-                                    prescale).reshape(local.shape)
-            out = self.comm.reducescatter(buf)
-            e.output = self.scale_buffer(out.reshape(-1),
-                                         postscale).reshape(out.shape)
-        return Status.ok()
+        self._act_start(entries, "XLA_REDUCESCATTER")
+        try:
+            dtype = np.dtype(to_numpy(response.tensor_type))
+            prescale = response.prescale_factor
+            postscale = response.postscale_factor
+            for e in entries:
+                local = np.ascontiguousarray(
+                    np.asarray(e.tensor, dtype=dtype))
+                buf = self.scale_buffer(local.reshape(-1),
+                                        prescale).reshape(local.shape)
+                out = self.comm.reducescatter(buf)
+                e.output = self.scale_buffer(out.reshape(-1),
+                                             postscale).reshape(out.shape)
+            return Status.ok()
+        finally:
+            self._act_end(entries)

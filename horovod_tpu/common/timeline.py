@@ -25,6 +25,19 @@ class Timeline:
         self._file = None
         self._start = time.monotonic()
         self._tensor_tids: dict[str, int] = {}
+        # Per-tensor negotiation state (the reference's per-tensor phase
+        # machine, timeline.cc): a request resubmitted across cycles —
+        # e.g. a local cache hit whose bit didn't survive the global AND
+        # and was pushed back to the queue — must not open a second
+        # NEGOTIATE span, and a joined rank's stand-in entry (which never
+        # negotiated here) must not emit an unmatched end.
+        self._negotiating: set[str] = set()
+        # Per-tensor count of OPEN activity spans: an activity_end whose
+        # matching start was suppressed (timeline off at the time, e.g. a
+        # dynamic start_timeline() mid-collective) must not emit an
+        # unmatched 'E' — the guard lives here so every call site (core
+        # and all backends) inherits it.
+        self._open_acts: dict[str, int] = {}
         self._lock = threading.Lock()
         if path and path != "DYNAMIC":
             self.start(path)
@@ -36,6 +49,12 @@ class Timeline:
         with self._lock:
             if self._active:
                 return
+            # Fresh file: reset per-tensor state so lanes re-emit their
+            # thread_name metadata and no phase state leaks from a
+            # previous recording window.
+            self._negotiating.clear()
+            self._open_acts.clear()
+            self._tensor_tids.clear()
             self._path = path
             self._file = open(path, "w")
             self._file.write("[\n")
@@ -54,6 +73,8 @@ class Timeline:
             self._queue.put({"name": "end", "ph": "i", "ts": self._ts(),
                              "pid": 0, "s": "g"})
             self._active = False
+            self._negotiating.clear()
+            self._open_acts.clear()
             self._queue.put(None)
         if self._writer is not None:
             self._writer.join(timeout=5)
@@ -84,30 +105,54 @@ class Timeline:
             self._queue.put(event)
 
     def negotiate_start(self, tensor_name: str, request_type) -> None:
-        if not self._active:
+        if not self._active or tensor_name in self._negotiating:
             return
+        self._negotiating.add(tensor_name)
         name = getattr(request_type, "name", str(request_type))
         self._emit({"name": f"NEGOTIATE_{name}", "ph": "B",
                     "ts": self._ts(), "pid": 0,
                     "tid": self._tid(tensor_name)})
 
     def negotiate_end(self, tensor_name: str) -> None:
-        if not self._active:
+        if not self._active or tensor_name not in self._negotiating:
             return
+        self._negotiating.discard(tensor_name)
         self._emit({"name": "", "ph": "E", "ts": self._ts(), "pid": 0,
                     "tid": self._tid(tensor_name)})
 
     def activity_start(self, tensor_name: str, activity: str) -> None:
         if not self._active:
             return
+        self._open_acts[tensor_name] = \
+            self._open_acts.get(tensor_name, 0) + 1
         self._emit({"name": activity, "ph": "B", "ts": self._ts(),
                     "pid": 0, "tid": self._tid(tensor_name)})
 
     def activity_end(self, tensor_name: str) -> None:
         if not self._active:
             return
+        count = self._open_acts.get(tensor_name, 0)
+        if count <= 0:
+            return   # matching start was suppressed: drop the end too
+        self._open_acts[tensor_name] = count - 1
         self._emit({"name": "", "ph": "E", "ts": self._ts(), "pid": 0,
                     "tid": self._tid(tensor_name)})
+
+    def activity_start_all(self, entries, activity: str) -> None:
+        """Open one ``activity`` span per entry of a (possibly fused)
+        response — the reference's ActivityStartAll (timeline.cc), called
+        from inside ops so pack/collective/unpack phases are separable in
+        the trace."""
+        if not self._active:
+            return
+        for e in entries:
+            self.activity_start(e.tensor_name, activity)
+
+    def activity_end_all(self, entries) -> None:
+        if not self._active:
+            return
+        for e in entries:
+            self.activity_end(e.tensor_name)
 
     def mark_cycle(self) -> None:
         if self._active and self._mark_cycles:

@@ -324,6 +324,8 @@ def init(*, rank: int | None = None, size: int | None = None,
             local_rank=local_rank, local_size=local_size,
             cross_rank=cross_rank, cross_size=cross_size,
             timeline=_global.timeline)
+        for backend in backends:
+            backend.timeline = _global.timeline
         _global.op_manager = OperationManager(backends)
 
         if config.AUTOTUNE.get():
