@@ -137,6 +137,12 @@ class ElasticDriver:
         """Size of the most recently formed world (0 before any round)."""
         return len(self._assignments)
 
+    @property
+    def current_epoch(self) -> int:
+        """Epoch of the most recently formed round."""
+        with self._round_cond:
+            return self._epoch
+
     # ------------------------------------------------------------------
     # Round formation / rank assignment
     # ------------------------------------------------------------------

@@ -130,12 +130,17 @@ def launch_elastic(args, command: list[str], *,
     def _done(rc: int):
         if not collect_results:
             return rc
-        # Read per-final-rank outcomes BEFORE the rendezvous stops.
+        # Read per-final-rank outcomes BEFORE the rendezvous stops; keys
+        # are epoch-qualified so a stale result from an earlier round's
+        # incarnation of a rank is never misattributed to the final round
+        # (it would otherwise defeat the caller's "ranks returned no
+        # result" guard).
         from ..runner.elastic_run_worker import RESULT_SCOPE
         world = driver.world_size()
+        epoch = driver.current_epoch
         fn_results = {}
         for rank in range(world):
-            blob = rendezvous.get(RESULT_SCOPE, str(rank))
+            blob = rendezvous.get(RESULT_SCOPE, f"{epoch}:{rank}")
             if blob is not None:
                 import pickle
                 fn_results[rank] = pickle.loads(blob)

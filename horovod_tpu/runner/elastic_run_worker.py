@@ -53,13 +53,18 @@ def main() -> int:
         outcome, rc = (True, result), 0
     except BaseException:  # noqa: BLE001 — ship the traceback to the parent
         outcome, rc = (False, traceback.format_exc()), 1
-    # HOROVOD_RANK reflects the latest elastic assignment (elastic/run.py
-    # _apply_assignment re-exports it each round). A worker that failed
-    # BEFORE receiving any assignment must not publish — a fallback key
-    # would clobber/misattribute the real rank 0's outcome; its nonzero
-    # exit reaches the driver's results instead.
+    # HOROVOD_RANK/RENDEZVOUS_EPOCH reflect the latest elastic assignment
+    # (elastic/run.py _apply_assignment re-exports them each round). A
+    # worker that failed BEFORE receiving any assignment must not publish
+    # — a fallback key would clobber/misattribute the real rank 0's
+    # outcome; its nonzero exit reaches the driver's results instead.
+    # The key carries the epoch so a result published by an EARLIER
+    # round's incarnation of rank r (killed before the final round) can
+    # never masquerade as the final round's rank-r outcome.
     if assigned or "HOROVOD_RANK" in os.environ:
-        kv.put(RESULT_SCOPE, os.environ["HOROVOD_RANK"],
+        epoch = os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", "0")
+        kv.put(RESULT_SCOPE,
+               f"{epoch}:{os.environ['HOROVOD_RANK']}",
                pickle.dumps(outcome))
     return rc
 

@@ -71,10 +71,18 @@ def build_mpi_command(command: list[str], *, np: int,
         "intel": _INTEL_FLAGS,
     }.get(mpi_flavor, _OMPI_FLAGS)
 
-    cmd = ["mpirun", "--allow-run-as-root", "-np", str(np)]
+    # 'unknown' (version probe failed/unparseable) keeps the OpenMPI
+    # treatment throughout — matching the impl_flags fallback above.
+    ompi_style = mpi_flavor not in ("mpich", "intel")
+    cmd = ["mpirun"]
+    if ompi_style:
+        # OpenMPI-only flag: mpich/intel Hydra mpirun rejects it and
+        # would fail at launch (advisor finding).
+        cmd.append("--allow-run-as-root")
+    cmd += ["-np", str(np)]
     if hosts:
         cmd += ["-H", hosts]
-    if mpi_flavor in ("openmpi", "spectrum"):
+    if ompi_style:
         cmd += _NO_BINDING_ARGS
         cmd += impl_flags
         if ssh_port:

@@ -410,6 +410,25 @@ class TestMpiLauncher:
         assert "MPI_RANK0_OK" in proc.stdout
         assert "MPI_RANK1_OK" in proc.stdout
 
+    def test_build_command_flavor_flags(self):
+        """--allow-run-as-root is OpenMPI/Spectrum-only: mpich/intel Hydra
+        mpirun rejects it at launch (advisor finding); env export style
+        also differs per flavor."""
+        from horovod_tpu.runner.mpi_run import build_mpi_command
+
+        # 'unknown' (failed version probe) keeps the OpenMPI treatment.
+        for flavor in ("openmpi", "spectrum", "unknown"):
+            cmd = build_mpi_command(["python", "x.py"], np=2,
+                                    mpi_flavor=flavor, env={})
+            assert "--allow-run-as-root" in cmd, (flavor, cmd)
+            assert "-genvlist" not in cmd
+        for flavor in ("mpich", "intel"):
+            cmd = build_mpi_command(["python", "x.py"], np=2,
+                                    mpi_flavor=flavor,
+                                    env={"HOROVOD_RANK": "0"})
+            assert "--allow-run-as-root" not in cmd, (flavor, cmd)
+            assert "-genvlist" in cmd
+
     def test_use_mpi_without_mpirun_errors(self, tmp_path, monkeypatch):
         monkeypatch.setenv("PATH", str(tmp_path))   # no mpirun here
         from horovod_tpu.runner import launch
