@@ -143,6 +143,12 @@ class ElasticDriver:
         with self._round_cond:
             return self._epoch
 
+    def final_slots(self) -> dict[int, str]:
+        """rank -> "host[local_rank]" of the most recently formed round."""
+        with self._round_cond:
+            return {s.rank: f"{s.hostname}[{s.local_rank}]"
+                    for s in self._assignments.values()}
+
     # ------------------------------------------------------------------
     # Round formation / rank assignment
     # ------------------------------------------------------------------

@@ -134,16 +134,31 @@ def launch_elastic(args, command: list[str], *,
         # are epoch-qualified so a stale result from an earlier round's
         # incarnation of a rank is never misattributed to the final round
         # (it would otherwise defeat the caller's "ranks returned no
-        # result" guard).
+        # result" guard).  A result may legitimately sit one or more
+        # epochs BEHIND the final round — a worker's success can race the
+        # final round forming — so earlier epochs are accepted when the
+        # publishing slot provably IS the final round's slot for that
+        # rank and that slot's process exited cleanly.
+        import pickle
+
         from ..runner.elastic_run_worker import RESULT_SCOPE
         world = driver.world_size()
-        epoch = driver.current_epoch
+        final_epoch = driver.current_epoch
+        slots = driver.final_slots()
+        exit_codes = {name: code
+                      for name, (code, _) in driver.get_results().items()}
         fn_results = {}
         for rank in range(world):
-            blob = rendezvous.get(RESULT_SCOPE, f"{epoch}:{rank}")
-            if blob is not None:
-                import pickle
-                fn_results[rank] = pickle.loads(blob)
+            for epoch in range(final_epoch, 0, -1):
+                blob = rendezvous.get(RESULT_SCOPE, f"{epoch}:{rank}")
+                if blob is None:
+                    continue
+                outcome, slot = pickle.loads(blob)
+                if epoch == final_epoch or (
+                        slot == slots.get(rank)
+                        and exit_codes.get(slot, 1) == 0):
+                    fn_results[rank] = outcome
+                break   # nearer epochs take precedence; stop at first hit
         return rc, fn_results, world
 
     try:

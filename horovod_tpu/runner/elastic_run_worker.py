@@ -63,9 +63,15 @@ def main() -> int:
     # never masquerade as the final round's rank-r outcome.
     if assigned or "HOROVOD_RANK" in os.environ:
         epoch = os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", "0")
+        # The payload carries the publishing SLOT so the launcher can
+        # accept an earlier-epoch result only when it provably belongs to
+        # the final round's incarnation of the rank (a success can race
+        # the final round forming, landing one epoch behind).
+        slot = (f"{os.environ.get('HOROVOD_HOSTNAME', '')}"
+                f"[{os.environ.get('HOROVOD_LOCAL_RANK', '')}]")
         kv.put(RESULT_SCOPE,
                f"{epoch}:{os.environ['HOROVOD_RANK']}",
-               pickle.dumps(outcome))
+               pickle.dumps((outcome, slot)))
     return rc
 
 

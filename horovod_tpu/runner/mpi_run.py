@@ -81,7 +81,9 @@ def build_mpi_command(command: list[str], *, np: int,
         cmd.append("--allow-run-as-root")
     cmd += ["-np", str(np)]
     if hosts:
-        cmd += ["-H", hosts]
+        # OpenMPI takes -H host:slots; Hydra (mpich/intel) spells the
+        # same list -hosts and rejects -H outright.
+        cmd += ["-H" if ompi_style else "-hosts", hosts]
     if ompi_style:
         cmd += _NO_BINDING_ARGS
         cmd += impl_flags

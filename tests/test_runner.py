@@ -419,15 +419,20 @@ class TestMpiLauncher:
         # 'unknown' (failed version probe) keeps the OpenMPI treatment.
         for flavor in ("openmpi", "spectrum", "unknown"):
             cmd = build_mpi_command(["python", "x.py"], np=2,
+                                    hosts="h1:1,h2:1",
                                     mpi_flavor=flavor, env={})
             assert "--allow-run-as-root" in cmd, (flavor, cmd)
             assert "-genvlist" not in cmd
+            assert "-H" in cmd and "-hosts" not in cmd, (flavor, cmd)
         for flavor in ("mpich", "intel"):
             cmd = build_mpi_command(["python", "x.py"], np=2,
+                                    hosts="h1:1,h2:1",
                                     mpi_flavor=flavor,
                                     env={"HOROVOD_RANK": "0"})
             assert "--allow-run-as-root" not in cmd, (flavor, cmd)
             assert "-genvlist" in cmd
+            # Hydra spells the host list -hosts and rejects -H.
+            assert "-hosts" in cmd and "-H" not in cmd, (flavor, cmd)
 
     def test_use_mpi_without_mpirun_errors(self, tmp_path, monkeypatch):
         monkeypatch.setenv("PATH", str(tmp_path))   # no mpirun here
