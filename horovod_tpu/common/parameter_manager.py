@@ -33,7 +33,8 @@ class ParameterManager:
         self._log_path = config.AUTOTUNE_LOG.get()
         if self._log_path and active:
             with open(self._log_path, "w") as f:
-                f.write("timestamp,fusion_threshold,cycle_time_ms,score\n")
+                f.write("timestamp,fusion_threshold,cycle_time_ms,score,"
+                        "event\n")
 
         self._steps = 0
         self._bytes = 0
@@ -74,6 +75,8 @@ class ParameterManager:
             (log_thr, cycle), best_score = best
             self._propose(2.0 ** log_thr, cycle)
             self._done = True
+            self._log(2.0 ** log_thr, cycle, best_score,
+                      event="converged")
             logger.info(
                 "autotune converged: fusion_threshold=%d cycle_time=%.1fms "
                 "(%.1f MB/s)", int(2.0 ** log_thr), cycle,
@@ -90,7 +93,9 @@ class ParameterManager:
         self._controller.pending_tuned_params = (int(threshold),
                                                  float(cycle_ms))
 
-    def _log(self, threshold: float, cycle: float, score: float) -> None:
+    def _log(self, threshold: float, cycle: float, score: float,
+             event: str = "sample") -> None:
         if self._log_path:
             with open(self._log_path, "a") as f:
-                f.write(f"{time.time()},{int(threshold)},{cycle},{score}\n")
+                f.write(f"{time.time()},{int(threshold)},{cycle},{score},"
+                        f"{event}\n")

@@ -149,7 +149,12 @@ def launch_elastic(args, command: list[str], *,
                       for name, (code, _) in driver.get_results().items()}
         fn_results = {}
         for rank in range(world):
-            for epoch in range(final_epoch, 0, -1):
+            # Bounded lookback: the success-vs-round-formation race spans
+            # adjacent rounds, and acceptance needs the final round's
+            # exact slot anyway — scanning all history would make
+            # teardown O(epochs x world) HTTP gets for ranks that died
+            # without publishing.
+            for epoch in range(final_epoch, max(final_epoch - 3, 0), -1):
                 blob = rendezvous.get(RESULT_SCOPE, f"{epoch}:{rank}")
                 if blob is None:
                     continue
