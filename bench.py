@@ -158,7 +158,9 @@ def _spawn_inner(args, extra_env: dict, timeout: float
            "--iters", str(args.iters),
            "--remat", str(args.remat),
            "--block-q", str(args.block_q),
-           "--block-k", str(args.block_k)]
+           "--block-k", str(args.block_k),
+           "--block-q-bwd", str(args.block_q_bwd),
+           "--block-k-bwd", str(args.block_k_bwd)]
     if args.image_size is not None:
         cmd += ["--image-size", str(args.image_size)]
     env = {**os.environ, **extra_env,
@@ -261,6 +263,10 @@ def main() -> int:
     # 1024/2048 exceeds the 16M scoped-vmem limit. docs/PERFORMANCE.md.
     parser.add_argument("--block-q", type=int, default=1024)
     parser.add_argument("--block-k", type=int, default=1024)
+    # 0 = same as forward; the bwd kernel's VMEM-optimal tiling is often
+    # smaller (it holds dq/dk/dv accumulators + the recomputed p block).
+    parser.add_argument("--block-q-bwd", type=int, default=0)
+    parser.add_argument("--block-k-bwd", type=int, default=0)
     parser.add_argument("--inner", action="store_true",
                         help="internal: run one attempt in-process")
     args = parser.parse_args()
@@ -394,6 +400,10 @@ def bench_gpt(args, info: dict) -> int:
                  if on_tpu else args.block_q),
         block_k=(_divisor_block(args.block_k, args.seq_len)
                  if on_tpu else args.block_k),
+        block_q_bwd=(_divisor_block(args.block_q_bwd, args.seq_len)
+                     if on_tpu and args.block_q_bwd else None),
+        block_k_bwd=(_divisor_block(args.block_k_bwd, args.seq_len)
+                     if on_tpu and args.block_k_bwd else None),
         # XLA CPU crashes promoting 16-bit all-reduces; bf16 is TPU-only.
         dtype=jnp.bfloat16 if on_tpu else jnp.float32)
     model = models.TransformerLM(cfg)

@@ -42,9 +42,12 @@ class TransformerConfig:
     attention: str = "dense"          # dense | flash | ring | ulysses
     causal: bool = True
     remat: bool = False               # checkpoint each block
-    # flash kernel tiling
+    # flash kernel tiling (bwd defaults to the fwd blocks; the backward
+    # kernel holds more live VMEM tiles so its optimum is often smaller)
     block_q: int = 128
     block_k: int = 128
+    block_q_bwd: int | None = None
+    block_k_bwd: int | None = None
     flash_interpret: bool = False     # run Pallas kernels interpreted (tests)
     # sequence-parallel wiring (ring/ulysses)
     mesh: Any = None
@@ -108,6 +111,8 @@ def _make_attention(cfg: TransformerConfig) -> Callable:
         from ..ops.flash_attention import flash_attention
         return partial(flash_attention, causal=cfg.causal,
                        block_q=cfg.block_q, block_k=cfg.block_k,
+                       block_q_bwd=cfg.block_q_bwd,
+                       block_k_bwd=cfg.block_k_bwd,
                        interpret=cfg.flash_interpret)
     if cfg.attention in ("ring", "ulysses"):
         if cfg.mesh is None:
@@ -151,6 +156,8 @@ def _bthd_attn_adapter(q, k, v, causal=False, sm_scale=None, *,
         from ..ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                block_q=cfg.block_q, block_k=cfg.block_k,
+                               block_q_bwd=cfg.block_q_bwd,
+                               block_k_bwd=cfg.block_k_bwd,
                                interpret=cfg.flash_interpret)
     from ..ops.flash_attention import mha_reference
     return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)

@@ -378,8 +378,9 @@ def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k,
+           block_q_bwd, block_k_bwd, interpret):
     o, _res = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
                          interpret)
     return o
@@ -399,19 +400,26 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     return o, (q, k, v, o, lse)
 
 
-def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash_fwd_rule(q, k, v, sm_scale, causal, block_q, block_k,
+                    block_q_bwd, block_k_bwd, interpret):
     o, res = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k,
                         interpret)
     return o, res
 
 
-def _flash_bwd_rule(sm_scale, causal, block_q, block_k, interpret,
-                    res, g):
+def _flash_bwd_rule(sm_scale, causal, block_q, block_k,
+                    block_q_bwd, block_k_bwd, interpret, res, g):
+    # The backward kernel holds more live tiles than the forward (dq, dk,
+    # dv accumulators + recomputed p), so its VMEM-optimal blocks are
+    # usually SMALLER; they default to the forward's but are sweepable
+    # independently (r3 found fwd 1024/1024 optimal while 1024/2048
+    # exceeded the 16 MiB scoped-vmem limit).
     q, k, v, o, lse = res
     if _on_tpu() or interpret:
         dq, dk, dv = _flash_bwd_pallas(
             q, k, v, o, lse, g, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k, interpret=interpret)
+            block_q=block_q_bwd or block_q,
+            block_k=block_k_bwd or block_k, interpret=interpret)
     else:
         _, vjp = jax.vjp(
             lambda q_, k_, v_: _blockwise_jax(q_, k_, v_,
@@ -449,18 +457,23 @@ def _check_causal_shapes(causal: bool, tq: int, tk: int) -> None:
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False, sm_scale: float | None = None,
                     block_q: int = 128, block_k: int = 128,
+                    block_q_bwd: int | None = None,
+                    block_k_bwd: int | None = None,
                     interpret: bool = False) -> jax.Array:
     """Fused multi-head attention. q,k,v: [B, T, H, D] (BTHD). Differentiable
-    (custom VJP with Pallas backward kernels on TPU)."""
+    (custom VJP with Pallas backward kernels on TPU).  ``block_*_bwd``
+    override the backward kernel's tiling (defaults: same as forward)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     _check_causal_shapes(causal, q.shape[1], k.shape[1])
     b, _, h, _ = q.shape
     block_q = _fit_block(q.shape[1], block_q)
     block_k = _fit_block(k.shape[1], block_k)
+    bq_bwd = _fit_block(q.shape[1], block_q_bwd) if block_q_bwd else 0
+    bk_bwd = _fit_block(k.shape[1], block_k_bwd) if block_k_bwd else 0
     out = _flash(_merge_heads(q), _merge_heads(k), _merge_heads(v),
                  float(sm_scale), bool(causal), int(block_q), int(block_k),
-                 bool(interpret))
+                 int(bq_bwd), int(bk_bwd), bool(interpret))
     return _split_heads(out, b, h)
 
 

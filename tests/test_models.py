@@ -84,14 +84,18 @@ def test_inception_v3_forward_and_params():
 ])
 def test_benchmark_models_train_step(ctor, image):
     """Every reference benchmark family trains under the SPMD Trainer on
-    the dp mesh (fused+compressed gradient sync included)."""
-    mesh = build_mesh(MeshSpec(dp=len(jax.devices())))
+    the dp mesh (fused+compressed gradient sync included).  A dp=2
+    submesh: partitioning these deep graphs over all 8 virtual devices
+    more than doubles XLA-CPU compile time (Inception: 220s at dp=8 vs
+    98s at dp=2) without adding coverage — the 8-device sync machinery is
+    exercised by the resnet Trainer tests."""
+    mesh = build_mesh(MeshSpec(dp=2), devices=jax.devices()[:2])
     trainer = training.Trainer(
         ctor(), optax.sgd(0.01, momentum=0.9), mesh,
         sync=GradSyncConfig(axes=("dp",), op="average",
                             compression="fp16"))
     batch = training.synthetic_image_batch(
-        2 * len(jax.devices()), image_size=image, num_classes=8)
+        4, image_size=image, num_classes=8)
     state = trainer.init(jax.random.key(0), batch)
     state, metrics = trainer.step(state, batch)
     jax.block_until_ready(metrics)

@@ -98,9 +98,12 @@ def test_hierarchical_collectives():
 
 @pytest.mark.parametrize("size", [2, 4])
 def test_adasum(size):
-    # Generous timeout: every worker imports torch AND tensorflow for the
-    # delta-optimizer checks, which serializes badly under CI load.
-    _run_world(size, "adasum", timeout=300.0)
+    # Generous timeout: workers import torch AND tensorflow for the
+    # delta-optimizer checks, which serializes badly under CI load — so
+    # the framework halves run at size 2 only, and size 4 covers the
+    # two-level VHDD pairing numpy-only.
+    _run_world(size, "adasum" if size == 2 else "adasum_np",
+               timeout=300.0)
 
 
 @pytest.mark.parametrize("size", [2, 4])
