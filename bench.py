@@ -157,6 +157,7 @@ def _spawn_inner(args, extra_env: dict, timeout: float
            "--warmup", str(args.warmup),
            "--iters", str(args.iters),
            "--remat", str(args.remat),
+           "--remat-policy", args.remat_policy,
            "--block-q", str(args.block_q),
            "--block-k", str(args.block_k),
            "--block-q-bwd", str(args.block_q_bwd),
@@ -258,6 +259,10 @@ def main() -> int:
     parser.add_argument("--remat", type=int, default=0,
                         help="gpt: rematerialize each block (saves HBM, "
                         "costs recompute; default off for throughput)")
+    parser.add_argument("--remat-policy", default="full",
+                        choices=["full", "dots"],
+                        help="gpt remat granularity: 'dots' saves matmul "
+                        "outputs (less recompute, more HBM)")
     # Defaults from the r3 on-TPU sweep (v5e, gpt-small seq 2048):
     # 256/512→66.2k tok/s, 512/1024→78.2k, 1024/1024→79.5k (MFU 0.37);
     # 1024/2048 exceeds the 16M scoped-vmem limit. docs/PERFORMANCE.md.
@@ -395,6 +400,7 @@ def bench_gpt(args, info: dict) -> int:
     cfg = models.gpt_small(
         max_seq_len=args.seq_len,
         attention="flash" if on_tpu else "dense", remat=bool(args.remat),
+        remat_policy=args.remat_policy,
         # Dense attention (off-TPU) ignores blocks — don't validate there.
         block_q=(_divisor_block(args.block_q, args.seq_len)
                  if on_tpu else args.block_q),

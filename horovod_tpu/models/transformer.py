@@ -42,6 +42,12 @@ class TransformerConfig:
     attention: str = "dense"          # dense | flash | ring | ulysses
     causal: bool = True
     remat: bool = False               # checkpoint each block
+    # Remat granularity when remat=True: "full" recomputes the whole
+    # block; "dots" saves matmul outputs and recomputes only the cheap
+    # elementwise work (jax.checkpoint_policies.checkpoint_dots) — less
+    # recompute for modestly more HBM, the middle point of the
+    # memory/FLOPs trade (SURVEY: jax.checkpoint for remat).
+    remat_policy: str = "full"        # full | dots
     # flash kernel tiling (bwd defaults to the fwd blocks; the backward
     # kernel holds more live VMEM tiles so its optimum is often smaller)
     block_q: int = 128
@@ -264,7 +270,14 @@ class TransformerLM(nn.Module):
         x = embed(tokens)
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, prevent_cse=False)
+            policy = None
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.checkpoint_dots
+            elif cfg.remat_policy != "full":
+                raise ValueError(
+                    f"unknown remat_policy {cfg.remat_policy!r} "
+                    "(expected 'full' or 'dots')")
+            block = nn.remat(Block, prevent_cse=False, policy=policy)
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"layer_{i}")(x)
         x = RMSNorm(cfg.dtype, cfg.param_dtype, name="final_norm")(x)

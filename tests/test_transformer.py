@@ -105,3 +105,16 @@ class TestTraining:
         mesh = build_mesh(MeshSpec(dp=8))
         losses = self._train(gpt_tiny(dtype=jnp.float32, remat=True), mesh)
         assert losses[-1] < losses[0]
+
+    def test_remat_dots_policy_matches_full(self):
+        """remat_policy='dots' (save matmul outputs, recompute elementwise)
+        must match full-block remat numerics — only the memory/FLOPs
+        trade changes.  rtol matches test_ring_equals_dense_training:
+        saved-vs-recomputed values may fuse/round differently, and adamw
+        steps compound ulp-level differences."""
+        mesh = build_mesh(MeshSpec(dp=8))
+        dots = self._train(gpt_tiny(dtype=jnp.float32, remat=True,
+                                    remat_policy="dots"), mesh)
+        assert dots[-1] < dots[0]
+        full = self._train(gpt_tiny(dtype=jnp.float32, remat=True), mesh)
+        np.testing.assert_allclose(dots, full, rtol=2e-4)
