@@ -40,9 +40,16 @@ class HierarchicalTcpBackend(CollectiveBackend):
     name = "tcp-hierarchical"
 
     def __init__(self, local: TcpCollectives, cross: TcpCollectives, *,
-                 allreduce_on: bool, allgather_on: bool) -> None:
+                 allreduce_on: bool, allgather_on: bool,
+                 shm_local=None) -> None:
         self.local = local
         self.cross = cross
+        # Optional same-host shm world over the LOCAL ranks: the
+        # intra-host legs then ride mmap regions instead of TCP loopback
+        # (the NCCL-intra-node analogue; ~2x on multi-rank hosts).  The
+        # decision is per-host — hosts with and without shm interoperate
+        # because the cross-leg traffic pattern is identical either way.
+        self.shm_local = shm_local
         self.allreduce_on = allreduce_on
         self.allgather_on = allgather_on
         # Per-leg observability: op counts and analytic payload volumes.
@@ -62,6 +69,15 @@ class HierarchicalTcpBackend(CollectiveBackend):
             return self.allgather_on
         return False
 
+    def _use_shm_legs(self, wire_dtype: np.dtype, nbytes: int) -> bool:
+        from .base import accum_dtype as _accum_dtype
+        return (self.shm_local is not None and self.shm_local.formed
+                and nbytes <= self.shm_local.capacity
+                # 16-bit wires keep the TCP legs: those stay in one fp32
+                # accumulation across all three legs, which the wire-dtype
+                # shm regions cannot represent.
+                and _accum_dtype(wire_dtype) == wire_dtype)
+
     # -- allreduce: RS(local) -> AR(cross) -> AG(local) -------------------
     def allreduce(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:
@@ -71,6 +87,8 @@ class HierarchicalTcpBackend(CollectiveBackend):
         buf = self.scale_buffer(buf, response.prescale_factor)
         wire_dtype = buf.dtype
         nbytes = buf.size * wire_dtype.itemsize
+        if self._use_shm_legs(wire_dtype, nbytes):
+            return self._allreduce_shm_local(response, entries, buf)
         # Accumulate ALL THREE legs in the widened dtype: each leg's
         # round-trip through TcpCollectives returns its input dtype, so a
         # 16-bit wire buffer would otherwise be rounded between legs —
@@ -119,6 +137,100 @@ class HierarchicalTcpBackend(CollectiveBackend):
         full = self.scale_buffer(full, response.postscale_factor)
         full = full.astype(wire_dtype, copy=False)
         self.unpack_fusion_buffer(full, response, entries)
+        return Status.ok()
+
+    def _allreduce_shm_local(self, response: Response,
+                             entries: list[TensorTableEntry],
+                             buf: np.ndarray) -> Status:
+        """Local legs over the per-host shm world, cross leg over TCP.
+
+        Same 3-barrier sequence-word protocol as ShmBackend's chunked
+        path (disjoint chunk ownership makes the in-place writes safe);
+        the cross-host TCP allreduce of the owned shard slots between the
+        reduce and gather phases.  Deliberately NOT shared with
+        ShmBackend._allreduce_locked: that protocol has no fallible I/O
+        between publishes (and a 2-rank fused fast path that cannot host
+        a cross leg — hierarchical needs per-rank shard ownership), while
+        this one must poison the world if the cross leg throws
+        mid-protocol."""
+        w = self.shm_local
+        try:
+            return self._shm_local_protocol(response, entries, buf)
+        except BaseException:
+            # A cross-leg failure between barrier publishes would leave
+            # local peers spinning: poison so every rank on this host
+            # raises now and falls back to the TCP planes afterwards.
+            w.poison()
+            raise
+
+    def _shm_local_protocol(self, response: Response,
+                            entries: list[TensorTableEntry],
+                            buf: np.ndarray) -> Status:
+        w = self.shm_local
+        rank, size = w.rank, w.size
+        np_dtype = buf.dtype
+        n = buf.size
+        nbytes = n * np_dtype.itemsize
+        t = w._t
+        w._t += 1
+
+        base, rem = divmod(n, size)
+        sizes = [base + (1 if i < rem else 0) for i in range(size)]
+        bounds = np.cumsum([0] + sizes)
+        lo, hi = int(bounds[rank]), int(bounds[rank + 1])
+
+        w.wait_all(3 * t)
+        my_region = w.data(rank)[:nbytes].view(np_dtype)
+        my_region[:] = buf
+        w.publish(3 * t + 1)
+
+        # Leg 1 (shm): reduce my chunk across the local ranks' regions.
+        self._act_start(entries, "LOCAL_REDUCESCATTER")
+        try:
+            w.wait_all(3 * t + 1)
+            mine = my_region[lo:hi]
+            for r in range(size):
+                if r != rank:
+                    mine += w.data(r)[lo * np_dtype.itemsize:
+                                      hi * np_dtype.itemsize].view(np_dtype)
+        finally:
+            self._act_end(entries)
+        self.leg_ops["local_rs"] += 1
+        self.leg_bytes["local_rs"] += nbytes
+
+        # Leg 2 (TCP): allreduce the host-reduced shard across hosts,
+        # writing the result back into my chunk (peers only read their
+        # OWN chunk index before the 3t+2 barrier, never mine).
+        if hi > lo:
+            self._act_start(entries, "CROSS_ALLREDUCE")
+            try:
+                my_region[lo:hi] = self.cross.allreduce(
+                    np.ascontiguousarray(my_region[lo:hi]))
+            finally:
+                self._act_end(entries)
+        self.leg_ops["cross_ar"] += 1
+        self.leg_bytes["cross_ar"] += (hi - lo) * np_dtype.itemsize
+        w.publish(3 * t + 2)
+
+        # Leg 3 (shm): gather the fully reduced chunks from their owners.
+        self._act_start(entries, "LOCAL_ALLGATHER")
+        try:
+            w.wait_all(3 * t + 2)
+            out = np.empty(n, dtype=np_dtype)
+            for r in range(size):
+                rlo, rhi = int(bounds[r]), int(bounds[r + 1])
+                if rhi > rlo:
+                    out[rlo:rhi] = w.data(r)[rlo * np_dtype.itemsize:
+                                             rhi * np_dtype.itemsize
+                                             ].view(np_dtype)
+            w.publish(3 * t + 3)
+        finally:
+            self._act_end(entries)
+        self.leg_ops["local_ag"] += 1
+        self.leg_bytes["local_ag"] += nbytes
+
+        out = self.scale_buffer(out, response.postscale_factor)
+        self.unpack_fusion_buffer(out, response, entries)
         return Status.ok()
 
     # -- allgather: gather(local) -> gather node blocks (cross) ------------

@@ -42,6 +42,12 @@ from .base import CollectiveBackend, accum_dtype as _accum_dtype
 
 _HEADER = 4096          # one page: seq word + padding
 _SEQ_OFFSET = 0
+# Sequence value a rank publishes when an op failed mid-protocol (e.g. the
+# hierarchical cross leg raising between barriers): peers detect it in
+# wait_all, raise, and the whole host falls back to the TCP planes —
+# instead of spinning out the barrier timeout or completing with
+# partially-reduced garbage.
+_POISON = 1 << 62
 
 
 def _boot_fingerprint() -> str:
@@ -211,12 +217,28 @@ class ShmWorld:
     def publish(self, value: int) -> None:
         self._seqs[self.rank][0] = value
 
+    def poison(self) -> None:
+        """Mark this world failed: peers blocked in wait_all raise instead
+        of timing out, and this world opts out of future ops (every rank
+        reaches the same conclusion at the same op, keeping the backend
+        chain rank-symmetric)."""
+        self.formed = False
+        try:
+            self._seqs[self.rank][0] = _POISON   # type: ignore[index]
+        except Exception:  # noqa: BLE001 - already closed
+            pass
+
     def wait_all(self, target: int) -> None:
         start = time.monotonic()
         deadline = start + self.barrier_timeout
         next_liveness = start + 0.5
         while True:
-            if all(int(s[0]) >= target for s in self._seqs):  # type: ignore
+            seqs = [int(s[0]) for s in self._seqs]  # type: ignore[index]
+            if any(s >= _POISON for s in seqs):
+                self.formed = False
+                raise ConnectionError(
+                    "shm world poisoned by a peer failure")
+            if all(s >= target for s in seqs):
                 return
             now = time.monotonic()
             if now >= next_liveness:
@@ -289,6 +311,10 @@ class ShmBackend(CollectiveBackend):
         self._act_start(entries, "SHM_ALLREDUCE")
         try:
             return self._allreduce_locked(response, entries, t)
+        except BaseException:
+            # Leave no peer spinning on a barrier we will never publish.
+            self.world.poison()
+            raise
         finally:
             self._act_end(entries)
 

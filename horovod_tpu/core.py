@@ -246,13 +246,13 @@ def init(*, rank: int | None = None, size: int | None = None,
             # auto-formed plane.
             shm_backend = None
             shm_mode = config.parse_tristate(config.SHM_OPERATIONS.get())
+            shm_capacity = config.SHM_CAPACITY.get() or \
+                max(config.FUSION_THRESHOLD.get(), 64 * 1024 * 1024)
             if shm_mode is not False:
                 from .backend.shm import ShmBackend, ShmWorld
                 shm_world = ShmWorld(
                     rank, size, kv, scope=f"shm{epoch}",
-                    capacity=config.SHM_CAPACITY.get() or
-                    max(config.FUSION_THRESHOLD.get(), 64 * 1024 * 1024),
-                    timeout=timeout)
+                    capacity=shm_capacity, timeout=timeout)
                 if shm_world.formed:
                     _global.resources.append(shm_world)
                     shm_backend = ShmBackend(shm_world)
@@ -304,10 +304,26 @@ def init(*, rank: int | None = None, size: int | None = None,
                         cross_rank, cross_size, kv,
                         scope=f"hcross{epoch}.{local_rank}", timeout=timeout)
                     _global.resources.extend([local_mesh, cross_mesh])
+                    # Intra-host legs ride shm when the local ranks share
+                    # a memory domain (per-host decision: the cross-leg
+                    # pattern is identical either way, so hosts with and
+                    # without shm interoperate).
+                    hier_shm = None
+                    if shm_mode is not False:
+                        from .backend.shm import ShmWorld
+                        hier_shm = ShmWorld(
+                            local_rank, local_size, kv,
+                            scope=f"hshm{epoch}.{cross_rank}",
+                            capacity=shm_capacity, timeout=timeout)
+                        if hier_shm.formed:
+                            _global.resources.append(hier_shm)
+                        else:
+                            hier_shm = None
                     backends.append(HierarchicalTcpBackend(
                         TcpCollectives(local_mesh),
                         TcpCollectives(cross_mesh),
-                        allreduce_on=hier_ar, allgather_on=hier_ag))
+                        allreduce_on=hier_ar, allgather_on=hier_ag,
+                        shm_local=hier_shm))
             if shm_backend is not None:
                 backends.append(shm_backend)
             backends.append(TcpBackend(TcpCollectives(data_mesh)))

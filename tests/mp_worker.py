@@ -1014,6 +1014,12 @@ def battery_hierarchical(hvd, rank, size):
     assert names.index("tcp-hierarchical") < names.index("tcp"), names
     hier = _global.op_manager.backends[names.index("tcp-hierarchical")]
     lsize = hvd.local_size()
+    if os.environ.get("HOROVOD_SHM_OPERATIONS") == "0":
+        assert hier.shm_local is None   # TCP local legs under test
+    else:
+        # Localhost "hosts" share one memory domain: the intra-host legs
+        # must ride the per-host shm world.
+        assert hier.shm_local is not None and hier.shm_local.formed
 
     # -- allreduce sum, odd length (uneven shard bounds) ------------------
     x = np.arange(17, dtype=np.float32) + rank
@@ -1281,6 +1287,9 @@ def main() -> int:
     if battery == "shm":
         os.environ["HOROVOD_SHM_OPERATIONS"] = "1"   # require formation
         os.environ["HOROVOD_SHM_CAPACITY"] = str(1 << 20)
+    if battery == "hierarchical_tcp":
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+        battery = "hierarchical"
     if battery == "hierarchical":
         # Two hosts x two slots, homogeneous host-major layout (what the
         # launcher assigns); both knobs on.

@@ -1,0 +1,96 @@
+"""ShmWorld unit tests: formation, lockstep, and the poison protocol
+(fallible I/O between barrier publishes — e.g. the hierarchical cross
+leg — must fail every rank fast, not hang peers until the barrier
+timeout or complete with partial reductions)."""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from horovod_tpu.backend.shm import ShmWorld, _POISON
+from horovod_tpu.runner.network import RendezvousClient, RendezvousServer
+
+
+@pytest.fixture()
+def kv():
+    server = RendezvousServer()
+    port = server.start()
+    yield RendezvousClient("127.0.0.1", port, 10.0)
+    server.stop()
+
+
+def _form_pair(kv, scope: str, capacity: int = 1 << 16):
+    """Form a 2-rank world with both ranks in one process (two instances
+    attaching to each other's regions — formation needs concurrency)."""
+    worlds: list = [None, None]
+    errors: list = []
+
+    def make(rank: int) -> None:
+        try:
+            worlds[rank] = ShmWorld(rank, 2, kv, scope=scope,
+                                    capacity=capacity, timeout=10.0)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=make, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20.0)
+    assert not errors, errors
+    assert all(w is not None and w.formed for w in worlds), worlds
+    return worlds
+
+
+def test_shm_world_forms_and_steps(kv):
+    a, b = _form_pair(kv, "unit1")
+    try:
+        a.data(0)[:4] = np.frombuffer(b"\x01\x02\x03\x04", np.uint8)
+        # b reads a's region through its own mapping (shared memory).
+        assert bytes(b.data(0)[:4]) == b"\x01\x02\x03\x04"
+        a.publish(3)
+        b.publish(3)
+        a.wait_all(3)
+        b.wait_all(3)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_shm_poison_unblocks_waiters(kv):
+    a, b = _form_pair(kv, "unit2")
+    try:
+        result: list = []
+
+        def waiter():
+            try:
+                a.wait_all(5)
+                result.append("returned")
+            except ConnectionError:
+                result.append("poisoned")
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        b.poison()
+        th.join(10.0)
+        assert not th.is_alive(), "waiter should have been unblocked"
+        assert result == ["poisoned"]
+        assert not b.formed
+        assert not a.formed   # detection side also opts out of future ops
+    finally:
+        a.close()
+        b.close()
+
+
+def test_shm_poison_value_is_detectable(kv):
+    a, b = _form_pair(kv, "unit3")
+    try:
+        b.poison()
+        assert int(b._seqs[1][0]) == _POISON
+        with pytest.raises(ConnectionError):
+            a.wait_all(0)   # even a satisfied target reports the poison
+    finally:
+        a.close()
+        b.close()
