@@ -10,7 +10,10 @@ way plus staging) to ~3 (pack, reduce, copy-out) and roughly tripling
 effective allreduce bandwidth on localhost worlds.
 
 Protocol (per collective, lockstep across ranks — the identical-response-
-order invariant guarantees every rank runs the same op sequence):
+order invariant guarantees every rank runs the same op sequence); this is
+the allreduce shape, with broadcast/allgather using a 2-barrier variant
+(stage, publish 3t+1, read peers, publish 3t+3 — monotonic ``>=`` waits
+make the skipped middle word equivalent):
 
   wait all seq >= 3t      (peers finished reading my previous result)
   pack payload into my region;            publish seq = 3t+1
@@ -287,8 +290,12 @@ class ShmWorld:
 
 
 class ShmBackend(CollectiveBackend):
-    """Same-host allreduce over a ShmWorld; everything else falls through
-    to the TCP/XLA planes via ``enabled()``."""
+    """Same-host allreduce, broadcast and ragged allgather over a
+    ShmWorld; alltoall and fused non-allreduce responses fall through to
+    the TCP/XLA planes via ``enabled()``.  Broadcast/allgather use a
+    2-barrier variant of the protocol (publish 3t+1 after staging, jump
+    straight to 3t+3 after reading — the monotonic ``>=`` waits make the
+    skipped middle word equivalent)."""
 
     name = "shm"
 
@@ -298,10 +305,26 @@ class ShmBackend(CollectiveBackend):
 
     def enabled(self, response: Response,
                 entries: list[TensorTableEntry]) -> bool:
-        if response.response_type != ResponseType.ALLREDUCE:
+        rt = response.response_type
+        if rt == ResponseType.ALLREDUCE:
+            # Fused payload must fit one region.
+            nbytes = sum(response.tensor_sizes) * \
+                element_size(response.tensor_type)
+        elif rt == ResponseType.BROADCAST and len(entries) == 1:
+            nbytes = response.tensor_sizes[0] * \
+                element_size(response.tensor_type)
+        elif rt == ResponseType.ALLGATHER and len(entries) == 1 \
+                and entries[0].tensor is not None:
+            # Each rank stages only its OWN (largest-anywhere) block;
+            # allgather/broadcast responses are per-tensor by protocol
+            # (only ALLREDUCE/ADASUM fuse) — the len gate makes that a
+            # checked assumption rather than a silent one.
+            shape = np.asarray(entries[0].tensor).shape
+            rest = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+            nbytes = max(response.tensor_sizes) * rest * \
+                element_size(response.tensor_type)
+        else:
             return False
-        nbytes = sum(response.tensor_sizes) * \
-            element_size(response.tensor_type)
         return self.world.formed and nbytes <= self.world.capacity
 
     def allreduce(self, response: Response,
@@ -410,11 +433,87 @@ class ShmBackend(CollectiveBackend):
             return out
         return (a.astype(acc_dt) + b.astype(acc_dt)).astype(np_dtype)
 
-    def allgather(self, response, entries) -> Status:
-        return Status.unknown_error("shm backend only implements allreduce")
+    def broadcast(self, response: Response,
+                  entries: list[TensorTableEntry]) -> Status:
+        """Root writes its payload once; every peer reads it straight out
+        of the root's region — one copy in, one copy out per rank,
+        vs the TCP star's per-peer socket round trips (big win for
+        broadcast_parameters at model startup)."""
+        w = self.world
+        t = w._t
+        w._t += 1
+        self._act_start(entries, "SHM_BCAST")
+        try:
+            np_dtype = to_numpy(response.tensor_type)
+            root = response.root_rank
+            (entry,) = entries
+            w.wait_all(3 * t)
+            if w.rank == root:
+                local = np.ascontiguousarray(
+                    np.asarray(entry.tensor, dtype=np_dtype))
+                w.data(root)[:local.nbytes] = \
+                    local.reshape(-1).view(np.uint8)
+                w.publish(3 * t + 1)
+                entry.output = local.copy()   # no region round-trip
+            else:
+                w.publish(3 * t + 1)
+                w.wait_all(3 * t + 1)
+                n = response.tensor_sizes[0]
+                src = w.data(root)[:n * np_dtype.itemsize].view(np_dtype)
+                shape = np.asarray(entry.tensor).shape \
+                    if entry.tensor is not None else (n,)
+                entry.output = src.reshape(shape).copy()
+            w.publish(3 * t + 3)
+            self.ops_executed += 1
+            return Status.ok()
+        except BaseException:
+            w.poison()
+            raise
+        finally:
+            self._act_end(entries)
 
-    def broadcast(self, response, entries) -> Status:
-        return Status.unknown_error("shm backend only implements allreduce")
+    def allgather(self, response: Response,
+                  entries: list[TensorTableEntry]) -> Status:
+        """Each rank stages its (ragged dim-0) block in its own region;
+        peers assemble the rank-ordered concatenation directly from the
+        owners' regions."""
+        w = self.world
+        t = w._t
+        w._t += 1
+        self._act_start(entries, "SHM_ALLGATHER")
+        try:
+            np_dtype = to_numpy(response.tensor_type)
+            dims = list(response.tensor_sizes)   # per-rank first dims
+            (entry,) = entries
+            local = np.ascontiguousarray(
+                np.asarray(entry.tensor, dtype=np_dtype))
+            rest = int(np.prod(local.shape[1:])) if local.ndim > 1 else 1
+            w.wait_all(3 * t)
+            w.data(w.rank)[:local.nbytes] = \
+                local.reshape(-1).view(np.uint8)
+            w.publish(3 * t + 1)
+            w.wait_all(3 * t + 1)
+            total = sum(dims)
+            out = np.empty(total * rest, dtype=np_dtype)
+            offset = 0
+            for r in range(w.size):
+                count = dims[r] * rest
+                if r == w.rank:   # own block: skip the region round-trip
+                    out[offset:offset + count] = local.reshape(-1)
+                else:
+                    out[offset:offset + count] = \
+                        w.data(r)[:count * np_dtype.itemsize].view(np_dtype)
+                offset += count
+            entry.output = out.reshape((total,) + local.shape[1:])
+            w.publish(3 * t + 3)
+            self.ops_executed += 1
+            return Status.ok()
+        except BaseException:
+            w.poison()
+            raise
+        finally:
+            self._act_end(entries)
 
     def alltoall(self, response, entries) -> Status:
-        return Status.unknown_error("shm backend only implements allreduce")
+        return Status.unknown_error(
+            "shm backend does not implement alltoall")

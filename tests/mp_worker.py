@@ -989,10 +989,28 @@ def battery_shm(hvd, rank, size):
                                np.full(8, sum(range(1, size + 1))))
     assert shm.ops_executed == before, "oversized op must ride TCP"
 
-    # Lockstep survives interleaved non-shm ops (allgather via TCP).
+    # Broadcast rides shm (root writes once, peers read the region).
+    before = shm.ops_executed
+    root = size - 1
+    v = np.arange(12, dtype=np.float64).reshape(3, 4) * (rank + 1)
+    out = hvd.broadcast(v, root_rank=root, name="shm_bc")
+    np.testing.assert_array_equal(
+        out, np.arange(12, dtype=np.float64).reshape(3, 4) * (root + 1))
+    assert shm.ops_executed == before + 1, "broadcast must ride shm"
+
+    # Ragged allgather rides shm (per-rank blocks from owners' regions).
     g = hvd.allgather(np.full((rank + 1, 2), rank, np.float32),
                       name="shm_ag")
-    assert g.shape == (sum(r + 1 for r in range(size)), 2)
+    expected = np.concatenate([np.full((r + 1, 2), r, np.float32)
+                               for r in range(size)])
+    np.testing.assert_array_equal(g, expected)
+    assert shm.ops_executed == before + 2, "allgather must ride shm"
+
+    # Lockstep survives interleaved non-shm ops (alltoall via TCP).
+    splits = [1] * size
+    a2a, _ = hvd.alltoall(np.full(size, float(rank), np.float32),
+                          splits=splits, name="shm_a2a")
+    np.testing.assert_array_equal(a2a, np.arange(size, dtype=np.float32))
     for i in range(5):
         out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
                             name="shm_steady")
