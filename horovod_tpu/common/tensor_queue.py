@@ -47,6 +47,11 @@ class TensorQueue:
         self._table: dict[str, TensorTableEntry] = {}
         self._queue: list[Request] = []
         self._finalized = False
+        # Pulses on enqueue so the background loop can wake immediately
+        # instead of finishing its cycle sleep (single-op latency); the
+        # loop still applies a short batching grace so gradient bursts
+        # keep fusing (the reason the reference holds a fixed cadence).
+        self._work = threading.Event()
 
     def add_to_tensor_queue(self, entry: TensorTableEntry, request: Request) -> Status:
         return self.add_to_tensor_queue_multi([entry], [request])
@@ -63,7 +68,18 @@ class TensorQueue:
             for e, r in zip(entries, requests):
                 self._table[e.tensor_name] = e
                 self._queue.append(r)
+            self._work.set()
         return Status.ok()
+
+    def wait_for_work(self, timeout: float) -> bool:
+        """Block up to ``timeout`` seconds for an enqueue pulse; returns
+        True if work arrived.  Resubmissions via push_back_to_queue do
+        NOT pulse — they are next-cycle work by design."""
+        if timeout <= 0:
+            return self._work.is_set()
+        fired = self._work.wait(timeout)
+        self._work.clear()
+        return fired
 
     def pop_messages_from_queue(self) -> list[Request]:
         with self._mutex:
