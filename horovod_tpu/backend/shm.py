@@ -449,12 +449,15 @@ class ShmBackend(CollectiveBackend):
             (entry,) = entries
             w.wait_all(3 * t)
             if w.rank == root:
+                shape = np.asarray(entry.tensor).shape
+                # NB: ascontiguousarray promotes 0-d to 1-d — restore the
+                # original shape on the output.
                 local = np.ascontiguousarray(
                     np.asarray(entry.tensor, dtype=np_dtype))
                 w.data(root)[:local.nbytes] = \
                     local.reshape(-1).view(np.uint8)
                 w.publish(3 * t + 1)
-                entry.output = local.copy()   # no region round-trip
+                entry.output = local.copy().reshape(shape)
             else:
                 w.publish(3 * t + 1)
                 w.wait_all(3 * t + 1)

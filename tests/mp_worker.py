@@ -998,6 +998,14 @@ def battery_shm(hvd, rank, size):
         out, np.arange(12, dtype=np.float64).reshape(3, 4) * (root + 1))
     assert shm.ops_executed == before + 1, "broadcast must ride shm"
 
+    # Scalar broadcast keeps 0-d shape ON EVERY RANK (regression: numpy
+    # ascontiguousarray promotes 0-d to 1-d, which broke TF's
+    # BroadcastGlobalVariables on the optimizer iteration counter).
+    s = hvd.broadcast(np.float32(7.5 * (rank + 1)), root_rank=0,
+                      name="shm_bc_scalar")
+    assert np.asarray(s).shape == (), np.asarray(s).shape
+    assert float(np.asarray(s)) == 7.5
+
     # Ragged allgather rides shm (per-rank blocks from owners' regions).
     g = hvd.allgather(np.full((rank + 1, 2), rank, np.float32),
                       name="shm_ag")
