@@ -1005,6 +1005,7 @@ def battery_shm(hvd, rank, size):
                       name="shm_bc_scalar")
     assert np.asarray(s).shape == (), np.asarray(s).shape
     assert float(np.asarray(s)) == 7.5
+    assert shm.ops_executed == before + 2, "scalar bcast must ride shm"
 
     # Ragged allgather rides shm (per-rank blocks from owners' regions).
     g = hvd.allgather(np.full((rank + 1, 2), rank, np.float32),
@@ -1012,7 +1013,7 @@ def battery_shm(hvd, rank, size):
     expected = np.concatenate([np.full((r + 1, 2), r, np.float32)
                                for r in range(size)])
     np.testing.assert_array_equal(g, expected)
-    assert shm.ops_executed == before + 2, "allgather must ride shm"
+    assert shm.ops_executed == before + 3, "allgather must ride shm"
 
     # Lockstep survives interleaved non-shm ops (alltoall via TCP).
     splits = [1] * size
