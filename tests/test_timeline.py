@@ -76,9 +76,9 @@ def test_timeline_backend_sub_activities(tmp_path):
     # The grouped allreduce stages through the fusion buffer...
     assert "MEMCPY_IN_FUSION_BUFFER" in names, names
     # ...and the data plane identifies itself inside the op span (the
-    # same-host test world rides shm; TCP carries the allgather).
+    # same-host test world rides shm for allreduce AND allgather).
     assert "SHM_ALLREDUCE" in names or "TCP_RING_ALLREDUCE" in names, names
-    assert "TCP_ALLGATHERV" in names, names
+    assert "SHM_ALLGATHER" in names or "TCP_ALLGATHERV" in names, names
     _assert_balanced(events)
 
     # Sub-activities nest INSIDE the op span on each tensor's lane:
