@@ -202,8 +202,13 @@ class CollectiveBackend(ABC):
     def resolve_alltoall_splits(entry: TensorTableEntry, dim0: int,
                                 world_size: int) -> list[int] | Status:
         """Explicit splits, or an even division of dim 0; a Status error
-        when neither applies (shared by the XLA and TCP planes)."""
+        when neither applies (shared by the XLA, TCP and shm planes)."""
         if entry.splits:
+            if len(entry.splits) != world_size:
+                return Status.invalid_argument(
+                    f"alltoall splits must have one entry per rank "
+                    f"(got {len(entry.splits)} for world size "
+                    f"{world_size})")
             return list(entry.splits)
         if dim0 % world_size != 0:
             return Status.invalid_argument(

@@ -43,8 +43,13 @@ from ..common.status import Status
 from ..common.tensor_queue import TensorTableEntry
 from .base import CollectiveBackend, accum_dtype as _accum_dtype
 
-_HEADER = 4096          # one page: seq word + padding
+_HEADER = 4096          # one page: seq word + splits table + padding
 _SEQ_OFFSET = 0
+# Alltoall publishes the sender-side split row-counts in the header (the
+# receiver needs the sender's offsets to find its slice): int64 count at
+# +8, then up to _MAX_SPLITS int64 entries at +16.
+_SPLITS_OFFSET = 16
+_MAX_SPLITS = (_HEADER - _SPLITS_OFFSET) // 8
 # Sequence value a rank publishes when an op failed mid-protocol (e.g. the
 # hierarchical cross leg raising between barriers): peers detect it in
 # wait_all, raise, and the whole host falls back to the TCP planes —
@@ -122,6 +127,7 @@ class ShmWorld:
             "HOROVOD_SHM_BARRIER_TIMEOUT_SECONDS", "600"))
         self._maps: list[mmap.mmap | None] = [None] * size
         self._seqs: list[np.ndarray | None] = [None] * size
+        self._splits: list[np.ndarray | None] = [None] * size
         self._datas: list[np.ndarray | None] = [None] * size
         self._pids: list[int] = [0] * size
         self._paths: list[str] = [""] * size
@@ -213,6 +219,8 @@ class ShmWorld:
         self._paths[r] = path
         self._seqs[r] = np.frombuffer(mm, dtype=np.uint64, count=1,
                                       offset=_SEQ_OFFSET)
+        self._splits[r] = np.frombuffer(mm, dtype=np.int64,
+                                        count=1 + _MAX_SPLITS, offset=8)
         self._datas[r] = np.frombuffer(mm, dtype=np.uint8,
                                        count=self.capacity, offset=_HEADER)
 
@@ -273,6 +281,7 @@ class ShmWorld:
 
     def close(self) -> None:
         self._seqs = [None] * self.size
+        self._splits = [None] * self.size
         self._datas = [None] * self.size
         for mm in self._maps:
             if mm is not None:
@@ -290,18 +299,26 @@ class ShmWorld:
 
 
 class ShmBackend(CollectiveBackend):
-    """Same-host allreduce, broadcast and ragged allgather over a
-    ShmWorld; alltoall and fused non-allreduce responses fall through to
-    the TCP/XLA planes via ``enabled()``.  Broadcast/allgather use a
+    """Same-host allreduce, broadcast, ragged allgather and alltoall over
+    a ShmWorld; fused non-allreduce responses fall through to the TCP/XLA
+    planes via ``enabled()``.  Broadcast/allgather/alltoall use a
     2-barrier variant of the protocol (publish 3t+1 after staging, jump
     straight to 3t+3 after reading — the monotonic ``>=`` waits make the
-    skipped middle word equivalent)."""
+    skipped middle word equivalent); alltoall additionally publishes its
+    split table in the region header, with sentinel flags that delegate
+    oversized payloads to TCP or surface invalid splits symmetrically."""
 
     name = "shm"
 
     def __init__(self, world: ShmWorld) -> None:
         self.world = world
         self.ops_executed = 0   # observability for tests/PERFORMANCE.md
+        # TcpBackend delegate for alltoall payloads that exceed the
+        # region capacity: per-rank dim-0 sizes are not in the response,
+        # so the fit decision can only be made mid-protocol — an
+        # oversized rank raises a header flag and EVERY rank delegates
+        # (set by core.init).
+        self.tcp = None
 
     def enabled(self, response: Response,
                 entries: list[TensorTableEntry]) -> bool:
@@ -313,6 +330,14 @@ class ShmBackend(CollectiveBackend):
         elif rt == ResponseType.BROADCAST and len(entries) == 1:
             nbytes = response.tensor_sizes[0] * \
                 element_size(response.tensor_type)
+        elif rt == ResponseType.ALLTOALL:
+            # Every clause is rank-symmetric (alltoall with a joined rank
+            # is rejected upstream, so tensors are present everywhere);
+            # capacity is checked mid-protocol via the header flag.
+            return (self.world.formed and self.tcp is not None
+                    and len(entries) == 1
+                    and entries[0].tensor is not None
+                    and self.world.size <= _MAX_SPLITS)
         elif rt == ResponseType.ALLGATHER and len(entries) == 1 \
                 and entries[0].tensor is not None:
             # Each rank stages only its OWN (largest-anywhere) block;
@@ -517,6 +542,82 @@ class ShmBackend(CollectiveBackend):
         finally:
             self._act_end(entries)
 
-    def alltoall(self, response, entries) -> Status:
-        return Status.unknown_error(
-            "shm backend does not implement alltoall")
+    def alltoall(self, response: Response,
+                 entries: list[TensorTableEntry]) -> Status:
+        """Each rank stages its full send buffer + its split row-counts
+        (header table); peers pull exactly their targeted slice from each
+        sender's region — no pairwise socket exchange."""
+        w = self.world
+        t = w._t
+        w._t += 1
+        self._act_start(entries, "SHM_ALLTOALL")
+        try:
+            np_dtype = to_numpy(response.tensor_type)
+            (entry,) = entries
+            local = np.ascontiguousarray(
+                np.asarray(entry.tensor, dtype=np_dtype))
+            splits = self.resolve_alltoall_splits(entry, local.shape[0],
+                                                  w.size)
+            rest = int(np.prod(local.shape[1:])) if local.ndim > 1 else 1
+            w.wait_all(3 * t)
+            table = w._splits[w.rank]
+            if isinstance(splits, Status):
+                # Rank-local argument error: the sentinel keeps every
+                # peer IN the lockstep (a bare return would strand them
+                # at the barrier) and makes the failure symmetric — an
+                # improvement over pairwise planes, where one bad rank
+                # can stall its partners.
+                table[0] = -2
+            elif local.nbytes > w.capacity:
+                table[0] = -1   # too big: ask every rank to delegate
+            else:
+                w.data(w.rank)[:local.nbytes] = \
+                    local.reshape(-1).view(np.uint8)
+                table[0] = len(splits)
+                table[1:1 + len(splits)] = splits
+            w.publish(3 * t + 1)
+            w.wait_all(3 * t + 1)
+            flags = [int(w._splits[r][0]) for r in range(w.size)]
+            if any(f == -2 for f in flags):
+                w.publish(3 * t + 3)
+                return splits if isinstance(splits, Status) else \
+                    Status.invalid_argument(
+                        "a peer submitted invalid alltoall splits")
+            if any(f == -1 for f in flags):
+                # Unanimous fallback: some rank's buffer exceeds the
+                # region; all ranks run the pairwise TCP exchange.
+                w.publish(3 * t + 3)
+                return self.tcp.alltoall(response, entries)
+            recv_splits = []
+            slices = []
+            for r in range(w.size):
+                peer_table = w._splits[r]
+                peer_splits = [int(x)
+                               for x in peer_table[1:1 + int(peer_table[0])]]
+                start = sum(peer_splits[:w.rank]) * rest
+                rows = peer_splits[w.rank]
+                slices.append((start, rows * rest))
+                recv_splits.append(rows)
+            out = np.empty(sum(n for _, n in slices), dtype=np_dtype)
+            offset = 0
+            for r, (start, count) in enumerate(slices):
+                if r == w.rank:   # own block: skip the region round-trip
+                    out[offset:offset + count] = \
+                        local.reshape(-1)[start:start + count]
+                else:
+                    lo = start * np_dtype.itemsize
+                    out[offset:offset + count] = \
+                        w.data(r)[lo:lo + count * np_dtype.itemsize
+                                  ].view(np_dtype)
+                offset += count
+            w.publish(3 * t + 3)
+            entry.output = out.reshape((sum(recv_splits),)
+                                       + local.shape[1:])
+            entry.received_splits = recv_splits
+            self.ops_executed += 1
+            return Status.ok()
+        except BaseException:
+            w.poison()
+            raise
+        finally:
+            self._act_end(entries)

@@ -324,9 +324,11 @@ def init(*, rank: int | None = None, size: int | None = None,
                         TcpCollectives(cross_mesh),
                         allreduce_on=hier_ar, allgather_on=hier_ag,
                         shm_local=hier_shm))
+            tcp_backend = TcpBackend(TcpCollectives(data_mesh))
             if shm_backend is not None:
+                shm_backend.tcp = tcp_backend   # oversized-alltoall delegate
                 backends.append(shm_backend)
-            backends.append(TcpBackend(TcpCollectives(data_mesh)))
+            backends.append(tcp_backend)
         else:
             transport = LocalTransport()
         backends.append(BasicBackend(size))

@@ -1015,11 +1015,32 @@ def battery_shm(hvd, rank, size):
     np.testing.assert_array_equal(g, expected)
     assert shm.ops_executed == before + 3, "allgather must ride shm"
 
-    # Lockstep survives interleaved non-shm ops (alltoall via TCP).
-    splits = [1] * size
-    a2a, _ = hvd.alltoall(np.full(size, float(rank), np.float32),
-                          splits=splits, name="shm_a2a")
-    np.testing.assert_array_equal(a2a, np.arange(size, dtype=np.float32))
+    # Alltoall rides shm (uneven splits; receivers pull their slice from
+    # each sender's region using the header split table).
+    before = shm.ops_executed
+    splits = [rank + 1] * size
+    v = (np.arange((rank + 1) * size, dtype=np.float32) + 10 * rank)
+    a2a, recv = hvd.alltoall(v, splits=splits, name="shm_a2a")
+    expected = np.concatenate(
+        [(np.arange(rank * (r + 1), (rank + 1) * (r + 1))
+          + 10 * r).astype(np.float32) for r in range(size)])
+    np.testing.assert_array_equal(a2a, expected)
+    np.testing.assert_array_equal(np.asarray(recv),
+                                  np.arange(1, size + 1))
+    assert shm.ops_executed == before + 1, "alltoall must ride shm"
+
+    # Oversized alltoall (2 MB > the 1 MB battery capacity): every rank
+    # delegates to the TCP exchange mid-protocol via the header flag.
+    rows_per_dst = (2 << 20) // 4 // size + 1   # ~2 MB total buffer
+    v = np.arange(rows_per_dst * size, dtype=np.float32) + 1000 * rank
+    a2a, recv = hvd.alltoall(v, splits=[rows_per_dst] * size,
+                             name="shm_a2a_big")
+    expected = np.concatenate(
+        [np.arange(rank * rows_per_dst, (rank + 1) * rows_per_dst,
+                   dtype=np.float32) + 1000 * r for r in range(size)])
+    np.testing.assert_array_equal(a2a, expected)
+    assert shm.ops_executed == before + 1, "oversized a2a must delegate"
+
     for i in range(5):
         out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
                             name="shm_steady")
