@@ -18,6 +18,15 @@ from ..common.status import Status
 from ..common.tensor_queue import TensorTableEntry
 
 
+def dim0_row_bounds(n_rows: int, size: int) -> list[int]:
+    """Uneven dim-0 reducescatter split: rank r owns rows
+    [bounds[r], bounds[r+1]); the first ``rem`` ranks get one extra row.
+    MUST stay identical across the TCP/shm/XLA planes — they interoperate
+    (fallbacks, hierarchical mixes) and must scatter the same rows."""
+    base, rem = divmod(n_rows, size)
+    return [r * base + min(r, rem) for r in range(size + 1)]
+
+
 def accum_dtype(dtype: np.dtype) -> np.dtype:
     """Accumulation dtype for reductions: 16-bit floats widen to fp32,
     everything else reduces in place (the numerics contract shared by the

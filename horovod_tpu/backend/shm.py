@@ -41,7 +41,8 @@ from ..common.dtypes import element_size, to_numpy
 from ..common.message import Response, ResponseType
 from ..common.status import Status
 from ..common.tensor_queue import TensorTableEntry
-from .base import CollectiveBackend, accum_dtype as _accum_dtype
+from .base import (CollectiveBackend, accum_dtype as _accum_dtype,
+                   dim0_row_bounds)
 
 _HEADER = 4096          # one page: seq word + splits table + padding
 _SEQ_OFFSET = 0
@@ -330,6 +331,12 @@ class ShmBackend(CollectiveBackend):
         elif rt == ResponseType.BROADCAST and len(entries) == 1:
             nbytes = response.tensor_sizes[0] * \
                 element_size(response.tensor_type)
+        elif rt == ResponseType.REDUCESCATTER and len(entries) == 1 \
+                and entries[0].tensor is not None:
+            # Shapes are cross-rank validated for reducescatter, so the
+            # local staging size is a rank-symmetric decision.
+            nbytes = np.asarray(entries[0].tensor).size * \
+                element_size(response.tensor_type)
         elif rt == ResponseType.ALLTOALL:
             # Every clause is rank-symmetric (alltoall with a joined rank
             # is rejected upstream, so tensors are present everywhere);
@@ -534,6 +541,53 @@ class ShmBackend(CollectiveBackend):
                 offset += count
             entry.output = out.reshape((total,) + local.shape[1:])
             w.publish(3 * t + 3)
+            self.ops_executed += 1
+            return Status.ok()
+        except BaseException:
+            w.poison()
+            raise
+        finally:
+            self._act_end(entries)
+
+    def reducescatter(self, response: Response,
+                      entries: list[TensorTableEntry]) -> Status:
+        """Stage the full buffer; reduce only my dim-0 row range across
+        all regions (same uneven row split as the TCP plane) — no gather
+        phase at all, 2 barriers, (size-1)/size of the payload read."""
+        w = self.world
+        t = w._t
+        w._t += 1
+        self._act_start(entries, "SHM_REDUCESCATTER")
+        try:
+            np_dtype = to_numpy(response.tensor_type)
+            (entry,) = entries
+            local = np.ascontiguousarray(
+                np.asarray(entry.tensor, dtype=np_dtype))
+            shape = local.shape
+            rest = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+            rows = dim0_row_bounds(shape[0], w.size)
+            lo = rows[w.rank] * rest
+            hi = rows[w.rank + 1] * rest
+
+            w.wait_all(3 * t)
+            flat = self.scale_buffer(local.reshape(-1),
+                                     response.prescale_factor)
+            w.data(w.rank)[:flat.nbytes] = flat.view(np.uint8)
+            w.publish(3 * t + 1)
+            w.wait_all(3 * t + 1)
+            acc_dt = _accum_dtype(np_dtype)
+            acc = flat[lo:hi].astype(acc_dt, copy=True)
+            for r in range(w.size):
+                if r != w.rank:
+                    peer = w.data(r)[lo * np_dtype.itemsize:
+                                     hi * np_dtype.itemsize].view(np_dtype)
+                    acc += peer.astype(acc_dt) if acc_dt != np_dtype \
+                        else peer
+            w.publish(3 * t + 3)
+            out = self.scale_buffer(acc.astype(np_dtype, copy=False),
+                                    response.postscale_factor)
+            my_rows = rows[w.rank + 1] - rows[w.rank]
+            entry.output = out.reshape((my_rows,) + shape[1:])
             self.ops_executed += 1
             return Status.ok()
         except BaseException:

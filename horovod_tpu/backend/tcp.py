@@ -25,7 +25,8 @@ from ..common.status import Status
 from ..common.tensor_queue import TensorTableEntry
 from ..common.dtypes import to_numpy
 from ..runner.network import PeerMesh
-from .base import CollectiveBackend, accum_dtype as _accum_dtype
+from .base import (CollectiveBackend, accum_dtype as _accum_dtype,
+                   dim0_row_bounds)
 
 
 class TcpCollectives:
@@ -319,8 +320,7 @@ class TcpBackend(CollectiveBackend):
                 np.asarray(e.tensor, dtype=to_numpy(response.tensor_type)))
             shape = local.shape
             rest = int(np.prod(shape[1:])) if len(shape) > 1 else 1
-            base, rem = divmod(shape[0], size)
-            rows = [r * base + min(r, rem) for r in range(size + 1)]
+            rows = dim0_row_bounds(shape[0], size)
             bounds = np.asarray(rows) * rest
             buf = self.scale_buffer(local.reshape(-1),
                                     response.prescale_factor)
@@ -345,9 +345,7 @@ class TcpBackend(CollectiveBackend):
             offset += n
             shape = np.asarray(e.tensor).shape
             full = chunk.reshape(shape)
-            base, rem = divmod(shape[0], self.coll.size)
-            starts = [r * base + min(r, rem)
-                      for r in range(self.coll.size + 1)]
+            starts = dim0_row_bounds(shape[0], self.coll.size)
             sliced = full[starts[self.coll.rank]:
                           starts[self.coll.rank + 1]]
             e.output = sliced.copy() if self.fusion_buffers.owns(buf) \

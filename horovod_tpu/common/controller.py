@@ -450,6 +450,12 @@ class Controller:
 
         if rtype in (RequestType.ALLREDUCE, RequestType.ADASUM,
                      RequestType.REDUCESCATTER):
+            if rtype == RequestType.REDUCESCATTER and joined:
+                # A joined rank's zero stand-in has no shape, and the
+                # dim-0 output split needs every rank's shape — same
+                # category as allgather/broadcast under Join.
+                return error("Reducescatter is not supported after a rank "
+                             "has joined: all ranks must participate.")
             for r in reqs[1:]:
                 if tuple(r.tensor_shape) != tuple(first.tensor_shape):
                     return error(

@@ -1029,6 +1029,20 @@ def battery_shm(hvd, rank, size):
                                   np.arange(1, size + 1))
     assert shm.ops_executed == before + 1, "alltoall must ride shm"
 
+    # Reducescatter rides shm (uneven rows; last rank may get fewer).
+    before = shm.ops_executed
+    x = (np.arange((2 * size + 1) * 3, dtype=np.float32)
+         .reshape(2 * size + 1, 3) * (rank + 1))
+    out = hvd.reducescatter(x, op=hvd.Sum, name="shm_rs")
+    total = (np.arange((2 * size + 1) * 3, dtype=np.float64)
+             .reshape(2 * size + 1, 3) * sum(r + 1 for r in range(size)))
+    base, rem = divmod(2 * size + 1, size)
+    starts = [r * base + min(r, rem) for r in range(size + 1)]
+    np.testing.assert_allclose(np.asarray(out, np.float64),
+                               total[starts[rank]:starts[rank + 1]],
+                               rtol=1e-6)
+    assert shm.ops_executed == before + 1, "reducescatter must ride shm"
+
     # Oversized alltoall (2 MB > the 1 MB battery capacity): every rank
     # delegates to the TCP exchange mid-protocol via the header flag.
     rows_per_dst = (2 << 20) // 4 // size + 1   # ~2 MB total buffer
