@@ -218,7 +218,19 @@ class CollectiveBackend(ABC):
                     f"alltoall splits must have one entry per rank "
                     f"(got {len(entry.splits)} for world size "
                     f"{world_size})")
-            return list(entry.splits)
+            splits = [int(s) for s in entry.splits]
+            if any(s < 0 for s in splits):
+                return Status.invalid_argument(
+                    f"alltoall splits must be non-negative (got {splits})")
+            # Reference rejects split tables inconsistent with the tensor
+            # (operations.cc:1176 "Sum of splits entries is greater than
+            # the first dimension"); we require exact coverage so no plane
+            # can silently read stale or truncated bytes.
+            if sum(splits) != dim0:
+                return Status.invalid_argument(
+                    f"alltoall splits must sum to the first dimension "
+                    f"(sum {sum(splits)} != dim0 {dim0})")
+            return splits
         if dim0 % world_size != 0:
             return Status.invalid_argument(
                 "alltoall first dimension must be divisible by the "

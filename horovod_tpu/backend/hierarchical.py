@@ -71,7 +71,14 @@ class HierarchicalTcpBackend(CollectiveBackend):
 
     def _use_shm_legs(self, wire_dtype: np.dtype, nbytes: int) -> bool:
         from .base import accum_dtype as _accum_dtype
-        return (self.shm_local is not None and self.shm_local.formed
+        # poison_seen (not bare `formed`): after any host-local rank
+        # poisons — e.g. its cross leg threw after op t — EVERY local
+        # rank must decline the shm legs for op t+1 together, or the
+        # fallen-back rank blocks in the TCP local legs while its peers
+        # error inside the shm protocol (the same unanimous-decline rule
+        # as ShmBackend.enabled()).
+        return (self.shm_local is not None
+                and not self.shm_local.poison_seen()
                 and nbytes <= self.shm_local.capacity
                 # 16-bit wires keep the TCP legs: those stay in one fp32
                 # accumulation across all three legs, which the wire-dtype

@@ -51,11 +51,14 @@ _SEQ_OFFSET = 0
 # +8, then up to _MAX_SPLITS int64 entries at +16.
 _SPLITS_OFFSET = 16
 _MAX_SPLITS = (_HEADER - _SPLITS_OFFSET) // 8
-# Sequence value a rank publishes when an op failed mid-protocol (e.g. the
-# hierarchical cross leg raising between barriers): peers detect it in
-# wait_all, raise, and the whole host falls back to the TCP planes —
-# instead of spinning out the barrier timeout or completing with
-# partially-reduced garbage.
+# Poison flag bit, OR'd onto the failing rank's LAST PUBLISHED sequence
+# value (e.g. a rank failing after publishing 3t+1 poisons to
+# _POISON + 3t+1).  Carrying the high-water mark matters: a rank that
+# fails AFTER completing op t must not error a slow peer still inside op
+# t's last wait — everything that peer needs was already published — so
+# wait_all honors published progress below the mark and raises only for
+# barriers beyond it (data that will never arrive).  The whole host then
+# declines shm unanimously at the next op via ``poison_seen``.
 _POISON = 1 << 62
 
 
@@ -230,15 +233,53 @@ class ShmWorld:
         self._seqs[self.rank][0] = value
 
     def poison(self) -> None:
-        """Mark this world failed: peers blocked in wait_all raise instead
-        of timing out, and this world opts out of future ops (every rank
-        reaches the same conclusion at the same op, keeping the backend
-        chain rank-symmetric)."""
+        """Mark this world failed: peers blocked on data we never staged
+        raise instead of timing out, peers merely draining barriers we
+        already satisfied complete normally, and every rank declines shm
+        for the next op (``poison_seen``), keeping the backend chain
+        rank-symmetric."""
         self.formed = False
         try:
-            self._seqs[self.rank][0] = _POISON   # type: ignore[index]
+            cur = int(self._seqs[self.rank][0])   # type: ignore[index]
+            if cur < _POISON:   # idempotent: keep the original mark
+                self._seqs[self.rank][0] = _POISON + cur
         except Exception:  # noqa: BLE001 - already closed
             pass
+
+    def poison_seen(self) -> bool:
+        """Cross-rank poison probe for ``enabled()``.  A rank that fails
+        AFTER its peers' last wait of op t (e.g. MemoryError during
+        unpack) poisons and runs op t+1 on TCP — but peers that already
+        finished op t would only notice inside op t+1's shm wait, a
+        one-op plane desync that leaves the fallen-back rank blocked in
+        the TCP ring until transport timeout.  Reading every seq word
+        BEFORE claiming an op makes the decline unanimous.
+
+        Residual race (accepted, bounded): a fast peer can pass this
+        probe and enter op t+1's shm protocol before the failing rank
+        writes its mark.  Outcome: the peer's first data wait (>= 3t+4)
+        exceeds the decliner's boundary mark (3t+3) and raises a
+        structured error for op t+1, while the decliner waits out the
+        TCP transport timeout for the same op; from op t+2 every rank is
+        on TCP.  Blast radius is ONE op, surfaced as
+        HorovodInternalError on every affected rank (elastic recovery's
+        trigger) — never stale data (see the freshness invariant in
+        wait_all).  A TCP retry inside the raising op would be unsound:
+        the mark cannot distinguish "declined to TCP" from "claimed op
+        t+1 on shm and died before its first publish", and retrying
+        against the latter mis-pairs payloads on the persistent TCP
+        sockets."""
+        if not self.formed:
+            return True
+        try:
+            if any(int(s[0]) >= _POISON  # type: ignore[index]
+                   for s in self._seqs):
+                self.formed = False
+                return True
+        except Exception:  # noqa: BLE001 - region torn down under us
+            self.formed = False
+            return True
+        return False
 
     def wait_all(self, target: int) -> None:
         start = time.monotonic()
@@ -246,12 +287,26 @@ class ShmWorld:
         next_liveness = start + 0.5
         while True:
             seqs = [int(s[0]) for s in self._seqs]  # type: ignore[index]
-            if any(s >= _POISON for s in seqs):
+            # Published progress counts even from a poisoned rank (the
+            # mark is its last publish + _POISON): barriers the failing
+            # rank already satisfied complete; only barriers past its
+            # high-water mark — data that will never arrive — raise.
+            # A LIVE rank below the target is simply slow: keep waiting
+            # (PID liveness and the barrier deadline cover death/stalls)
+            # rather than letting a covering poison mark error an op the
+            # slow rank is about to finish.  Freshness invariant: every
+            # data-guarded wait in the five protocols targets >= 3t+1 of
+            # its own op, while a rank that completed op t-1 and then
+            # declined marks at exactly the 3t boundary — so a poison
+            # mark can never satisfy a wait that would read data the
+            # marked rank never staged.
+            if all((s - _POISON if s >= _POISON else s) >= target
+                   for s in seqs):
+                return
+            if any(s >= _POISON and s - _POISON < target for s in seqs):
                 self.formed = False
                 raise ConnectionError(
                     "shm world poisoned by a peer failure")
-            if all(s >= target for s in seqs):
-                return
             now = time.monotonic()
             if now >= next_liveness:
                 next_liveness = now + 0.5
@@ -323,6 +378,8 @@ class ShmBackend(CollectiveBackend):
 
     def enabled(self, response: Response,
                 entries: list[TensorTableEntry]) -> bool:
+        if self.world.poison_seen():
+            return False
         rt = response.response_type
         if rt == ResponseType.ALLREDUCE:
             # Fused payload must fit one region.

@@ -658,10 +658,23 @@ def enqueue_alltoall(name: str, tensor,
     arr = _as_array(tensor)
     split_list = [int(x) for x in np.asarray(splits).reshape(-1)] \
         if splits is not None else []
-    if split_list and sum(split_list) != arr.shape[0]:
-        raise ValueError(
-            f"alltoall splits sum to {sum(split_list)} but tensor first "
-            f"dimension is {arr.shape[0]}")
+    # Validate at ENQUEUE like the reference (operations.cc:1176): the
+    # submitting rank fails fast before negotiation, so an invalid table
+    # never reaches a pairwise exchange where a rank-local rejection
+    # would strand peers mid-protocol.  resolve_alltoall_splits repeats
+    # these checks defensively for internal callers.
+    if split_list:
+        if len(split_list) != st.size:
+            raise ValueError(
+                f"alltoall splits must have one entry per rank (got "
+                f"{len(split_list)} for world size {st.size})")
+        if any(s < 0 for s in split_list):
+            raise ValueError(
+                f"alltoall splits must be non-negative (got {split_list})")
+        if sum(split_list) != arr.shape[0]:
+            raise ValueError(
+                f"alltoall splits sum to {sum(split_list)} but tensor "
+                f"first dimension is {arr.shape[0]}")
     entry = TensorTableEntry(tensor_name=name, tensor=arr,
                              splits=split_list)
     request = Request(request_rank=st.rank,

@@ -76,6 +76,39 @@ def test_alltoall_identity():
     np.testing.assert_array_equal(out, x)
 
 
+def test_alltoall_splits_validation():
+    """Split tables are validated before any plane touches bytes
+    (reference: operations.cc:1176 rejects splits inconsistent with dim 0):
+    wrong length, negative entries, and sum != dim0 are structured errors,
+    not silent truncation/stale reads."""
+    from horovod_tpu.backend.base import CollectiveBackend
+    from horovod_tpu.common.status import Status
+    from horovod_tpu.common.tensor_queue import TensorTableEntry
+
+    def resolve(splits, dim0=8, world=4):
+        e = TensorTableEntry(tensor_name="t")
+        e.splits = splits or []
+        return CollectiveBackend.resolve_alltoall_splits(e, dim0, world)
+
+    assert resolve([2, 2, 2, 2]) == [2, 2, 2, 2]
+    assert resolve([0, 8, 0, 0]) == [0, 8, 0, 0]
+    # even default when no splits given
+    assert resolve(None) == [2, 2, 2, 2]
+    assert isinstance(resolve([2, 2, 2]), Status)           # wrong length
+    assert isinstance(resolve([2, 2, 2, -2]), Status)       # negative
+    assert isinstance(resolve([2, 2, 2, 4]), Status)        # sum > dim0
+    assert isinstance(resolve([1, 1, 1, 1]), Status)        # sum < dim0
+    assert isinstance(resolve(None, dim0=7), Status)        # indivisible
+
+
+def test_alltoall_bad_splits_structured_error():
+    """End-to-end: a bad split table surfaces as a raised error through
+    the public API, on whatever plane is active."""
+    x = np.arange(8, dtype=np.float32)
+    with pytest.raises(Exception, match="splits"):
+        hvd.alltoall(x, splits=[3, 3, 3, 3])   # single rank: len != 1
+
+
 def test_barrier():
     hvd.barrier()
 

@@ -21,20 +21,20 @@ def kv():
     server.stop()
 
 
-def _form_pair(kv, scope: str, capacity: int = 1 << 16):
-    """Form a 2-rank world with both ranks in one process (two instances
+def _form_world(kv, scope: str, n: int = 2, capacity: int = 1 << 16):
+    """Form an n-rank world with all ranks in one process (instances
     attaching to each other's regions — formation needs concurrency)."""
-    worlds: list = [None, None]
+    worlds: list = [None] * n
     errors: list = []
 
     def make(rank: int) -> None:
         try:
-            worlds[rank] = ShmWorld(rank, 2, kv, scope=scope,
+            worlds[rank] = ShmWorld(rank, n, kv, scope=scope,
                                     capacity=capacity, timeout=10.0)
         except BaseException as e:  # noqa: BLE001
             errors.append(e)
 
-    threads = [threading.Thread(target=make, args=(r,)) for r in range(2)]
+    threads = [threading.Thread(target=make, args=(r,)) for r in range(n)]
     for t in threads:
         t.start()
     for t in threads:
@@ -42,6 +42,10 @@ def _form_pair(kv, scope: str, capacity: int = 1 << 16):
     assert not errors, errors
     assert all(w is not None and w.formed for w in worlds), worlds
     return worlds
+
+
+def _form_pair(kv, scope: str, capacity: int = 1 << 16):
+    return _form_world(kv, scope, 2, capacity)
 
 
 def test_shm_world_forms_and_steps(kv):
@@ -84,13 +88,89 @@ def test_shm_poison_unblocks_waiters(kv):
         b.close()
 
 
-def test_shm_poison_value_is_detectable(kv):
+def test_shm_poison_carries_high_water_mark(kv):
+    """A rank that fails AFTER publishing seq k poisons to _POISON+k:
+    barriers <= k (data already staged) still complete on peers; barriers
+    beyond k raise.  This is the post-op-failure case — without the mark,
+    a slow peer still draining op t's last wait would error an op whose
+    data was fully published."""
     a, b = _form_pair(kv, "unit3")
     try:
-        b.poison()
-        assert int(b._seqs[1][0]) == _POISON
+        b.publish(4)        # b completed through seq 4...
+        b.poison()          # ...then failed
+        assert int(b._seqs[1][0]) == _POISON + 4
+        a.publish(4)
+        a.wait_all(4)       # satisfied by b's published progress: no raise
         with pytest.raises(ConnectionError):
-            a.wait_all(0)   # even a satisfied target reports the poison
+            a.wait_all(5)   # beyond b's mark: will never arrive
+        assert not a.formed
+    finally:
+        a.close()
+        b.close()
+
+
+def test_shm_poison_is_idempotent(kv):
+    a, b = _form_pair(kv, "unit3b")
+    try:
+        b.publish(2)
+        b.poison()
+        b.poison()          # double-fault keeps the original mark
+        assert int(b._seqs[1][0]) == _POISON + 2
+    finally:
+        a.close()
+        b.close()
+
+
+def test_shm_poison_mark_does_not_error_live_slow_rank(kv):
+    """3-rank world: c completes through seq 2 then poisons; a is live
+    but still at seq 1.  b's wait_all(2) must KEEP WAITING for a (live
+    slow ranks are the liveness poll's job), not raise on c's covering
+    mark — and must complete once a catches up.  Raising here would make
+    the same collective fail on b but succeed on a (rank-divergent
+    outcome)."""
+    a, b, c = _form_world(kv, "unit3c", n=3)
+    try:
+        a.publish(1)
+        b.publish(2)
+        c.publish(2)
+        c.poison()
+        assert int(c._seqs[2][0]) == _POISON + 2
+
+        result: list = []
+
+        def waiter():
+            try:
+                b.wait_all(2)
+                result.append("completed")
+            except ConnectionError:
+                result.append("poisoned")
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        th.join(0.5)
+        assert th.is_alive(), "b must wait for live rank a, not raise"
+        a.publish(2)          # slow rank catches up
+        th.join(10.0)
+        assert result == ["completed"]
+        with pytest.raises(ConnectionError):
+            b.wait_all(3)     # beyond c's mark: genuinely unsatisfiable
+    finally:
+        a.close()
+        b.close()
+        c.close()
+
+
+def test_shm_poison_seen_declines_next_op(kv):
+    """enabled()'s cross-rank probe: after any rank poisons, EVERY rank's
+    poison_seen() is True before the next op is claimed — the unanimous
+    TCP fallback that prevents a one-op plane desync."""
+    a, b = _form_pair(kv, "unit4")
+    try:
+        assert not a.poison_seen() and not b.poison_seen()
+        b.poison()
+        assert a.poison_seen()      # peer sees the mark...
+        assert not a.formed         # ...and opts out locally
+        assert b.poison_seen()
     finally:
         a.close()
         b.close()
