@@ -1,0 +1,180 @@
+"""Shaped-link validation of the cross-host collectives story.
+
+Emulates a 2-host x 2-slot cluster on one machine with network
+namespaces: ranks 0-1 live in netns h0, ranks 2-3 in h1, joined by a
+veth pair carrying a token-bucket bandwidth cap (tc tbf) — so intra-host
+traffic rides each namespace's loopback at memory speed while cross-host
+bytes squeeze through the shaped link, the topology the hierarchical
+schedule exists for (reference: NCCLHierarchicalAllreduce,
+nccl_operations.cc:187-398 — the cross leg carries 1/local_size of the
+payload; docs/benchmarks.rst:13-14 measures the reference cross-host).
+
+Measures end-to-end allreduce algorithm bandwidth (payload bytes / wall
+time) for:
+  flat      — one world-size TCP ring over the shaped link
+  hier-tcp  — RS(local) -> AR(cross) -> AG(local), all legs TCP
+  hier-shm  — same schedule, intra-host legs on the mmap shm plane
+
+The shm memory-domain fingerprint includes the net-namespace inode, so
+the namespace boundary behaves exactly like a host boundary: the global
+shm world declines to form across "hosts" (as on real clusters), while
+the hierarchical per-host local worlds still form inside each namespace.
+
+Run as root: python benchmarks/shaped_link.py [--rate 1gbit] [--mb 16]
+Requires: iproute2 (ip, tc with tbf), CAP_NET_ADMIN.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys, time
+sys.path.insert(0, os.environ["SHAPED_REPO"])
+import numpy as np
+import horovod_tpu as hvd
+
+hvd.init()
+mb = int(os.environ["SHAPED_MB"])
+reps = int(os.environ["SHAPED_REPS"])
+v = np.ones(mb * (1 << 20) // 4, np.float32)
+for _ in range(2):
+    hvd.allreduce(v, op=hvd.Sum, name="warm")
+t0 = time.perf_counter()
+for _ in range(reps):
+    hvd.allreduce(v, op=hvd.Sum, name="ar")
+dt = time.perf_counter() - t0
+out = hvd.allreduce(np.full(4, float(hvd.rank()), np.float32),
+                    op=hvd.Sum, name="check")
+assert abs(float(out[0]) - sum(range(hvd.size()))) < 1e-6
+if hvd.rank() == 0:
+    print("RESULT %.4f" % (reps * v.nbytes / dt / 1e9), flush=True)
+hvd.shutdown()
+"""
+
+
+def sh(cmd: str) -> None:
+    subprocess.run(cmd, shell=True, check=True)
+
+
+def setup(rate: str) -> None:
+    teardown()
+    sh("ip netns add h0 && ip netns add h1")
+    sh("ip link add veth0 type veth peer name veth1")
+    sh("ip link set veth0 netns h0 && ip link set veth1 netns h1")
+    for ns, dev, ip in (("h0", "veth0", "10.99.0.1"),
+                        ("h1", "veth1", "10.99.0.2")):
+        sh(f"ip netns exec {ns} ip addr add {ip}/24 dev {dev}")
+        sh(f"ip netns exec {ns} ip link set {dev} up")
+        sh(f"ip netns exec {ns} ip link set lo up")
+        if rate != "unshaped":
+            sh(f"ip netns exec {ns} tc qdisc add dev {dev} root tbf "
+               f"rate {rate} burst 256kb latency 100ms")
+
+
+def teardown() -> None:
+    subprocess.run("ip netns del h0; ip netns del h1", shell=True,
+                   capture_output=True)
+
+
+def run_config(name: str, mb: int, reps: int, extra_env: dict) -> float:
+    """Launch 4 ranks (2 per namespace) against a rendezvous server that
+    itself runs inside h0, bound on the veth address."""
+    epoch = f"{name}-{time.time()}"
+    server = subprocess.Popen(
+        ["ip", "netns", "exec", "h0", sys.executable, "-c",
+         "import sys; sys.path.insert(0, %r)\n"
+         "from horovod_tpu.runner.network import RendezvousServer\n"
+         "import time\n"
+         "s = RendezvousServer()\n"
+         "print('PORT', s.start(), flush=True)\n"
+         "time.sleep(600)" % REPO],
+        stdout=subprocess.PIPE)
+    line = server.stdout.readline().decode().split()
+    assert line and line[0] == "PORT", line
+    port = int(line[1])
+    procs = []
+    for rank in range(4):
+        host = rank // 2
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO,
+                   SHAPED_REPO=REPO, SHAPED_MB=str(mb),
+                   SHAPED_REPS=str(reps),
+                   HOROVOD_RANK=str(rank), HOROVOD_SIZE="4",
+                   HOROVOD_LOCAL_RANK=str(rank % 2),
+                   HOROVOD_LOCAL_SIZE="2",
+                   HOROVOD_CROSS_RANK=str(host), HOROVOD_CROSS_SIZE="2",
+                   HOROVOD_GLOO_RENDEZVOUS_ADDR="10.99.0.1",
+                   HOROVOD_GLOO_RENDEZVOUS_PORT=str(port),
+                   HOROVOD_RENDEZVOUS_EPOCH=epoch,
+                   HOROVOD_GLOO_IFACE=f"veth{host}",
+                   **{k: str(v) for k, v in extra_env.items()})
+        procs.append(subprocess.Popen(
+            ["ip", "netns", "exec", f"h{host}", sys.executable, "-c",
+             WORKER], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT))
+    result = None
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        text = out.decode(errors="replace")
+        if p.returncode != 0:
+            print(f"--- rank {r} FAILED (rc={p.returncode}) ---\n{text}",
+                  file=sys.stderr)
+        for line in text.splitlines():
+            if line.startswith("RESULT "):
+                result = float(line.split()[1])
+    server.kill()
+    if result is None:
+        raise RuntimeError(f"config {name}: no result")
+    return result
+
+
+CONFIGS = {
+    "flat": {"HOROVOD_SHM_OPERATIONS": 0},
+    "hier-tcp": {"HOROVOD_SHM_OPERATIONS": 0,
+                 "HOROVOD_HIERARCHICAL_ALLREDUCE": 1,
+                 "HOROVOD_HIERARCHICAL_ALLGATHER": 1},
+    # SHM auto: the global world declines across the netns boundary (as
+    # on real clusters); the per-host local-leg worlds form.
+    "hier-shm": {"HOROVOD_HIERARCHICAL_ALLREDUCE": 1,
+                 "HOROVOD_HIERARCHICAL_ALLGATHER": 1},
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rates", default="unshaped,5gbit,1gbit,200mbit")
+    ap.add_argument("--mb", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=6)
+    ap.add_argument("--configs", default="flat,hier-tcp,hier-shm")
+    args = ap.parse_args()
+
+    if os.geteuid() != 0:
+        sys.exit("needs root (netns + tc)")
+    results: dict = {}
+    for rate in args.rates.split(","):
+        setup(rate)
+        try:
+            for cfg in args.configs.split(","):
+                gbps = run_config(cfg, args.mb, args.reps, CONFIGS[cfg])
+                results.setdefault(rate, {})[cfg] = round(gbps, 4)
+                print(f"{rate:>10}  {cfg:>9}: {gbps:.3f} GB/s "
+                      f"(payload {args.mb} MiB, 4 ranks)", flush=True)
+        finally:
+            teardown()
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -238,6 +238,77 @@ class CollectiveBackend(ABC):
         return [dim0 // world_size] * world_size
 
     @staticmethod
+    def allgather_entry_dims(response: Response, n_entries: int,
+                             world_size: int) -> list[list[int]]:
+        """Per-entry per-rank first dims of a (possibly fused) allgather
+        response: tensor_sizes holds one world_size block per entry
+        (reference: message.cc:380-388 Response::add_allgather_response)."""
+        sizes = list(response.tensor_sizes)
+        assert len(sizes) == n_entries * world_size, \
+            (len(sizes), n_entries, world_size)
+        return [sizes[i * world_size:(i + 1) * world_size]
+                for i in range(n_entries)]
+
+    @staticmethod
+    def _fused_allgather_layout(dims: list[list[int]], rests: list[int],
+                                itemsize: int) -> tuple[np.ndarray,
+                                                        np.ndarray]:
+        """(bytes[i][r], exclusive per-rank entry prefix[i][r]) for the
+        rank-major/entry-major packed layout — one cumsum instead of an
+        O(entries) Python sum per (entry, rank) in the hot unpack path."""
+        nbytes = np.asarray(dims, dtype=np.int64) * \
+            (np.asarray(rests, dtype=np.int64)[:, None] * itemsize)
+        return nbytes, np.cumsum(nbytes, axis=0) - nbytes
+
+    @staticmethod
+    def pack_fused_allgather(response: Response,
+                             entries: list[TensorTableEntry],
+                             dtype: np.dtype, world_size: int):
+        """Encode the fused-allgather wire layout shared by the TCP, XLA,
+        shm and hierarchical planes: each rank's packed payload is the
+        concatenation of its block of every entry (entry-major), as raw
+        bytes so entries with different trailing shapes share one
+        exchange.  Returns (locals_, dims, rests, per_rank_bytes,
+        payload)."""
+        dims = CollectiveBackend.allgather_entry_dims(
+            response, len(entries), world_size)
+        locals_ = [np.ascontiguousarray(np.asarray(e.tensor, dtype=dtype))
+                   for e in entries]
+        rests = [int(np.prod(a.shape[1:])) if a.ndim > 1 else 1
+                 for a in locals_]
+        nbytes, _ = CollectiveBackend._fused_allgather_layout(
+            dims, rests, dtype.itemsize)
+        per_rank = nbytes.sum(axis=0).tolist()
+        payload = np.concatenate([a.reshape(-1).view(np.uint8)
+                                  for a in locals_])
+        return locals_, dims, rests, per_rank, payload
+
+    @staticmethod
+    def unpack_fused_allgather(full: np.ndarray,
+                               entries: list[TensorTableEntry],
+                               locals_: list[np.ndarray],
+                               dims: list[list[int]],
+                               rests: list[int],
+                               dtype: np.dtype,
+                               per_rank: list[int]) -> None:
+        """Slice a rank-major/entry-major packed byte exchange back into
+        per-entry outputs in global rank order (the decoder paired with
+        pack_fused_allgather)."""
+        size = len(per_rank)
+        rank_off = np.cumsum([0] + list(per_rank))
+        nbytes, ent_off = CollectiveBackend._fused_allgather_layout(
+            dims, rests, dtype.itemsize)
+        for i, e in enumerate(entries):
+            blocks = []
+            rest_shape = locals_[i].shape[1:]
+            for r in range(size):
+                off = int(rank_off[r] + ent_off[i, r])
+                blk = full[off:off + int(nbytes[i, r])].view(dtype) \
+                    .reshape((dims[i][r],) + rest_shape)
+                blocks.append(blk)
+            e.output = np.concatenate(blocks, axis=0)
+
+    @staticmethod
     def scale_buffer(buf: np.ndarray, factor: float) -> np.ndarray:
         if factor == 1.0:
             return buf

@@ -243,27 +243,51 @@ class HierarchicalTcpBackend(CollectiveBackend):
     # -- allgather: gather(local) -> gather node blocks (cross) ------------
     def allgather(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:
+        """Node-local gather, then one exchange of whole node blocks —
+        and for a fused response the packing happens ONCE: every entry's
+        block rides a single local gather and a single cross exchange
+        (reference: mpi_operations.cc MPIHierarchicalAllgather, which
+        likewise moves the node block as one unit), instead of 2×N
+        collectives for N fused tensors.
+
+        Packed byte layout (shared with the flat planes'
+        unpack_fused_allgather): rank-major, entry-major within a rank;
+        the global rank order is host-major × local-rank-major, so
+        concatenating host blocks reproduces it."""
         lsize = self.local.size
         csize = self.cross.size
         crank = self.cross.rank
-        dims = list(response.tensor_sizes)  # per-rank first dims, rank order
         np_dtype = to_numpy(response.tensor_type)
-        for e in entries:
-            local_arr = np.asarray(e.tensor, dtype=np_dtype)
-            # Host-major rank layout: host h owns dims[h*lsize:(h+1)*lsize].
-            node_dims = dims[crank * lsize:(crank + 1) * lsize]
-            node_block = self.local.allgatherv(local_arr, node_dims)
-            self.leg_ops["local_gather"] += 1
-            self.leg_bytes["local_gather"] += \
-                node_block.size * node_block.dtype.itemsize
-            # Cross leg: exchange whole node blocks; concatenation in host
-            # order reproduces global rank order.
-            host_dims = [sum(dims[h * lsize:(h + 1) * lsize])
-                         for h in range(csize)]
-            e.output = self.cross.allgatherv(node_block, host_dims)
-            self.leg_ops["cross_gather"] += 1
-            self.leg_bytes["cross_gather"] += \
-                e.output.size * e.output.dtype.itemsize
+        locals_, dims, rests, per_rank, payload = \
+            self.pack_fused_allgather(response, entries, np_dtype,
+                                      lsize * csize)
+
+        # Leg 1: gather this host's packed rank blocks over the local
+        # mesh (shm-free path rides the TCP ring; byte-level so fused
+        # entries with different trailing shapes share the exchange).
+        node_bytes = per_rank[crank * lsize:(crank + 1) * lsize]
+        self._act_start(entries, "LOCAL_GATHER")
+        try:
+            node_block = self.local.allgatherv(payload, node_bytes)
+        finally:
+            self._act_end(entries)
+        self.leg_ops["local_gather"] += 1
+        self.leg_bytes["local_gather"] += node_block.size
+
+        # Leg 2: exchange whole node blocks across hosts; only the cross
+        # axis pays per-host traffic (the point of the hierarchy).
+        host_bytes = [sum(per_rank[h * lsize:(h + 1) * lsize])
+                      for h in range(csize)]
+        self._act_start(entries, "CROSS_GATHER")
+        try:
+            full = self.cross.allgatherv(node_block, host_bytes)
+        finally:
+            self._act_end(entries)
+        self.leg_ops["cross_gather"] += 1
+        self.leg_bytes["cross_gather"] += full.size
+
+        self.unpack_fused_allgather(full, entries, locals_, dims, rests,
+                                    np_dtype, per_rank)
         return Status.ok()
 
     # Never selected (enabled() is False for these response types).

@@ -68,14 +68,19 @@ def _boot_fingerprint() -> str:
     machine, and the mount/IPC namespace inodes pin the /dev/shm tmpfs —
     two containers on one host share a boot id but NOT a mount ns, and a
     private /dev/shm must disqualify formation up front (the attach
-    verdict round below is the backstop)."""
+    verdict round below is the backstop).  The NET namespace is included
+    deliberately: it never splits ranks that could otherwise share
+    /dev/shm in practice (container setups split mnt/ipc too), and it
+    makes a network-namespace boundary behave exactly like a host
+    boundary — which is what netns-based cross-host emulation
+    (benchmarks/shaped_link.py) relies on."""
     parts = []
     try:
         with open("/proc/sys/kernel/random/boot_id") as f:
             parts.append(f.read().strip())
     except OSError:
         parts.append("noboot")
-    for ns in ("mnt", "ipc"):
+    for ns in ("mnt", "ipc", "net"):
         try:
             parts.append(str(os.stat(f"/proc/self/ns/{ns}").st_ino))
         except OSError:
@@ -356,8 +361,9 @@ class ShmWorld:
 
 class ShmBackend(CollectiveBackend):
     """Same-host allreduce, broadcast, ragged allgather and alltoall over
-    a ShmWorld; fused non-allreduce responses fall through to the TCP/XLA
-    planes via ``enabled()``.  Broadcast/allgather/alltoall use a
+    a ShmWorld; fused allreduce/allgather responses ride it natively
+    (entry-major packed staging), other fused shapes fall through to the
+    TCP/XLA planes via ``enabled()``.  Broadcast/allgather/alltoall use a
     2-barrier variant of the protocol (publish 3t+1 after staging, jump
     straight to 3t+3 after reading — the monotonic ``>=`` waits make the
     skipped middle word equivalent); alltoall additionally publishes its
@@ -402,16 +408,23 @@ class ShmBackend(CollectiveBackend):
                     and len(entries) == 1
                     and entries[0].tensor is not None
                     and self.world.size <= _MAX_SPLITS)
-        elif rt == ResponseType.ALLGATHER and len(entries) == 1 \
-                and entries[0].tensor is not None:
-            # Each rank stages only its OWN (largest-anywhere) block;
-            # allgather/broadcast responses are per-tensor by protocol
-            # (only ALLREDUCE/ADASUM fuse) — the len gate makes that a
-            # checked assumption rather than a silent one.
-            shape = np.asarray(entries[0].tensor).shape
-            rest = int(np.prod(shape[1:])) if len(shape) > 1 else 1
-            nbytes = max(response.tensor_sizes) * rest * \
-                element_size(response.tensor_type)
+        elif rt == ResponseType.ALLGATHER \
+                and all(e.tensor is not None for e in entries):
+            # Each rank stages only its OWN blocks (entry-major packed
+            # for fused responses); capacity must hold the LARGEST
+            # per-rank packed payload anywhere so the decision is
+            # rank-symmetric (dims come from the response, trailing
+            # shapes from our own entries — cross-rank validated equal).
+            esz = element_size(response.tensor_type)
+            dims = self.allgather_entry_dims(response, len(entries),
+                                             self.world.size)
+            rests = []
+            for e in entries:
+                shape = np.asarray(e.tensor).shape
+                rests.append(int(np.prod(shape[1:]))
+                             if len(shape) > 1 else 1)
+            per_rank, _ = self._fused_allgather_layout(dims, rests, esz)
+            nbytes = int(per_rank.sum(axis=0).max())
         else:
             return False
         return self.world.formed and nbytes <= self.world.capacity
@@ -566,37 +579,53 @@ class ShmBackend(CollectiveBackend):
 
     def allgather(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:
-        """Each rank stages its (ragged dim-0) block in its own region;
-        peers assemble the rank-ordered concatenation directly from the
-        owners' regions."""
+        """Each rank stages its (ragged dim-0) blocks in its own region —
+        entry-major packed for fused responses — and peers assemble the
+        rank-ordered concatenation directly from the owners' regions:
+        one staging pass and one read pass regardless of how many
+        tensors the response fused."""
         w = self.world
         t = w._t
         w._t += 1
         self._act_start(entries, "SHM_ALLGATHER")
         try:
             np_dtype = to_numpy(response.tensor_type)
-            dims = list(response.tensor_sizes)   # per-rank first dims
-            (entry,) = entries
-            local = np.ascontiguousarray(
-                np.asarray(entry.tensor, dtype=np_dtype))
-            rest = int(np.prod(local.shape[1:])) if local.ndim > 1 else 1
+            dims = self.allgather_entry_dims(response, len(entries),
+                                             w.size)
+            locals_ = [np.ascontiguousarray(
+                np.asarray(e.tensor, dtype=np_dtype)) for e in entries]
+            rests = [int(np.prod(a.shape[1:])) if a.ndim > 1 else 1
+                     for a in locals_]
+            itemsize = np_dtype.itemsize
+            # bytes[i][r] and each entry's exclusive prefix inside rank
+            # r's entry-major region (shared layout with the flat planes).
+            nbytes, ent_off = self._fused_allgather_layout(dims, rests,
+                                                           itemsize)
             w.wait_all(3 * t)
-            w.data(w.rank)[:local.nbytes] = \
-                local.reshape(-1).view(np.uint8)
+            staged = 0
+            for a in locals_:
+                w.data(w.rank)[staged:staged + a.nbytes] = \
+                    a.reshape(-1).view(np.uint8)
+                staged += a.nbytes
             w.publish(3 * t + 1)
             w.wait_all(3 * t + 1)
-            total = sum(dims)
-            out = np.empty(total * rest, dtype=np_dtype)
-            offset = 0
-            for r in range(w.size):
-                count = dims[r] * rest
-                if r == w.rank:   # own block: skip the region round-trip
-                    out[offset:offset + count] = local.reshape(-1)
-                else:
-                    out[offset:offset + count] = \
-                        w.data(r)[:count * np_dtype.itemsize].view(np_dtype)
-                offset += count
-            entry.output = out.reshape((total,) + local.shape[1:])
+            for i, entry in enumerate(entries):
+                total = sum(dims[i])
+                out = np.empty(total * rests[i], dtype=np_dtype)
+                offset = 0
+                for r in range(w.size):
+                    count = dims[i][r] * rests[i]
+                    if r == w.rank:   # own block: skip the region trip
+                        out[offset:offset + count] = \
+                            locals_[i].reshape(-1)
+                    else:
+                        lo = int(ent_off[i, r])
+                        out[offset:offset + count] = \
+                            w.data(r)[lo:lo + count * itemsize
+                                      ].view(np_dtype)
+                    offset += count
+                entry.output = out.reshape((total,)
+                                           + locals_[i].shape[1:])
             w.publish(3 * t + 3)
             self.ops_executed += 1
             return Status.ok()

@@ -248,11 +248,22 @@ class TcpBackend(CollectiveBackend):
                   entries: list[TensorTableEntry]) -> Status:
         self._act_start(entries, "TCP_ALLGATHERV")
         try:
-            for e in entries:
-                local = np.asarray(e.tensor,
-                                   dtype=to_numpy(response.tensor_type))
-                e.output = self.coll.allgatherv(local,
-                                                response.tensor_sizes)
+            dtype = to_numpy(response.tensor_type)
+            size = self.coll.size
+            if len(entries) == 1:
+                dims = self.allgather_entry_dims(response, 1, size)
+                local = np.ascontiguousarray(
+                    np.asarray(entries[0].tensor, dtype=dtype))
+                entries[0].output = self.coll.allgatherv(local, dims[0])
+                return Status.ok()
+            # Fused response: ONE ring exchange for all entries
+            # (reference: MPI_Allgatherv over the fusion buffer,
+            # mpi_operations.cc MPIAllgather::Execute).
+            locals_, dims, rests, per_rank, payload = \
+                self.pack_fused_allgather(response, entries, dtype, size)
+            full = self.coll.allgatherv(payload, per_rank)
+            self.unpack_fused_allgather(full, entries, locals_, dims,
+                                        rests, dtype, per_rank)
             return Status.ok()
         finally:
             self._act_end(entries)

@@ -408,11 +408,20 @@ class XlaBackend(CollectiveBackend):
         self._act_start(entries, "XLA_ALLGATHER")
         try:
             dtype = np.dtype(to_numpy(response.tensor_type))
-            first_dims = list(response.tensor_sizes)
-            for e in entries:
+            size = self.world_size
+            if len(entries) == 1:
+                dims = self.allgather_entry_dims(response, 1, size)
                 local = np.ascontiguousarray(
-                    np.asarray(e.tensor, dtype=dtype))
-                e.output = self.comm.allgatherv(local, first_dims)
+                    np.asarray(entries[0].tensor, dtype=dtype))
+                entries[0].output = self.comm.allgatherv(local, dims[0])
+                return Status.ok()
+            # Fused response: one padded device all-gather moves every
+            # entry's packed bytes (same layout as the TCP plane).
+            locals_, dims, rests, per_rank, payload = \
+                self.pack_fused_allgather(response, entries, dtype, size)
+            full = self.comm.allgatherv(payload, per_rank)
+            self.unpack_fused_allgather(full, entries, locals_, dims,
+                                        rests, dtype, per_rank)
             return Status.ok()
         finally:
             self._act_end(entries)

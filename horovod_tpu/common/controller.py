@@ -176,12 +176,26 @@ class Controller:
             coordinator = CacheCoordinator(self.response_cache.capacity)
             uncached: list[Request] = []
             if self.local_joined:
-                # A joined rank asserts every active cache bit so the global
-                # AND can still pass for the remaining ranks — it then
-                # executes the cached responses with zero stand-ins
+                # A joined rank asserts the cache bits of ops a zero
+                # stand-in can legally satisfy (allreduce/adasum) so the
+                # global AND still passes for the remaining ranks
                 # (reference: controller.cc joined-rank cache handling).
+                # Ops where absence has MEANING — allgather/alltoall/
+                # reducescatter contribute shaped blocks, broadcast a
+                # root — cannot be fabricated: mark those positions
+                # INVALID instead, so the OR-propagated invalidation
+                # evicts them everywhere, the peers renegotiate, and
+                # ConstructResponse surfaces the structured
+                # join-unsupported error rather than this rank executing
+                # a cached response it never submitted (or hanging its
+                # peers by silently dropping the bit).
+                fabricatable = {ResponseType.ALLREDUCE, ResponseType.ADASUM}
                 for pos in self.response_cache.positions():
-                    coordinator.record_hit(pos)
+                    resp = self.response_cache.get_response_by_position(pos)
+                    if resp.response_type in fabricatable:
+                        coordinator.record_hit(pos)
+                    else:
+                        coordinator.record_invalid(pos)
             if self.is_coordinator and self.pending_tuned_params is not None:
                 # Force one negotiation cycle so autotuned parameters reach
                 # every rank even in cache steady state.
@@ -540,15 +554,48 @@ class Controller:
         return error(f"Unsupported request type {rtype} for tensor {name}.")
 
     # -- FuseResponses (reference: controller.cc:778-915) --------------
+    def _response_payload_bytes(self, resp: Response) -> int:
+        """Bytes a response contributes to a fusion buffer.  Allreduce:
+        element count × element size.  Allgather: OUTPUT bytes —
+        sum of per-rank first dims × the entry's trailing-dim element
+        count (reference: controller.cc:917-937
+        TotalByteSizeOfAllgatherOutput, looked up via the tensor queue
+        exactly as the reference does).  Fusion-determinism invariant:
+        every rank that reaches here with an allgather response HAS the
+        entry — it submitted the request (a joined rank invalidates
+        cached allgather bits instead of asserting them, so these
+        responses never execute there), and trailing dims are cross-rank
+        validated equal — so the computed size is identical on all ranks.
+        The KeyError arm is defensive only."""
+        esz = element_size(resp.tensor_type)
+        total = sum(resp.tensor_sizes)
+        if resp.response_type == ResponseType.ALLGATHER:
+            try:
+                entry = self.tensor_queue.get_tensor_entry(
+                    resp.tensor_names[0])
+                shape = getattr(entry.tensor, "shape", ())
+                rest = 1
+                for d in shape[1:]:
+                    rest *= int(d)
+            except KeyError:   # defensive: see docstring
+                rest = 1
+            return total * rest * esz
+        return total * esz
+
     def fuse_responses(self, responses: list[Response]) -> list[Response]:
-        """Greedy fusion with look-ahead: merge compatible allreduce/adasum
-        responses until the fusion-buffer threshold is reached.  Later
-        compatible responses may be pulled forward past incompatible ones —
-        legal because the merged order is identical on all ranks."""
+        """Greedy fusion with look-ahead: merge compatible
+        allreduce/adasum/allgather responses until the fusion-buffer
+        threshold is reached.  Later compatible responses may be pulled
+        forward past incompatible ones — legal because the merged order
+        is identical on all ranks.  A fused allgather response keeps one
+        world_size block of per-rank first dims per entry in
+        tensor_sizes (reference: message.cc:380-388
+        Response::add_allgather_response)."""
         threshold = self.fusion_threshold_bytes()
         if threshold <= 0:
             return list(responses)
-        fusable = {ResponseType.ALLREDUCE, ResponseType.ADASUM}
+        fusable = {ResponseType.ALLREDUCE, ResponseType.ADASUM,
+                   ResponseType.ALLGATHER}
         out: list[Response] = []
         pending = list(responses)
         i = 0
@@ -561,8 +608,7 @@ class Controller:
             if self.disable_group_fusion and getattr(resp, "grouped", False):
                 out.append(resp)
                 continue
-            esz = element_size(resp.tensor_type)
-            acc_bytes = sum(resp.tensor_sizes) * esz
+            acc_bytes = self._response_payload_bytes(resp)
             if acc_bytes >= threshold:
                 out.append(resp)
                 continue
@@ -577,7 +623,7 @@ class Controller:
                         cand.tensor_sizes and
                         not (self.disable_group_fusion and
                              getattr(cand, "grouped", False))):
-                    cand_bytes = sum(cand.tensor_sizes) * esz
+                    cand_bytes = self._response_payload_bytes(cand)
                     if acc_bytes + cand_bytes <= threshold:
                         resp.tensor_names.extend(cand.tensor_names)
                         resp.tensor_sizes.extend(cand.tensor_sizes)

@@ -143,6 +143,7 @@ class GlobalState:
 
 _global = GlobalState()
 _init_lock = threading.Lock()
+_atexit_registered = False
 
 
 def global_state() -> GlobalState:
@@ -355,6 +356,15 @@ def init(*, rank: int | None = None, size: int | None = None,
             target=_background_loop, daemon=True, name="hvd-background")
         _global.initialized = True
         _global.background_thread.start()
+        # Finalize on interpreter exit like the reference (its library
+        # destructor shuts Horovod down when the process ends): a script
+        # that returns without calling hvd.shutdown() still flushes the
+        # timeline writer and tears sockets/regions down cleanly.
+        global _atexit_registered
+        if not _atexit_registered:
+            import atexit
+            atexit.register(shutdown)
+            _atexit_registered = True
         logger.debug("horovod_tpu initialized: rank=%d size=%d", rank, size)
 
 

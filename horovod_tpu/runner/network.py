@@ -8,6 +8,7 @@ numpy buffers).
 """
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
@@ -239,7 +240,7 @@ class PeerMesh:
         listener.bind(("", 0))
         listener.listen(size)
         port = listener.getsockname()[1]
-        host = socket.gethostbyname(socket.gethostname())
+        host = self._advertised_host()
         kv.put(scope, f"addr:{rank}", f"{host}:{port}".encode())
 
         expected_inbound = size - 1 - rank   # peers with higher rank dial in
@@ -290,6 +291,17 @@ class PeerMesh:
                 f"inbound peers connected")
         self._socks.update(accepted)
         listener.close()
+
+    @staticmethod
+    def _advertised_host() -> str:
+        """Address peers dial: HOROVOD_GLOO_IFACE pins the NIC when set
+        (reference: gloo_context.cc reads the same variable to select the
+        Gloo transport device); otherwise the hostname's address."""
+        iface = os.environ.get("HOROVOD_GLOO_IFACE")
+        if iface:
+            from .driver_service import candidate_addresses
+            return candidate_addresses(iface)[0]
+        return socket.gethostbyname(socket.gethostname())
 
     def send(self, peer: int, payload: bytes) -> None:
         send_msg(self._socks[peer], payload)

@@ -61,6 +61,19 @@ def battery_collectives(hvd, rank, size):
         expected_rows.append(np.full((r + 1, 3), r, dtype=np.float32))
     np.testing.assert_array_equal(out, np.concatenate(expected_rows))
 
+    # -- allgather burst: async submissions land in one cycle and fuse
+    # (controller allgather fusion); correctness must hold either way,
+    # with mixed trailing shapes sharing the packed exchange.
+    handles = [hvd.allgather_async(
+        np.full((rank + 1, i + 2), 10.0 * rank + i, np.float32),
+        name=f"ag_burst{i}") for i in range(4)]
+    for i, h in enumerate(handles):
+        out = hvd.synchronize(h)
+        expected = np.concatenate([np.full((r + 1, i + 2), 10.0 * r + i,
+                                           np.float32)
+                                   for r in range(size)])
+        np.testing.assert_array_equal(out, expected)
+
     # -- broadcast --------------------------------------------------------
     root = size - 1
     v = np.arange(6, dtype=np.float64) * (rank + 1)
@@ -330,6 +343,30 @@ def battery_join(hvd, rank, size):
     assert 0 <= joined_last < size
     out = hvd.allreduce(np.ones(2, dtype=np.float32), op=hvd.Sum,
                         name="after_join")
+    np.testing.assert_allclose(out, np.full(2, float(size)))
+
+    # Cached allgather + join: warm the cache, then have rank size-1
+    # join while the others resubmit the cached name.  The joined rank
+    # must NOT assert the cached allgather bit (it cannot fabricate a
+    # shaped block) — it invalidates it, peers renegotiate, and
+    # ConstructResponse surfaces the structured join-unsupported error
+    # on the submitting ranks instead of a hang or a phantom execution.
+    for _ in range(2):   # insert + steady-state hit
+        hvd.allgather(np.full((rank + 1, 2), rank, np.float32),
+                      name="join_ag")
+    if rank == size - 1:
+        hvd.join()
+    else:
+        try:
+            hvd.allgather(np.full((rank + 1, 2), rank, np.float32),
+                          name="join_ag")
+            raise SystemExit("cached allgather with a joined rank "
+                             "must error")
+        except hvd.HorovodInternalError as e:
+            assert "join" in str(e).lower(), e
+        hvd.join()
+    out = hvd.allreduce(np.ones(2, dtype=np.float32), op=hvd.Sum,
+                        name="after_join2")
     np.testing.assert_allclose(out, np.full(2, float(size)))
 
 
@@ -795,6 +832,31 @@ def battery_xla(hvd, rank, size):
     assert any(k[0] == "allgather" for k in xla_backend.comm._cache), \
         "allgather did not ride the XLA plane"
 
+    # Fused allgather on the device plane: a multi-entry response moves
+    # every entry's packed bytes in ONE padded all-gather (direct
+    # lockstep call, as in the shm/hierarchical batteries).
+    from horovod_tpu.common.dtypes import from_any
+    from horovod_tpu.common.message import Response, ResponseType
+    from horovod_tpu.common.tensor_queue import TensorTableEntry
+    fents = [TensorTableEntry(
+        tensor_name=f"xla_fag{i}",
+        tensor=np.full((rank + 1, i + 1), 10.0 * rank + i, np.float32))
+        for i in range(2)]
+    fsizes = []
+    for i in range(2):
+        fsizes.extend(r + 1 for r in range(size))
+    fresp = Response(response_type=ResponseType.ALLGATHER,
+                     tensor_names=[e.tensor_name for e in fents],
+                     tensor_type=from_any(np.dtype(np.float32)),
+                     tensor_sizes=fsizes)
+    fst = xla_backend.allgather(fresp, fents)
+    assert fst.ok_p(), fst
+    for i, e in enumerate(fents):
+        expected = np.concatenate([np.full((r + 1, i + 1), 10.0 * r + i,
+                                           np.float32)
+                                   for r in range(size)])
+        np.testing.assert_array_equal(e.output, expected)
+
     # Ragged alltoall on-device (NCCLAlltoall analogue).
     splits = [rank + 1] * size
     v = np.arange((rank + 1) * size, dtype=np.float32) + 1000 * rank
@@ -1015,6 +1077,33 @@ def battery_shm(hvd, rank, size):
     np.testing.assert_array_equal(g, expected)
     assert shm.ops_executed == before + 3, "allgather must ride shm"
 
+    # Fused allgather rides shm in ONE staging pass: the response packs
+    # three tensors (entry-major per rank), yet ops_executed moves by 1.
+    from horovod_tpu.common.dtypes import from_any
+    from horovod_tpu.common.message import Response, ResponseType
+    from horovod_tpu.common.tensor_queue import TensorTableEntry
+    before = shm.ops_executed
+    ents = [TensorTableEntry(
+        tensor_name=f"shm_fag{i}",
+        tensor=np.full((rank + 1, i + 1), 10.0 * rank + i, np.float32))
+        for i in range(3)]
+    fsizes = []
+    for i in range(3):
+        fsizes.extend(r + 1 for r in range(size))
+    fresp = Response(response_type=ResponseType.ALLGATHER,
+                     tensor_names=[e.tensor_name for e in ents],
+                     tensor_type=from_any(np.dtype(np.float32)),
+                     tensor_sizes=fsizes)
+    assert shm.enabled(fresp, ents), "fused allgather must ride shm"
+    st = shm.allgather(fresp, ents)
+    assert st.ok_p(), st
+    for i, e in enumerate(ents):
+        expected = np.concatenate([np.full((r + 1, i + 1), 10.0 * r + i,
+                                           np.float32)
+                                   for r in range(size)])
+        np.testing.assert_array_equal(e.output, expected)
+    assert shm.ops_executed == before + 1, "fused allgather is ONE shm op"
+
     # Alltoall rides shm (uneven splits; receivers pull their slice from
     # each sender's region using the header split table).
     before = shm.ops_executed
@@ -1138,6 +1227,50 @@ def battery_hierarchical(hvd, rank, size):
     np.testing.assert_array_equal(out, expected)
     assert hier.leg_ops["local_gather"] >= 1, hier.leg_ops
     assert hier.leg_ops["cross_gather"] >= 1, hier.leg_ops
+
+    # -- fused allgather: N entries ride TWO collectives with leg spans --
+    # Direct lockstep call (every rank executes the same fused response
+    # at the same program point — the identical-response-order invariant
+    # the background loop provides for real fused responses).
+    from horovod_tpu.common.dtypes import from_any
+    from horovod_tpu.common.message import Response, ResponseType
+    from horovod_tpu.common.tensor_queue import TensorTableEntry
+
+    tl_path = f"/tmp/h_tl_{os.environ['HOROVOD_RENDEZVOUS_EPOCH']}.json"
+    if rank == 0:
+        hvd.start_timeline(tl_path)
+    before = dict(hier.leg_ops)
+    ents = [TensorTableEntry(
+        tensor_name=f"h_fag{i}",
+        tensor=np.full((rank + 1, i + 1), 10 * rank + i, np.float32))
+        for i in range(3)]
+    sizes = []
+    for i in range(3):
+        sizes.extend(r + 1 for r in range(size))
+    resp = Response(response_type=ResponseType.ALLGATHER,
+                    tensor_names=[e.tensor_name for e in ents],
+                    tensor_type=from_any(np.dtype(np.float32)),
+                    tensor_sizes=sizes)
+    st = hier.allgather(resp, ents)
+    assert st.ok_p(), st
+    for i, e in enumerate(ents):
+        expected = np.concatenate([np.full((r + 1, i + 1), 10 * r + i,
+                                           np.float32)
+                                   for r in range(size)])
+        np.testing.assert_array_equal(e.output, expected)
+    # 3 fused tensors -> exactly one local gather + one cross exchange.
+    assert hier.leg_ops["local_gather"] == before["local_gather"] + 1, \
+        hier.leg_ops
+    assert hier.leg_ops["cross_gather"] == before["cross_gather"] + 1, \
+        hier.leg_ops
+    if rank == 0:
+        hvd.stop_timeline()
+        import json
+        names = {ev.get("name", "")
+                 for ev in json.load(open(tl_path))}
+        assert "LOCAL_GATHER" in names, names
+        assert "CROSS_GATHER" in names, names
+        os.unlink(tl_path)
 
     # -- adasum is NOT claimed: falls through to the flat backend ---------
     from horovod_tpu.ops.adasum import adasum_reference

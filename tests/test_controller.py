@@ -312,6 +312,89 @@ def test_allgather_variable_first_dim():
         assert resp.tensor_sizes == [1, 2, 3]
 
 
+def _queued_allgather(ctrl, rank, name, dim0, rest=(2,)):
+    """Enqueue an allgather with a REAL tensor entry (fusion sizing needs
+    the trailing dims via the tensor queue, reference controller.cc:917)."""
+    from horovod_tpu.common.tensor_queue import TensorTableEntry
+    tensor = np.zeros((dim0,) + rest, np.float32)
+    entry = TensorTableEntry(tensor_name=name, tensor=tensor)
+    ctrl.tensor_queue.add_to_tensor_queue(
+        entry,
+        Request(request_rank=rank, request_type=RequestType.ALLGATHER,
+                tensor_type=DataType.FLOAT32, tensor_name=name,
+                tensor_shape=tuple(tensor.shape)))
+
+
+def test_fusion_merges_small_allgathers():
+    """Allgather responses fuse like the reference's (controller.cc
+    FuseResponses ALLGATHER branch): one world_size block of per-rank
+    first dims per entry (message.cc:380-388), sized by OUTPUT bytes."""
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world,
+                                   fusion_threshold=64 * 1024 * 1024)
+                   for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        for i in range(3):
+            _queued_allgather(ctrl, rank, f"a{i}", dim0=rank + 1)
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        assert len(rl.responses) == 1
+        resp = rl.responses[0]
+        assert resp.response_type == ResponseType.ALLGATHER
+        assert resp.tensor_names == ["a0", "a1", "a2"]
+        assert resp.tensor_sizes == [1, 2] * 3   # per-entry rank blocks
+
+
+def test_allgather_fusion_sized_by_output_bytes():
+    """The fusion threshold counts allgather OUTPUT bytes (sum of all
+    ranks' first dims × trailing elems), not the local payload: three
+    256-byte-output tensors against a 512-byte threshold fuse 2+1."""
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world, fusion_threshold=512)
+                   for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        for i in range(3):
+            # output = (8+8 rows) × 4 elems × 4 B = 256 B per tensor
+            _queued_allgather(ctrl, rank, f"b{i}", dim0=8, rest=(4,))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        counts = [len(r.tensor_names) for r in rl.responses]
+        assert counts == [2, 1], counts
+
+
+def test_allgather_does_not_fuse_with_allreduce():
+    size = 2
+    world = InProcWorld(size)
+    controllers = [make_controller(r, size, world,
+                                   fusion_threshold=64 * 1024 * 1024)
+                   for r in range(size)]
+
+    def step(rank):
+        ctrl = controllers[rank]
+        ctrl.tensor_queue.push_back_to_queue(_allreduce_req(rank, "r0"))
+        _queued_allgather(ctrl, rank, "g0", dim0=2)
+        ctrl.tensor_queue.push_back_to_queue(_allreduce_req(rank, "r1"))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        kinds = sorted((r.response_type.name, len(r.tensor_names))
+                       for r in rl.responses)
+        # The two allreduces fuse (look-ahead past the allgather); the
+        # allgather stays its own response.
+        assert kinds == [("ALLGATHER", 1), ("ALLREDUCE", 2)], kinds
+
+
 def test_broadcast_root_mismatch_is_error():
     size = 2
     world = InProcWorld(size)
