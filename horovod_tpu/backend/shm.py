@@ -658,7 +658,14 @@ class ShmBackend(CollectiveBackend):
             w.wait_all(3 * t)
             flat = self.scale_buffer(local.reshape(-1),
                                      response.prescale_factor)
-            w.data(w.rank)[:flat.nbytes] = flat.view(np.uint8)
+            # Peers only read THEIR row ranges from this region; my own
+            # [lo, hi) is accumulated from the local buffer directly, so
+            # skip staging it (1/size less write traffic).
+            fb = flat.view(np.uint8)
+            w.data(w.rank)[:lo * np_dtype.itemsize] = \
+                fb[:lo * np_dtype.itemsize]
+            w.data(w.rank)[hi * np_dtype.itemsize:fb.nbytes] = \
+                fb[hi * np_dtype.itemsize:]
             w.publish(3 * t + 1)
             w.wait_all(3 * t + 1)
             acc_dt = _accum_dtype(np_dtype)
@@ -711,8 +718,15 @@ class ShmBackend(CollectiveBackend):
             elif local.nbytes > w.capacity:
                 table[0] = -1   # too big: ask every rank to delegate
             else:
-                w.data(w.rank)[:local.nbytes] = \
-                    local.reshape(-1).view(np.uint8)
+                # Stage everything EXCEPT the slice destined to self
+                # (peers never read it; the own block is copied straight
+                # from the local buffer below) — two writes instead of
+                # one, 1/size less staging traffic.
+                flat = local.reshape(-1).view(np.uint8)
+                own_lo = sum(splits[:w.rank]) * rest * np_dtype.itemsize
+                own_hi = own_lo + splits[w.rank] * rest * np_dtype.itemsize
+                w.data(w.rank)[:own_lo] = flat[:own_lo]
+                w.data(w.rank)[own_hi:local.nbytes] = flat[own_hi:]
                 table[0] = len(splits)
                 table[1:1 + len(splits)] = splits
             w.publish(3 * t + 1)

@@ -191,8 +191,9 @@ class Controller:
                 # peers by silently dropping the bit).
                 fabricatable = {ResponseType.ALLREDUCE, ResponseType.ADASUM}
                 for pos in self.response_cache.positions():
-                    resp = self.response_cache.get_response_by_position(pos)
-                    if resp.response_type in fabricatable:
+                    rtype = self.response_cache.response_type_by_position(
+                        pos)
+                    if rtype in fabricatable:
                         coordinator.record_hit(pos)
                     else:
                         coordinator.record_invalid(pos)

@@ -118,6 +118,12 @@ class ResponseCache:
                        tensor_sizes=list(resp.tensor_sizes),
                        devices=list(resp.devices))
 
+    def response_type_by_position(self, position: int):
+        """Type of the cached response, without the defensive copy (and
+        LRU refresh) get_response_by_position pays — for per-cycle scans
+        like the joined-rank bit loop that only need the type."""
+        return self._entries[self._by_position[position]][1].response_type
+
     def erase_by_position(self, position: int) -> None:
         name = self._by_position.pop(position, None)
         if name is not None:
