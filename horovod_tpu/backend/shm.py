@@ -429,6 +429,16 @@ class ShmBackend(CollectiveBackend):
             return False
         return self.world.formed and nbytes <= self.world.capacity
 
+    @staticmethod
+    def _stage_except(region: np.ndarray, flat_u8: np.ndarray,
+                      lo_byte: int, hi_byte: int) -> None:
+        """Stage a payload into this rank's region, skipping the
+        [lo_byte, hi_byte) range destined to self: no peer ever reads it
+        (the own slice is copied straight from the local buffer), so two
+        writes save 1/size of the staging traffic."""
+        region[:lo_byte] = flat_u8[:lo_byte]
+        region[hi_byte:flat_u8.nbytes] = flat_u8[hi_byte:]
+
     def allreduce(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:
         t = self.world._t
@@ -658,14 +668,9 @@ class ShmBackend(CollectiveBackend):
             w.wait_all(3 * t)
             flat = self.scale_buffer(local.reshape(-1),
                                      response.prescale_factor)
-            # Peers only read THEIR row ranges from this region; my own
-            # [lo, hi) is accumulated from the local buffer directly, so
-            # skip staging it (1/size less write traffic).
-            fb = flat.view(np.uint8)
-            w.data(w.rank)[:lo * np_dtype.itemsize] = \
-                fb[:lo * np_dtype.itemsize]
-            w.data(w.rank)[hi * np_dtype.itemsize:fb.nbytes] = \
-                fb[hi * np_dtype.itemsize:]
+            self._stage_except(w.data(w.rank), flat.view(np.uint8),
+                               lo * np_dtype.itemsize,
+                               hi * np_dtype.itemsize)
             w.publish(3 * t + 1)
             w.wait_all(3 * t + 1)
             acc_dt = _accum_dtype(np_dtype)
@@ -718,15 +723,11 @@ class ShmBackend(CollectiveBackend):
             elif local.nbytes > w.capacity:
                 table[0] = -1   # too big: ask every rank to delegate
             else:
-                # Stage everything EXCEPT the slice destined to self
-                # (peers never read it; the own block is copied straight
-                # from the local buffer below) — two writes instead of
-                # one, 1/size less staging traffic.
-                flat = local.reshape(-1).view(np.uint8)
                 own_lo = sum(splits[:w.rank]) * rest * np_dtype.itemsize
                 own_hi = own_lo + splits[w.rank] * rest * np_dtype.itemsize
-                w.data(w.rank)[:own_lo] = flat[:own_lo]
-                w.data(w.rank)[own_hi:local.nbytes] = flat[own_hi:]
+                self._stage_except(w.data(w.rank),
+                                   local.reshape(-1).view(np.uint8),
+                                   own_lo, own_hi)
                 table[0] = len(splits)
                 table[1:1 + len(splits)] = splits
             w.publish(3 * t + 1)

@@ -38,13 +38,19 @@ try:
     jax.config.update("jax_platforms", "cpu")
     if "JAX_COMPILATION_CACHE_DIR" in os.environ:
         # The env var is read at jax import in recent versions; set the
-        # config explicitly too in case a sitecustomize imported jax
+        # config explicitly too (from the env values, which setdefault
+        # left user-overridable) in case a sitecustomize imported jax
         # before this file ran.
         jax.config.update("jax_compilation_cache_dir",
                           os.environ["JAX_COMPILATION_CACHE_DIR"])
-        jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          2.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(os.environ.get(
+                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2.0")))
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes",
+            int(os.environ.get(
+                "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")))
 except ImportError:
     pass
 

@@ -1454,6 +1454,21 @@ BATTERIES = {
     "tf_grid": battery_tf_grid,
     "tf_function": battery_tf_function,
     "sparse": battery_sparse,
+    # Merged one-world batteries: the torch/TF imports (~8-12 s per
+    # spawned rank) dominated separate 2-rank worlds, so the 2-rank
+    # coverage shares one spin-up per framework (the reference CI
+    # likewise groups framework tests per container,
+    # .buildkite/gen-pipeline.sh); the 3- and 4-rank worlds stay
+    # separate.
+    "torch_all": lambda hvd, rank, size: [
+        battery_torch(hvd, rank, size),
+        battery_torch_grid(hvd, rank, size),
+        battery_sparse(hvd, rank, size),
+        battery_syncbn(hvd, rank, size)],
+    "tensorflow_all": lambda hvd, rank, size: [
+        battery_tensorflow(hvd, rank, size),
+        battery_tf_grid(hvd, rank, size),
+        battery_tf_function(hvd, rank, size)],
     "hierarchical": battery_hierarchical,
     "shm": battery_shm,
     "mxnet": battery_mxnet,

@@ -187,9 +187,17 @@ def test_shm_poison_seen_declines_next_op(kv):
 # ShmBackend protocol-branch tests: two in-process "ranks" drive the real
 # lockstep concurrently (threads), pinning each sentinel/failure branch.
 # ---------------------------------------------------------------------------
+import contextlib
+
+
+@contextlib.contextmanager
 def _backend_pair(kv, scope: str, capacity: int = 1 << 16):
     worlds = _form_pair(kv, scope, capacity)
-    return [ShmBackend(w) for w in worlds]
+    try:
+        yield [ShmBackend(w) for w in worlds]
+    finally:
+        for w in worlds:
+            w.close()
 
 
 def _run_op_pair(backends, op: str, entries_of, response_of):
@@ -223,44 +231,44 @@ def test_shm_alltoall_invalid_splits_sentinel(kv):
     split table keeps every peer IN the lockstep and surfaces a Status
     error on ALL ranks symmetrically — the world stays formed and the
     next valid op still rides shm."""
-    backends = _backend_pair(kv, "a2a_bad")
+    with _backend_pair(kv, "a2a_bad") as backends:
 
-    def entries_of(r):
-        e = TensorTableEntry(tensor_name="x",
-                             tensor=_f32(np.arange(8)))
-        # Rank 0 submits a corrupt table (internal-caller path: the
-        # public API rejects this at enqueue); rank 1 is valid.
-        e.splits = [9, -1] if r == 0 else [4, 4]
-        return [e]
+        def entries_of(r):
+            e = TensorTableEntry(tensor_name="x",
+                                 tensor=_f32(np.arange(8)))
+            # Rank 0 submits a corrupt table (internal-caller path: the
+            # public API rejects this at enqueue); rank 1 is valid.
+            e.splits = [9, -1] if r == 0 else [4, 4]
+            return [e]
 
-    resp = Response(response_type=ResponseType.ALLTOALL,
-                    tensor_names=["x"],
-                    tensor_type=from_any(np.dtype(np.float32)))
-    out = _run_op_pair(backends, "alltoall", entries_of,
-                       lambda r: resp)
-    for r in range(2):
-        st = out[r][0]
-        assert isinstance(st, Status) and not st.ok_p(), (r, st)
-    assert backends[0].world.formed and backends[1].world.formed
+        resp = Response(response_type=ResponseType.ALLTOALL,
+                        tensor_names=["x"],
+                        tensor_type=from_any(np.dtype(np.float32)))
+        out = _run_op_pair(backends, "alltoall", entries_of,
+                           lambda r: resp)
+        for r in range(2):
+            st = out[r][0]
+            assert isinstance(st, Status) and not st.ok_p(), (r, st)
+        assert backends[0].world.formed and backends[1].world.formed
 
-    def good_entries(r):
-        e = TensorTableEntry(tensor_name="y",
-                             tensor=_f32(np.arange(8) + 10 * r))
-        e.splits = [4, 4]
-        return [e]
+        def good_entries(r):
+            e = TensorTableEntry(tensor_name="y",
+                                 tensor=_f32(np.arange(8) + 10 * r))
+            e.splits = [4, 4]
+            return [e]
 
-    resp2 = Response(response_type=ResponseType.ALLTOALL,
-                     tensor_names=["y"],
-                     tensor_type=from_any(np.dtype(np.float32)))
-    out = _run_op_pair(backends, "alltoall", good_entries,
-                       lambda r: resp2)
-    for r in range(2):
-        st, entries = out[r]
-        assert isinstance(st, Status) and st.ok_p(), (r, st)
-        expected = np.concatenate([np.arange(4 * r, 4 * r + 4),
-                                   np.arange(4 * r, 4 * r + 4) + 10])
-        np.testing.assert_array_equal(entries[0].output, expected)
-        assert entries[0].received_splits == [4, 4]
+        resp2 = Response(response_type=ResponseType.ALLTOALL,
+                         tensor_names=["y"],
+                         tensor_type=from_any(np.dtype(np.float32)))
+        out = _run_op_pair(backends, "alltoall", good_entries,
+                           lambda r: resp2)
+        for r in range(2):
+            st, entries = out[r]
+            assert isinstance(st, Status) and st.ok_p(), (r, st)
+            expected = np.concatenate([np.arange(4 * r, 4 * r + 4),
+                                       np.arange(4 * r, 4 * r + 4) + 10])
+            np.testing.assert_array_equal(entries[0].output, expected)
+            assert entries[0].received_splits == [4, 4]
 
 
 def test_shm_alltoall_oversized_delegates_to_tcp(kv):
@@ -278,25 +286,25 @@ def test_shm_alltoall_oversized_delegates_to_tcp(kv):
                 e.received_splits = list(e.splits)
             return Status.ok()
 
-    backends = _backend_pair(kv, "a2a_big", capacity=256)
-    for b in backends:
-        b.tcp = FakeTcp()
+    with _backend_pair(kv, "a2a_big", capacity=256) as backends:
+        for b in backends:
+            b.tcp = FakeTcp()
 
-    def entries_of(r):
-        e = TensorTableEntry(tensor_name="big",
-                             tensor=_f32(np.ones(512)))   # 2 KiB > 256 B
-        e.splits = [256, 256]
-        return [e]
+        def entries_of(r):
+            e = TensorTableEntry(tensor_name="big",
+                                 tensor=_f32(np.ones(512)))   # 2 KiB > 256 B
+            e.splits = [256, 256]
+            return [e]
 
-    resp = Response(response_type=ResponseType.ALLTOALL,
-                    tensor_names=["big"],
-                    tensor_type=from_any(np.dtype(np.float32)))
-    out = _run_op_pair(backends, "alltoall", entries_of, lambda r: resp)
-    for r in range(2):
-        st = out[r][0]
-        assert isinstance(st, Status) and st.ok_p(), (r, st)
-    assert len(delegated) == 2, "both ranks must run the TCP exchange"
-    assert backends[0].world.formed     # delegation is not a failure
+        resp = Response(response_type=ResponseType.ALLTOALL,
+                        tensor_names=["big"],
+                        tensor_type=from_any(np.dtype(np.float32)))
+        out = _run_op_pair(backends, "alltoall", entries_of, lambda r: resp)
+        for r in range(2):
+            st = out[r][0]
+            assert isinstance(st, Status) and st.ok_p(), (r, st)
+        assert len(delegated) == 2, "both ranks must run the TCP exchange"
+        assert backends[0].world.formed     # delegation is not a failure
 
 
 @pytest.mark.parametrize("op", ["allreduce", "broadcast", "allgather",
@@ -305,74 +313,74 @@ def test_shm_poison_unblocks_each_op(kv, op):
     """A peer poisoning while this rank is inside op X's wait must
     surface a structured error for EVERY op type X — not a barrier
     timeout (reference discipline: mismatch -> error, never hang)."""
-    backends = _backend_pair(kv, f"poison_{op}")
+    with _backend_pair(kv, f"poison_{op}") as backends:
 
-    def entries_of(r):
-        e = TensorTableEntry(tensor_name="t",
-                             tensor=_f32(np.ones((8, 2))),
-                             root_rank=1)
-        e.splits = [4, 4]
-        return [e]
+        def entries_of(r):
+            e = TensorTableEntry(tensor_name="t",
+                                 tensor=_f32(np.ones((8, 2))),
+                                 root_rank=1)
+            e.splits = [4, 4]
+            return [e]
 
-    kwargs = {}
-    if op == "broadcast":
-        # Rank 0 must be a READER: the root waits on nobody (its only
-        # barrier is the entry wait, already satisfied), so a root would
-        # legitimately complete — the branch under test is the reader's
-        # data wait.
-        kwargs["root_rank"] = 1
-    sizes = {"allreduce": [16], "broadcast": [16],
-             "allgather": [8, 8], "reducescatter": [16],
-             "alltoall": []}[op]
-    resp = Response(response_type=getattr(ResponseType, op.upper()),
-                    tensor_names=["t"],
-                    tensor_type=from_any(np.dtype(np.float32)),
-                    tensor_sizes=sizes, **kwargs)
+        kwargs = {}
+        if op == "broadcast":
+            # Rank 0 must be a READER: the root waits on nobody (its only
+            # barrier is the entry wait, already satisfied), so a root would
+            # legitimately complete — the branch under test is the reader's
+            # data wait.
+            kwargs["root_rank"] = 1
+        sizes = {"allreduce": [16], "broadcast": [16],
+                 "allgather": [8, 8], "reducescatter": [16],
+                 "alltoall": []}[op]
+        resp = Response(response_type=getattr(ResponseType, op.upper()),
+                        tensor_names=["t"],
+                        tensor_type=from_any(np.dtype(np.float32)),
+                        tensor_sizes=sizes, **kwargs)
 
-    result: list = []
+        result: list = []
 
-    def run_rank0():
-        try:
-            backends[0].__getattribute__(op)(resp, entries_of(0))
-            result.append("completed")
-        except ConnectionError:
-            result.append("poisoned")
+        def run_rank0():
+            try:
+                backends[0].__getattribute__(op)(resp, entries_of(0))
+                result.append("completed")
+            except ConnectionError:
+                result.append("poisoned")
 
-    th = threading.Thread(target=run_rank0)
-    th.start()
-    # Rank 1 never claims the op; it fails "elsewhere" and poisons.
-    import time
-    time.sleep(0.2)
-    backends[1].world.poison()
-    th.join(15.0)
-    assert not th.is_alive(), f"{op} hung on a poisoned world"
-    assert result == ["poisoned"], result
-    assert not backends[0].world.formed
+        th = threading.Thread(target=run_rank0)
+        th.start()
+        # Rank 1 never claims the op; it fails "elsewhere" and poisons.
+        import time
+        time.sleep(0.2)
+        backends[1].world.poison()
+        th.join(15.0)
+        assert not th.is_alive(), f"{op} hung on a poisoned world"
+        assert result == ["poisoned"], result
+        assert not backends[0].world.formed
 
 
 def test_shm_fused_multi_tensor_allreduce(kv):
     """A fused (multi-entry) allreduce response packs through one region
     round-trip and unpacks entry-by-entry with original shapes."""
-    backends = _backend_pair(kv, "fused_ar")
+    with _backend_pair(kv, "fused_ar") as backends:
 
-    def entries_of(r):
-        return [TensorTableEntry(tensor_name=f"g{i}",
-                                 tensor=_f32(np.full((3, i + 1),
-                                                     r + i)))
-                for i in range(3)]
+        def entries_of(r):
+            return [TensorTableEntry(tensor_name=f"g{i}",
+                                     tensor=_f32(np.full((3, i + 1),
+                                                         r + i)))
+                    for i in range(3)]
 
-    resp = Response(response_type=ResponseType.ALLREDUCE,
-                    tensor_names=["g0", "g1", "g2"],
-                    tensor_type=from_any(np.dtype(np.float32)),
-                    tensor_sizes=[3, 6, 9])
-    out = _run_op_pair(backends, "allreduce", entries_of, lambda r: resp)
-    for r in range(2):
-        st, entries = out[r]
-        assert isinstance(st, Status) and st.ok_p(), (r, st)
-        for i, e in enumerate(entries):
-            np.testing.assert_allclose(
-                e.output, np.full((3, i + 1), (0 + i) + (1 + i)))
-            assert e.output.shape == (3, i + 1)
+        resp = Response(response_type=ResponseType.ALLREDUCE,
+                        tensor_names=["g0", "g1", "g2"],
+                        tensor_type=from_any(np.dtype(np.float32)),
+                        tensor_sizes=[3, 6, 9])
+        out = _run_op_pair(backends, "allreduce", entries_of, lambda r: resp)
+        for r in range(2):
+            st, entries = out[r]
+            assert isinstance(st, Status) and st.ok_p(), (r, st)
+            for i, e in enumerate(entries):
+                np.testing.assert_allclose(
+                    e.output, np.full((3, i + 1), (0 + i) + (1 + i)))
+                assert e.output.shape == (3, i + 1)
 
 
 def test_shm_dead_peer_liveness_mid_wait(kv):
