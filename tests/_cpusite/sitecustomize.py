@@ -1,0 +1,37 @@
+"""Sitecustomize shim for spawned test-worker processes.
+
+An environment-level sitecustomize (e.g. an accelerator-tunnel site
+earlier on PYTHONPATH) may import jax and force-register its PJRT
+plugin in EVERY python process, then override platform selection with
+``jax.config.update("jax_platforms", ...)`` — which supersedes the
+``JAX_PLATFORMS=cpu`` env var the test suite sets for its virtual CPU
+mesh.  In-process, tests/conftest.py flips the config back; spawned
+worker subprocesses (multiprocess batteries, estimators, multihost
+workers) never import conftest, so without this shim they would
+silently run jax work on the real accelerator AND pay the per-process
+plugin registration/dial cost (~3-6 s each).
+
+conftest.py prepends this file's directory to PYTHONPATH so children
+import THIS module as ``sitecustomize`` instead: when the caller asked
+for CPU (JAX_PLATFORMS starts with "cpu"), accelerator registration is
+skipped entirely and the env var works as documented; otherwise the
+original sitecustomize is chained so accelerator-backed children (e.g.
+an on-TPU bench spawned from a test shell) behave exactly as before.
+"""
+import os
+import sys
+
+if os.environ.get("JAX_PLATFORMS", "").partition(",")[0].strip() != "cpu":
+    import importlib.util
+
+    _here = os.path.dirname(os.path.abspath(__file__))
+    for _p in sys.path:
+        if not _p or os.path.abspath(_p) == _here:
+            continue
+        _cand = os.path.join(_p, "sitecustomize.py")
+        if os.path.isfile(_cand):
+            _spec = importlib.util.spec_from_file_location(
+                "_chained_sitecustomize", _cand)
+            _mod = importlib.util.module_from_spec(_spec)
+            _spec.loader.exec_module(_mod)
+            break

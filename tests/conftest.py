@@ -13,6 +13,17 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Spawned worker subprocesses must honor JAX_PLATFORMS=cpu even when an
+# environment sitecustomize force-registers an accelerator plugin at
+# interpreter start (see tests/_cpusite/sitecustomize.py): put the shim
+# first on PYTHONPATH so every child imports it instead.
+_shim_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "_cpusite")
+_pp = os.pathsep.join(
+    p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    if p and p != _shim_dir)   # re-prepend even if present: position wins
+os.environ["PYTHONPATH"] = (_shim_dir + os.pathsep + _pp if _pp
+                            else _shim_dir)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

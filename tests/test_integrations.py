@@ -56,7 +56,12 @@ class TestRunApi:
             lambda hostname, script: ["/bin/sh", "-c", script])
         tests_dir = os.path.dirname(os.path.abspath(__file__))
         repo = os.path.dirname(tests_dir)
-        env = {"PYTHONPATH": f"{repo}:{tests_dir}",
+        # Keep the conftest _cpusite shim first: this env REPLACES the
+        # inherited PYTHONPATH on the exported remote command line, and
+        # without the shim the workers would re-register any ambient
+        # accelerator plugin despite JAX_PLATFORMS=cpu.
+        shim = os.path.join(tests_dir, "_cpusite")
+        env = {"PYTHONPATH": f"{shim}:{repo}:{tests_dir}",
                "JAX_PLATFORMS": "cpu"}
         # Non-loopback names: loopback aliases count as LOCAL everywhere
         # (runner.hosts.is_local_host), so the remote path needs real-
