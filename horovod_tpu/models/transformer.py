@@ -258,7 +258,12 @@ class Block(nn.Module):
 
 class TransformerLM(nn.Module):
     """Decoder-only LM. ``apply(variables, tokens[B,T] int32) -> logits
-    [B, T, vocab] (fp32)``."""
+    [B, T, vocab]`` in ``cfg.dtype``.
+
+    Logits stay in the compute dtype on purpose: at benchmark scale the
+    fp32 copy of a [B, S, vocab] tensor is gigabytes of HBM traffic,
+    and the loss (`training.cross_entropy_loss` → ops/loss.py streaming
+    CE) does its math in fp32 without needing an fp32 input tensor."""
     cfg: TransformerConfig
 
     @nn.compact
@@ -281,9 +286,8 @@ class TransformerLM(nn.Module):
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"layer_{i}")(x)
         x = RMSNorm(cfg.dtype, cfg.param_dtype, name="final_norm")(x)
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
-                          param_dtype=cfg.param_dtype, name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="lm_head")(x)
 
 
 # ---------------------------------------------------------------------------

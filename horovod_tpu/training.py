@@ -40,9 +40,21 @@ class TrainState:
     batch_stats: Any
 
 
+# Above this size the loss streams over the vocab axis instead of
+# materializing an fp32 log_softmax of the whole logits tensor (an LM
+# head at benchmark scale is gigabytes of pure HBM traffic; see
+# ops/loss.py). 2^27 elements = 512 MB fp32: far above any test-scale
+# logits, far below benchmark LM-head logits.
+_STREAMING_CE_MIN_ELEMENTS = 1 << 27
+
+
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
                        label_smoothing: float = 0.0) -> jax.Array:
-    """Mean softmax cross entropy over integer labels (fp32)."""
+    """Mean softmax cross entropy over integer labels (fp32 math)."""
+    if logits.size >= _STREAMING_CE_MIN_ELEMENTS:
+        from .ops.loss import streaming_softmax_cross_entropy
+        return streaming_softmax_cross_entropy(logits, labels,
+                                               label_smoothing)
     num_classes = logits.shape[-1]
     onehot = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
     if label_smoothing > 0.0:
