@@ -1,0 +1,52 @@
+#!/bin/bash
+# MFU sweep on the live TPU window.  Appends one line per config to the
+# results log: "<tag> <bench.py JSON line>".  Each config is one bench.py
+# orchestrated run (probe + retry + compile-cache), so a tunnel blip costs
+# one config, not the sweep.
+#
+# Usage: benchmarks/mfu_sweep.sh [results_log]
+set -u
+LOG="${1:-/tmp/mfu_sweep_r5.log}"
+cd "$(dirname "$0")/.."
+
+run() {
+  local tag="$1"; shift
+  if grep -q "^${tag} {" "$LOG" 2>/dev/null; then
+    echo "skip ${tag} (already in log)" >&2
+    return
+  fi
+  echo "=== ${tag}: python bench.py $*" >&2
+  local out rc
+  out=$(python bench.py "$@" 2>/tmp/mfu_sweep_err.log)
+  rc=$?
+  if [ $rc -ne 0 ] || [ -z "$out" ]; then
+    # Keep the log parseable as "<tag> <JSON>": failures go to stderr only.
+    echo "FAILED ${tag} rc=${rc} (see /tmp/mfu_sweep_err.log)" >&2
+    return
+  fi
+  echo "${tag} ${out}" >> "$LOG"
+  echo "${tag} ${out}" >&2
+}
+
+# --- GPT: bwd-block tiling x batch x remat (r3 best: 1024/1024 fwd, MFU .37)
+run gpt-base          --model gpt --iters 20
+run gpt-bwd-512-1024  --model gpt --iters 20 --block-q-bwd 512  --block-k-bwd 1024
+run gpt-bwd-1024-512  --model gpt --iters 20 --block-q-bwd 1024 --block-k-bwd 512
+run gpt-bwd-512-512   --model gpt --iters 20 --block-q-bwd 512  --block-k-bwd 512
+run gpt-bwd-256-1024  --model gpt --iters 20 --block-q-bwd 256  --block-k-bwd 1024
+run gpt-bs256         --model gpt --iters 20 --batch-size 256
+run gpt-bs512         --model gpt --iters 20 --batch-size 512
+run gpt-bs256-dots    --model gpt --iters 20 --batch-size 256 --remat 1 --remat-policy dots
+run gpt-bs512-dots    --model gpt --iters 20 --batch-size 512 --remat 1 --remat-policy dots
+
+# --- ResNet-50: batch sweep (r5 first number: bs128 -> 2427 img/s, MFU .295)
+run rn50-bs256        --model resnet50 --iters 20 --batch-size 256
+run rn50-bs512        --model resnet50 --iters 20 --batch-size 512
+run rn50-bs1024       --model resnet50 --iters 20 --batch-size 1024
+
+# --- Other CNN families, one record each
+run rn101-bs256       --model resnet101 --iters 15 --batch-size 256
+run vgg16-bs128       --model vgg16 --iters 15 --batch-size 128
+run incv3-bs256       --model inception3 --iters 15 --batch-size 256
+
+echo "sweep done" >&2

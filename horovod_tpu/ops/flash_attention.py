@@ -88,9 +88,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)              # [bq, d]
-        k = k_ref[0].astype(jnp.float32)              # [bk, d]
-        v = v_ref[0].astype(jnp.float32)              # [bk, d]
+        # MXU dots take the inputs in their own (bf16) dtype with fp32
+        # accumulation: casting inputs to fp32 first would force fp32
+        # multiply passes at a fraction of the bf16 MXU rate. Softmax
+        # statistics stay fp32 (standard flash numerics).
+        q = q_ref[0]                                  # [bq, d]
+        k = k_ref[0]                                  # [bk, d]
+        v = v_ref[0]                                  # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale   # [bq, bk]
@@ -108,7 +112,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[:, 0] = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
         m_ref[:, 0] = m_cur
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
     @pl.when(kj == n_kv - 1)
     def _finalize():
@@ -188,10 +192,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 MXU inputs + fp32 accumulation (see _fwd_kernel note).
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, :, 0]                        # lane-bcast → [bq]
         delta = delta_ref[0, :, 0]
         s = jax.lax.dot_general(
@@ -208,7 +213,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bq, bk]
         ds = p * (dp - delta[:, None]) * sm_scale
-        dq_acc[...] += jax.lax.dot(ds, k,
+        dq_acc[...] += jax.lax.dot(ds.astype(k.dtype), k,
                                    preferred_element_type=jnp.float32)
 
     @pl.when(kj == n_kv - 1)
@@ -236,10 +241,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 MXU inputs + fp32 accumulation (see _fwd_kernel note).
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0, :, 0]                        # lane-bcast → [bq]
         delta = delta_ref[0, :, 0]
         s = jax.lax.dot_general(
@@ -253,12 +259,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(rows + causal_offset >= cols, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])                 # [bq, bk]
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bk, d]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bq, bk]
-        ds = p * (dp - delta[:, None]) * sm_scale     # [bq, bk]
+        ds = (p * (dp - delta[:, None]) * sm_scale).astype(q.dtype)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bk, d]

@@ -57,6 +57,39 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                        atol=5e-5)
 
+    def test_bf16_forward_and_grads_match_dense(self, qkv, causal):
+        # Pins the bf16 MXU-input path: on TPU the kernels feed the dots
+        # bf16 operands with fp32 accumulation and downcast p/ds between
+        # the two matmuls (p.astype(v.dtype), ds.astype(k.dtype)). The
+        # fp32 tests above make every one of those casts a no-op; this
+        # runs the identical kernel code on bf16 inputs (interpret mode)
+        # so a misplaced cast — e.g. exp() in bf16, or accumulation
+        # without preferred_element_type — shows up here, not as silent
+        # loss degradation on hardware.
+        q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+        ref = mha_reference(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=16,
+                              block_k=16, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=0.05)   # bf16 has ~3 decimal digits
+
+        def loss(fn):
+            return lambda q, k, v: (fn(q, k, v).astype(jnp.float32)
+                                    ** 2).sum()
+
+        g_ref = jax.grad(loss(partial(mha_reference, causal=causal)),
+                         argnums=(0, 1, 2))(q, k, v)
+        g_fl = jax.grad(loss(partial(flash_attention, causal=causal,
+                                     block_q=16, block_k=16,
+                                     interpret=True)),
+                        argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_fl):
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            rel = np.linalg.norm(b - a) / (1e-6 + np.linalg.norm(a))
+            assert rel < 0.03, rel
+
     def test_lse_consistent(self, qkv, causal):
         q, k, v = qkv
         o, lse = flash_attention_with_lse(q, k, v, causal=causal,
