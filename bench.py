@@ -235,6 +235,17 @@ def _orchestrate(args) -> int:
             return 0
         print(f"bench: attempt {attempt + 1}/{attempts} failed "
               f"(rc={rc}): {err}", file=sys.stderr)
+        if "Ran out of memory" in err:
+            # Deterministic config error (XLA's HBM/VMEM OOM signature):
+            # retrying the same shapes can only fail identically — report
+            # now. (Matching broad gRPC codes like RESOURCE_EXHAUSTED
+            # would misclassify the tunnel's transient flow-control
+            # errors, which the retry loop exists for.)
+            _emit({"metric": f"{args.model}_failed", "value": 0.0,
+                   "unit": "error", "vs_baseline": 0.0, "backend": "tpu",
+                   "error": f"out of memory (deterministic): {err[-300:]}",
+                   "attempts": attempt + 1})
+            return 0
         if attempt + 1 < attempts:
             time.sleep(backoff)
     print("bench: accelerator attempts exhausted; falling back to CPU",

@@ -15,6 +15,7 @@ the reference's controller protocol exists to establish dynamically).
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import Any, Callable, Sequence
 
@@ -41,11 +42,30 @@ class TrainState:
 
 
 # Above this size the loss streams over the vocab axis instead of
-# materializing an fp32 log_softmax of the whole logits tensor (an LM
-# head at benchmark scale is gigabytes of pure HBM traffic; see
-# ops/loss.py). 2^27 elements = 512 MB fp32: far above any test-scale
-# logits, far below benchmark LM-head logits.
-_STREAMING_CE_MIN_ELEMENTS = 1 << 27
+# materializing an fp32 log_softmax of the whole logits tensor (see
+# ops/loss.py). Streaming is the memory-survival path, NOT a speed win:
+# the on-TPU A/B at gpt-small benchmark scale (824M-element logits,
+# v5e) measured dense 80.1k tok/s vs streaming 72.3k — the vocab-chunk
+# scan serializes work XLA otherwise fuses. So the default threshold
+# sits where the dense path's fp32 logits copy (4 bytes/elem, plus the
+# bf16 logits and their gradient alongside) stops plausibly fitting in
+# a 16 GB chip: 2^30 elements = 4 GiB fp32. The benchmark config
+# (824M) stays dense; the 8k-sequence long-context recipe (1.6G) stays
+# streaming. Override via HOROVOD_STREAMING_CE_MIN_ELEMENTS (0 forces
+# streaming everywhere).
+def _ce_threshold() -> int:
+    raw = os.environ.get("HOROVOD_STREAMING_CE_MIN_ELEMENTS")
+    if raw is None:
+        return 1 << 30
+    try:
+        return int(raw)
+    except ValueError as exc:
+        raise ValueError(
+            "HOROVOD_STREAMING_CE_MIN_ELEMENTS must be a plain integer "
+            f"(got {raw!r})") from exc
+
+
+_STREAMING_CE_MIN_ELEMENTS = _ce_threshold()
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
