@@ -162,10 +162,16 @@ def _sync(metrics) -> float:
 _CACHE_DIR = "/tmp/horovod_tpu_jax_cache"
 
 
+# XLA's deterministic out-of-memory signatures (HBM allocation failure /
+# Mosaic scoped-VMEM overflow). Matched against the FULL stderr — the
+# returned tail may truncate them away.
+_OOM_SIGNATURES = ("Ran out of memory", "exceeded scoped vmem limit")
+
+
 def _spawn_inner(args, extra_env: dict, timeout: float
-                 ) -> tuple[int, dict | None, str]:
+                 ) -> tuple[int, dict | None, str, bool]:
     """Run one benchmark attempt in a subprocess; return (rc, parsed JSON
-    payload or None, stderr tail)."""
+    payload or None, stderr tail, deterministic-OOM flag)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--inner",
            "--model", args.model,
            "--batch-size", str(args.batch_size),
@@ -177,7 +183,8 @@ def _spawn_inner(args, extra_env: dict, timeout: float
            "--block-q", str(args.block_q),
            "--block-k", str(args.block_k),
            "--block-q-bwd", str(args.block_q_bwd),
-           "--block-k-bwd", str(args.block_k_bwd)]
+           "--block-k-bwd", str(args.block_k_bwd),
+           "--stem", args.stem]
     if args.image_size is not None:
         cmd += ["--image-size", str(args.image_size)]
     env = {**os.environ, **extra_env,
@@ -186,7 +193,7 @@ def _spawn_inner(args, extra_env: dict, timeout: float
         out = subprocess.run(cmd, capture_output=True, text=True,
                              timeout=timeout, env=env)
     except subprocess.TimeoutExpired:
-        return -1, None, f"inner run timed out after {timeout:.0f}s"
+        return -1, None, f"inner run timed out after {timeout:.0f}s", False
     payload = None
     for line in reversed(out.stdout.strip().splitlines()):
         try:
@@ -196,7 +203,8 @@ def _spawn_inner(args, extra_env: dict, timeout: float
         if isinstance(cand, dict) and "metric" in cand:
             payload = cand
             break
-    return out.returncode, payload, out.stderr[-2000:]
+    oom = any(sig in out.stderr for sig in _OOM_SIGNATURES)
+    return out.returncode, payload, out.stderr[-2000:], oom
 
 
 def _orchestrate(args) -> int:
@@ -225,7 +233,7 @@ def _orchestrate(args) -> int:
         # instead of silently completing a CPU benchmark the retry loop
         # would discard; CPU execution happens only in the final explicit
         # fallback below.
-        rc, payload, err = _spawn_inner(
+        rc, payload, err, oom = _spawn_inner(
             args, {"HVD_BENCH_REQUIRE_ACCEL": "1"}, timeout=900.0)
         if rc == 0 and payload and \
                 not str(payload.get("metric", "")).endswith("_failed") and \
@@ -235,12 +243,13 @@ def _orchestrate(args) -> int:
             return 0
         print(f"bench: attempt {attempt + 1}/{attempts} failed "
               f"(rc={rc}): {err}", file=sys.stderr)
-        if "Ran out of memory" in err:
-            # Deterministic config error (XLA's HBM/VMEM OOM signature):
-            # retrying the same shapes can only fail identically — report
-            # now. (Matching broad gRPC codes like RESOURCE_EXHAUSTED
-            # would misclassify the tunnel's transient flow-control
-            # errors, which the retry loop exists for.)
+        if oom:
+            # Deterministic config error (XLA's HBM/VMEM OOM signatures,
+            # matched on the full stderr): retrying the same shapes can
+            # only fail identically — report now. (Matching broad gRPC
+            # codes like RESOURCE_EXHAUSTED would misclassify the
+            # tunnel's transient flow-control errors, which the retry
+            # loop exists for.)
             _emit({"metric": f"{args.model}_failed", "value": 0.0,
                    "unit": "error", "vs_baseline": 0.0, "backend": "tpu",
                    "error": f"out of memory (deterministic): {err[-300:]}",
@@ -250,8 +259,8 @@ def _orchestrate(args) -> int:
             time.sleep(backoff)
     print("bench: accelerator attempts exhausted; falling back to CPU",
           file=sys.stderr)
-    rc, payload, err = _spawn_inner(args, {"JAX_PLATFORMS": "cpu"},
-                                    timeout=900.0)
+    rc, payload, err, _ = _spawn_inner(args, {"JAX_PLATFORMS": "cpu"},
+                                       timeout=900.0)
     if rc == 0 and payload:
         payload["backend"] = "cpu-fallback"
         payload["attempts"] = attempts + 1
@@ -277,6 +286,11 @@ def main() -> int:
                         "gpt: transformer tokens/sec (flash attention); "
                         "eager: controller/TCP eager-core microbenchmark")
     parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--stem", default="conv7",
+                        choices=["conv7", "space_to_depth"],
+                        help="resnet*: stem layout (space_to_depth folds "
+                        "the 7x7/s2 3-channel conv into an equivalent "
+                        "4x4/s1 12-channel conv for the MXU)")
     parser.add_argument("--image-size", type=int, default=None,
                         help="default: the model's canonical input "
                         "(299 for inception3, else 224)")
@@ -302,6 +316,13 @@ def main() -> int:
     parser.add_argument("--inner", action="store_true",
                         help="internal: run one attempt in-process")
     args = parser.parse_args()
+    if args.model.startswith("resnet") and args.stem == "space_to_depth" \
+            and (args.image_size or 224) % 2:
+        # Validate BEFORE orchestration: a trace-time shape error in the
+        # inner process would be indistinguishable from a transient
+        # failure and burn the whole retry schedule.
+        parser.error(f"--stem space_to_depth needs an even --image-size "
+                     f"(got {args.image_size})")
     if args.model == "eager":   # CPU/localhost only — no tunnel exposure
         try:
             return bench_eager(args)
@@ -345,7 +366,10 @@ def bench_resnet(args, info: dict) -> int:
             "vgg16": models.VGG16, "inception3": models.InceptionV3}
     if args.image_size is None:   # per-model canonical input
         args.image_size = 299 if args.model == "inception3" else 224
-    model = ctor[args.model](num_classes=1000)
+    kw = {}
+    if args.model.startswith("resnet"):
+        kw["stem"] = args.stem
+    model = ctor[args.model](num_classes=1000, **kw)
     # bf16 wire on TPU; fp16 elsewhere (XLA CPU crashes promoting bf16
     # all-reduces — same guard as __graft_entry__.dryrun_multichip).
     wire = "bf16" if on_tpu else "fp16"

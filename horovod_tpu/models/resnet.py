@@ -13,7 +13,12 @@ shaped for the TPU MXU:
   torchvision models the reference benchmarks;
 - optional cross-replica batch norm over a mesh axis (the reference ships
   SyncBatchNorm as an opt-in, reference: torch/sync_batch_norm.py:40-218);
-  flax's BatchNorm takes `axis_name` and lowers to a psum on ICI.
+  flax's BatchNorm takes `axis_name` and lowers to a psum on ICI;
+- optional space-to-depth stem (`stem="space_to_depth"`): the 7x7/s2
+  conv on a 3-channel input maps poorly onto the 128-lane MXU; folding
+  2x2 spatial blocks into channels turns it into an exactly-equivalent
+  4x4/s1 conv on 12 channels (`fold_conv7_stem_weights` converts
+  trained conv7 weights into the folded layout bit-for-bit in fp32).
 """
 from __future__ import annotations
 
@@ -24,6 +29,35 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 ModuleDef = Any
+
+
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """[N, H, W, C] → [N, H/b, W/b, b*b*C], folding b×b spatial cells
+    into channels (cell-major, then input-row, input-col, channel)."""
+    n, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(
+            f"space_to_depth needs H and W divisible by {block} "
+            f"(got {h}x{w}); pad or resize the input")
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, block * block * c)
+
+
+def fold_conv7_stem_weights(w7: jnp.ndarray) -> jnp.ndarray:
+    """[7, 7, C, F] conv7/s2/p3 kernel → the equivalent [4, 4, 4C, F]
+    kernel for a stride-1 conv over the 2×2 space-to-depth input with
+    cell padding ((2,1),(2,1)).
+
+    out(i) = Σ_{a=0..6} x[2i−3+a]·W[a] = Σ_{a=0..7} x[2i−4+a]·W8[a]
+    with a zero row/col padded at the FRONT; rows 2i−4..2i+3 span s2d
+    cells i−2..i+1 — a 4-cell window starting at cell i−2."""
+    kh, kw, c, f = w7.shape
+    assert (kh, kw) == (7, 7), (kh, kw)
+    w8 = jnp.pad(w7, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    w8 = w8.reshape(4, 2, 4, 2, c, f)
+    w8 = w8.transpose(0, 2, 1, 3, 4, 5)          # [4, 4, 2, 2, C, F]
+    return w8.reshape(4, 4, 4 * c, f)
 
 
 class BasicBlock(nn.Module):
@@ -93,6 +127,10 @@ class ResNet(nn.Module):
     param_dtype: Any = jnp.float32
     act: Callable = nn.relu
     axis_name: str | None = None
+    # "conv7" (torchvision-identical stem) | "space_to_depth" (MXU-
+    # friendly folded stem; same function class — conv7 checkpoints
+    # convert via fold_conv7_stem_weights).
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -104,8 +142,16 @@ class ResNet(nn.Module):
                        axis_name=self.axis_name if train else None)
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2),
-                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            x = space_to_depth(x, 2)
+            x = conv(self.num_filters, (4, 4),
+                     padding=[(2, 1), (2, 1)], name="conv_init")(x)
+        elif self.stem == "conv7":
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r} "
+                             "(expected 'conv7' or 'space_to_depth')")
         x = norm(name="bn_init")(x)
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

@@ -54,6 +54,8 @@ class TrainState:
 # streaming. Override via HOROVOD_STREAMING_CE_MIN_ELEMENTS (0 forces
 # streaming everywhere).
 def _ce_threshold() -> int:
+    # Read per call (trace-time Python, so this is free): the documented
+    # env override must work even when set after `import horovod_tpu`.
     raw = os.environ.get("HOROVOD_STREAMING_CE_MIN_ELEMENTS")
     if raw is None:
         return 1 << 30
@@ -65,13 +67,10 @@ def _ce_threshold() -> int:
             f"(got {raw!r})") from exc
 
 
-_STREAMING_CE_MIN_ELEMENTS = _ce_threshold()
-
-
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
                        label_smoothing: float = 0.0) -> jax.Array:
     """Mean softmax cross entropy over integer labels (fp32 math)."""
-    if logits.size >= _STREAMING_CE_MIN_ELEMENTS:
+    if logits.size >= _ce_threshold():
         from .ops.loss import streaming_softmax_cross_entropy
         return streaming_softmax_cross_entropy(logits, labels,
                                                label_smoothing)

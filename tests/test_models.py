@@ -32,6 +32,36 @@ def test_resnet50_forward_shape():
     assert logits.dtype == jnp.float32
 
 
+def test_space_to_depth_stem_equivalent_to_conv7():
+    """The folded stem is the SAME function as conv7/s2/p3: convert the
+    conv7 model's stem kernel with fold_conv7_stem_weights, share every
+    other parameter verbatim, and the logits must match in fp32."""
+    x = jax.random.normal(jax.random.key(3), (2, 64, 64, 3), jnp.float32)
+    m7 = models.ResNet18(num_classes=10, dtype=jnp.float32)
+    ms = models.ResNet18(num_classes=10, dtype=jnp.float32,
+                         stem="space_to_depth")
+    v7 = m7.init(jax.random.key(0), x, train=False)
+    vs = jax.tree_util.tree_map(lambda a: a, v7)      # shallow copy
+    vs["params"] = dict(v7["params"])
+    vs["params"]["conv_init"] = {
+        "kernel": models.resnet.fold_conv7_stem_weights(
+            v7["params"]["conv_init"]["kernel"])}
+    np.testing.assert_allclose(
+        np.asarray(ms.apply(vs, x, train=False)),
+        np.asarray(m7.apply(v7, x, train=False)), atol=1e-4)
+
+
+def test_space_to_depth_helpers_roundtrip():
+    x = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+    y = models.resnet.space_to_depth(x)
+    assert y.shape == (2, 4, 4, 12)
+    # cell (0,0) holds rows 0-1 x cols 0-1, channel-last within the cell
+    np.testing.assert_array_equal(
+        np.asarray(y[0, 0, 0]),
+        np.asarray(jnp.concatenate(
+            [x[0, 0, 0], x[0, 0, 1], x[0, 1, 0], x[0, 1, 1]])))
+
+
 @pytest.mark.parametrize("ctor,n_params_min", [
     (models.ResNet18, 11e6), (models.ResNet50, 25e6)])
 def test_param_counts(ctor, n_params_min):
