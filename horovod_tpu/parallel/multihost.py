@@ -98,6 +98,19 @@ def init_jax_distributed(rank: int, size: int, kv: Any = None,
             local_device_ids=local_device_ids,
             heartbeat_timeout_seconds=heartbeat,
             initialization_timeout=int(timeout))
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            # Eagerly form the gloo transport pairs while every process
+            # is still in init lockstep (reference parity: the gloo
+            # context connects its pairs AT init, gloo_context.cc, not
+            # lazily). Without this the pairs connect at the first REAL
+            # collective — which under per-process compile skew can sit
+            # beyond gloo's connect timeout and fail world formation
+            # exactly when the program is largest.
+            try:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices("horovod_tpu_init")
+            except Exception:  # noqa: BLE001 - barrier is best-effort
+                logger.debug("init barrier skipped", exc_info=True)
         global _world
         _world = (rank, size, kv, epoch)
         _initialized_here = True

@@ -41,11 +41,10 @@ def test_space_to_depth_stem_equivalent_to_conv7():
     ms = models.ResNet18(num_classes=10, dtype=jnp.float32,
                          stem="space_to_depth")
     v7 = m7.init(jax.random.key(0), x, train=False)
-    vs = jax.tree_util.tree_map(lambda a: a, v7)      # shallow copy
-    vs["params"] = dict(v7["params"])
-    vs["params"]["conv_init"] = {
-        "kernel": models.resnet.fold_conv7_stem_weights(
-            v7["params"]["conv_init"]["kernel"])}
+    vs = {**v7, "params": {
+        **v7["params"],
+        "conv_init": {"kernel": models.resnet.fold_conv7_stem_weights(
+            v7["params"]["conv_init"]["kernel"])}}}
     np.testing.assert_allclose(
         np.asarray(ms.apply(vs, x, train=False)),
         np.asarray(m7.apply(v7, x, train=False)), atol=1e-4)

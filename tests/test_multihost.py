@@ -30,6 +30,60 @@ def _parse_loss(out: bytes, tag: str) -> float:
     return float(m.group(1))
 
 
+# Failure signatures of HOST OVERSUBSCRIPTION, not product bugs: on this
+# 1-core CI box a concurrent xdist lane can stretch a worker past its
+# wall timeout or past gloo's (non-configurable) internal connect
+# timeout; SIGKILL (-9) is this harness's own kill cascade. Signatures
+# are matched ONLY in the failed rank's own output — a surviving peer's
+# inevitable "Socket closed" noise must not whitewash another rank's
+# real crash — and signal deaths other than SIGKILL (e.g. a SIGSEGV in
+# native code) are product bugs, never infra.
+_INFRA_SIGNATURES = (b"Connect timeout", b"coordination service",
+                     b"Socket closed")
+
+
+def _infra_failure(failed: list, outputs: list[str]) -> bool:
+    if not failed:
+        return False
+    for rank, rc in failed:
+        own = outputs[rank].encode(errors="replace") \
+            if rank < len(outputs) else b""
+        if rc in ("timeout", -9):
+            continue
+        if isinstance(rc, int) and \
+                not any(sig in own for sig in _INFRA_SIGNATURES):
+            return False          # clean nonzero exit / non-kill signal
+    return True
+
+
+def _run_world(env: dict, port: int, mode: str):
+    procs = [_launch(r, 2, port, 4, env, mode) for r in range(2)]
+    outputs, losses, failed = [], [], []
+    try:
+        for r, p in enumerate(procs):
+            timed_out = False
+            try:
+                out, _ = p.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+                failed.append((r, "timeout"))
+                timed_out = True
+            outputs.append(f"--- rank {r} (rc={p.returncode}) ---\n"
+                           + out.decode(errors="replace"))
+            if timed_out:
+                pass                  # already recorded as a timeout
+            elif p.returncode != 0:
+                failed.append((r, p.returncode))
+            else:
+                losses.append(_parse_loss(out, f"rank{r}"))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outputs, losses, failed
+
+
 def _run_mode(mode: str) -> None:
     env = dict(os.environ)
     for k in list(env):
@@ -43,28 +97,22 @@ def _run_mode(mode: str) -> None:
     baseline = _parse_loss(out, "baseline")
 
     # 2-process run: the same mesh across 2 "hosts" of 4 devices.
+    # ONE retry, strictly for oversubscription signatures (see
+    # _INFRA_SIGNATURES) — a loss mismatch or clean failure is final.
     server = RendezvousServer()
     port = server.start()
-    procs = [_launch(r, 2, port, 4, env, mode) for r in range(2)]
-    outputs, losses, failed = [], [], []
     try:
-        for r, p in enumerate(procs):
-            try:
-                out, _ = p.communicate(timeout=300)
-            except subprocess.TimeoutExpired:
-                p.kill()
-                out, _ = p.communicate()
-                failed.append((r, "timeout"))
-            outputs.append(f"--- rank {r} (rc={p.returncode}) ---\n"
-                           + out.decode(errors="replace"))
-            if p.returncode != 0:
-                failed.append((r, p.returncode))
-            else:
-                losses.append(_parse_loss(out, f"rank{r}"))
+        for attempt in range(2):
+            env["HOROVOD_RENDEZVOUS_EPOCH"] = f"mh-{mode}-{attempt}"
+            outputs, losses, failed = _run_world(env, port, mode)
+            if not failed:
+                break
+            if attempt == 0 and _infra_failure(failed, outputs):
+                print(f"multihost {mode}: infra failure {failed}; "
+                      "retrying once with a fresh epoch", file=sys.stderr)
+                continue
+            break
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
         server.stop()
     assert not failed, "worker failures: %s\n%s" % (failed,
                                                     "\n".join(outputs))
