@@ -184,7 +184,8 @@ def _spawn_inner(args, extra_env: dict, timeout: float
            "--block-k", str(args.block_k),
            "--block-q-bwd", str(args.block_q_bwd),
            "--block-k-bwd", str(args.block_k_bwd),
-           "--stem", args.stem]
+           "--stem", args.stem,
+           "--gpt-preset", args.gpt_preset]
     if args.image_size is not None:
         cmd += ["--image-size", str(args.image_size)]
     env = {**os.environ, **extra_env,
@@ -295,6 +296,11 @@ def main() -> int:
                         help="default: the model's canonical input "
                         "(299 for inception3, else 224)")
     parser.add_argument("--seq-len", type=int, default=2048)
+    parser.add_argument("--gpt-preset", default="small",
+                        choices=["small", "medium"],
+                        help="gpt: model size (small=124M, medium=350M; "
+                        "medium's d_model=1024 shapes map better onto "
+                        "the 128x128 MXU)")
     parser.add_argument("--warmup", type=int, default=3)
     parser.add_argument("--iters", type=int, default=20)
     parser.add_argument("--remat", type=int, default=0,
@@ -448,7 +454,9 @@ def bench_gpt(args, info: dict) -> int:
             f"--seq-len; {seq} is not a multiple of 128 (requested "
             f"block {block}).")
 
-    cfg = models.gpt_small(
+    preset = models.gpt_medium if args.gpt_preset == "medium" \
+        else models.gpt_small
+    cfg = preset(
         max_seq_len=args.seq_len,
         attention="flash" if on_tpu else "dense", remat=bool(args.remat),
         remat_policy=args.remat_policy,
@@ -492,7 +500,7 @@ def bench_gpt(args, info: dict) -> int:
     mfu = (round(flops * iters / elapsed / peak, 4)
            if flops and peak else None)
     _emit({
-        "metric": "gpt_small_tokens_per_sec_per_chip",
+        "metric": f"gpt_{args.gpt_preset}_tokens_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": 0.0,   # no reference LM baseline exists

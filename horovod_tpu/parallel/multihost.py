@@ -69,7 +69,8 @@ def init_jax_distributed(rank: int, size: int, kv: Any = None,
                 coordinator_address = kv.wait(_COORD_SCOPE, key,
                                               timeout).decode()
 
-        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        cpu_gloo = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        if cpu_gloo:
             # Cross-process collectives on the CPU backend need the gloo
             # implementation (the virtual-mesh test path; real deployments
             # ride ICI/DCN through the TPU runtime instead).
@@ -98,7 +99,7 @@ def init_jax_distributed(rank: int, size: int, kv: Any = None,
             local_device_ids=local_device_ids,
             heartbeat_timeout_seconds=heartbeat,
             initialization_timeout=int(timeout))
-        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        if cpu_gloo:
             # Eagerly form the gloo transport pairs while every process
             # is still in init lockstep (reference parity: the gloo
             # context connects its pairs AT init, gloo_context.cc, not

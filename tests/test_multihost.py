@@ -46,13 +46,15 @@ def _infra_failure(failed: list, outputs: list[str]) -> bool:
     if not failed:
         return False
     for rank, rc in failed:
+        if rc in ("timeout", -9):
+            continue              # harness wall timeout / its kill cascade
+        if isinstance(rc, int) and rc < 0:
+            return False          # non-SIGKILL signal (e.g. SIGSEGV):
+                                  # a product bug, never infra
         own = outputs[rank].encode(errors="replace") \
             if rank < len(outputs) else b""
-        if rc in ("timeout", -9):
-            continue
-        if isinstance(rc, int) and \
-                not any(sig in own for sig in _INFRA_SIGNATURES):
-            return False          # clean nonzero exit / non-kill signal
+        if not any(sig in own for sig in _INFRA_SIGNATURES):
+            return False          # clean nonzero exit
     return True
 
 
