@@ -79,6 +79,19 @@ def init_jax_distributed(rank: int, size: int, kv: Any = None,
                                   "gloo")
             except Exception:  # noqa: BLE001 - older jaxlib: no such knob
                 pass
+            if not (os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                    or jax.config.jax_compilation_cache_dir):
+                # The compile→barrier→dispatch pattern (Trainer.step →
+                # kv_barrier) only shrinks skew if the post-barrier
+                # dispatch can reload the AOT compile from a persistent
+                # cache — lower().compile() does not seed jit's
+                # in-memory executable cache. Configure a host-shared
+                # cache when the caller hasn't.
+                try:
+                    jax.config.update("jax_compilation_cache_dir",
+                                      "/tmp/horovod_tpu_jax_cache")
+                except Exception:  # noqa: BLE001 - knob absent
+                    pass
 
         # Elastic worlds must SURVIVE peer death: without recoverability
         # the coordination service FATALs the surviving processes when the
@@ -112,10 +125,53 @@ def init_jax_distributed(rank: int, size: int, kv: Any = None,
                 multihost_utils.sync_global_devices("horovod_tpu_init")
             except Exception:  # noqa: BLE001 - barrier is best-effort
                 logger.debug("init barrier skipped", exc_info=True)
-        global _world
+        global _world, _barrier_seq, _cpu_gloo_world
         _world = (rank, size, kv, epoch)
+        # Every member of the (possibly re-formed elastic) world starts
+        # the barrier sequence from zero — a survivor carrying its old
+        # counter would wait on keys no newcomer ever writes.
+        _barrier_seq = 0
+        _cpu_gloo_world = cpu_gloo
         _initialized_here = True
         return True
+
+
+_barrier_seq = 0
+_cpu_gloo_world = False
+
+
+def kv_barrier(tag: str, timeout: float = 300.0) -> None:
+    """Rendezvous-KV barrier across the world — pure HTTP, NO collective.
+
+    gloo forms a fresh transport context per compiled program, and its
+    pair-connect timeout is a hardcoded ~30 s: any cross-rank skew
+    larger than that (per-process compile of a big program on a loaded
+    host) fails the program's FIRST collective with "Gloo context
+    initialization failed: Connect timeout". A barrier that is itself a
+    collective inherits the same bound, so this one rides the rendezvous
+    KV instead. No-op outside a multi-process world."""
+    global _barrier_seq
+    if not _initialized_here or _world is None:
+        return
+    rank, size, kv, epoch = _world
+    if kv is None or size <= 1:
+        return
+    with _lock:
+        _barrier_seq += 1
+        seq = _barrier_seq
+    key = f"{epoch}:{tag}:{seq}"
+    kv.put("barrier", f"{key}:{rank}", b"1")
+    for r in range(size):
+        kv.wait("barrier", f"{key}:{r}", timeout)
+
+
+def sync_compile_needed() -> bool:
+    """True when the compile→barrier→dispatch pattern is required: a
+    multi-process world on the CPU/gloo backend (see kv_barrier). Reads
+    the decision RECORDED at world formation — a later JAX_PLATFORMS
+    mutation must not make step-time behavior disagree with how the
+    world was actually formed."""
+    return _initialized_here and _cpu_gloo_world
 
 
 def shutdown_jax_distributed() -> None:

@@ -227,6 +227,20 @@ class Trainer:
     def step(self, state: TrainState, batch: dict):
         if self._step_fn is None:
             self._step_fn = self._build(state)
+            from .parallel import multihost
+            if multihost.sync_compile_needed():
+                # Compile → KV-barrier → dispatch: gloo's per-program
+                # transport context connects at the program's first
+                # collective, and per-rank compile skew beyond its
+                # ~30 s connect timeout would fail the step outright
+                # (multihost.kv_barrier docstring). AOT-compiling here
+                # warms the persistent compilation cache, the barrier
+                # aligns the ranks, and the dispatch below re-lowers
+                # from cache in seconds — skew shrinks below the bound.
+                try:
+                    self._step_fn.lower(state, batch).compile()
+                finally:
+                    multihost.kv_barrier("trainer-step-compile")
         return self._step_fn(state, batch)
 
     # -- fit loop with callbacks ------------------------------------------

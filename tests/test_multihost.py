@@ -48,13 +48,17 @@ def _infra_failure(failed: list, outputs: list[str]) -> bool:
     for rank, rc in failed:
         if rc in ("timeout", -9):
             continue              # harness wall timeout / its kill cascade
-        if isinstance(rc, int) and rc < 0:
-            return False          # non-SIGKILL signal (e.g. SIGSEGV):
-                                  # a product bug, never infra
+        if isinstance(rc, int) and rc < 0 and rc != -6:
+            return False          # signal death other than SIGABRT (e.g.
+                                  # SIGSEGV): a product bug, never infra
+        # SIGABRT (-6) is jaxlib's LOG(FATAL) path — infra only when the
+        # rank's OWN output carries an oversubscription signature (a
+        # survivor outliving the torn-down coordination service);
+        # likewise a nonzero exit needs a signature to count as infra.
         own = outputs[rank].encode(errors="replace") \
             if rank < len(outputs) else b""
         if not any(sig in own for sig in _INFRA_SIGNATURES):
-            return False          # clean nonzero exit
+            return False
     return True
 
 
