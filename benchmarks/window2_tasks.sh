@@ -1,0 +1,48 @@
+#!/bin/bash
+# Runs the queued TPU-window experiments in priority order the moment the
+# tunnel is green. Appends "<tag> <JSON>" lines like mfu_sweep.sh.
+set -u
+LOG="${1:-/tmp/window2.log}"
+cd "$(dirname "$0")/.."
+
+run() {
+  local tag="$1"; shift
+  if grep -q "^${tag} " "$LOG" 2>/dev/null; then
+    echo "skip ${tag}" >&2; return
+  fi
+  echo "=== ${tag}: $*" >&2
+  local out rc
+  out=$("$@" 2>/tmp/window2_err.log); rc=$?
+  if [ $rc -ne 0 ] || [ -z "$out" ]; then
+    echo "FAILED ${tag} rc=${rc}" >&2; return
+  fi
+  case "$out" in
+    *'"unit": "error"'*)
+      echo "${tag} ${out}" >> "${LOG}.failed"
+      echo "FAILED ${tag} (structured): ${out}" >&2
+      return;;
+  esac
+  echo "${tag} ${out}" >> "$LOG"
+  echo "${tag} ${out}" >&2
+}
+
+# 1. s2d stem A/B — back-to-back same window, conv7 first (the default).
+run rn50-conv7  python bench.py --model resnet50 --iters 60
+run rn50-s2d    python bench.py --model resnet50 --iters 60 --stem space_to_depth
+# 2. gpt-medium flagship MFU (d_model=1024 MXU shapes); batch 8 rows/chip
+#    = --batch-size 128 default scaling (128//16=8). 350M params, no remat.
+run gptmed-bs8  python bench.py --model gpt --gpt-preset medium --iters 30
+run gptmed-bs4  python bench.py --model gpt --gpt-preset medium --iters 30 --batch-size 64 --remat 1 --remat-policy dots
+# 3. corrected HBM roofline (optimization_barrier between passes);
+#    multi-line output -> its own file
+if ! [ -s /tmp/window2_roofline.jsonl ]; then
+  echo "=== roofline" >&2
+  if ! timeout 580 python benchmarks/roofline.py \
+      > /tmp/window2_roofline.jsonl 2>/tmp/window2_err.log; then
+    echo "FAILED roofline" >&2
+    rm -f /tmp/window2_roofline.jsonl   # partial output must not satisfy
+  fi                                    # the rerun guard
+fi
+# 4. gpt default confirm (dense CE now the default path)
+run gpt-default python bench.py --model gpt --iters 40
+echo "window2 done" >&2

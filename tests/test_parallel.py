@@ -385,3 +385,68 @@ class TestMoE:
         state, metrics = trainer.step(state, batch)
         assert int(state.step) == 1
         assert np.isfinite(float(metrics["loss"]))
+
+
+class TestKvBarrier:
+    """kv_barrier protocol (parallel/multihost.py): rendezvous-KV barrier
+    with a per-world sequence — the non-collective alignment primitive
+    the compile→barrier→dispatch pattern relies on."""
+
+    def _fake_world(self, monkeypatch, rank, size, store):
+        from horovod_tpu.parallel import multihost
+
+        class FakeKV:
+            def put(self, scope, key, value):
+                store[(scope, key)] = value
+
+            def wait(self, scope, key, timeout=5.0):
+                import time
+                end = time.time() + timeout
+                while (scope, key) not in store:
+                    if time.time() > end:
+                        raise TimeoutError(key)
+                    time.sleep(0.01)
+                return store[(scope, key)]
+
+        monkeypatch.setattr(multihost, "_initialized_here", True)
+        monkeypatch.setattr(multihost, "_world",
+                            (rank, size, FakeKV(), "ep0"))
+        return multihost
+
+    def test_barrier_waits_for_every_rank(self, monkeypatch):
+        import threading
+
+        store: dict = {}
+        mh = self._fake_world(monkeypatch, 0, 2, store)
+        monkeypatch.setattr(mh, "_barrier_seq", 0)
+        done = threading.Event()
+
+        def rank0():
+            mh.kv_barrier("t", timeout=5.0)
+            done.set()
+
+        t = threading.Thread(target=rank0, daemon=True)
+        t.start()
+        # Rank 0 has published its key but must still be blocked on
+        # rank 1's.
+        assert not done.wait(0.3)
+        assert ("barrier", "ep0:t:1:0") in store
+        store[("barrier", "ep0:t:1:1")] = b"1"   # rank 1 arrives
+        assert done.wait(5.0)
+        t.join(5.0)
+
+    def test_sequence_advances_per_call(self, monkeypatch):
+        store: dict = {}
+        mh = self._fake_world(monkeypatch, 0, 2, store)
+        monkeypatch.setattr(mh, "_barrier_seq", 0)
+        store[("barrier", "ep0:a:1:1")] = b"1"
+        store[("barrier", "ep0:b:2:1")] = b"1"
+        mh.kv_barrier("a", timeout=2.0)
+        mh.kv_barrier("b", timeout=2.0)
+        assert ("barrier", "ep0:a:1:0") in store
+        assert ("barrier", "ep0:b:2:0") in store
+
+    def test_noop_outside_world(self, monkeypatch):
+        from horovod_tpu.parallel import multihost
+        monkeypatch.setattr(multihost, "_initialized_here", False)
+        multihost.kv_barrier("t", timeout=0.1)   # must not raise
