@@ -45,4 +45,6 @@ if ! [ -s /tmp/window2_roofline.jsonl ]; then
 fi
 # 4. gpt default confirm (dense CE now the default path)
 run gpt-default python bench.py --model gpt --iters 40
+# 5. accuracy-metric cost A/B (argmax over the [B,S,V] logits)
+run gpt-noacc env HOROVOD_TRACK_ACCURACY=0 python bench.py --model gpt --iters 40
 echo "window2 done" >&2

@@ -166,6 +166,11 @@ NUM_STREAMS = register(
     "HOROVOD_NUM_STREAMS", 1, int,
     "Parallel dispatch lanes for fused collective programs "
     "(analogue of HOROVOD_NUM_NCCL_STREAMS).")
+TRACK_ACCURACY = register(
+    "HOROVOD_TRACK_ACCURACY", True, _parse_bool,
+    "Compute the per-step training-accuracy metric in Trainer.step. "
+    "For LM-head-sized logits the argmax is a full extra read of a "
+    "multi-GB tensor per step; disable for throughput runs.")
 def parse_tristate(value: str) -> bool | None:
     """'1'/'true'/... -> True, '0'/'false'/... -> False, else None (auto).
     Shared by the tri-state knobs (JAX_DISTRIBUTED, XLA_OPERATIONS)."""

@@ -67,6 +67,11 @@ def _ce_threshold() -> int:
             f"(got {raw!r})") from exc
 
 
+def _track_accuracy() -> bool:
+    from .common import config
+    return bool(config.TRACK_ACCURACY.get())
+
+
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
                        label_smoothing: float = 0.0) -> jax.Array:
     """Mean softmax cross entropy over integer labels (fp32 math)."""
@@ -176,13 +181,16 @@ class Trainer:
                                                 state.params)
             params = optax.apply_updates(state.params, updates)
 
-            acc = jnp.mean(
-                (jnp.argmax(logits, -1) == batch["label"]).astype(
-                    jnp.float32))
-            metrics = {
-                "loss": allreduce(loss, sync_cfg.axes, "average"),
-                "accuracy": allreduce(acc, sync_cfg.axes, "average"),
-            }
+            metrics = {"loss": allreduce(loss, sync_cfg.axes, "average")}
+            if _track_accuracy():
+                # For LM-head-sized logits the argmax is a full extra
+                # read of a multi-GB tensor per step; the knob lets a
+                # throughput run drop it (HOROVOD_TRACK_ACCURACY=0).
+                acc = jnp.mean(
+                    (jnp.argmax(logits, -1) == batch["label"]).astype(
+                        jnp.float32))
+                metrics["accuracy"] = allreduce(acc, sync_cfg.axes,
+                                                "average")
             new_stats = updated.get("batch_stats", state.batch_stats)
             if state.batch_stats and getattr(self.model, "axis_name",
                                              None) is None:
