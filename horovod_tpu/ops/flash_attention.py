@@ -33,9 +33,10 @@ def _out_struct(shape, dtype, *like):
     """ShapeDtypeStruct carrying the union of the inputs' varying mesh axes
     (vma) — required for pallas_call inside shard_map regions with
     check_vma=True."""
+    aval_of = getattr(jax, "typeof", None) or jax.core.get_aval
     vma: frozenset = frozenset()
     for x in like:
-        v = getattr(jax.core.get_aval(x), "vma", None)
+        v = getattr(aval_of(x), "vma", None)
         if v:
             vma |= frozenset(v)
     try:
