@@ -253,7 +253,11 @@ def _orchestrate(args) -> int:
             # loop exists for.)
             _emit({"metric": f"{args.model}_failed", "value": 0.0,
                    "unit": "error", "vs_baseline": 0.0, "backend": "tpu",
-                   "error": f"out of memory (deterministic): {err[-300:]}",
+                   "error": ("out of memory (deterministic; if the fp32 "
+                             "logits buffer is the culprit, lower "
+                             "HOROVOD_STREAMING_CE_MIN_ELEMENTS — 0 "
+                             "forces the streaming cross-entropy path): "
+                             f"{err[-300:]}"),
                    "attempts": attempt + 1})
             return 0
         if attempt + 1 < attempts:

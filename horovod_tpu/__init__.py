@@ -124,12 +124,16 @@ def _auto_name(prefix: str, name: str | None) -> str:
 # ---------------------------------------------------------------------------
 def allreduce_async(tensor, average: bool | None = None, name: str | None = None,
                     op=None, prescale_factor: float = 1.0,
-                    postscale_factor: float = 1.0) -> Handle:
+                    postscale_factor: float = 1.0,
+                    compression=None) -> Handle:
+    """``compression`` selects the wire codec: a name ("fp16", "bf16",
+    "int8", "uint4"), a compress.CompressionCodec, or a framework
+    Compression marker class; None honors HOROVOD_COMPRESSION."""
     kind, adasum = _op_kind(op, average)
     _, handle = core.enqueue_allreduce(
         _auto_name("allreduce", name), tensor, op=kind,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        adasum=adasum)
+        adasum=adasum, codec=compression)
     handle.wrap_refs = [tensor]
     return handle
 
@@ -138,13 +142,15 @@ def grouped_allreduce_async(tensors: Sequence[Any],
                             average: bool | None = None,
                             name: str | None = None, op=None,
                             prescale_factor: float = 1.0,
-                            postscale_factor: float = 1.0) -> Handle:
+                            postscale_factor: float = 1.0,
+                            compression=None) -> Handle:
     kind, adasum = _op_kind(op, average)
     base = _auto_name("grouped_allreduce", name)
     names = [f"{base}.{i}" for i in range(len(tensors))]
     _, handle = core.enqueue_grouped_allreduce(
         names, list(tensors), op=kind, prescale_factor=prescale_factor,
-        postscale_factor=postscale_factor, adasum=adasum)
+        postscale_factor=postscale_factor, adasum=adasum,
+        codec=compression)
     handle.wrap_refs = list(tensors)
     return handle
 
@@ -191,18 +197,19 @@ def poll(handle: Handle) -> bool:
 # ---------------------------------------------------------------------------
 def allreduce(tensor, average: bool | None = None, name: str | None = None,
               op=None, prescale_factor: float = 1.0,
-              postscale_factor: float = 1.0):
+              postscale_factor: float = 1.0, compression=None):
     handle = allreduce_async(tensor, average, name, op, prescale_factor,
-                             postscale_factor)
+                             postscale_factor, compression)
     return _result(handle, tensor)
 
 
 def grouped_allreduce(tensors: Sequence[Any], average: bool | None = None,
                       name: str | None = None, op=None,
                       prescale_factor: float = 1.0,
-                      postscale_factor: float = 1.0):
+                      postscale_factor: float = 1.0, compression=None):
     handle = grouped_allreduce_async(tensors, average, name, op,
-                                     prescale_factor, postscale_factor)
+                                     prescale_factor, postscale_factor,
+                                     compression)
     status = handle.wait()
     status.raise_if_error()
     return [_wrap_like(t, e.output)

@@ -308,6 +308,52 @@ class CollectiveBackend(ABC):
                 blocks.append(blk)
             e.output = np.concatenate(blocks, axis=0)
 
+    # ------------------------------------------------------------------
+    # Wire-compression codec helpers (compress/ subsystem).  Shared by
+    # the planes so every backend interprets Response.codec identically.
+    # ------------------------------------------------------------------
+    @staticmethod
+    def quantized_codec(response: Response):
+        """The response's quantized codec (int8/uint4) when it applies —
+        floating payloads only — else None."""
+        from ..common.dtypes import is_floating
+        from ..compress import QUANTIZED_CODECS, CompressionCodec
+        codec = CompressionCodec(response.codec)
+        if codec in QUANTIZED_CODECS and is_floating(response.tensor_type):
+            return codec
+        return None
+
+    @staticmethod
+    def codec_block_size(response: Response) -> int:
+        """Negotiated quantization block size (falls back to the config
+        default for hand-built responses that omitted it)."""
+        if response.codec_block_size > 0:
+            return response.codec_block_size
+        from ..compress import default_block_size
+        return default_block_size()
+
+    @staticmethod
+    def wire_cast_dtype(response: Response):
+        """Wire dtype for the cast codecs (fp16/bf16) when the payload is
+        a wider float, else None.  The planes reduce 16-bit wires with
+        fp32 accumulation already (accum_dtype), so the cast alone
+        reproduces the legacy Compression.fp16 semantics."""
+        from ..common.dtypes import element_size, is_floating
+        from ..compress import CompressionCodec
+        codec = CompressionCodec(response.codec)
+        if not is_floating(response.tensor_type) or \
+                element_size(response.tensor_type) <= 2:
+            return None
+        if codec == CompressionCodec.FP16:
+            return np.dtype(np.float16)
+        if codec == CompressionCodec.BF16:
+            try:
+                import ml_dtypes
+                return np.dtype(ml_dtypes.bfloat16)
+            except ImportError:   # bf16 wire unavailable: ship fp16
+                return np.dtype(np.float16)
+        return None
+
     @staticmethod
     def scale_buffer(buf: np.ndarray, factor: float) -> np.ndarray:
         if factor == 1.0:

@@ -78,6 +78,18 @@ class TcpCollectives:
         if native.ring_allreduce(self.mesh._socks[nxt].fileno(),
                                  self.mesh._socks[prv].fileno(),
                                  acc, rank, size):
+            # The native path writes the raw fds directly; account its
+            # known ring volume so the mesh byte counters stay truthful
+            # (2(N-1) chunk sends per rank, uneven chunk split).
+            sent = sum(sizes[(rank - s) % size] +
+                       sizes[(rank + 1 - s) % size]
+                       for s in range(size - 1)) * acc.dtype.itemsize
+            rcvd = sum(sizes[(rank - s - 1) % size] +
+                       sizes[(rank - s) % size]
+                       for s in range(size - 1)) * acc.dtype.itemsize
+            with self.mesh._lock:
+                self.mesh.bytes_sent += sent
+                self.mesh.bytes_received += rcvd
             return acc.astype(buf.dtype, copy=False)
 
         # Reduce-scatter: after step s, rank owns-partial chunk
@@ -100,6 +112,110 @@ class TcpCollectives:
             acc[bounds[recv_idx]:bounds[recv_idx + 1]] = incoming
 
         return acc.astype(buf.dtype, copy=False)
+
+    # -- cast-codec allreduce (compress/ subsystem) ---------------------
+    def cast_allreduce(self, buf: np.ndarray,
+                       wire_dtype: np.dtype) -> np.ndarray:
+        """Allreduce with a narrow wire dtype (fp16/bf16) that ACTUALLY
+        halves socket bytes: the plain ring widens 16-bit payloads to the
+        fp32 accumulation dtype before the wire, so a cast codec there
+        saves nothing.  Same owner-reduce shape as the quantized path —
+        each rank ships its wire-cast chunks to their owners, owners
+        accumulate in fp32 and round ONCE, reduced chunks return in the
+        wire dtype — so numerics match the planes' one-rounding contract
+        instead of the reference's per-hop fp16 rounding."""
+        n, rank, size = buf.size, self.rank, self.size
+        if size == 1:
+            return buf
+        from ..compress import chunk_bounds
+        wire_dtype = np.dtype(wire_dtype)
+        x = np.ascontiguousarray(buf).astype(wire_dtype, copy=False)
+        bounds = chunk_bounds(n, size)
+        my_len = int(bounds[rank + 1] - bounds[rank])
+
+        contrib: list = [None] * size
+        contrib[rank] = x[bounds[rank]:bounds[rank + 1]]
+        for offset in range(1, size):
+            to = (rank + offset) % size
+            frm = (rank - offset) % size
+            payload = np.ascontiguousarray(
+                x[bounds[to]:bounds[to + 1]]).tobytes()
+            data = self._sendrecv(to, payload, frm)
+            contrib[frm] = np.frombuffer(data, dtype=wire_dtype,
+                                         count=my_len)
+        acc = np.zeros(my_len, np.float32)
+        for c in contrib:                      # rank order (see above)
+            acc += np.asarray(c).astype(np.float32)
+        reduced = acc.astype(wire_dtype)
+
+        out = np.empty(n, dtype=wire_dtype)
+        out[bounds[rank]:bounds[rank + 1]] = reduced
+        payload = reduced.tobytes()
+        for offset in range(1, size):
+            to = (rank + offset) % size
+            frm = (rank - offset) % size
+            data = self._sendrecv(to, payload, frm)
+            out[bounds[frm]:bounds[frm + 1]] = np.frombuffer(
+                data, dtype=wire_dtype,
+                count=int(bounds[frm + 1] - bounds[frm]))
+        return out.astype(buf.dtype, copy=False)
+
+    # -- quantized allreduce (compress/ subsystem) ----------------------
+    def quantized_allreduce(self, buf: np.ndarray, codec,
+                            block_size: int) -> np.ndarray:
+        """Block-quantized allreduce — the EQuARX owner-reduce shape on
+        sockets (PAPERS.md, arxiv 2506.17615):
+
+          1. quantize each destination chunk of my buffer independently;
+          2. pairwise-exchange the QUANTIZED chunks (scales+zp+payload)
+             so each owner holds every rank's contribution to its chunk;
+          3. dequantize + sum in fp32 (including my own contribution's
+             dequantized form, so every rank reconstructs the identical
+             value regardless of ownership);
+          4. requantize the reduced chunk ONCE and exchange it pairwise.
+
+        Wire bytes: 2(N-1)/N · quantized-size — the ring-allreduce
+        structure at ~1/4 (int8) / ~1/8 (uint4) of the fp32 volume."""
+        from ..compress import (chunk_bounds, dequantize, from_bytes,
+                                quantize, to_bytes)
+        n, rank, size = buf.size, self.rank, self.size
+        if size == 1:
+            return buf
+        x = np.ascontiguousarray(buf).astype(np.float32, copy=False)
+        bounds = chunk_bounds(n, size)
+
+        my_chunks = [quantize(x[bounds[j]:bounds[j + 1]], codec,
+                              block_size) for j in range(size)]
+        my_len = int(bounds[rank + 1] - bounds[rank])
+        contrib: list = [None] * size
+        contrib[rank] = my_chunks[rank]
+        for offset in range(1, size):
+            to = (rank + offset) % size
+            frm = (rank - offset) % size
+            data = self._sendrecv(to, to_bytes(my_chunks[to]), frm)
+            contrib[frm] = from_bytes(data, my_len, codec, block_size)
+
+        # Accumulate in RANK order — fp32 addition is order-sensitive and
+        # the shm plane reduces in rank order, so this keeps the two
+        # planes' reconstructions bit-identical (they interoperate).
+        acc = np.zeros(my_len, np.float32)
+        for c in contrib:
+            acc += dequantize(c)
+        reduced = quantize(acc, codec, block_size)
+
+        out_chunks: list = [None] * size
+        out_chunks[rank] = reduced
+        payload = to_bytes(reduced)
+        for offset in range(1, size):
+            to = (rank + offset) % size
+            frm = (rank - offset) % size
+            data = self._sendrecv(to, payload, frm)
+            out_chunks[frm] = from_bytes(
+                data, int(bounds[frm + 1] - bounds[frm]), codec,
+                block_size)
+        out = np.concatenate([dequantize(c) for c in out_chunks]) \
+            if size > 1 else dequantize(out_chunks[0])
+        return out.astype(buf.dtype, copy=False)
 
     # -- reduce-scatter --------------------------------------------------
     def reduce_scatter(self, buf: np.ndarray,
@@ -218,12 +334,18 @@ class TcpBackend(CollectiveBackend):
                   entries: list[TensorTableEntry]) -> Status:
         buf = self.pack_fusion_buffer(response, entries)
         buf = self.scale_buffer(buf, response.prescale_factor)
+        np_dtype = buf.dtype
+        wire_dt = self.wire_cast_dtype(response)
         if response.response_type == ResponseType.ADASUM:
             from ..ops.adasum import adasum_tcp
             # Adasum semantics are per-tensor: the reference computes
             # per-layer dot products even inside fused buffers
             # (adasum.h:38-552), so a fused response must not mix norms
-            # across tensor boundaries — run VHDD per segment.
+            # across tensor boundaries — run VHDD per segment.  Cast
+            # codecs shrink the exchanged payload; quantized codecs were
+            # rejected at negotiation.
+            if wire_dt is not None:
+                buf = buf.astype(wire_dt)
             self._act_start(entries, "TCP_ADASUM")
             try:
                 offset, parts = 0, []
@@ -232,6 +354,21 @@ class TcpBackend(CollectiveBackend):
                                             buf[offset:offset + n]))
                     offset += n
                 buf = np.concatenate(parts) if len(parts) > 1 else parts[0]
+            finally:
+                self._act_end(entries)
+            buf = buf.astype(np_dtype, copy=False)
+        elif self.quantized_codec(response) is not None:
+            self._act_start(entries, "TCP_QUANTIZED_ALLREDUCE")
+            try:
+                buf = self.coll.quantized_allreduce(
+                    buf, self.quantized_codec(response),
+                    self.codec_block_size(response))
+            finally:
+                self._act_end(entries)
+        elif wire_dt is not None:
+            self._act_start(entries, "TCP_CAST_ALLREDUCE")
+            try:
+                buf = self.coll.cast_allreduce(buf, wire_dt)
             finally:
                 self._act_end(entries)
         else:

@@ -26,7 +26,7 @@ import numpy as np
 from ..common.message import Response, ResponseType
 from ..common.status import Status
 from ..common.tensor_queue import TensorTableEntry
-from .base import CollectiveBackend
+from .base import CollectiveBackend, accum_dtype as _accum_dtype
 
 
 class XlaCommunicator:
@@ -91,8 +91,10 @@ class XlaCommunicator:
             # collective_operations.h ScaleBuffer fp16 path; also the XLA
             # CPU backend crashes promoting 16-bit all-reduces). Averaging
             # rides the response's postscale factor, so sum is the only
-            # reduction.
-            widen = np_dtype.kind == "f" and np_dtype.itemsize <= 2
+            # reduction.  accum_dtype (not dtype.kind) so bf16 — numpy
+            # kind 'V' — widens too, which the fp16/bf16 wire-cast codecs
+            # rely on.
+            widen = _accum_dtype(np_dtype) != np_dtype
 
             @partial(jax.jit, out_shardings=out_sharding,
                      donate_argnums=(0,))
@@ -115,6 +117,69 @@ class XlaCommunicator:
             sharding, buf[None, :], global_shape=(size, buf.size))
         out = self._reduce_fn(buf.dtype, size)(g)
         return np.asarray(out)
+
+    # -- quantized allreduce (compress/ subsystem) -----------------------
+    def _quantized_reduce_fn(self, codec, size: int, n: int,
+                             block_size: int):
+        def build():
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..compress import jax_ops
+
+            mesh = self._world_mesh()
+            rep = NamedSharding(mesh, P())
+
+            @partial(jax.jit, out_shardings=rep)
+            def _qar(q, s, zp):
+                # Replicate the QUANTIZED rows + block metadata — the
+                # resharding is the all-gather, so ICI/DCN moves uint8
+                # payload and fp32 scales (~1/4 of the fp32 volume for
+                # int8) — then dequantize and sum locally in fp32: the
+                # EQuARX shape with the quantize/dequantize fused into
+                # the same XLA program as the collective.
+                q = jax.lax.with_sharding_constraint(q, rep)
+                s = jax.lax.with_sharding_constraint(s, rep)
+                zp = jax.lax.with_sharding_constraint(zp, rep)
+                deq = jax_ops.dequantize_rows(q, s, zp, codec, block_size)
+                return deq.sum(axis=0)[:n]
+
+            return _qar
+
+        return self._cached_program(
+            ("qallreduce", int(codec), size, n, block_size), build)
+
+    def quantized_allreduce(self, buf: np.ndarray, codec,
+                            block_size: int) -> np.ndarray:
+        """Block-quantized allreduce: quantize host-side (one input
+        quantization, shared semantics with the tcp/shm planes), exchange
+        int8/uint4 payloads device-side, dequantize+sum in fp32.  Unlike
+        the socket planes there is no output requantization — the reduced
+        fp32 values come straight off the device — so this plane's error
+        is strictly within the shared bound."""
+        import jax
+
+        from ..compress import CompressionCodec, num_blocks, quantize
+
+        mesh = self._world_mesh()
+        size = mesh.shape["world"]
+        n = buf.size
+        qb = quantize(buf, codec, block_size)
+        nb = num_blocks(n, block_size)
+        m = nb * block_size
+        pb = m // 2 if codec == CompressionCodec.UINT4 else m
+        payload = np.zeros(pb, np.uint8)
+        payload[:qb.payload.size] = qb.payload
+        sharding = self._world_sharding()
+        g_q = jax.make_array_from_process_local_data(
+            sharding, payload[None, :], global_shape=(size, pb))
+        g_s = jax.make_array_from_process_local_data(
+            sharding, qb.scales[None, :], global_shape=(size, nb))
+        g_z = jax.make_array_from_process_local_data(
+            sharding, qb.zero_points[None, :], global_shape=(size, nb))
+        out = self._quantized_reduce_fn(codec, size, n, block_size)(
+            g_q, g_s, g_z)
+        return np.asarray(out).astype(buf.dtype, copy=False)
 
     # -- broadcast -------------------------------------------------------
     def _bcast_fn(self, np_dtype: np.dtype, size: int):
@@ -368,11 +433,26 @@ class XlaBackend(CollectiveBackend):
                   entries: list[TensorTableEntry]) -> Status:
         buf = self.pack_fusion_buffer(response, entries)
         buf = self.scale_buffer(buf, response.prescale_factor)
-        self._act_start(entries, "XLA_ALLREDUCE")
-        try:
-            buf = self.comm.allreduce(np.ascontiguousarray(buf))
-        finally:
-            self._act_end(entries)
+        np_dtype = buf.dtype
+        codec = self.quantized_codec(response)
+        if codec is not None:
+            self._act_start(entries, "XLA_QUANTIZED_ALLREDUCE")
+            try:
+                buf = self.comm.quantized_allreduce(
+                    np.ascontiguousarray(buf), codec,
+                    self.codec_block_size(response))
+            finally:
+                self._act_end(entries)
+        else:
+            wire_dt = self.wire_cast_dtype(response)
+            if wire_dt is not None:
+                buf = buf.astype(wire_dt)
+            self._act_start(entries, "XLA_ALLREDUCE")
+            try:
+                buf = self.comm.allreduce(np.ascontiguousarray(buf))
+            finally:
+                self._act_end(entries)
+            buf = buf.astype(np_dtype, copy=False)
         buf = self.scale_buffer(buf, response.postscale_factor)
         self.unpack_fusion_buffer(buf, response, entries)
         return Status.ok()

@@ -87,6 +87,23 @@ ELASTIC = register(
     "HOROVOD_ELASTIC", False, _parse_bool,
     "Enable elastic (fault tolerant / autoscaling) mode.")
 
+# --- Wire compression (compress/ subsystem; EQuARX-style, PAPERS.md) --------
+COMPRESSION = register(
+    "HOROVOD_COMPRESSION", "none", str,
+    "Default wire codec for eager allreduces: none | fp16 | bf16 | int8 "
+    "| uint4.  Quantized codecs apply blockwise scale+zero-point "
+    "compression to floating tensors; integer tensors always ride "
+    "uncompressed.  Per-call `codec=`/`compression=` arguments override.")
+COMPRESSION_BLOCK_SIZE = register(
+    "HOROVOD_COMPRESSION_BLOCK_SIZE", 256, int,
+    "Elements per quantization block for the int8/uint4 codecs (must be "
+    "even for uint4).  Smaller blocks: tighter error bound, more scale "
+    "metadata on the wire (8 bytes/block).")
+AUTOTUNE_COMPRESSION = register(
+    "HOROVOD_AUTOTUNE_COMPRESSION", False, _parse_bool,
+    "Let the autotuner sweep wire codecs (none/fp16/int8) by measured "
+    "allreduce throughput and broadcast the winner to every rank.")
+
 # --- Autotune (reference: common/parameter_manager.cc) ----------------------
 AUTOTUNE = register(
     "HOROVOD_AUTOTUNE", False, _parse_bool,

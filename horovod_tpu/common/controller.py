@@ -135,8 +135,9 @@ class Controller:
         # This rank has called join() and is riding along with zero
         # stand-ins until everyone joins.
         self.local_joined = False
-        # Autotuner proposal awaiting broadcast (coordinator only).
+        # Autotuner proposals awaiting broadcast (coordinator only).
         self.pending_tuned_params: tuple[int, float] | None = None
+        self.pending_tuned_codec: int | None = None
         # Last request params per tensor, for cache insertion on every rank.
         self._last_request_params: dict[str, Request] = {}
 
@@ -197,7 +198,9 @@ class Controller:
                         coordinator.record_hit(pos)
                     else:
                         coordinator.record_invalid(pos)
-            if self.is_coordinator and self.pending_tuned_params is not None:
+            if self.is_coordinator and (
+                    self.pending_tuned_params is not None
+                    or self.pending_tuned_codec is not None):
                 # Force one negotiation cycle so autotuned parameters reach
                 # every rank even in cache steady state.
                 coordinator.uncached_in_queue = True
@@ -309,7 +312,9 @@ class Controller:
                           root_rank=resp.root_rank,
                           tensor_shape=(sum(resp.tensor_sizes),),
                           prescale_factor=resp.prescale_factor,
-                          postscale_factor=resp.postscale_factor)
+                          postscale_factor=resp.postscale_factor,
+                          codec=resp.codec,
+                          codec_block_size=resp.codec_block_size)
         self.response_cache.put(resp, req)
 
     # ------------------------------------------------------------------
@@ -341,6 +346,9 @@ class Controller:
                 response_list.tuned_fusion_threshold = threshold
                 response_list.tuned_cycle_time_ms = cycle
                 self.pending_tuned_params = None
+            if self.pending_tuned_codec is not None:
+                response_list.tuned_codec = self.pending_tuned_codec
+                self.pending_tuned_codec = None
             self.transport.broadcast_responses(response_list)
         else:
             self.transport.gather_requests(my_list)
@@ -455,6 +463,16 @@ class Controller:
                r.postscale_factor != first.postscale_factor for r in reqs):
             return error(f"Mismatched prescale/postscale factors for tensor "
                          f"{name}.")
+        if any(r.codec != first.codec or
+               r.codec_block_size != first.codec_block_size for r in reqs):
+            # A rank decoding int8 blocks against a peer's raw payload
+            # would corrupt silently — same failure class as a dtype
+            # mismatch, same structured-ERROR answer (SURVEY §5.2).
+            codecs = {r.request_rank: (r.codec, r.codec_block_size)
+                      for r in reqs}
+            return error(f"Mismatched compression codecs for tensor "
+                         f"{name}: {codecs}. All ranks must use the same "
+                         f"codec and block size.")
 
         rtype = first.request_type
         joined = len(self.joined_ranks) > 0
@@ -479,6 +497,15 @@ class Controller:
                         f"{tuple(r.tensor_shape)}, rank "
                         f"{first.request_rank} has shape "
                         f"{tuple(first.tensor_shape)}.")
+            from ..compress import QUANTIZED_CODECS
+            if rtype == RequestType.ADASUM and \
+                    first.codec in QUANTIZED_CODECS:
+                # Adasum's per-layer dot products are computed on the
+                # wire payload; quantized blocks would make the norms
+                # meaningless.  Cast codecs (fp16/bf16) compose fine.
+                return error("Adasum does not support quantized "
+                             "compression codecs (int8/uint4); use none, "
+                             "fp16 or bf16.")
             resp_type = {
                 RequestType.ALLREDUCE: ResponseType.ALLREDUCE,
                 RequestType.ADASUM: ResponseType.ADASUM,
@@ -490,7 +517,9 @@ class Controller:
                 tensor_sizes=[first.tensor_size_elements()],
                 prescale_factor=first.prescale_factor,
                 postscale_factor=first.postscale_factor,
-                last_joined_rank=self.last_joined_rank)
+                last_joined_rank=self.last_joined_rank,
+                codec=first.codec,
+                codec_block_size=first.codec_block_size)
 
         if rtype == RequestType.ALLGATHER:
             if joined:
@@ -621,6 +650,8 @@ class Controller:
                         cand.devices == resp.devices and
                         cand.prescale_factor == resp.prescale_factor and
                         cand.postscale_factor == resp.postscale_factor and
+                        cand.codec == resp.codec and
+                        cand.codec_block_size == resp.codec_block_size and
                         cand.tensor_sizes and
                         not (self.disable_group_fusion and
                              getattr(cand, "grouped", False))):

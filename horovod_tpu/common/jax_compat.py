@@ -1,0 +1,38 @@
+"""Version-tolerant jax API shims.
+
+The codebase targets the modern ``jax.shard_map`` surface
+(``check_vma=``, ``axis_names=``); older jaxlib builds (<= 0.4.x, the
+pin in some CI containers) only ship
+``jax.experimental.shard_map.shard_map`` with the ``check_rep=`` /
+``auto=`` spelling.  This module maps one onto the other so every
+caller — training.Trainer, parallel/collectives, grad_sync, the model
+zoo and the tests — works on both without scattering try/except imports.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              axis_names: Any = None):
+    """``jax.shard_map`` when available, else the experimental API with
+    ``check_vma``→``check_rep`` and ``axis_names``→``auto`` translated."""
+    modern = getattr(jax, "shard_map", None)
+    if modern is not None:
+        kwargs: dict[str, Any] = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return modern(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+    # axis_names (manual axes) would map to the legacy ``auto=``
+    # complement, but legacy partial-auto lowering is broken on the
+    # versions that lack jax.shard_map (axis_index emits a PartitionId
+    # the SPMD partitioner rejects).  Run fully manual instead: axes the
+    # specs don't mention are replicated, which preserves results for
+    # spec-closed functions at the cost of duplicated compute on the
+    # would-be-auto axes.
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma, auto=frozenset())

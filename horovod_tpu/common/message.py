@@ -55,6 +55,13 @@ class Request:
     tensor_shape: tuple[int, ...] = ()
     prescale_factor: float = 1.0
     postscale_factor: float = 1.0
+    # Wire-compression codec (compress.CompressionCodec value) + block
+    # size for the quantized codecs.  Negotiated like every other request
+    # parameter: the coordinator rejects cross-rank mismatches with a
+    # structured ERROR (a rank reducing int8 blocks against a peer's raw
+    # fp32 would corrupt silently).
+    codec: int = 0
+    codec_block_size: int = 0
 
     def tensor_size_elements(self) -> int:
         n = 1
@@ -71,7 +78,9 @@ class Request:
             .svarint(self.device)
             .svarint_list(list(self.tensor_shape))
             .f64(self.prescale_factor)
-            .f64(self.postscale_factor))
+            .f64(self.postscale_factor)
+            .uvarint(self.codec)
+            .uvarint(self.codec_block_size))
 
     @classmethod
     def decode(cls, dec: Decoder) -> "Request":
@@ -85,6 +94,8 @@ class Request:
             tensor_shape=tuple(dec.svarint_list()),
             prescale_factor=dec.f64(),
             postscale_factor=dec.f64(),
+            codec=dec.uvarint(),
+            codec_block_size=dec.uvarint(),
         )
 
 
@@ -127,6 +138,10 @@ class Response:
     last_joined_rank: int = -1
     root_rank: int = -1          # broadcast root
     grouped: bool = False        # built from an explicit tensor group
+    # Negotiated wire-compression codec the data planes must apply
+    # (identical on every rank by construction — see Request.codec).
+    codec: int = 0
+    codec_block_size: int = 0
 
     def encode(self, enc: Encoder) -> None:
         (enc.uvarint(int(self.response_type))
@@ -139,7 +154,9 @@ class Response:
             .f64(self.postscale_factor)
             .svarint(self.last_joined_rank)
             .svarint(self.root_rank)
-            .bool_(self.grouped))
+            .bool_(self.grouped)
+            .uvarint(self.codec)
+            .uvarint(self.codec_block_size))
 
     @classmethod
     def decode(cls, dec: Decoder) -> "Response":
@@ -155,6 +172,8 @@ class Response:
             last_joined_rank=dec.svarint(),
             root_rank=dec.svarint(),
             grouped=dec.bool_(),
+            codec=dec.uvarint(),
+            codec_block_size=dec.uvarint(),
         )
 
 
@@ -166,12 +185,17 @@ class ResponseList:
     # (reference: Controller::SynchronizeParameters, controller.cc:39-53).
     tuned_fusion_threshold: int = -1
     tuned_cycle_time_ms: float = -1.0
+    # Autotuned default wire codec (-1 = unchanged): lets the parameter
+    # manager flip HOROVOD_COMPRESSION at runtime on every rank in the
+    # same cycle.
+    tuned_codec: int = -1
 
     def to_bytes(self) -> bytes:
         enc = Encoder()
         enc.bool_(self.shutdown)
         enc.svarint(self.tuned_fusion_threshold)
         enc.f64(self.tuned_cycle_time_ms)
+        enc.svarint(self.tuned_codec)
         enc.uvarint(len(self.responses))
         for r in self.responses:
             r.encode(enc)
@@ -183,8 +207,10 @@ class ResponseList:
         shutdown = dec.bool_()
         threshold = dec.svarint()
         cycle = dec.f64()
+        codec = dec.svarint()
         n = dec.uvarint()
         return cls(responses=[Response.decode(dec) for _ in range(n)],
                    shutdown=shutdown,
                    tuned_fusion_threshold=threshold,
-                   tuned_cycle_time_ms=cycle)
+                   tuned_cycle_time_ms=cycle,
+                   tuned_codec=codec)

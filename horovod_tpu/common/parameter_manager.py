@@ -43,6 +43,19 @@ class ParameterManager:
         self._current = (float(controller.tensor_fusion_threshold),
                          float(config.CYCLE_TIME.get()))
 
+        # Codec sweep (HOROVOD_AUTOTUNE_COMPRESSION): before the BO
+        # phase, score each candidate wire codec for one sample window by
+        # the same logical-bytes/sec metric — a faster wire moves more
+        # gradient bytes per second — and broadcast the winner through
+        # ResponseList.tuned_codec.  Candidates stay conservative (the
+        # codecs whose accuracy story needs no per-model judgement rides
+        # on error feedback for int8; uint4 is opt-in only).
+        self._codec_candidates: list[str] = \
+            ["none", "fp16", "int8"] if active and \
+            config.AUTOTUNE_COMPRESSION.get() else []
+        self._codec_scores: dict[str, float] = {}
+        self._codec_index = 0
+
     def observe(self, tensor_names: list[str], nbytes: int) -> None:
         """Called once per background cycle with the allreduced bytes."""
         if not self._active or self._done:
@@ -61,6 +74,31 @@ class ParameterManager:
 
         if self._warmup_left > 0:
             self._warmup_left -= 1
+            return
+
+        if self._codec_candidates:
+            from ..compress import codec_from_name
+            if self._codec_index > 0:
+                # This window measured the previously proposed codec.
+                measured = self._codec_candidates[self._codec_index - 1]
+                self._codec_scores[measured] = score
+                self._log(*self._current, score,
+                          event=f"codec-{measured}")
+            if self._codec_index < len(self._codec_candidates):
+                nxt = self._codec_candidates[self._codec_index]
+                self._codec_index += 1
+                self._controller.pending_tuned_codec = int(
+                    codec_from_name(nxt))
+                return
+            # Sweep complete: pin the winner, then continue into BO.
+            best = max(self._codec_scores, key=self._codec_scores.get)
+            self._controller.pending_tuned_codec = int(
+                codec_from_name(best))
+            self._log(*self._current, self._codec_scores[best],
+                      event=f"codec-winner-{best}")
+            logger.info("autotune codec sweep: %s -> %s",
+                        self._codec_scores, best)
+            self._codec_candidates = []
             return
 
         import math

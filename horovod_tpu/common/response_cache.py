@@ -38,6 +38,8 @@ class _Params:
     prescale_factor: float
     postscale_factor: float
     last_joined_rank: int
+    codec: int
+    codec_block_size: int
 
 
 def _params_of(request: Request, joined_size: int) -> _Params:
@@ -54,7 +56,7 @@ def _params_of(request: Request, joined_size: int) -> _Params:
     return _Params(rt, request.tensor_type, tuple(request.tensor_shape),
                    request.root_rank, request.device,
                    request.prescale_factor, request.postscale_factor,
-                   joined_size)
+                   joined_size, request.codec, request.codec_block_size)
 
 
 class ResponseCache:

@@ -1,0 +1,111 @@
+"""horovod_tpu.compress — quantized-collective wire compression.
+
+The reference's only wire compression is the fp16 cast
+(reference: horovod/torch/compression.py:46-63) — a 2× ceiling.  This
+package is the EQuARX-style generalisation (PAPERS.md, arxiv 2506.17615):
+a codec registry spanning
+
+  none         passthrough
+  fp16 / bf16  wire-dtype cast (subsumes the legacy Compression classes)
+  int8         block-wise 8-bit affine quantization (~3.9× wire bytes)
+  uint4        block-wise 4-bit affine quantization (~7.5× wire bytes)
+
+negotiated through the controller (a codec mismatch across ranks is a
+structured ERROR, never a corrupted reduce), carried by every data plane
+(xla / tcp / shm eager, compiled grad_sync), with an EF-SGD style
+error-feedback accumulator so quantization error is re-injected into the
+next step instead of lost.
+
+Layering:
+  quantize.py        numpy block quantization (eager planes)
+  jax_ops.py         pure-jax twin + the fused quantized allreduce that
+                     XLA schedules around the collective (grad_sync)
+  error_feedback.py  residual accumulators (eager keyed store + the
+                     functional jax form)
+"""
+from __future__ import annotations
+
+import enum
+
+
+class CompressionCodec(enum.IntEnum):
+    """Wire codec ids — part of the control-plane wire format
+    (common/message.py encodes them on Request/Response)."""
+    NONE = 0
+    FP16 = 1
+    BF16 = 2
+    INT8 = 3
+    UINT4 = 4
+
+
+#: Codecs that quantize (block scale + zero point) rather than cast.
+QUANTIZED_CODECS = (CompressionCodec.INT8, CompressionCodec.UINT4)
+
+#: Codecs that cast the wire dtype without quantizing.
+CAST_CODECS = (CompressionCodec.FP16, CompressionCodec.BF16)
+
+_BY_NAME = {
+    "none": CompressionCodec.NONE,
+    "fp16": CompressionCodec.FP16,
+    "bf16": CompressionCodec.BF16,
+    "int8": CompressionCodec.INT8,
+    "uint4": CompressionCodec.UINT4,
+}
+
+
+def codec_from_name(name) -> CompressionCodec:
+    """Resolve a codec from a user-facing spelling: a name string, a
+    CompressionCodec, None, or an object exposing ``wire_codec`` (the
+    torch/tf Compression marker classes)."""
+    if name is None:
+        return CompressionCodec.NONE
+    if isinstance(name, CompressionCodec):
+        return name
+    wire = getattr(name, "wire_codec", None)
+    if wire is not None:
+        return codec_from_name(wire)
+    try:
+        return _BY_NAME[str(name).strip().lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown compression codec {name!r}; expected one of "
+            f"{sorted(_BY_NAME)}") from None
+
+
+def codec_name(codec: CompressionCodec) -> str:
+    return CompressionCodec(codec).name.lower()
+
+
+def codec_levels(codec: CompressionCodec) -> int:
+    """Quantization levels (256 for int8 wire bytes, 16 for uint4)."""
+    if codec == CompressionCodec.UINT4:
+        return 16
+    if codec == CompressionCodec.INT8:
+        return 256
+    raise ValueError(f"codec {codec!r} is not a quantized codec")
+
+
+def default_block_size() -> int:
+    from ..common import config
+    return int(config.COMPRESSION_BLOCK_SIZE.get())
+
+
+def default_codec() -> CompressionCodec:
+    from ..common import config
+    return codec_from_name(config.COMPRESSION.get())
+
+
+from .quantize import (QuantizedBlocks, chunk_bounds, dequantize,  # noqa: E402
+                       from_bytes, num_blocks, payload_nbytes, quantize,
+                       roundtrip_error_bound, serialized_nbytes,
+                       staged_nbytes, to_bytes)
+from .error_feedback import ErrorFeedback  # noqa: E402
+
+__all__ = [
+    "CompressionCodec", "QUANTIZED_CODECS", "CAST_CODECS",
+    "codec_from_name", "codec_name", "codec_levels",
+    "default_block_size", "default_codec",
+    "QuantizedBlocks", "quantize", "dequantize", "to_bytes", "from_bytes",
+    "num_blocks", "payload_nbytes", "serialized_nbytes", "staged_nbytes",
+    "chunk_bounds", "roundtrip_error_bound", "ErrorFeedback",
+]

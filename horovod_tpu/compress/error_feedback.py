@@ -1,0 +1,58 @@
+"""Error-feedback residual accumulators (EF-SGD, Karimireddy et al.).
+
+Quantization is lossy; without compensation the per-step error is simply
+discarded and biased codecs stall convergence.  Error feedback keeps a
+per-tensor residual ``e``:
+
+    compensated = grad + e
+    wire        = quantize(compensated)
+    e'          = compensated - dequantize(wire)
+
+so every bit of quantization error re-enters the optimizer on the next
+step — the standard result is that EF recovers the uncompressed
+convergence rate for arbitrary contractive compressors.
+
+Two forms live here:
+- :class:`ErrorFeedback` — a name-keyed numpy store for the eager /
+  framework-binding paths (one residual per named gradient).
+- the functional jax form is ``compress.jax_ops.quantized_allreduce``
+  with ``residual=...`` (state threads through the compiled step).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import CompressionCodec, default_block_size
+from .quantize import dequantize, quantize
+
+
+class ErrorFeedback:
+    """Per-name residual store for eager compression paths."""
+
+    def __init__(self, codec: CompressionCodec,
+                 block_size: int | None = None) -> None:
+        self.codec = CompressionCodec(codec)
+        self.block_size = int(block_size or default_block_size())
+        self._residuals: dict[str, np.ndarray] = {}
+
+    def compensate(self, name: str, flat) -> np.ndarray:
+        """grad + residual (fp32); call before quantizing."""
+        x = np.asarray(flat, dtype=np.float32).reshape(-1)
+        res = self._residuals.get(name)
+        if res is not None and res.size == x.size:
+            x = x + res
+        return x
+
+    def update(self, name: str, compensated: np.ndarray) -> np.ndarray:
+        """Record the residual left after quantizing ``compensated``;
+        returns what the wire actually carries (the dequantized view)."""
+        qb = quantize(compensated, self.codec, self.block_size)
+        wire = dequantize(qb)
+        self._residuals[name] = compensated - wire
+        return wire
+
+    def residual(self, name: str) -> np.ndarray | None:
+        return self._residuals.get(name)
+
+    def reset(self) -> None:
+        self._residuals.clear()

@@ -132,6 +132,9 @@ class GlobalState:
     cycle_time_ms: float = 1.0
     joined: bool = False
     elastic_enabled: bool = False
+    # Runtime default wire codec (autotuner override via the ResponseList
+    # tuned_codec field); None = honor HOROVOD_COMPRESSION.
+    codec_override: str | None = None
     # resources to close at shutdown (sockets, rendezvous server, ...)
     resources: list[Any] = field(default_factory=list)
 
@@ -480,6 +483,10 @@ def _background_loop() -> None:
         # every rank applies parameters broadcast through the ResponseList.
         if response_list.tuned_cycle_time_ms > 0:
             st.cycle_time_ms = response_list.tuned_cycle_time_ms
+        if response_list.tuned_codec >= 0:
+            from .compress import CompressionCodec, codec_name
+            st.codec_override = codec_name(
+                CompressionCodec(response_list.tuned_codec))
         if st.parameter_manager is not None:
             st.parameter_manager.observe(tensor_names, total_bytes)
 
@@ -579,14 +586,40 @@ def _enqueue(entries: list[TensorTableEntry],
     return hid, handle
 
 
+def _resolve_codec(codec) -> tuple[int, int]:
+    """(codec id, block size) for a Request: explicit argument beats the
+    autotuner's runtime override beats the HOROVOD_COMPRESSION knob."""
+    from .common import config as _config
+    from .compress import (QUANTIZED_CODECS, CompressionCodec,
+                           codec_from_name, default_block_size)
+    if codec is None:
+        codec = _global.codec_override
+    if codec is None:
+        codec = _config.COMPRESSION.get()
+    c = codec_from_name(codec)
+    if c not in QUANTIZED_CODECS:
+        return int(c), 0
+    bs = default_block_size()
+    if bs <= 0:
+        raise ValueError(
+            f"HOROVOD_COMPRESSION_BLOCK_SIZE must be positive (got {bs})")
+    if c == CompressionCodec.UINT4 and bs % 2:
+        raise ValueError(
+            "uint4 compression requires an even "
+            f"HOROVOD_COMPRESSION_BLOCK_SIZE (got {bs})")
+    return int(c), int(bs)
+
+
 def enqueue_allreduce(name: str, tensor, *, op: str = "sum",
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
-                      adasum: bool = False) -> tuple[int, Handle]:
+                      adasum: bool = False,
+                      codec=None) -> tuple[int, Handle]:
     return enqueue_grouped_allreduce([name], [tensor], op=op,
                                      prescale_factor=prescale_factor,
                                      postscale_factor=postscale_factor,
-                                     adasum=adasum, register_group=False)
+                                     adasum=adasum, register_group=False,
+                                     codec=codec)
 
 
 def enqueue_grouped_allreduce(names: Sequence[str], tensors: Sequence[Any], *,
@@ -594,13 +627,15 @@ def enqueue_grouped_allreduce(names: Sequence[str], tensors: Sequence[Any], *,
                               prescale_factor: float = 1.0,
                               postscale_factor: float = 1.0,
                               adasum: bool = False,
-                              register_group: bool = True) -> tuple[int, Handle]:
+                              register_group: bool = True,
+                              codec=None) -> tuple[int, Handle]:
     st = _require_init()
     if op == "average":
         postscale_factor = postscale_factor / st.size
     elif op != "sum":
         raise ValueError(f"Unknown allreduce op: {op}")
     rtype = RequestType.ADASUM if adasum else RequestType.ALLREDUCE
+    codec_id, codec_bs = _resolve_codec(codec)
     entries, requests = [], []
     if register_group and len(names) > 1:
         st.group_table.register_group(list(names))
@@ -612,7 +647,8 @@ def enqueue_grouped_allreduce(names: Sequence[str], tensors: Sequence[Any], *,
             tensor_type=from_any(arr.dtype), tensor_name=name,
             tensor_shape=tuple(arr.shape),
             prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor))
+            postscale_factor=postscale_factor,
+            codec=codec_id, codec_block_size=codec_bs))
     return _enqueue(entries, requests)
 
 

@@ -449,6 +449,18 @@ def _fit_block(t: int, block: int) -> int:
     return block
 
 
+def _check_dtypes(q: jax.Array, k: jax.Array, v: jax.Array) -> None:
+    """The kernels feed q/k/v to the MXU dots in their RAW dtypes (fp32
+    casts would forfeit the bf16 MXU rate), so mixed-dtype inputs either
+    fail Mosaic lowering with an opaque error or silently change
+    accumulation.  Make the contract explicit at the entry point."""
+    if not (q.dtype == k.dtype == v.dtype):
+        raise ValueError(
+            f"flash attention requires q, k and v to share one dtype "
+            f"(got q={q.dtype}, k={k.dtype}, v={v.dtype}); cast the "
+            f"inputs to a common dtype first")
+
+
 def _check_causal_shapes(causal: bool, tq: int, tk: int) -> None:
     """Bottom-right causal alignment leaves the first tq-tk query rows with
     zero valid keys when tq > tk — attention is undefined there (the dense
@@ -472,6 +484,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     override the backward kernel's tiling (defaults: same as forward)."""
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    _check_dtypes(q, k, v)
     _check_causal_shapes(causal, q.shape[1], k.shape[1])
     b, _, h, _ = q.shape
     block_q = _fit_block(q.shape[1], block_q)
@@ -496,6 +509,7 @@ def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
     b, _, h, _ = q.shape
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
+    _check_dtypes(q, k, v)
     _check_causal_shapes(causal, q.shape[1], k.shape[1])
     block_q = _fit_block(q.shape[1], block_q)
     block_k = _fit_block(k.shape[1], block_k)

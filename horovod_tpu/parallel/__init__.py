@@ -16,7 +16,9 @@ first, then DCN.
 from .mesh import MeshSpec, build_mesh, axis_size, data_axes, DEFAULT_AXES
 from .collectives import (allreduce, allgather, alltoall, broadcast,
                           reduce_scatter, adasum_allreduce, device_collective)
-from .grad_sync import GradSyncConfig, build_grad_sync, sync_gradients
+from .grad_sync import (GradSyncConfig, build_grad_sync,
+                        init_error_feedback, sync_gradients,
+                        sync_gradients_ef)
 from .sharding import (ShardingRules, shard_params, named_sharding,
                        constrain, replicated)
 from .ring_attention import local_attention, ring_attention
@@ -28,6 +30,7 @@ __all__ = [
     "allreduce", "allgather", "alltoall", "broadcast", "reduce_scatter",
     "adasum_allreduce", "device_collective",
     "GradSyncConfig", "build_grad_sync", "sync_gradients",
+    "sync_gradients_ef", "init_error_feedback",
     "ShardingRules", "shard_params", "named_sharding", "constrain",
     "replicated",
 ]

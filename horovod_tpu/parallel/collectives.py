@@ -32,7 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from ..common.jax_compat import shard_map
 
 
 def _axes(axis: str | Sequence[str]) -> tuple[str, ...]:
@@ -116,7 +117,9 @@ def adasum_allreduce(x: jax.Array, axis: str | Sequence[str] = "dp",
 
 
 def _adasum_one_axis(x: jax.Array, axis: str, eps: float) -> jax.Array:
-    n = lax.axis_size(axis)
+    # lax.axis_size only exists on newer jax; psum of a literal 1 is the
+    # portable static axis size.
+    n = lax.psum(1, axis)
     if n == 1:
         return x
     if n & (n - 1):

@@ -12,10 +12,19 @@ loop — negotiation cost is zero because SPMD guarantees every rank runs the
 identical program (the property the reference's controller exists to
 establish dynamically).
 
-Compression mirrors horovod.torch.Compression.fp16 (reference:
-horovod/torch/compression.py:46-63): cast the bucket to a 16-bit wire type
-before the reduce, cast back after, with the reduction itself carried out
-in the wire dtype exactly like the reference's fp16 NCCL allreduce.
+Compression:
+- fp16/bf16 mirror horovod.torch.Compression.fp16 (reference:
+  horovod/torch/compression.py:46-63): cast the bucket to a 16-bit wire
+  type before the reduce, cast back after, with the reduction itself
+  carried out in the wire dtype exactly like the reference's fp16 NCCL
+  allreduce.
+- int8/uint4 are the EQuARX-style block-quantized allreduce
+  (compress/jax_ops.py): XLA fuses per-block quantize → all_to_all →
+  fp32 reduce → requantize → all_gather into the step program, moving
+  ~1/4 (int8) / ~1/8 (uint4) of the fp32 bytes over ICI/DCN.  With
+  ``error_feedback=True`` the quantization error threads through
+  ``sync_gradients_ef`` as explicit residual state (EF-SGD), so it is
+  re-injected next step instead of lost.
 """
 from __future__ import annotations
 
@@ -29,6 +38,14 @@ from .collectives import allreduce, adasum_allreduce
 
 _WIRE_DTYPES = {"fp16": jnp.float16, "bf16": jnp.bfloat16,
                 "none": None, None: None}
+_QUANTIZED = ("int8", "uint4")
+
+
+def _quantized_codec(compression):
+    if compression in _QUANTIZED:
+        from ..compress import codec_from_name
+        return codec_from_name(compression)
+    return None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,7 +54,12 @@ class GradSyncConfig:
     (reference: common/common.h:66-96 HOROVOD_FUSION_THRESHOLD et al.)."""
     axes: tuple[str, ...] = ("dp",)
     op: str = "average"                   # sum | average | adasum
-    compression: str | None = None        # fp16 | bf16 | None
+    compression: str | None = None        # fp16 | bf16 | int8 | uint4 | None
+    # Quantization block for int8/uint4 (elements; even for uint4).
+    compression_block_size: int = 256
+    # EF-SGD residual re-injection for the quantized codecs; state
+    # threads through sync_gradients_ef (see init_error_feedback).
+    error_feedback: bool = False
     fusion_threshold_bytes: int = 64 * 1024 * 1024
     # Hierarchical two-stage reduction (reference: HOROVOD_HIERARCHICAL_
     # ALLREDUCE + NCCLHierarchicalAllreduce, nccl_operations.cc:187-398):
@@ -76,12 +98,44 @@ def sync_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig()
                    ) -> Any:
     """Reduce a gradient pytree over the mesh axes. Call inside a
     shard_mapped / jitted train step."""
+    out, _ = _sync_impl(grads, config, None)
+    return out
+
+
+def init_error_feedback(grads: Any) -> Any:
+    """Zero EF residual state matching a gradient pytree (fp32 — the
+    residual must hold error finer than the wire can carry)."""
+    return jax.tree_util.tree_map(
+        lambda g: jnp.zeros(jnp.shape(g), jnp.float32), grads)
+
+
+def sync_gradients_ef(grads: Any, residuals: Any,
+                      config: GradSyncConfig) -> tuple[Any, Any]:
+    """Error-feedback variant: quantization error of THIS step's wire is
+    returned as residual state and re-added to the next step's gradients
+    (EF-SGD), recovering uncompressed convergence for the quantized
+    codecs.  Thread ``residuals`` through the jitted step; initialize
+    with :func:`init_error_feedback`.  For non-quantized codecs the
+    residuals pass through untouched."""
+    if _quantized_codec(config.compression) is None:
+        return sync_gradients(grads, config), residuals
+    return _sync_impl(grads, config, residuals)
+
+
+def _sync_impl(grads: Any, config: GradSyncConfig,
+               residuals: Any | None) -> tuple[Any, Any | None]:
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
-        return grads
-    wire = _WIRE_DTYPES[config.compression]
+        return grads, residuals
+    codec = _quantized_codec(config.compression)
+    wire = _WIRE_DTYPES[config.compression] if codec is None else None
 
     if config.op == "adasum":
+        if codec is not None:
+            raise ValueError(
+                "adasum does not compose with quantized compression "
+                "(int8/uint4): the scale-adaptive dot products would be "
+                "computed on quantized blocks. Use none, fp16 or bf16.")
         # Per-tensor combine (the reference computes per-layer dot
         # products, adasum.h:38-552); compression composes around the
         # exchange exactly as in the sum path.
@@ -91,7 +145,16 @@ def sync_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig()
             if wire is not None and jnp.issubdtype(leaf.dtype, jnp.floating):
                 v = v.astype(wire)
             out.append(adasum_allreduce(v, config.axes).astype(leaf.dtype))
-        return jax.tree_util.tree_unflatten(treedef, out)
+        return jax.tree_util.tree_unflatten(treedef, out), residuals
+
+    res_leaves: list | None = None
+    if residuals is not None:
+        res_leaves = jax.tree_util.tree_flatten(residuals)[0]
+        if len(res_leaves) != len(leaves):
+            raise ValueError(
+                "error-feedback residual pytree does not match the "
+                "gradient pytree; initialize with init_error_feedback()")
+    res_out = list(res_leaves) if res_leaves is not None else None
 
     out: list[jax.Array | None] = [None] * len(leaves)
     # Group leaves by dtype so each fused buffer is homogeneous, same as
@@ -103,28 +166,64 @@ def sync_gradients(grads: Any, config: GradSyncConfig = GradSyncConfig()
 
     for dtype, idxs in by_dtype.items():
         group = [leaves[i] for i in idxs]
-        wire_itemsize = jnp.dtype(wire).itemsize \
-            if wire is not None and jnp.issubdtype(dtype, jnp.floating) \
-            else None
+        quantized = codec is not None and jnp.issubdtype(dtype,
+                                                         jnp.floating)
+        if quantized:
+            # Buckets sized in wire bytes: ~1 byte/elem (int8) or
+            # ~0.5 (uint4) + block metadata; 1 is a close upper bound.
+            wire_itemsize: int | None = 1
+        else:
+            wire_itemsize = jnp.dtype(wire).itemsize \
+                if wire is not None and jnp.issubdtype(dtype, jnp.floating) \
+                else None
         for bucket in _bucketize(group, config.fusion_threshold_bytes,
                                  wire_itemsize):
             members = [idxs[j] for j in bucket]
             flat = jnp.concatenate(
                 [leaves[i].reshape(-1) for i in members]) \
                 if len(members) > 1 else leaves[members[0]].reshape(-1)
-            if wire is not None and jnp.issubdtype(dtype, jnp.floating):
-                flat = flat.astype(wire)
-            if config.hierarchical and len(config.axes) >= 2:
-                flat = _hierarchical_allreduce(flat, config.axes, config.op)
+            if quantized:
+                from ..compress.jax_ops import quantized_allreduce
+                # The quantized exchange is already its own two-phase
+                # (scatter-reduce/gather) decomposition, so the explicit
+                # hierarchical split does not apply on top of it.
+                if res_out is not None:
+                    rflat = jnp.concatenate(
+                        [res_leaves[i].reshape(-1) for i in members]) \
+                        if len(members) > 1 \
+                        else res_leaves[members[0]].reshape(-1)
+                    flat, new_res = quantized_allreduce(
+                        flat, config.axes, config.op, codec,
+                        config.compression_block_size, residual=rflat)
+                    offset = 0
+                    for i in members:
+                        n = leaves[i].size
+                        res_out[i] = new_res[offset:offset + n].reshape(
+                            leaves[i].shape)
+                        offset += n
+                else:
+                    flat = quantized_allreduce(
+                        flat, config.axes, config.op, codec,
+                        config.compression_block_size)
             else:
-                flat = allreduce(flat, config.axes, config.op)
+                if wire is not None and jnp.issubdtype(dtype, jnp.floating):
+                    flat = flat.astype(wire)
+                if config.hierarchical and len(config.axes) >= 2:
+                    flat = _hierarchical_allreduce(flat, config.axes,
+                                                   config.op)
+                else:
+                    flat = allreduce(flat, config.axes, config.op)
             flat = flat.astype(dtype)
             offset = 0
             for i in members:
                 n = leaves[i].size
                 out[i] = flat[offset:offset + n].reshape(leaves[i].shape)
                 offset += n
-    return jax.tree_util.tree_unflatten(treedef, out)
+    synced = jax.tree_util.tree_unflatten(treedef, out)
+    if res_out is None:
+        return synced, residuals
+    res_treedef = jax.tree_util.tree_flatten(residuals)[1]
+    return synced, jax.tree_util.tree_unflatten(res_treedef, res_out)
 
 
 def _hierarchical_allreduce(flat: jax.Array, axes: Sequence[str],
@@ -164,7 +263,8 @@ def build_grad_sync(mesh, config: GradSyncConfig = GradSyncConfig()):
     has leading dim = prod(axis sizes); mainly for tests and the eager
     API."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    from ..common.jax_compat import shard_map
 
     spec = P(config.axes)
 

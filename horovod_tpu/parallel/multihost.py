@@ -92,6 +92,16 @@ def init_jax_distributed(rank: int, size: int, kv: Any = None,
                                       "/tmp/horovod_tpu_jax_cache")
                 except Exception:  # noqa: BLE001 - knob absent
                     pass
+            # JAX declines to persist programs that compiled faster than
+            # jax_persistent_cache_min_compile_time_secs (default 1s), so
+            # a fast-compiling step would silently repeat its AOT compile
+            # after the barrier — exactly the skew the compile→barrier→
+            # dispatch pattern exists to remove.  Persist everything.
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0)
+            except Exception:  # noqa: BLE001 - older jax: knob absent
+                pass
 
         # Elastic worlds must SURVIVE peer death: without recoverability
         # the coordination service FATALs the surviving processes when the
@@ -106,12 +116,24 @@ def init_jax_distributed(rank: int, size: int, kv: Any = None,
             "HOROVOD_JAX_HEARTBEAT_TIMEOUT_SECONDS", "100"))
         logger.debug("jax.distributed.initialize rank=%d size=%d coord=%s",
                      rank, size, coordinator_address)
-        jax.distributed.initialize(
+        # Older jaxlibs lack some tuning kwargs (e.g. 0.4.x has no
+        # heartbeat_timeout_seconds): filter by the actual signature so
+        # world formation works across the supported jax range.
+        import inspect
+        init_kwargs = dict(
             coordinator_address=coordinator_address,
             num_processes=size, process_id=rank,
             local_device_ids=local_device_ids,
             heartbeat_timeout_seconds=heartbeat,
             initialization_timeout=int(timeout))
+        try:
+            accepted = set(inspect.signature(
+                jax.distributed.initialize).parameters)
+            init_kwargs = {k: v for k, v in init_kwargs.items()
+                           if k in accepted}
+        except (TypeError, ValueError):  # C-level signature: keep all
+            pass
+        jax.distributed.initialize(**init_kwargs)
         if cpu_gloo:
             # Eagerly form the gloo transport pairs while every process
             # is still in init lockstep (reference parity: the gloo
@@ -149,7 +171,19 @@ def kv_barrier(tag: str, timeout: float = 300.0) -> None:
     host) fails the program's FIRST collective with "Gloo context
     initialization failed: Connect timeout". A barrier that is itself a
     collective inherits the same bound, so this one rides the rendezvous
-    KV instead. No-op outside a multi-process world."""
+    KV instead. No-op outside a multi-process world.
+
+    SYMMETRIC-CALL CONTRACT: every rank must call kv_barrier the same
+    number of times, in the same order — keys are derived from an
+    implicit per-process sequence counter, so an asymmetric extra call
+    on one rank (e.g. constructing an extra Trainer, or ranks
+    disagreeing on sync_compile_needed() because JAX_PLATFORMS differed
+    at world formation) permanently misaligns every later barrier.  A
+    timeout therefore means ONE of two distinct faults, and the raised
+    error carries enough state (rank/tag/seq/waited-on key) to tell
+    them apart: a dead or wedged peer (its key for THIS seq never
+    appears), or a seq mismatch (the peer is alive but publishing under
+    a different sequence number)."""
     global _barrier_seq
     if not _initialized_here or _world is None:
         return
@@ -162,7 +196,18 @@ def kv_barrier(tag: str, timeout: float = 300.0) -> None:
     key = f"{epoch}:{tag}:{seq}"
     kv.put("barrier", f"{key}:{rank}", b"1")
     for r in range(size):
-        kv.wait("barrier", f"{key}:{r}", timeout)
+        try:
+            kv.wait("barrier", f"{key}:{r}", timeout)
+        except TimeoutError as exc:
+            raise TimeoutError(
+                f"kv_barrier timeout: rank {rank}/{size} waited {timeout}s "
+                f"for rank {r} on tag={tag!r} seq={seq} "
+                f"(key barrier/{key}:{r}). Either rank {r} is dead/wedged, "
+                f"or the barrier sequence numbers have diverged — every "
+                f"rank must call kv_barrier symmetrically (same count, "
+                f"same order); check for rank-dependent Trainer "
+                f"construction or JAX_PLATFORMS skew at world formation."
+            ) from exc
 
 
 def sync_compile_needed() -> bool:

@@ -232,6 +232,11 @@ class PeerMesh:
         self.size = size
         self._socks: dict[int, socket.socket] = {}
         self._lock = threading.Lock()
+        # Payload byte counters (framing excluded): the observability the
+        # compression subsystem's bandwidth claims are asserted against
+        # (tests/test_compress.py) and PERFORMANCE.md numbers come from.
+        self.bytes_sent = 0
+        self.bytes_received = 0
         if size == 1:
             return
 
@@ -305,9 +310,14 @@ class PeerMesh:
 
     def send(self, peer: int, payload: bytes) -> None:
         send_msg(self._socks[peer], payload)
+        with self._lock:   # sender threads run concurrently with the ring
+            self.bytes_sent += len(payload)
 
     def recv(self, peer: int) -> bytearray:
-        return recv_msg(self._socks[peer])
+        data = recv_msg(self._socks[peer])
+        with self._lock:
+            self.bytes_received += len(data)
+        return data
 
     def close(self) -> None:
         for sock in self._socks.values():

@@ -82,7 +82,10 @@ def allreduce(tensor, average: bool | None = None, op=None,
                                 dense_shape=tensor.dense_shape)
 
     nm = _auto_name("allreduce", name)
-    compressor = compression or Compression.none
+    compressor = Compression.resolve(compression)
+    # Codec markers (compression="int8"/"uint4", or the marker classes)
+    # delegate quantization to the runtime's data planes.
+    wire_codec = getattr(compressor, "wire_codec", None)
     the_op = op if op is not None else (
         Sum if average is False else Average)
 
@@ -93,7 +96,8 @@ def allreduce(tensor, average: bool | None = None, op=None,
         def _run(x):
             return _allreduce_np(x.numpy(), op=the_op, name=nm,
                                  prescale_factor=prescale_factor,
-                                 postscale_factor=postscale_factor)
+                                 postscale_factor=postscale_factor,
+                                 compression=wire_codec)
 
         out = _py_collective(_run, [compressed], compressed.dtype, t.shape)
         out = compressor.decompress(out, ctx)
@@ -275,7 +279,11 @@ def _make_adasum_optimizer(optimizer, compression,
     per-variable ``delta_start`` slots plus a step counter, created
     lazily on first apply (keras slot-variable style)."""
     base = optimizer.__class__
-    comp = compression or Compression.none
+    comp = Compression.resolve(compression)
+    if getattr(comp, "wire_codec", None) in ("int8", "uint4"):
+        raise ValueError(
+            "op=Adasum does not compose with quantized compression "
+            "(int8/uint4); use none, fp16 or bf16.")
     state = {"starts": None, "step": None, "initialized": None,
              "bps": int(backward_passes_per_step)}
 
@@ -404,6 +412,9 @@ else:  # gated stubs so `import horovod_tpu.tensorflow` always works
     class Compression:  # type: ignore[no-redef]
         none = None
         fp16 = None
+        bf16 = None
+        int8 = None
+        uint4 = None
 
     def SyncBatchNormalization(*_a, **_k):  # type: ignore[no-redef]
         _require_tf()

@@ -54,7 +54,44 @@ class BF16Compressor(Compressor):
         return tensor if ctx is None else tf.cast(tensor, ctx)
 
 
+class Int8Compressor(Compressor):
+    """Block-wise int8 wire quantization (compress/ subsystem): a
+    pass-through marker — the runtime's data planes quantize per fusion
+    bucket, so what crosses the wire is int8 payload + per-block
+    scale/zero-point, not this graph tensor."""
+
+    wire_codec = "int8"
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class Uint4Compressor(Int8Compressor):
+    wire_codec = "uint4"
+
+
 class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor
+    uint4 = Uint4Compressor
+
+    @staticmethod
+    def resolve(spec):
+        """Accept a Compressor class or a codec name string."""
+        if spec is None:
+            return Compression.none
+        if isinstance(spec, str):
+            try:
+                return getattr(Compression, spec.strip().lower())
+            except AttributeError:
+                raise ValueError(
+                    f"Unknown compression {spec!r}; expected one of "
+                    "none/fp16/bf16/int8/uint4") from None
+        return spec

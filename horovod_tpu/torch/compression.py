@@ -67,8 +67,51 @@ class BF16Compressor(Compressor):
         return tensor
 
 
+class Int8Compressor(Compressor):
+    """Block-wise int8 wire quantization (compress/ subsystem, EQuARX
+    shape).  The tensor passes through UNCHANGED here — the runtime's
+    data planes quantize per fusion bucket (per-block scale+zero-point,
+    fp32 accumulation at the reduce) so the quantized payload is what
+    actually crosses the network/shm, ~4x fewer wire bytes than fp32.
+    Not composable with op=Adasum (the controller rejects it with a
+    structured error).  Block size: HOROVOD_COMPRESSION_BLOCK_SIZE."""
+
+    wire_codec = "int8"
+
+    @staticmethod
+    def compress(tensor: torch.Tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor: torch.Tensor, ctx):
+        return tensor
+
+
+class Uint4Compressor(Int8Compressor):
+    """4-bit variant: ~8x fewer wire bytes, wider error bound."""
+
+    wire_codec = "uint4"
+
+
 class Compression:
     """Optional gradient compression algorithm used during allreduce."""
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor
+    uint4 = Uint4Compressor
+
+    @staticmethod
+    def resolve(spec):
+        """Accept a Compressor class or a codec name string
+        ("none"/"fp16"/"bf16"/"int8"/"uint4")."""
+        if spec is None:
+            return Compression.none
+        if isinstance(spec, str):
+            try:
+                return getattr(Compression, spec.strip().lower())
+            except AttributeError:
+                raise ValueError(
+                    f"Unknown compression {spec!r}; expected one of "
+                    "none/fp16/bf16/int8/uint4") from None
+        return spec

@@ -63,15 +63,18 @@ def _copy_out(target: torch.Tensor, out: np.ndarray) -> torch.Tensor:
 
 # -- allreduce ---------------------------------------------------------------
 def allreduce_async(tensor, average=None, name=None, op=None,
-                    prescale_factor=1.0, postscale_factor=1.0) -> Handle:
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    compression=None) -> Handle:
     return _allreduce_async(_check_cpu(tensor), average, name, op,
-                            prescale_factor, postscale_factor)
+                            prescale_factor, postscale_factor,
+                            compression)
 
 
 def allreduce(tensor, average=None, name=None, op=None,
-              prescale_factor=1.0, postscale_factor=1.0) -> torch.Tensor:
+              prescale_factor=1.0, postscale_factor=1.0,
+              compression=None) -> torch.Tensor:
     handle = allreduce_async(tensor, average, name, op, prescale_factor,
-                             postscale_factor)
+                             postscale_factor, compression)
     return synchronize(handle)
 
 
@@ -91,10 +94,11 @@ def allreduce_(tensor, average=None, name=None, op=None,
 
 def grouped_allreduce_async(tensors: Sequence[torch.Tensor], average=None,
                             name=None, op=None, prescale_factor=1.0,
-                            postscale_factor=1.0) -> Handle:
+                            postscale_factor=1.0,
+                            compression=None) -> Handle:
     return _grouped_allreduce_async([_check_cpu(t) for t in tensors],
                                     average, name, op, prescale_factor,
-                                    postscale_factor)
+                                    postscale_factor, compression)
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None,

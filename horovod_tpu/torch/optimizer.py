@@ -34,7 +34,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         # param_groups dicts carry every option, so its defaults are
         # never consulted.
         super(self.__class__, self).__init__(params)
-        self._compression = compression
+        self._compression = Compression.resolve(compression)
+        # Codec marker classes (int8/uint4) delegate the actual
+        # quantization to the runtime's data planes; the wire_codec tag
+        # rides every allreduce this optimizer fires.
+        self._wire_codec = getattr(self._compression, "wire_codec", None)
         self.op = op
         self.gradient_predivide_factor = gradient_predivide_factor
         self.backward_passes_per_step = backward_passes_per_step
@@ -175,7 +179,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         prescale, postscale, op = self._scale_factors()
         handle = allreduce_async(tensor_compressed, name=name, op=op,
                                  prescale_factor=prescale,
-                                 postscale_factor=postscale)
+                                 postscale_factor=postscale,
+                                 compression=self._wire_codec)
         return handle, (tensor_compressed, ctx)
 
     def _grouped_allreduce_grad_async(self, ps):
@@ -186,7 +191,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         prescale, postscale, op = self._scale_factors()
         handle = grouped_allreduce_async(
             tensors, name=f"group.{name}", op=op,
-            prescale_factor=prescale, postscale_factor=postscale)
+            prescale_factor=prescale, postscale_factor=postscale,
+            compression=self._wire_codec)
         return handle, compressed
 
     # -- synchronize / step (reference: optimizer.py:249-332) --------------
@@ -287,7 +293,12 @@ class _DistributedAdasumOptimizer(torch.optim.Optimizer):
                  compression=Compression.none,
                  backward_passes_per_step=1):
         super(self.__class__, self).__init__(params)
-        self._compression = compression
+        self._compression = Compression.resolve(compression)
+        if getattr(self._compression, "wire_codec", None) in \
+                ("int8", "uint4"):
+            raise ValueError(
+                "op=Adasum does not compose with quantized compression "
+                "(int8/uint4); use none, fp16 or bf16.")
         self.backward_passes_per_step = backward_passes_per_step
 
         named_parameters = list(named_parameters or [])

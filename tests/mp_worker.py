@@ -1437,6 +1437,178 @@ def battery_tf_grid(hvd, rank, size):
     np.testing.assert_allclose(np.asarray(got), expected_rows)
 
 
+def _compress_reference(size, n=4096, seed=123):
+    """Deterministic per-rank payloads + their exact fp32 sum (identical
+    on every rank: same seed)."""
+    rng = np.random.default_rng(seed)
+    data = rng.standard_normal((size, n)).astype(np.float32) * 2.0
+    return data, data.sum(axis=0)
+
+
+def _compress_error_bound(data, codec, block_size):
+    """Documented bound for the eager quantized allreduce: every rank's
+    input quantization error, plus one requantization of the reduced
+    chunk (half a block step of the reduced values under the owner-chunk
+    split, widened by the input error the accumulator already carries)."""
+    from horovod_tpu.compress import chunk_bounds, roundtrip_error_bound
+    size = data.shape[0]
+    input_bound = sum(roundtrip_error_bound(data[r], codec, block_size)
+                      for r in range(size))
+    ref = data.sum(axis=0)
+    b = chunk_bounds(ref.size, size)
+    requant = np.concatenate(
+        [roundtrip_error_bound(ref[b[r]:b[r + 1]], codec, block_size)
+         for r in range(size)])
+    return 2 * input_bound + requant + 1e-5
+
+
+def battery_compress(hvd, rank, size):
+    """Quantized-collective subsystem over the TCP plane: int8/uint4
+    equivalence within the documented bound, measurably fewer wire
+    bytes than fp32 for the same payload (the plane's byte counters),
+    fp16 cast codec, and the codec-mismatch structured ERROR."""
+    from horovod_tpu.backend.tcp import TcpBackend
+    from horovod_tpu.compress import CompressionCodec
+    from horovod_tpu.core import _global
+
+    block_size = 256   # the HOROVOD_COMPRESSION_BLOCK_SIZE default
+    data, ref = _compress_reference(size)
+    x = data[rank]
+    tcp = next(b for b in _global.op_manager.backends
+               if isinstance(b, TcpBackend))
+    mesh = tcp.coll.mesh
+
+    base = mesh.bytes_sent
+    out32 = hvd.allreduce(x.copy(), op=hvd.Sum, name="c_fp32")
+    fp32_bytes = mesh.bytes_sent - base
+    np.testing.assert_allclose(out32, ref, rtol=1e-5, atol=1e-5)
+    assert fp32_bytes > 0, "fp32 allreduce moved no counted bytes"
+
+    for codec_name, codec, min_ratio in (
+            ("int8", CompressionCodec.INT8, 3.0),
+            ("uint4", CompressionCodec.UINT4, 5.0)):
+        base = mesh.bytes_sent
+        out_q = hvd.allreduce(x.copy(), op=hvd.Sum,
+                              name=f"c_{codec_name}",
+                              compression=codec_name)
+        q_bytes = mesh.bytes_sent - base
+        bound = _compress_error_bound(data, codec, block_size)
+        err = np.abs(np.asarray(out_q, np.float64) - ref)
+        assert np.all(err <= bound), \
+            (codec_name, float(err.max()), float(bound.max()))
+        # The acceptance criterion: the tcp plane transmits measurably
+        # fewer bytes for the same bucket.
+        assert q_bytes * min_ratio < fp32_bytes, \
+            (codec_name, q_bytes, fp32_bytes)
+
+    # Cast codec: half the wire bytes, fp16-grade accuracy.
+    base = mesh.bytes_sent
+    out16 = hvd.allreduce(x.copy(), op=hvd.Sum, name="c_fp16",
+                          compression="fp16")
+    fp16_bytes = mesh.bytes_sent - base
+    np.testing.assert_allclose(out16, ref, rtol=2e-2, atol=2e-2)
+    assert fp16_bytes * 1.8 < fp32_bytes, (fp16_bytes, fp32_bytes)
+
+    # Averaging composes through the postscale factor.
+    out_avg = hvd.allreduce(x.copy(), op=hvd.Average, name="c_avg8",
+                            compression="int8")
+    bound = _compress_error_bound(data, CompressionCodec.INT8,
+                                  block_size) / size
+    assert np.all(np.abs(np.asarray(out_avg, np.float64) - ref / size)
+                  <= bound)
+
+    # Codec mismatch across ranks -> structured ERROR, never a hang or
+    # a corrupted reduce; the world stays usable afterwards.
+    try:
+        hvd.allreduce(x.copy(), op=hvd.Sum, name="c_mismatch",
+                      compression="int8" if rank == 0 else None)
+    except hvd.HorovodInternalError as e:
+        assert "codec" in str(e).lower(), str(e)
+    else:
+        raise AssertionError("expected HorovodInternalError")
+    out_after = hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum,
+                              name="c_after")
+    np.testing.assert_allclose(out_after, np.full(8, float(size)))
+
+    # Adasum + quantized codec is rejected with a structured error too.
+    try:
+        hvd.allreduce(x.copy(), op=hvd.Adasum, name="c_adasum8",
+                      compression="int8")
+    except hvd.HorovodInternalError as e:
+        assert "adasum" in str(e).lower(), str(e)
+    else:
+        raise AssertionError("expected HorovodInternalError")
+
+
+def battery_compress_shm(hvd, rank, size):
+    """Quantized allreduce over the same-host shm plane: the shm backend
+    must claim it (quantized staging fits the region), reconstruct
+    within the shared bound, and fall through to TCP when the region is
+    too small for the staged quantized chunks."""
+    from horovod_tpu.compress import CompressionCodec
+    from horovod_tpu.core import _global
+
+    names = [b.name for b in _global.op_manager.backends]
+    assert "shm" in names, names
+    shm = _global.op_manager.backends[names.index("shm")]
+    assert shm.world.formed
+
+    block_size = 256
+    data, ref = _compress_reference(size)
+    executed = shm.ops_executed
+    out_q = hvd.allreduce(data[rank].copy(), op=hvd.Sum, name="s_int8",
+                          compression="int8")
+    assert shm.ops_executed == executed + 1, "shm plane did not claim it"
+    bound = _compress_error_bound(data, CompressionCodec.INT8, block_size)
+    assert np.all(np.abs(np.asarray(out_q, np.float64) - ref) <= bound)
+
+    # Oversized quantized payload falls through to the TCP ring with the
+    # same numerics (capacity is 1 MB in this battery; 2M floats stage
+    # ~2 MB even quantized).
+    big, big_ref = _compress_reference(size, n=2_000_000, seed=7)
+    executed = shm.ops_executed
+    out_big = hvd.allreduce(big[rank].copy(), op=hvd.Sum, name="s_big8",
+                            compression="int8")
+    assert shm.ops_executed == executed, "oversized op must not ride shm"
+    bound = _compress_error_bound(big, CompressionCodec.INT8, block_size)
+    assert np.all(np.abs(np.asarray(out_big, np.float64) - big_ref)
+                  <= bound)
+
+
+def battery_compress_xla(hvd, rank, size):
+    """Quantized allreduce over the XLA device plane: the xla backend
+    claims the response, the device program dequantizes+sums the int8
+    payload, and the reconstruction stays within the shared bound."""
+    from horovod_tpu.backend.xla import XlaBackend
+    from horovod_tpu.compress import CompressionCodec
+    from horovod_tpu.core import _global
+
+    xla = next(b for b in _global.op_manager.backends
+               if isinstance(b, XlaBackend))
+    claimed = []
+    orig = xla.allreduce
+
+    def counting_allreduce(resp, entries):
+        claimed.append(resp.tensor_names[0])
+        return orig(resp, entries)
+
+    xla.allreduce = counting_allreduce
+    block_size = 256
+    data, ref = _compress_reference(size)
+    out_q = hvd.allreduce(data[rank].copy(), op=hvd.Sum, name="x_int8",
+                          compression="int8")
+    assert any("x_int8" in nm for nm in claimed), claimed
+    bound = _compress_error_bound(data, CompressionCodec.INT8, block_size)
+    assert np.all(np.abs(np.asarray(out_q, np.float64) - ref) <= bound)
+
+    out4 = hvd.allreduce(data[rank].copy(), op=hvd.Average, name="x_u4",
+                         compression="uint4")
+    bound = _compress_error_bound(data, CompressionCodec.UINT4,
+                                  block_size) / size
+    assert np.all(np.abs(np.asarray(out4, np.float64) - ref / size)
+                  <= bound)
+
+
 BATTERIES = {
     "collectives": battery_collectives,
     "matrix": battery_matrix,
@@ -1471,6 +1643,9 @@ BATTERIES = {
         battery_tf_function(hvd, rank, size)],
     "hierarchical": battery_hierarchical,
     "shm": battery_shm,
+    "compress": battery_compress,
+    "compress_shm": battery_compress_shm,
+    "compress_xla": battery_compress_xla,
     "mxnet": battery_mxnet,
     "peerdeath": battery_peerdeath,
 }
@@ -1497,6 +1672,19 @@ def main() -> int:
     if battery == "shm":
         os.environ["HOROVOD_SHM_OPERATIONS"] = "1"   # require formation
         os.environ["HOROVOD_SHM_CAPACITY"] = str(1 << 20)
+    if battery == "compress":
+        # Pin the TCP plane so its byte counters see the traffic.
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+    if battery == "compress_shm":
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "1"
+        os.environ["HOROVOD_SHM_CAPACITY"] = str(1 << 20)
+    if battery == "compress_xla":
+        os.environ["HOROVOD_JAX_DISTRIBUTED"] = "1"
+        os.environ["HOROVOD_XLA_OPERATIONS"] = "1"
+        os.environ["HOROVOD_GLOO_TIMEOUT_SECONDS"] = "60"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     if battery == "hierarchical_tcp":
         os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
         battery = "hierarchical"

@@ -11,8 +11,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.common.jax_compat import shard_map
 
 from horovod_tpu.ops.flash_attention import (flash_attention,
                                              flash_attention_with_lse,

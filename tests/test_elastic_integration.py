@@ -62,6 +62,25 @@ def test_elastic_run_completes(tmp_path):
         assert size == "2"
 
 
+def _jax_recoverability_available() -> bool:
+    """Surviving a peer's death requires jax_enable_recoverability —
+    without it jaxlib's coordination client LOG(FATAL)s the survivors
+    from C++ (client.h:80), which no Python-side handling can soften
+    (see multihost.shutdown_jax_distributed).  Older jaxlibs lack the
+    knob entirely, making elastic-reform untestable there."""
+    import jax
+    try:
+        jax.config.update("jax_enable_recoverability",
+                          jax.config.jax_enable_recoverability)
+        return True
+    except AttributeError:
+        return False
+
+
+@pytest.mark.skipif(not _jax_recoverability_available(),
+                    reason="this jax lacks jax_enable_recoverability; "
+                           "survivors of a peer death are killed by "
+                           "jaxlib's fatal-error path")
 def test_elastic_xla_world_reforms(tmp_path):
     """Elastic x XLA (VERDICT r2 item 5): three loopback "hosts" with the
     XLA device plane active; one dies mid-training; the two survivors must

@@ -42,12 +42,32 @@ _INFRA_SIGNATURES = (b"Connect timeout", b"coordination service",
                      b"Socket closed")
 
 
+def _host_oversubscribed() -> bool:
+    """Corroborating load evidence for the timeout/SIGKILL arms: a 1-min
+    load average at or above the core count means a concurrent lane
+    really was starving the workers."""
+    try:
+        return os.getloadavg()[0] >= (os.cpu_count() or 1)
+    except OSError:
+        return False
+
+
 def _infra_failure(failed: list, outputs: list[str]) -> bool:
     if not failed:
         return False
     for rank, rc in failed:
+        own = outputs[rank].encode(errors="replace") \
+            if rank < len(outputs) else b""
+        has_signature = any(sig in own for sig in _INFRA_SIGNATURES)
         if rc in ("timeout", -9):
-            continue              # harness wall timeout / its kill cascade
+            # A bare wall timeout can equally be a genuine product
+            # deadlock, and a kernel OOM-kill is also SIGKILL — neither
+            # gets the silent retry unless there is corroborating
+            # oversubscription evidence: a signature in the rank's own
+            # output, or a load average at/above the core count.
+            if has_signature or _host_oversubscribed():
+                continue
+            return False
         if isinstance(rc, int) and rc < 0 and rc != -6:
             return False          # signal death other than SIGABRT (e.g.
                                   # SIGSEGV): a product bug, never infra
@@ -55,9 +75,7 @@ def _infra_failure(failed: list, outputs: list[str]) -> bool:
         # rank's OWN output carries an oversubscription signature (a
         # survivor outliving the torn-down coordination service);
         # likewise a nonzero exit needs a signature to count as infra.
-        own = outputs[rank].encode(errors="replace") \
-            if rank < len(outputs) else b""
-        if not any(sig in own for sig in _INFRA_SIGNATURES):
+        if not has_signature:
             return False
     return True
 
@@ -114,6 +132,11 @@ def _run_mode(mode: str) -> None:
             if not failed:
                 break
             if attempt == 0 and _infra_failure(failed, outputs):
+                # Print the failed ranks' output so a retried-away hang
+                # stays visible in the log instead of being masked.
+                for rank, _rc in failed:
+                    if rank < len(outputs):
+                        print(outputs[rank], file=sys.stderr)
                 print(f"multihost {mode}: infra failure {failed}; "
                       "retrying once with a fresh epoch", file=sys.stderr)
                 continue
