@@ -1,0 +1,26 @@
+"""Correctness tooling for the symmetric-collective contract.
+
+Horovod-class deadlock freedom rests on one invariant: every rank submits
+the same collectives in the same order (SURVEY §5.2; reference paper
+arxiv 1802.05799 turns *parameter* mismatches into structured errors, but
+ships no tooling for *call-pattern* mismatches).  This package closes that
+gap from both ends:
+
+- :mod:`horovod_tpu.analysis.lint` — **hvdlint**, an AST-based static
+  analyzer (CLI: ``python -m horovod_tpu.analysis.lint``) that proves the
+  contract at review time: rank-gated collectives, asymmetric early
+  returns, duplicated/dynamic ``kv_barrier`` tags, collectives under
+  locks the background loop takes, shared-state writes outside the
+  owning thread.
+- :mod:`horovod_tpu.analysis.fingerprint` — runtime collective
+  fingerprinting: each rank folds every submitted op into a rolling
+  hash; the coordinator compares fingerprints on the existing
+  Request/Response control plane and turns cross-rank divergence into a
+  structured ``Response.ERROR`` naming the first divergent op
+  (``HOROVOD_FINGERPRINT={off,cycle,strict}``).
+
+See docs/analysis.md for the rule catalogue and fingerprint modes.
+"""
+from .fingerprint import (FingerprintMode, FingerprintTracker,  # noqa: F401
+                          OpRecord)
+from .rules import RULES, Rule, Violation  # noqa: F401

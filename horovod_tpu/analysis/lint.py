@@ -1,0 +1,479 @@
+"""hvdlint — static analyzer for the symmetric-collective contract.
+
+CLI::
+
+    python -m horovod_tpu.analysis.lint [paths...] [--format text|json]
+                                        [--select RULES] [--ignore RULES]
+
+Walks a Python tree and flags call patterns that break the invariant the
+whole coordination protocol rests on — every rank submits the same
+collectives in the same order (SURVEY §5.2):
+
+- ``HVD101 rank-gated-collective``: a collective/barrier call under an
+  ``if``/``while``/ternary/boolean-guard whose condition depends on
+  ``rank``/``local_rank``/``cross_rank``/``is_coordinator``/... — only a
+  subset of ranks submits it and the peers hang (or, with
+  ``HOROVOD_FINGERPRINT`` on, get a structured error at runtime).
+- ``HVD102 rank-gated-early-return``: a collective reachable after a
+  rank-dependent early ``return``/``raise`` in the same function.
+- ``HVD201/HVD202`` barrier-tag discipline for ``kv_barrier``:
+  duplicated tag literals across call sites, and tags that are not
+  string literals (so cannot be proven rank-invariant).
+- ``HVD301 collective-under-lock``: a collective invoked while holding a
+  lock — if the background loop or a completion callback takes the same
+  lock, the world deadlocks.
+- ``HVD401 shared-state-write``: writes to controller/tensor-queue/
+  global-state fields outside their owning modules (single-writer
+  discipline for state the background thread owns).
+
+Heuristics are deliberately lexical (no type inference): a flagged line
+that is provably safe carries ``# hvdlint: disable=<rule> -- <why>``;
+the justification is mandatory (``HVD901``).
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+
+from .rules import RULES, Rule, Suppressions, Violation, parse_suppressions
+
+# Names whose value differs per rank: any condition containing one makes
+# the guarded code rank-asymmetric.
+RANK_SOURCES = frozenset({
+    "rank", "local_rank", "cross_rank", "node_rank", "request_rank",
+    "process_index", "is_coordinator", "local_joined", "joined_ranks",
+})
+
+# Terminal callable names that submit a collective/barrier every rank
+# must participate in (eager API, SPMD wrappers, control-plane barriers).
+COLLECTIVE_NAMES = frozenset({
+    "allreduce", "grouped_allreduce", "allgather", "grouped_allgather",
+    "broadcast", "alltoall", "reducescatter", "grouped_reducescatter",
+    "adasum",
+    "enqueue_allreduce", "enqueue_grouped_allreduce", "enqueue_allgather",
+    "enqueue_broadcast", "enqueue_alltoall", "enqueue_reducescatter",
+    "enqueue_barrier", "enqueue_join",
+    "barrier", "kv_barrier", "sync_global_devices",
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle",
+})
+
+BARRIER_NAME = "kv_barrier"
+
+# `with <expr>:` where the terminal name contains one of these is treated
+# as holding a lock (threading.Lock/RLock conventions in this tree).
+LOCK_HINTS = ("lock", "mutex")
+
+# Attribute spines that mark background-thread-owned shared state
+# ("_global" covers both the bare name and the `core._global` spelling).
+OWNED_STATE_ATTRS = frozenset({
+    "controller", "_controller", "tensor_queue", "_tensor_queue",
+    "_global"})
+OWNED_STATE_ROOTS = frozenset({"_global"})
+
+# Modules allowed to write owned state: the owners themselves plus the
+# background loop that drives them.
+DEFAULT_OWNER_BASENAMES = frozenset({
+    "core.py", "controller.py", "tensor_queue.py", "parameter_manager.py"})
+
+
+@dataclass
+class LintConfig:
+    select: set[str] = field(default_factory=set)    # empty = all
+    ignore: set[str] = field(default_factory=set)
+    owner_basenames: set[str] = field(
+        default_factory=lambda: set(DEFAULT_OWNER_BASENAMES))
+
+    def wants(self, rule: Rule) -> bool:
+        keys = {rule.id, rule.slug}
+        if self.select and not (keys & self.select):
+            return False
+        return not (keys & self.ignore)
+
+
+def _terminal_name(node: ast.AST) -> str | None:
+    """foo -> 'foo'; a.b.foo(...) -> 'foo'."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_rank_dependent(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in RANK_SOURCES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in RANK_SOURCES:
+            return True
+    return False
+
+
+def _body_exits(stmts: list[ast.stmt]) -> bool:
+    return any(isinstance(s, (ast.Return, ast.Raise, ast.Continue,
+                              ast.Break)) for s in stmts)
+
+
+def _string_literal(node: ast.AST | None) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and all(
+            isinstance(v, ast.Constant) for v in node.values):
+        return "".join(str(v.value) for v in node.values)
+    return None
+
+
+@dataclass
+class _BarrierSite:
+    path: str
+    line: int
+    col: int
+    tag: str
+
+
+class _Analyzer(ast.NodeVisitor):
+    def __init__(self, path: str, cfg: LintConfig, sup: Suppressions,
+                 out: list[Violation],
+                 barrier_sites: dict[str, _BarrierSite]) -> None:
+        self.path = path
+        self.cfg = cfg
+        self.sup = sup
+        self.out = out
+        self.barrier_sites = barrier_sites
+        self._rank_gate_depth = 0
+        self._gate_lines: list[int] = []     # lineno of each active gate
+        self._lock_lines: list[int] = []     # lineno of each held lock
+        # Per-function: (gate line, end line) of rank-dependent early exits.
+        self._func_exits: list[list[tuple[int, int]]] = []
+        self._flagged_101: set[int] = set()
+
+    # --- reporting ---------------------------------------------------------
+    def _report(self, rule_key: str, node: ast.AST, message: str) -> None:
+        rule = RULES[rule_key]
+        if not self.cfg.wants(rule):
+            return
+        line = getattr(node, "lineno", 1)
+        if self.sup.active(line, rule):
+            return
+        self.out.append(Violation(self.path, line,
+                                  getattr(node, "col_offset", 0) + 1,
+                                  rule, message))
+
+    # --- scope helpers -----------------------------------------------------
+    def _visit_gated(self, nodes: list, gate_line: int) -> None:
+        self._rank_gate_depth += 1
+        self._gate_lines.append(gate_line)
+        for n in nodes:
+            self.visit(n)
+        self._gate_lines.pop()
+        self._rank_gate_depth -= 1
+
+    # --- functions ---------------------------------------------------------
+    def _visit_function(self, node) -> None:
+        self._func_exits.append([])
+        self.generic_visit(node)
+        self._func_exits.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    # --- rank-dependent control flow ---------------------------------------
+    def visit_If(self, node: ast.If) -> None:
+        dep = _is_rank_dependent(node.test)
+        self.visit(node.test)
+        if dep:
+            self._visit_gated(node.body, node.lineno)
+            self._visit_gated(node.orelse, node.lineno)
+            if self._func_exits and \
+                    _body_exits(node.body) != _body_exits(node.orelse):
+                self._func_exits[-1].append(
+                    (node.lineno, node.end_lineno or node.lineno))
+        else:
+            for n in node.body:
+                self.visit(n)
+            for n in node.orelse:
+                self.visit(n)
+
+    def visit_While(self, node: ast.While) -> None:
+        dep = _is_rank_dependent(node.test)
+        self.visit(node.test)
+        bodies = node.body + node.orelse
+        if dep:
+            self._visit_gated(bodies, node.lineno)
+        else:
+            for n in bodies:
+                self.visit(n)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        dep = _is_rank_dependent(node.test)
+        self.visit(node.test)
+        if dep:
+            self._visit_gated([node.body, node.orelse], node.lineno)
+        else:
+            self.visit(node.body)
+            self.visit(node.orelse)
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> None:
+        # `rank == 0 and do_collective()`: operands after a rank-dependent
+        # operand are conditionally evaluated.
+        gated = False
+        for value in node.values:
+            if gated:
+                self._visit_gated([value], node.lineno)
+            else:
+                self.visit(value)
+            gated = gated or _is_rank_dependent(value)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        # `assert rank == 0` raises on every other rank: code after it is
+        # as asymmetric as code after a rank-gated raise.
+        if self._func_exits and _is_rank_dependent(node.test):
+            self._func_exits[-1].append(
+                (node.lineno, node.end_lineno or node.lineno))
+        self.generic_visit(node)
+
+    # --- locks -------------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        lockish = False
+        for item in node.items:
+            self.visit(item.context_expr)
+            name = _terminal_name(item.context_expr)
+            if name and any(h in name.lower() for h in LOCK_HINTS):
+                lockish = True
+        if lockish:
+            self._lock_lines.append(node.lineno)
+        for n in node.body:
+            self.visit(n)
+        if lockish:
+            self._lock_lines.pop()
+
+    visit_AsyncWith = visit_With
+
+    # --- calls -------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _terminal_name(node)
+        if name in COLLECTIVE_NAMES:
+            self._check_collective(node, name)
+        if name == BARRIER_NAME:
+            self._check_barrier_tag(node)
+        self.generic_visit(node)
+
+    def _check_collective(self, node: ast.Call, name: str) -> None:
+        if self._rank_gate_depth > 0:
+            self._report(
+                "rank-gated-collective", node,
+                f"collective '{name}' is only submitted by ranks "
+                f"satisfying the rank-dependent condition at line "
+                f"{self._gate_lines[-1]}; the other ranks will wait "
+                f"forever (every rank must submit the same collectives "
+                f"in the same order)")
+            self._flagged_101.add(node.lineno)
+        elif self._func_exits:
+            for gate_line, gate_end in self._func_exits[-1]:
+                if node.lineno > gate_end and \
+                        node.lineno not in self._flagged_101:
+                    self._report(
+                        "rank-gated-early-return", node,
+                        f"collective '{name}' is unreachable for ranks "
+                        f"taking the rank-dependent early exit at line "
+                        f"{gate_line}")
+                    break
+        if self._lock_lines:
+            self._report(
+                "collective-under-lock", node,
+                f"collective '{name}' invoked while holding the lock "
+                f"acquired at line {self._lock_lines[-1]}; if the "
+                f"background loop or a completion callback takes the "
+                f"same lock, the world deadlocks")
+
+    def _check_barrier_tag(self, node: ast.Call) -> None:
+        tag_node: ast.AST | None = None
+        if node.args:
+            tag_node = node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "tag":
+                tag_node = kw.value
+        if tag_node is None:
+            return
+        tag = _string_literal(tag_node)
+        if tag is None:
+            self._report(
+                "dynamic-barrier-tag", node,
+                "kv_barrier tag is not a string literal; it cannot be "
+                "proven identical on every rank (a rank-dependent tag "
+                "permanently misaligns the barrier sequence)")
+            return
+        prior = self.barrier_sites.get(tag)
+        if prior is not None and (prior.path, prior.line) != \
+                (self.path, node.lineno):
+            self._report(
+                "duplicate-barrier-tag", node,
+                f"kv_barrier tag {tag!r} is already used at "
+                f"{prior.path}:{prior.line}; a timeout naming this tag "
+                f"could not be attributed to a call site")
+        else:
+            self.barrier_sites[tag] = _BarrierSite(
+                self.path, node.lineno, node.col_offset + 1, tag)
+
+    # --- shared-state writes -----------------------------------------------
+    def _owned_state_target(self, target: ast.AST) -> str | None:
+        if not isinstance(target, ast.Attribute):
+            return None
+        spine: list[str] = []
+        node: ast.AST = target
+        while isinstance(node, ast.Attribute):
+            spine.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            spine.append(node.id)
+            if node.id in OWNED_STATE_ROOTS:
+                return ".".join(reversed(spine))
+        # owner attrs anywhere on the spine EXCEPT the final field being
+        # assigned (writing `x.controller = c` wires the object up;
+        # writing `x.controller.field = v` mutates its internals).
+        if set(spine[1:]) & OWNED_STATE_ATTRS:
+            return ".".join(reversed(spine))
+        return None
+
+    def _check_state_write(self, node, targets: list[ast.AST]) -> None:
+        if os.path.basename(self.path) in self.cfg.owner_basenames:
+            return
+        for target in targets:
+            chain = self._owned_state_target(target)
+            if chain is not None:
+                self._report(
+                    "shared-state-write", node,
+                    f"write to background-thread-owned state "
+                    f"'{chain}' outside its owning module; route the "
+                    f"change through the controller protocol (e.g. a "
+                    f"broadcast ResponseList field) instead")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._check_state_write(node, node.targets)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_state_write(node, [node.target])
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._check_state_write(node, [node.target])
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+def lint_source(source: str, path: str, cfg: LintConfig | None = None,
+                barrier_sites: dict[str, _BarrierSite] | None = None
+                ) -> list[Violation]:
+    cfg = cfg or LintConfig()
+    sup = parse_suppressions(source)
+    out: list[Violation] = []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        out.append(Violation(path, exc.lineno or 1, exc.offset or 1,
+                             RULES["syntax-error"],
+                             f"syntax error: {exc.msg}"))
+        return out
+    analyzer = _Analyzer(path, cfg, sup,
+                         out, barrier_sites if barrier_sites is not None
+                         else {})
+    analyzer.visit(tree)
+    bare_rule = RULES["bare-suppression"]
+    if cfg.wants(bare_rule):
+        for line, text in sup.bare:
+            if not sup.active(line, bare_rule):
+                out.append(Violation(
+                    path, line, 1, bare_rule,
+                    f"suppression without a '-- <justification>': "
+                    f"{text!r}"))
+    return out
+
+
+def iter_python_files(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_paths(paths: list[str],
+               cfg: LintConfig | None = None) -> list[Violation]:
+    cfg = cfg or LintConfig()
+    out: list[Violation] = []
+    barrier_sites: dict[str, _BarrierSite] = {}
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as exc:
+            print(f"hvdlint: cannot read {path}: {exc}", file=sys.stderr)
+            continue
+        out.extend(lint_source(source, path, cfg, barrier_sites))
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule.id))
+    return out
+
+
+def _parse_rule_set(raw: str | None) -> set[str]:
+    if not raw:
+        return set()
+    names = {r.strip() for r in raw.split(",") if r.strip()}
+    unknown = {n for n in names if n not in RULES and n != "all"}
+    if unknown:
+        raise SystemExit(f"hvdlint: unknown rule(s): {sorted(unknown)} "
+                         f"(known: {sorted(set(r.slug for r in RULES.values()))})")
+    return names
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis.lint",
+        description="Static analyzer for the symmetric-collective "
+                    "contract (see docs/analysis.md).")
+    parser.add_argument("paths", nargs="*", default=["horovod_tpu"],
+                        help="files or directories to lint "
+                             "(default: horovod_tpu)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--select", help="comma-separated rule ids/slugs "
+                                         "to enable (default: all)")
+    parser.add_argument("--ignore", help="comma-separated rule ids/slugs "
+                                         "to disable")
+    parser.add_argument("--owner-files",
+                        help="extra basenames allowed to write "
+                             "controller/queue shared state (HVD401)")
+    args = parser.parse_args(argv)
+
+    cfg = LintConfig(select=_parse_rule_set(args.select),
+                     ignore=_parse_rule_set(args.ignore))
+    if args.owner_files:
+        cfg.owner_basenames |= {b.strip()
+                                for b in args.owner_files.split(",")
+                                if b.strip()}
+    violations = lint_paths(args.paths, cfg)
+    if args.format == "json":
+        print(json.dumps([v.json() for v in violations], indent=2))
+    else:
+        for v in violations:
+            print(v.text())
+        print(f"hvdlint: {len(violations)} violation(s) in "
+              f"{', '.join(args.paths)}", file=sys.stderr)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,113 @@
+"""hvdlint rule registry, violations, and suppression parsing.
+
+Rules are identified both by a stable numeric id (``HVD1xx`` call-symmetry,
+``HVD2xx`` barrier-tag discipline, ``HVD3xx`` lock discipline, ``HVD4xx``
+thread-ownership) and a human slug.  Suppressions accept either form:
+
+    do_collective()  # hvdlint: disable=rank-gated-collective -- <why>
+
+A file-level escape hatch (``# hvdlint: disable-file=<rule>``) in the
+first ten lines suppresses a rule for the whole file.  Every suppression
+in this repository must carry a justifying comment after ``--`` (the
+linter itself flags bare suppressions via ``bare-suppression``).
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    slug: str
+    summary: str
+
+
+_RULE_LIST = [
+    Rule("HVD101", "rank-gated-collective",
+         "Collective/barrier called under a rank-dependent conditional: "
+         "only a subset of ranks will submit it, and the peers hang."),
+    Rule("HVD102", "rank-gated-early-return",
+         "Collective/barrier reachable after a rank-dependent early "
+         "return/raise: the exiting ranks never submit it."),
+    Rule("HVD201", "duplicate-barrier-tag",
+         "Two kv_barrier call sites share one tag literal: a barrier "
+         "timeout can no longer be attributed to a call site."),
+    Rule("HVD202", "dynamic-barrier-tag",
+         "kv_barrier tag is not a string literal: it cannot be proven "
+         "identical across ranks (a rank-dependent tag misaligns every "
+         "later barrier)."),
+    Rule("HVD301", "collective-under-lock",
+         "Collective/barrier invoked while holding a lock: if the "
+         "background coordination loop (or a peer's completion callback) "
+         "takes the same lock, the world deadlocks."),
+    Rule("HVD401", "shared-state-write",
+         "Write to controller/tensor-queue/global shared state outside "
+         "the owning module: the background thread owns that state; "
+         "cross-thread writes race the coordination cycle."),
+    Rule("HVD901", "bare-suppression",
+         "hvdlint suppression without a '-- <justification>' comment."),
+    Rule("HVD902", "syntax-error",
+         "File could not be parsed; nothing in it was analyzed."),
+]
+
+RULES: dict[str, Rule] = {}
+for _r in _RULE_LIST:
+    RULES[_r.id] = _r
+    RULES[_r.slug] = _r
+
+
+@dataclass
+class Violation:
+    path: str
+    line: int
+    col: int
+    rule: Rule
+    message: str
+
+    def text(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule.id} [{self.rule.slug}] {self.message}")
+
+    def json(self) -> dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule.id, "slug": self.rule.slug,
+                "message": self.message}
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*hvdlint:\s*(disable(?:-file)?)\s*=\s*([\w,\s-]+?)"
+    r"(?:\s*--\s*(.*))?\s*$")
+
+
+@dataclass
+class Suppressions:
+    """Per-file suppression table parsed from source comments."""
+    by_line: dict[int, set[str]] = field(default_factory=dict)
+    file_wide: set[str] = field(default_factory=set)
+    # Suppression comments missing a justification ("-- why"), for HVD901.
+    bare: list[tuple[int, str]] = field(default_factory=list)
+
+    def active(self, line: int, rule: Rule) -> bool:
+        keys = {rule.id, rule.slug, "all"}
+        if keys & self.file_wide:
+            return True
+        return bool(keys & self.by_line.get(line, set()))
+
+
+def parse_suppressions(source: str) -> Suppressions:
+    sup = Suppressions()
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        kind, rules_raw, why = m.group(1), m.group(2), m.group(3)
+        names = {r.strip() for r in rules_raw.split(",") if r.strip()}
+        if not (why and why.strip()):
+            sup.bare.append((lineno, text.strip()))
+        if kind == "disable-file" and lineno <= 10:
+            sup.file_wide |= names
+        else:
+            sup.by_line.setdefault(lineno, set()).update(names)
+    return sup

@@ -28,6 +28,17 @@ shared mappings are cache-coherent.  Liveness: each rank publishes its PID
 at formation and waiters poll peer PIDs, so a dead peer surfaces as a
 structured error in ~liveness-interval, not a transport timeout (SURVEY
 §5.2 "mismatch → structured error, not hang").
+
+SYMMETRIC-CALL CONTRACT: the barrier words above are sequence-counted
+like multihost.kv_barrier — the protocol is only safe because every rank
+executes the identical ResponseList in identical order, so a
+rank-asymmetric collective upstream of this plane would wedge a peer at
+``wait all seq >= 3t``.  That contract is proven statically by hvdlint
+(``python -m horovod_tpu.analysis.lint``; rank-gated-collective /
+collective-under-lock rules) and checked at runtime by
+``HOROVOD_FINGERPRINT`` — which names the first divergent op in a
+structured error before this plane's barrier deadline or the stall
+inspector ever fire.  See docs/analysis.md.
 """
 from __future__ import annotations
 

@@ -132,6 +132,21 @@ TIMELINE_MARK_CYCLES = register(
     "HOROVOD_TIMELINE_MARK_CYCLES", False, _parse_bool,
     "Mark background-loop cycles in the timeline.")
 
+# --- Collective fingerprinting (analysis/fingerprint.py) --------------------
+FINGERPRINT = register(
+    "HOROVOD_FINGERPRINT", "off", str,
+    "Runtime collective-symmetry fingerprinting: off | cycle (compare "
+    "rolling per-rank op fingerprints on every natural negotiation "
+    "cycle) | strict (force a negotiation heartbeat every cycle so "
+    "divergence is caught even in response-cache steady state).  "
+    "Cross-rank divergence becomes a structured ERROR naming the first "
+    "divergent op instead of a stall (docs/analysis.md).")
+FINGERPRINT_WINDOW = register(
+    "HOROVOD_FINGERPRINT_WINDOW", 64, int,
+    "Ops of fingerprint history each rank ships with its RequestList; "
+    "divergences older than the window are reported as 'at or before' "
+    "the oldest commonly-visible op.")
+
 # --- Stall inspector (reference: common/stall_inspector.cc) -----------------
 STALL_CHECK_DISABLE = register(
     "HOROVOD_STALL_CHECK_DISABLE", False, _parse_bool,

@@ -29,6 +29,7 @@ from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
 
 from . import config
+from ..analysis.fingerprint import FingerprintTracker, OpRecord
 from .dtypes import element_size
 from .group_table import GroupTable
 from .message import (Request, RequestList, RequestType, Response,
@@ -106,7 +107,8 @@ class Controller:
                  local_size: int = 1,
                  cross_rank: int = 0,
                  cross_size: int = 1,
-                 timeline=None) -> None:
+                 timeline=None,
+                 fingerprint: FingerprintTracker | None = None) -> None:
         self.rank = rank
         self.size = size
         self.local_rank = local_rank
@@ -119,6 +121,8 @@ class Controller:
         self.response_cache = response_cache if response_cache is not None \
             else ResponseCache(config.CACHE_CAPACITY.get())
         self.stall_inspector = stall_inspector or StallInspector()
+        self.fingerprint = fingerprint if fingerprint is not None \
+            else FingerprintTracker.from_config()
         self.timeline = timeline
         self.tensor_fusion_threshold = config.FUSION_THRESHOLD.get()
         self.disable_group_fusion = config.DISABLE_GROUP_FUSION.get()
@@ -152,6 +156,13 @@ class Controller:
     # ------------------------------------------------------------------
     def compute_response_list(self, shutdown_requested: bool = False) -> ResponseList:
         message_queue = self.tensor_queue.pop_messages_from_queue()
+        if self.fingerprint.enabled:
+            # Fold every locally-submitted op into this rank's rolling
+            # fingerprint in submission order (fold() itself skips JOIN —
+            # rank-asymmetric by design — and requests re-popped after a
+            # cache-bit miss, which were already folded on first pop).
+            for req in message_queue:
+                self.fingerprint.fold(req)
         if self.timeline is not None:
             for req in message_queue:
                 self.timeline.negotiate_start(req.tensor_name,
@@ -203,6 +214,13 @@ class Controller:
                     or self.pending_tuned_codec is not None):
                 # Force one negotiation cycle so autotuned parameters reach
                 # every rank even in cache steady state.
+                coordinator.uncached_in_queue = True
+            if self.fingerprint.strict:
+                # Strict mode: a negotiation heartbeat EVERY cycle, so
+                # fingerprints are compared even in cache steady state
+                # (which otherwise never ships RequestLists) — divergence
+                # surfaces within one cycle instead of at the next
+                # natural negotiation.
                 coordinator.uncached_in_queue = True
             for req in message_queue:
                 state = self.response_cache.cached(req)
@@ -324,6 +342,12 @@ class Controller:
             self._last_request_params[req.tensor_name] = req
         my_list = RequestList(requests=list(message_queue),
                               shutdown=shutdown_requested)
+        if self.fingerprint.enabled:
+            seq, digest, tail = self.fingerprint.snapshot()
+            my_list.fp_seq, my_list.fp_digest = seq, digest
+            my_list.fp_tail_seqs = [rec.seq for rec in tail]
+            my_list.fp_tail_digests = [rec.digest for rec in tail]
+            my_list.fp_tail_descs = [rec.descriptor for rec in tail]
         if self.is_coordinator:
             gathered = self.transport.gather_requests(my_list)
             assert gathered is not None
@@ -334,6 +358,11 @@ class Controller:
                     self._handle_request(req)
             responses = [self._construct_response(names)
                          for names in self._pop_ready_tensors()]
+            fp_error = self._check_fingerprints(gathered)
+            if fp_error is not None:
+                # The divergence error leads the list so every rank fails
+                # the divergent entries before executing anything else.
+                responses.insert(0, fp_error)
             join_resp = self._maybe_join_response()
             if join_resp is not None:
                 responses.append(join_resp)
@@ -363,6 +392,34 @@ class Controller:
     # ------------------------------------------------------------------
     # Coordinator internals
     # ------------------------------------------------------------------
+    def _check_fingerprints(self, gathered: list[RequestList]) -> Response | None:
+        """Compare the ranks' rolling collective fingerprints; divergence
+        becomes a structured ERROR naming the first divergent op — the
+        failure mode the per-tensor validation in _construct_single can
+        never see (it requires every rank to have submitted the SAME
+        tensor name; fingerprinting catches ranks submitting different
+        ops entirely, which otherwise stalls until the stall inspector's
+        60s warning or the job timeout)."""
+        if not self.fingerprint.enabled:
+            return None
+        divergence = self.fingerprint.check_gathered([
+            (rl.fp_seq, rl.fp_digest,
+             [OpRecord(s, d, t) for s, d, t in
+              zip(rl.fp_tail_seqs, rl.fp_tail_digests, rl.fp_tail_descs)])
+            for rl in gathered])
+        if divergence is None:
+            return None
+        names = divergence.tensor_names()
+        for name in names:
+            # Divergent tensors will never become globally ready: drop
+            # their readiness records so the stall inspector does not
+            # keep warning about an already-reported failure.
+            self._message_table.pop(name, None)
+            self.stall_inspector.remove_uncached_tensor(name)
+        return Response(response_type=ResponseType.ERROR,
+                        tensor_names=names,
+                        error_message=divergence.message())
+
     def _handle_request(self, req: Request) -> None:
         if req.request_type == RequestType.JOIN:
             self.joined_ranks.add(req.request_rank)
@@ -675,3 +732,4 @@ class Controller:
         self._local_hits.clear()
         self._last_request_params.clear()
         self.response_cache.clear()
+        self.fingerprint.reset()

@@ -103,10 +103,27 @@ class Request:
 class RequestList:
     requests: list[Request] = field(default_factory=list)
     shutdown: bool = False
+    # Collective-fingerprint stream state (analysis/fingerprint.py;
+    # HOROVOD_FINGERPRINT).  fp_seq counts ops this rank has folded into
+    # its rolling 64-bit digest; the tail lists carry the last
+    # HOROVOD_FINGERPRINT_WINDOW (seq, digest-after, descriptor) records
+    # so the coordinator can locate the FIRST divergent op, not just the
+    # fact of divergence.  Kept as parallel primitive lists so the wire
+    # layer stays free of analysis-layer imports.
+    fp_seq: int = 0
+    fp_digest: int = 0
+    fp_tail_seqs: list[int] = field(default_factory=list)
+    fp_tail_digests: list[int] = field(default_factory=list)
+    fp_tail_descs: list[str] = field(default_factory=list)
 
     def to_bytes(self) -> bytes:
         enc = Encoder()
         enc.bool_(self.shutdown)
+        enc.uvarint(self.fp_seq)
+        enc.uvarint(self.fp_digest)
+        enc.uvarint_list(self.fp_tail_seqs)
+        enc.uvarint_list(self.fp_tail_digests)
+        enc.string_list(self.fp_tail_descs)
         enc.uvarint(len(self.requests))
         for r in self.requests:
             r.encode(enc)
@@ -116,9 +133,17 @@ class RequestList:
     def from_bytes(cls, raw: bytes) -> "RequestList":
         dec = Decoder(raw)
         shutdown = dec.bool_()
+        fp_seq = dec.uvarint()
+        fp_digest = dec.uvarint()
+        fp_tail_seqs = dec.uvarint_list()
+        fp_tail_digests = dec.uvarint_list()
+        fp_tail_descs = dec.string_list()
         n = dec.uvarint()
         return cls(requests=[Request.decode(dec) for _ in range(n)],
-                   shutdown=shutdown)
+                   shutdown=shutdown, fp_seq=fp_seq, fp_digest=fp_digest,
+                   fp_tail_seqs=fp_tail_seqs,
+                   fp_tail_digests=fp_tail_digests,
+                   fp_tail_descs=fp_tail_descs)
 
 
 @dataclass

@@ -80,7 +80,12 @@ class StallInspector:
                 "One or more tensors were submitted to be reduced, gathered "
                 "or broadcasted by subset of ranks and are waiting for "
                 "remainder of ranks for more than %ds. Stalled op: %s "
-                "[missing ranks: %s]", int(self.warning_time), name,
+                "[missing ranks: %s]. If the missing ranks are alive, they "
+                "are likely submitting different collectives: set "
+                "HOROVOD_FINGERPRINT=cycle to get a structured error "
+                "naming the first divergent op, and run hvdlint "
+                "(python -m horovod_tpu.analysis.lint) over the training "
+                "script (docs/analysis.md).", int(self.warning_time), name,
                 ", ".join(map(str, missing)))
             if self.shutdown_time > 0 and lag > self.shutdown_time:
                 should_shutdown = True

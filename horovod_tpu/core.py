@@ -337,6 +337,10 @@ def init(*, rank: int | None = None, size: int | None = None,
             transport = LocalTransport()
         backends.append(BasicBackend(size))
 
+        # Runtime collective-symmetry fingerprinting (HOROVOD_FINGERPRINT;
+        # analysis/fingerprint.py): divergent ranks get a structured error
+        # naming the first divergent op instead of a stall.
+        from .analysis.fingerprint import FingerprintTracker
         _global.controller = Controller(
             rank=rank, size=size, transport=transport,
             tensor_queue=_global.tensor_queue,
@@ -345,7 +349,8 @@ def init(*, rank: int | None = None, size: int | None = None,
             stall_inspector=StallInspector(),
             local_rank=local_rank, local_size=local_size,
             cross_rank=cross_rank, cross_size=cross_size,
-            timeline=_global.timeline)
+            timeline=_global.timeline,
+            fingerprint=FingerprintTracker.from_config())
         for backend in backends:
             backend.timeline = _global.timeline
         _global.op_manager = OperationManager(backends)

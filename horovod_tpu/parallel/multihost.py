@@ -144,7 +144,7 @@ def init_jax_distributed(rank: int, size: int, kv: Any = None,
             # exactly when the program is largest.
             try:
                 from jax.experimental import multihost_utils
-                multihost_utils.sync_global_devices("horovod_tpu_init")
+                multihost_utils.sync_global_devices("horovod_tpu_init")  # hvdlint: disable=collective-under-lock -- init-time only: _lock orders init/shutdown on user threads (the background loop never takes it), every rank reaches this line by construction, and the barrier carries its own timeout
             except Exception:  # noqa: BLE001 - barrier is best-effort
                 logger.debug("init barrier skipped", exc_info=True)
         global _world, _barrier_seq, _cpu_gloo_world
@@ -178,7 +178,11 @@ def kv_barrier(tag: str, timeout: float = 300.0) -> None:
     implicit per-process sequence counter, so an asymmetric extra call
     on one rank (e.g. constructing an extra Trainer, or ranks
     disagreeing on sync_compile_needed() because JAX_PLATFORMS differed
-    at world formation) permanently misaligns every later barrier.  A
+    at world formation) permanently misaligns every later barrier.
+    hvdlint proves this contract statically (rank-gated-collective /
+    duplicate-barrier-tag / dynamic-barrier-tag rules), and
+    HOROVOD_FINGERPRINT checks the controller-plane half of it at
+    runtime — see docs/analysis.md.  A
     timeout therefore means ONE of two distinct faults, and the raised
     error carries enough state (rank/tag/seq/waited-on key) to tell
     them apart: a dead or wedged peer (its key for THIS seq never

@@ -48,23 +48,49 @@ class TrainState:
 # v5e) measured dense 80.1k tok/s vs streaming 72.3k — the vocab-chunk
 # scan serializes work XLA otherwise fuses. So the default threshold
 # sits where the dense path's fp32 logits copy (4 bytes/elem, plus the
-# bf16 logits and their gradient alongside) stops plausibly fitting in
-# a 16 GB chip: 2^30 elements = 4 GiB fp32. The benchmark config
-# (824M) stays dense; the 8k-sequence long-context recipe (1.6G) stays
-# streaming. Override via HOROVOD_STREAMING_CE_MIN_ELEMENTS (0 forces
-# streaming everywhere).
+# bf16 logits and their gradient alongside) stops plausibly fitting:
+# on a 16 GB chip that is 2^30 elements = 4 GiB fp32, i.e. HBM/16
+# bytes-per-element of headroom — and the default SCALES by the local
+# device's discoverable memory so a sub-16GB device (v5e-1-slice dev
+# boxes, trimmed GPU partitions) streams earlier instead of OOMing.
+# The benchmark config (824M) stays dense on 16 GB; the 8k-sequence
+# long-context recipe (1.6G) stays streaming. Override via
+# HOROVOD_STREAMING_CE_MIN_ELEMENTS (0 forces streaming everywhere).
+_DEVICE_MEMORY_SENTINEL = object()
+_device_memory_cache: Any = _DEVICE_MEMORY_SENTINEL
+
+
+def _device_memory_bytes() -> int | None:
+    """Discoverable memory of the first local device (None when the
+    backend doesn't report it — e.g. the CPU backend)."""
+    global _device_memory_cache
+    if _device_memory_cache is _DEVICE_MEMORY_SENTINEL:
+        limit = None
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            limit = stats.get("bytes_limit") \
+                or stats.get("bytes_reservable_limit")
+        except Exception:  # noqa: BLE001 - stats are best-effort
+            limit = None
+        _device_memory_cache = int(limit) if limit else None
+    return _device_memory_cache
+
+
 def _ce_threshold() -> int:
     # Read per call (trace-time Python, so this is free): the documented
     # env override must work even when set after `import horovod_tpu`.
     raw = os.environ.get("HOROVOD_STREAMING_CE_MIN_ELEMENTS")
-    if raw is None:
-        return 1 << 30
-    try:
-        return int(raw)
-    except ValueError as exc:
-        raise ValueError(
-            "HOROVOD_STREAMING_CE_MIN_ELEMENTS must be a plain integer "
-            f"(got {raw!r})") from exc
+    if raw is not None:
+        try:
+            return int(raw)
+        except ValueError as exc:
+            raise ValueError(
+                "HOROVOD_STREAMING_CE_MIN_ELEMENTS must be a plain "
+                f"integer (got {raw!r})") from exc
+    hbm = _device_memory_bytes()
+    if hbm is not None:
+        return max(hbm // 16, 1 << 20)
+    return 1 << 30
 
 
 def _track_accuracy() -> bool:
@@ -116,6 +142,9 @@ class Trainer:
         self.loss_fn = loss_fn
         self.batch_spec = batch_spec if batch_spec is not None else P(axes)
         self._step_fn: Callable | None = None
+        # AOT executable from the compile→barrier→dispatch path: dispatched
+        # directly so the warm-up compile is never repeated (see step()).
+        self._compiled: Callable | None = None
 
     # -- initialization ----------------------------------------------------
     def init(self, rng: jax.Array, sample_batch: dict) -> TrainState:
@@ -241,14 +270,26 @@ class Trainer:
                 # transport context connects at the program's first
                 # collective, and per-rank compile skew beyond its
                 # ~30 s connect timeout would fail the step outright
-                # (multihost.kv_barrier docstring). AOT-compiling here
-                # warms the persistent compilation cache, the barrier
-                # aligns the ranks, and the dispatch below re-lowers
-                # from cache in seconds — skew shrinks below the bound.
+                # (multihost.kv_barrier docstring). The AOT executable
+                # is KEPT and dispatched directly below — discarding it
+                # and re-dispatching through jit would repeat the whole
+                # compile whenever the persistent cache doesn't engage
+                # (fast-compiling programs, cold cache dir), exactly the
+                # skew the barrier exists to remove.
                 try:
-                    self._step_fn.lower(state, batch).compile()
+                    self._compiled = self._step_fn.lower(state,
+                                                         batch).compile()
                 finally:
                     multihost.kv_barrier("trainer-step-compile")
+        if self._compiled is not None:
+            try:
+                return self._compiled(state, batch)
+            except TypeError:
+                # Shape/dtype drift vs the AOT signature (e.g. a ragged
+                # final batch): the executable rejects the call before
+                # dispatch (donated buffers untouched), so fall back to
+                # the jit path, which re-specializes per signature.
+                self._compiled = None
         return self._step_fn(state, batch)
 
     # -- fit loop with callbacks ------------------------------------------

@@ -1,0 +1,19 @@
+"""Seeded hvdlint violations: kv_barrier tag discipline (HVD201/HVD202)."""
+import horovod_tpu as hvd
+from horovod_tpu.parallel import multihost
+
+
+def phase_one():
+    multihost.kv_barrier("checkpoint")                # first site: OK
+
+
+def phase_two():
+    multihost.kv_barrier("checkpoint")                # HVD201: duplicate tag
+
+
+def broken_dynamic_tag(step):
+    multihost.kv_barrier(f"step-{step}")              # HVD202: dynamic tag
+
+
+def broken_rank_tag():
+    multihost.kv_barrier("sync-%d" % hvd.rank())      # HVD202: dynamic tag

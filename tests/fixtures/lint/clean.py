@@ -1,0 +1,33 @@
+"""False-positive guard: symmetric patterns hvdlint must NOT flag."""
+import horovod_tpu as hvd
+from horovod_tpu.parallel import multihost
+
+
+def symmetric_allreduce(tensor):
+    # Every rank submits the same op unconditionally: fine.
+    return hvd.allreduce(tensor, name="grad")
+
+
+def rank_gated_logging(metrics):
+    # Rank-gated NON-collective work is the supported idiom.
+    if hvd.rank() == 0:
+        print(metrics)
+    return metrics
+
+
+def unique_barrier():
+    multihost.kv_barrier("clean-fixture-unique")
+    return True
+
+
+def rank_scaled_but_symmetric(tensor):
+    # A rank-dependent VALUE feeding a symmetric call is fine: every rank
+    # still submits the collective.
+    scale = 1.0 / (hvd.rank() + 1)
+    return hvd.allreduce(tensor * scale, name="scaled")
+
+
+def justified_suppression(tensor):
+    if hvd.rank() == 0:
+        hvd.allreduce(tensor, name="solo")  # hvdlint: disable=rank-gated-collective -- fixture: exercised only in a single-process world, never negotiates
+    return tensor

@@ -1,0 +1,16 @@
+"""Seeded hvdlint violation: collective after a rank-gated early return
+(HVD102): non-zero ranks exit before ever reaching the barrier."""
+import horovod_tpu as hvd
+from horovod_tpu.parallel import multihost
+
+
+def broken_early_return(state):
+    if hvd.rank() != 0:
+        return state
+    multihost.kv_barrier("early-return-fixture")      # HVD102
+    return state
+
+
+def broken_assert(tensor):
+    assert hvd.rank() == 0, "coordinator only"
+    return hvd.allreduce(tensor, name="grad")         # HVD102

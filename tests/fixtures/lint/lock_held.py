@@ -1,0 +1,24 @@
+"""Seeded hvdlint violation: collective invoked while holding a lock
+(HVD301). The background loop's completion callback takes the same lock
+to publish results -> classic lock-ordering deadlock."""
+import threading
+
+import horovod_tpu as hvd
+
+_state_lock = threading.Lock()
+_results = {}
+
+
+def broken_locked_allreduce(tensor):
+    with _state_lock:
+        _results["grad"] = hvd.allreduce(tensor, name="grad")   # HVD301
+    return _results["grad"]
+
+
+class Worker:
+    def __init__(self):
+        self._mutex = threading.Lock()
+
+    def broken_locked_barrier(self):
+        with self._mutex:
+            hvd.enqueue_barrier()                               # HVD301

@@ -1,0 +1,22 @@
+"""Seeded hvdlint violation: rank-gated collective (HVD101).
+
+Classic broken pattern: only rank 0 submits the allreduce, every other
+rank hangs in negotiation forever (ADVICE.md's kv_barrier seq-drift
+stall is the same failure class).
+"""
+import horovod_tpu as hvd
+
+
+def broken_conditional(tensor):
+    if hvd.rank() == 0:
+        return hvd.allreduce(tensor, name="grad")     # HVD101
+    return tensor
+
+
+def broken_guard(tensor, ctrl):
+    return ctrl.is_coordinator and hvd.allgather(tensor)   # HVD101
+
+
+def broken_loop(tensor):
+    while hvd.local_rank() != 0:
+        hvd.broadcast(tensor, root_rank=0)            # HVD101

@@ -1,0 +1,10 @@
+"""Seeded hvdlint violation: shared-state write outside the owning module
+(HVD401). Mutating the controller's fields from a user thread races the
+background coordination cycle."""
+from horovod_tpu import core
+
+
+def broken_threshold_override(threshold):
+    st = core.global_state()
+    st.controller.tensor_fusion_threshold = threshold      # HVD401
+    core._global.cycle_time_ms = 0.5                       # HVD401

@@ -1,0 +1,307 @@
+"""Runtime collective-fingerprint tests (analysis/fingerprint.py).
+
+The acceptance behavior (ISSUE 2): in a 2-rank world whose ranks submit
+divergent collectives, every rank receives a structured Response.ERROR
+naming the first divergent op — instead of the silent stall the
+reference runtime exhibits (the stall inspector would only WARN after
+60s, and the kv_barrier timeout after 300s).
+"""
+import numpy as np
+import pytest
+
+from horovod_tpu.analysis.fingerprint import (Divergence, FingerprintMode,
+                                              FingerprintTracker, OpRecord,
+                                              describe, find_divergence)
+from horovod_tpu.common.dtypes import DataType
+from horovod_tpu.common.message import (Request, RequestList, RequestType,
+                                        ResponseType)
+
+from util_world import InProcWorld, make_controller, run_ranks
+
+
+def _req(rank, name, rtype=RequestType.ALLREDUCE, shape=(4,),
+         dtype=DataType.FLOAT32, **kw):
+    return Request(request_rank=rank, request_type=rtype, tensor_type=dtype,
+                   tensor_name=name, tensor_shape=shape, **kw)
+
+
+def _tracker(mode="cycle", window=64):
+    return FingerprintTracker(mode, window)
+
+
+# --- tracker unit behavior --------------------------------------------------
+def test_mode_parsing_and_flags():
+    assert FingerprintMode.parse("CYCLE") is FingerprintMode.CYCLE
+    assert FingerprintMode.parse("bogus") is FingerprintMode.OFF
+    assert not FingerprintTracker("off").enabled
+    assert _tracker("cycle").enabled and not _tracker("cycle").strict
+    assert _tracker("strict").enabled and _tracker("strict").strict
+
+
+def test_fold_is_deterministic_and_order_sensitive():
+    a, b = _tracker(), _tracker()
+    for n in ("x", "y", "z"):
+        a.fold(_req(0, n))
+    for n in ("x", "y", "z"):
+        b.fold(_req(1, n))          # request_rank is NOT part of the hash
+    assert (a.seq, a.digest) == (b.seq, b.digest)
+
+    c = _tracker()
+    for n in ("x", "z", "y"):       # same ops, different order
+        c.fold(_req(0, n))
+    assert c.digest != a.digest
+
+
+def test_fold_skips_join_and_refolds():
+    t = _tracker()
+    t.fold(_req(0, "__join__", rtype=RequestType.JOIN))
+    assert t.seq == 0               # join is rank-asymmetric by design
+    req = _req(0, "a")
+    t.fold(req)
+    t.fold(req)                     # re-popped cache-hit request
+    assert t.seq == 1
+
+
+def test_descriptor_covers_op_name_dtype_dims_codec():
+    d = describe(_req(0, "g", shape=(2, 3), codec=2, codec_block_size=128))
+    assert d == "ALLREDUCE|g|FLOAT32|2x3|2/128"
+    # any component change changes the descriptor (and so the digest)
+    assert describe(_req(0, "g", shape=(3, 2))) != d
+    assert describe(_req(0, "g", shape=(2, 3), codec=1)) != d
+
+
+def test_window_bounds_tail():
+    t = _tracker(window=4)
+    for i in range(10):
+        t.fold(_req(0, f"t{i}"))
+    assert t.seq == 10
+    assert [r.seq for r in t.snapshot()[2]] == [7, 8, 9, 10]
+
+
+# --- divergence location ----------------------------------------------------
+def _diverged_pair(ops0, ops1, window=64):
+    a, b = _tracker(window=window), _tracker(window=window)
+    for n in ops0:
+        a.fold(_req(0, n))
+    for n in ops1:
+        b.fold(_req(1, n))
+    return find_divergence([a.snapshot(), b.snapshot()])
+
+
+def test_identical_streams_no_divergence():
+    assert _diverged_pair(["a", "b"], ["a", "b"]) is None
+
+
+def test_rank_ahead_is_not_divergence():
+    # One rank legitimately ahead: consistency judged at the common head.
+    assert _diverged_pair(["a", "b", "c"], ["a"]) is None
+
+
+def test_first_divergent_op_is_named():
+    div = _diverged_pair(["a", "b", "c"], ["a", "x", "c"])
+    assert div is not None and div.exact and div.seq == 2
+    assert div.tensor_names() == ["b", "x"]
+    assert "op #2" in div.message()
+    assert "rank 0: ALLREDUCE(b" in div.message()
+    assert "rank 1: ALLREDUCE(x" in div.message()
+
+
+def test_empty_streams_not_compared():
+    assert _diverged_pair([], []) is None
+    assert _diverged_pair(["a"], []) is None
+
+
+def test_divergence_older_than_window_reported_inexact():
+    ops0 = ["DIFF0"] + [f"t{i}" for i in range(20)]
+    ops1 = ["DIFF1"] + [f"t{i}" for i in range(20)]
+    div = _diverged_pair(ops0, ops1, window=4)
+    assert div is not None and not div.exact
+    assert "predates the fingerprint window" in div.message()
+
+
+def test_divergence_mid_window_pinpointed():
+    base = [f"t{i}" for i in range(10)]
+    div = _diverged_pair(base + ["p", "q"], base + ["P", "q"], window=8)
+    assert div is not None and div.exact and div.seq == 11
+
+
+def test_report_once_per_tracker():
+    t = _tracker()
+    t.fold(_req(0, "a"))
+    other = _tracker()
+    other.fold(_req(1, "b"))
+    triples = [t.snapshot(), other.snapshot()]
+    assert t.check_gathered(triples) is not None
+    assert t.check_gathered(triples) is None    # second report suppressed
+    t.reset()
+    assert t.check_gathered(triples) is not None
+
+
+# --- wire format ------------------------------------------------------------
+def test_requestlist_carries_fingerprint_over_wire():
+    t = _tracker()
+    for n in ("a", "b"):
+        t.fold(_req(0, n))
+    rl = RequestList(requests=[_req(0, "c")])
+    rl.fp_seq, rl.fp_digest, tail = t.snapshot()
+    rl.fp_tail_seqs = [r.seq for r in tail]
+    rl.fp_tail_digests = [r.digest for r in tail]
+    rl.fp_tail_descs = [r.descriptor for r in tail]
+    back = RequestList.from_bytes(rl.to_bytes())
+    assert (back.fp_seq, back.fp_digest) == (rl.fp_seq, rl.fp_digest)
+    assert back.fp_tail_seqs == rl.fp_tail_seqs
+    assert back.fp_tail_digests == rl.fp_tail_digests
+    assert back.fp_tail_descs == rl.fp_tail_descs
+    assert back.requests[0].tensor_name == "c"
+
+
+def test_requestlist_defaults_stay_zero_when_off():
+    back = RequestList.from_bytes(RequestList().to_bytes())
+    assert back.fp_seq == 0 and back.fp_tail_seqs == []
+
+
+# --- 2-rank world: structured error instead of a hang (acceptance) ----------
+def _fingerprinted_controllers(size, mode="cycle", cache_capacity=0):
+    world = InProcWorld(size)
+    ctrls = [make_controller(r, size, world,
+                             cache_capacity=cache_capacity)
+             for r in range(size)]
+    for c in ctrls:
+        c.fingerprint = FingerprintTracker(mode)
+    return world, ctrls
+
+
+def test_two_rank_divergence_yields_structured_error():
+    size = 2
+    _, ctrls = _fingerprinted_controllers(size)
+
+    def step(rank):
+        ctrl = ctrls[rank]
+        name = "grad/w" if rank == 0 else "grad/b"   # the seeded bug
+        ctrl.tensor_queue.push_back_to_queue(_req(rank, name))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        assert len(rl.responses) == 1
+        resp = rl.responses[0]
+        assert resp.response_type == ResponseType.ERROR
+        assert sorted(resp.tensor_names) == ["grad/b", "grad/w"]
+        assert "op #1" in resp.error_message
+        assert "grad/w" in resp.error_message
+        assert "grad/b" in resp.error_message
+        assert not rl.shutdown          # structured error, not a shutdown
+
+
+def test_two_rank_order_divergence_detected():
+    size = 2
+    _, ctrls = _fingerprinted_controllers(size)
+
+    def step(rank):
+        ctrl = ctrls[rank]
+        names = ("a", "b") if rank == 0 else ("b", "a")
+        for n in names:
+            ctrl.tensor_queue.push_back_to_queue(_req(rank, n))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        errors = [r for r in rl.responses
+                  if r.response_type == ResponseType.ERROR]
+        assert len(errors) == 1
+        assert "op #1" in errors[0].error_message
+
+
+def test_symmetric_ranks_unaffected_by_fingerprinting():
+    size = 3
+    _, ctrls = _fingerprinted_controllers(size, mode="strict")
+
+    def step(rank):
+        ctrl = ctrls[rank]
+        ctrl.tensor_queue.push_back_to_queue(_req(rank, "t0"))
+        return ctrl.compute_response_list()
+
+    results = run_ranks(size, step)
+    for rl in results:
+        assert [r.response_type for r in rl.responses] == \
+            [ResponseType.ALLREDUCE]
+
+
+def _warm_two_tensors(size, mode):
+    """Controllers with t0+t1 negotiated into every rank's cache.
+    Fusion is disabled so each tensor caches as its own single-tensor
+    response (fused responses are never cached as a unit)."""
+    world = InProcWorld(size)
+    ctrls = [make_controller(r, size, world, cache_capacity=64,
+                             fusion_threshold=0) for r in range(size)]
+    for c in ctrls:
+        c.fingerprint = FingerprintTracker(mode)
+
+    def warm(rank):
+        ctrl = ctrls[rank]
+        ctrl.tensor_queue.push_back_to_queue(_req(rank, "t0"))
+        ctrl.tensor_queue.push_back_to_queue(_req(rank, "t1"))
+        return ctrl.compute_response_list()
+
+    run_ranks(size, warm)
+    return world, ctrls
+
+
+def _diverge_on_cached(ctrls):
+    """Rank 0 submits cached t0, rank 1 submits cached t1: pure cache
+    hits whose global AND simply clears both bits — NO negotiation is
+    ever triggered, the classic silent stall (both ranks requeue and
+    retry forever)."""
+    def diverge(rank):
+        ctrl = ctrls[rank]
+        ctrl.tensor_queue.push_back_to_queue(
+            _req(rank, "t0" if rank == 0 else "t1"))
+        return ctrl.compute_response_list()
+
+    return run_ranks(len(ctrls), diverge)
+
+
+def test_strict_mode_detects_divergence_in_cache_steady_state():
+    """Cache-steady-state divergence never ships a RequestList, so cycle
+    mode stays blind; strict mode's forced negotiation heartbeat
+    compares fingerprints every cycle and surfaces it immediately."""
+    world, ctrls = _warm_two_tensors(2, "strict")
+    gather_after_warm = world.gather_count
+
+    results = _diverge_on_cached(ctrls)
+    assert world.gather_count > gather_after_warm   # strict heartbeat ran
+    for rl in results:
+        errors = [r for r in rl.responses
+                  if r.response_type == ResponseType.ERROR]
+        assert errors, "strict mode must surface the divergence"
+        assert sorted(errors[0].tensor_names) == ["t0", "t1"]
+        assert "op #3" in errors[0].error_message
+
+
+def test_cycle_mode_is_blind_in_cache_steady_state():
+    """The documented blind spot that motivates strict mode: without the
+    forced heartbeat no RequestList flows, so nothing is compared."""
+    world, ctrls = _warm_two_tensors(2, "cycle")
+    gather_after_warm = world.gather_count
+
+    results = _diverge_on_cached(ctrls)
+    assert world.gather_count == gather_after_warm  # no negotiation ran
+    for rl in results:
+        assert all(r.response_type != ResponseType.ERROR
+                   for r in rl.responses)
+
+
+def test_fingerprint_off_keeps_wire_quiet():
+    size = 2
+    world = InProcWorld(size)
+    ctrls = [make_controller(r, size, world) for r in range(size)]
+
+    def step(rank):
+        ctrl = ctrls[rank]
+        ctrl.tensor_queue.push_back_to_queue(_req(rank, "t0"))
+        return ctrl.compute_response_list()
+
+    run_ranks(size, step)
+    for ctrl in ctrls:
+        assert not ctrl.fingerprint.enabled
+        assert ctrl.fingerprint.seq == 0

@@ -1,0 +1,172 @@
+"""hvdlint gate: the tree itself must satisfy the symmetric-collective
+contract, and every seeded violation fixture must be detected.
+
+This is the CI half of the analysis subsystem (ISSUE 2 acceptance): new
+rank-asymmetric collective usage anywhere under horovod_tpu/ fails this
+test at review time instead of hanging a pod at run time.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.analysis.lint import (COLLECTIVE_NAMES, LintConfig,
+                                       lint_paths, lint_source, main)
+from horovod_tpu.analysis.rules import RULES, parse_suppressions
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TREE = os.path.join(REPO, "horovod_tpu")
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+
+def _slugs(violations):
+    return [v.rule.slug for v in violations]
+
+
+# --- the gate ---------------------------------------------------------------
+def test_horovod_tpu_tree_is_clean():
+    violations = lint_paths([TREE])
+    assert violations == [], "\n".join(v.text() for v in violations)
+
+
+def test_gate_catches_new_violation_in_tree_context():
+    """The gate actually bites: a rank-gated collective added to any
+    module under horovod_tpu/ would fail test_horovod_tpu_tree_is_clean."""
+    bad = ("import horovod_tpu as hvd\n"
+           "def f(t):\n"
+           "    if hvd.rank() == 0:\n"
+           "        hvd.allreduce(t, name='x')\n")
+    violations = lint_source(bad, os.path.join(TREE, "hypothetical.py"))
+    assert _slugs(violations) == ["rank-gated-collective"]
+
+
+# --- seeded fixtures: every rule detected, zero false positives -------------
+def test_fixture_rank_gated_collective():
+    out = lint_paths([os.path.join(FIXTURES, "rank_gated.py")])
+    assert _slugs(out) == ["rank-gated-collective"] * 3
+    assert {v.line for v in out} == {12, 17, 22}
+
+
+def test_fixture_rank_gated_early_return():
+    out = lint_paths([os.path.join(FIXTURES, "early_return.py")])
+    assert _slugs(out) == ["rank-gated-early-return"] * 2
+
+
+def test_fixture_barrier_tags():
+    out = lint_paths([os.path.join(FIXTURES, "barrier_tags.py")])
+    assert _slugs(out) == ["duplicate-barrier-tag",
+                           "dynamic-barrier-tag", "dynamic-barrier-tag"]
+    dup = out[0]
+    assert "'checkpoint'" in dup.message and ":7" in dup.message
+
+
+def test_fixture_lock_held_collective():
+    out = lint_paths([os.path.join(FIXTURES, "lock_held.py")])
+    assert _slugs(out) == ["collective-under-lock"] * 2
+
+
+def test_fixture_shared_state_write():
+    out = lint_paths([os.path.join(FIXTURES, "state_write.py")])
+    assert _slugs(out) == ["shared-state-write"] * 2
+
+
+def test_fixture_clean_has_zero_false_positives():
+    out = lint_paths([os.path.join(FIXTURES, "clean.py")])
+    assert out == [], "\n".join(v.text() for v in out)
+
+
+def test_all_fixtures_detected_together():
+    """Cross-file duplicate-tag state must survive a whole-directory walk
+    and the full seeded set must surface (ISSUE acceptance list)."""
+    out = lint_paths([FIXTURES])
+    found = set(_slugs(out))
+    assert {"rank-gated-collective", "rank-gated-early-return",
+            "duplicate-barrier-tag", "dynamic-barrier-tag",
+            "collective-under-lock", "shared-state-write"} <= found
+
+
+# --- suppression machinery --------------------------------------------------
+def test_suppression_requires_justification():
+    src = ("import horovod_tpu as hvd\n"
+           "def f(t, rank):\n"
+           "    if rank == 0:\n"
+           "        hvd.allreduce(t)  # hvdlint: disable=rank-gated-collective\n")
+    out = lint_source(src, "x.py")
+    assert _slugs(out) == ["bare-suppression"]
+
+
+def test_justified_suppression_is_silent():
+    src = ("import horovod_tpu as hvd\n"
+           "def f(t, rank):\n"
+           "    if rank == 0:\n"
+           "        hvd.allreduce(t)  # hvdlint: disable=HVD101 -- "
+           "single-rank tool, never negotiates\n")
+    assert lint_source(src, "x.py") == []
+
+
+def test_file_wide_suppression():
+    src = ("# hvdlint: disable-file=rank-gated-collective -- "
+           "generated file, reviewed by hand\n"
+           "import horovod_tpu as hvd\n"
+           "def f(t, rank):\n"
+           "    if rank == 0:\n"
+           "        hvd.allreduce(t)\n")
+    assert lint_source(src, "x.py") == []
+
+
+def test_parse_suppressions_both_forms():
+    sup = parse_suppressions(
+        "x = 1  # hvdlint: disable=HVD101,rank-gated-early-return -- why\n")
+    assert sup.by_line[1] == {"HVD101", "rank-gated-early-return"}
+    assert sup.bare == []
+
+
+# --- CLI --------------------------------------------------------------------
+def test_cli_json_format_and_exit_codes(capsys):
+    rc = main([os.path.join(FIXTURES, "rank_gated.py"),
+               "--format", "json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert all(p["rule"] == "HVD101" for p in payload)
+    rc = main([os.path.join(FIXTURES, "clean.py")])
+    assert rc == 0
+
+
+def test_cli_select_and_ignore(capsys):
+    rc = main([FIXTURES, "--select", "duplicate-barrier-tag"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "HVD201" in out and "HVD101" not in out
+    rc = main([os.path.join(FIXTURES, "barrier_tags.py"),
+               "--ignore", "HVD201,HVD202"])
+    assert rc == 0
+
+
+def test_cli_module_entrypoint():
+    """`python -m horovod_tpu.analysis.lint` is the documented interface."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.lint", TREE],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_rule_registry_is_coherent():
+    ids = {r.id for r in RULES.values()}
+    slugs = {r.slug for r in RULES.values()}
+    assert len(ids) == len(slugs)          # bijective id<->slug
+    for key, rule in RULES.items():
+        assert key in (rule.id, rule.slug)
+    assert "kv_barrier" in COLLECTIVE_NAMES
+
+
+# --- ruff rides along when installed (pyproject [tool.ruff]) ----------------
+@pytest.mark.skipif(importlib.util.find_spec("ruff") is None,
+                    reason="ruff not installed (optional [lint] extra)")
+def test_ruff_clean():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ruff", "check", "horovod_tpu"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -52,6 +52,22 @@ def _host_oversubscribed() -> bool:
         return False
 
 
+def _memory_pressure() -> bool:
+    """A kernel OOM-kill is also SIGKILL, and an oversubscribed box is
+    often ALSO memory-starved — so a -9 under memory pressure must not
+    be retried away as harness infra: the workers genuinely ran the host
+    out of memory (a product-weight problem, and the retry would just
+    OOM again). Threshold: <5% of MemTotal available."""
+    try:
+        with open("/proc/meminfo") as f:
+            fields = dict(line.split(":", 1) for line in f if ":" in line)
+        avail_kb = int(fields["MemAvailable"].split()[0])
+        total_kb = int(fields["MemTotal"].split()[0])
+        return avail_kb < total_kb * 0.05
+    except (OSError, KeyError, ValueError, IndexError):
+        return False
+
+
 def _infra_failure(failed: list, outputs: list[str]) -> bool:
     if not failed:
         return False
@@ -64,7 +80,12 @@ def _infra_failure(failed: list, outputs: list[str]) -> bool:
             # deadlock, and a kernel OOM-kill is also SIGKILL — neither
             # gets the silent retry unless there is corroborating
             # oversubscription evidence: a signature in the rank's own
-            # output, or a load average at/above the core count.
+            # output, or a load average at/above the core count.  The
+            # load check alone cannot corroborate a SIGKILL: the OOM
+            # killer fires on loaded hosts too, so a -9 under memory
+            # pressure stays a real failure.
+            if rc == -9 and _memory_pressure():
+                return False
             if has_signature or _host_oversubscribed():
                 continue
             return False

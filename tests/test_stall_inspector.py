@@ -1,0 +1,162 @@
+"""StallInspector edge cases (common/stall_inspector.py).
+
+The inspector is the slow-failure detector behind the fingerprint plane:
+fingerprinting catches provable divergence immediately, the inspector
+catches the remainder (a rank that is merely *absent*) on a timer.
+"""
+import contextlib
+import logging
+import time
+
+import pytest
+
+from horovod_tpu.common.logging import logger as hvd_logger
+from horovod_tpu.common.response_cache import CacheCoordinator, ResponseCache
+from horovod_tpu.common.stall_inspector import StallInspector
+
+
+@contextlib.contextmanager
+def _capture_warnings():
+    """The repo logger does not propagate to pytest's caplog handler:
+    attach one directly."""
+    records: list[logging.LogRecord] = []
+
+    class _Collector(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = _Collector(level=logging.WARNING)
+    hvd_logger.addHandler(handler)
+    old_level = hvd_logger.level
+    hvd_logger.setLevel(logging.WARNING)
+    try:
+        yield records
+    finally:
+        hvd_logger.setLevel(old_level)
+        hvd_logger.removeHandler(handler)
+
+
+@pytest.fixture
+def fast_inspector(monkeypatch):
+    """Inspector with millisecond thresholds via the real env knobs."""
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.05")
+    monkeypatch.setenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "0.15")
+    return StallInspector()
+
+
+def test_disabled_mode_never_checks(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_DISABLE", "1")
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.0")
+    insp = StallInspector()
+    assert not insp.enabled
+    assert not insp.should_check()
+    time.sleep(0.01)
+    assert not insp.should_check()
+    # invalidate path is a no-op when disabled, even with stalled entries
+    insp.record_cached_tensor("t0")
+    insp._uncached["t0"] -= 100.0          # force "stalled for 100s"
+    coordinator = CacheCoordinator(64)
+    insp.invalidate_stalled_cached_tensors(coordinator, ResponseCache(64))
+    assert coordinator.invalid_bits == set()
+    assert not coordinator.uncached_in_queue
+
+
+def test_submitted_then_removed_tensor_never_warns(fast_inspector):
+    insp = fast_inspector
+    insp.record_uncached_tensor("t0", rank=0)
+    insp.remove_uncached_tensor("t0")       # completed before the check
+    time.sleep(0.06)
+    with _capture_warnings() as records:
+        assert not insp.check_for_stalled_tensors(global_size=2)
+    assert not any("Stalled op" in r.getMessage() for r in records)
+
+
+def test_remove_unknown_tensor_is_harmless(fast_inspector):
+    fast_inspector.remove_uncached_tensor("never-submitted")
+    fast_inspector.remove_cached_tensor("never-submitted")
+
+
+def test_warning_names_missing_ranks_and_fingerprint_hint(fast_inspector):
+    insp = fast_inspector
+    insp.record_uncached_tensor("grad/w", rank=0)
+    insp.record_uncached_tensor("grad/w", rank=2)
+    time.sleep(0.06)
+    with _capture_warnings() as records:
+        should_shutdown = insp.check_for_stalled_tensors(global_size=4)
+    assert not should_shutdown              # warned, not yet past shutdown
+    text = "\n".join(r.getMessage() for r in records)
+    assert "grad/w" in text
+    assert "missing ranks: 1, 3" in text
+    # The warning routes operators to the analysis tooling.
+    assert "HOROVOD_FINGERPRINT" in text
+
+
+def test_shutdown_threshold_crossing(fast_inspector):
+    insp = fast_inspector
+    insp.record_uncached_tensor("t0", rank=0)
+    time.sleep(0.06)
+    assert not insp.check_for_stalled_tensors(global_size=2)  # warn only
+    time.sleep(0.12)                        # now past 0.15s shutdown bound
+    assert insp.check_for_stalled_tensors(global_size=2)
+
+
+def test_shutdown_disabled_when_zero(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.01")
+    monkeypatch.setenv("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "0.0")
+    insp = StallInspector()
+    insp.record_uncached_tensor("t0", rank=0)
+    time.sleep(0.05)
+    assert not insp.check_for_stalled_tensors(global_size=2)
+
+
+def test_should_check_paces_itself(fast_inspector):
+    insp = fast_inspector
+    assert not insp.should_check()          # just constructed
+    time.sleep(0.06)
+    assert insp.should_check()
+    insp.check_for_stalled_tensors(global_size=2)
+    assert not insp.should_check()          # timer reset by the check
+
+
+def test_resubmission_keeps_first_seen_time(fast_inspector):
+    """A tensor re-recorded by more ranks keeps its ORIGINAL first-seen
+    time: lateness is measured from the first submission, not the last."""
+    insp = fast_inspector
+    insp.record_uncached_tensor("t0", rank=0)
+    first, _ = insp._ready["t0"]
+    time.sleep(0.02)
+    insp.record_uncached_tensor("t0", rank=1)
+    again, ranks = insp._ready["t0"]
+    assert again == first
+    assert ranks == {0, 1}
+
+
+def test_invalidate_stalled_cached_tensor(monkeypatch):
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.01")
+    insp = StallInspector()
+    cache = ResponseCache(64)
+    from horovod_tpu.common.message import (Request, RequestType, Response,
+                                            ResponseType)
+    req = Request(request_rank=0, request_type=RequestType.ALLREDUCE,
+                  tensor_name="t0", tensor_shape=(4,))
+    cache.put(Response(response_type=ResponseType.ALLREDUCE,
+                       tensor_names=["t0"], tensor_sizes=[4]), req)
+    insp.record_cached_tensor("t0")
+    insp._uncached["t0"] -= 1.0             # stalled past the 0.01s bound
+    coordinator = CacheCoordinator(64)
+    insp.invalidate_stalled_cached_tensors(coordinator, cache)
+    assert coordinator.uncached_in_queue    # forces renegotiation
+    assert coordinator.invalid_bits == {cache.peek_cache_position("t0")}
+
+
+def test_invalidate_survives_evicted_cache_entry(monkeypatch):
+    """Tensor stalled locally but already evicted from the response cache
+    (peek raises KeyError): the inspector must skip it, not crash the
+    background loop."""
+    monkeypatch.setenv("HOROVOD_STALL_CHECK_TIME_SECONDS", "0.01")
+    insp = StallInspector()
+    insp.record_cached_tensor("gone")
+    insp._uncached["gone"] -= 1.0
+    coordinator = CacheCoordinator(64)
+    insp.invalidate_stalled_cached_tensors(coordinator, ResponseCache(64))
+    assert coordinator.invalid_bits == set()
