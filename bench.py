@@ -219,8 +219,25 @@ def _orchestrate(args) -> int:
     5 min (~22 min horizon worst case). Each attempt re-probes in the
     PARENT first with a short timeout — a wedged tunnel costs 90s, not a
     full inner spawn — and the inner run still fail-fasts via
-    HVD_BENCH_REQUIRE_ACCEL if the tunnel dies between probe and run."""
-    attempts = 6
+    HVD_BENCH_REQUIRE_ACCEL if the tunnel dies between probe and run.
+
+    HOROVOD_BENCH_PROBE_ATTEMPTS caps the schedule, and a CPU-pinned
+    environment (JAX_PLATFORMS=cpu) skips it outright: the accelerator
+    can never appear there, and the full backoff ladder burned ~13 idle
+    minutes per bench run in CPU-only containers (BENCH_r05)."""
+    try:
+        attempts = int(os.environ.get("HOROVOD_BENCH_PROBE_ATTEMPTS", "")
+                       or 6)
+    except ValueError:
+        attempts = 6
+    attempts = max(attempts, 1)
+    platforms = {p.strip().lower()
+                 for p in os.environ.get("JAX_PLATFORMS", "").split(",")
+                 if p.strip()}
+    if platforms and platforms <= {"cpu"}:
+        print("bench: JAX_PLATFORMS pins the cpu backend; skipping the "
+              "accelerator probe schedule", file=sys.stderr)
+        attempts = 0
     for attempt in range(attempts):
         backoff = min(15.0 * (2 ** attempt), 300.0)
         if _probe_backend(timeout=90.0) is None:

@@ -25,6 +25,10 @@ collectives in the same order (SURVEY §5.2):
 - ``HVD401 shared-state-write``: writes to controller/tensor-queue/
   global-state fields outside their owning modules (single-writer
   discipline for state the background thread owns).
+- ``HVD1001 thread-spawn-in-backend``: ``threading.Thread`` constructed
+  inside a ``backend/`` module — data-plane hot paths must ride the
+  transport's persistent per-peer sender lanes, not per-op threads (the
+  2(N-1)-spawns-per-ring regression the pipelined plane removed).
 
 Heuristics are deliberately lexical (no type inference): a flagged line
 that is provably safe carries ``# hvdlint: disable=<rule> -- <why>``;
@@ -79,6 +83,12 @@ OWNED_STATE_ROOTS = frozenset({"_global"})
 # background loop that drives them.
 DEFAULT_OWNER_BASENAMES = frozenset({
     "core.py", "controller.py", "tensor_queue.py", "parameter_manager.py"})
+
+# Directory whose modules are data-plane hot paths: thread construction
+# there is the per-ring-step spawn regression HVD1001 guards against.
+# (The persistent channel workers live in runner/network.py — outside
+# this directory by design, which IS the allowlist.)
+THREAD_HOT_DIRS = frozenset({"backend"})
 
 
 @dataclass
@@ -146,6 +156,9 @@ class _Analyzer(ast.NodeVisitor):
         self.sup = sup
         self.out = out
         self.barrier_sites = barrier_sites
+        self._in_hot_dir = bool(
+            THREAD_HOT_DIRS
+            & set(os.path.normpath(path).split(os.sep)[:-1]))
         self._rank_gate_depth = 0
         self._gate_lines: list[int] = []     # lineno of each active gate
         self._lock_lines: list[int] = []     # lineno of each held lock
@@ -262,6 +275,13 @@ class _Analyzer(ast.NodeVisitor):
             self._check_collective(node, name)
         if name == BARRIER_NAME:
             self._check_barrier_tag(node)
+        if name == "Thread" and self._in_hot_dir:
+            self._report(
+                "thread-spawn-in-backend", node,
+                "threading.Thread constructed in a backend/ hot path; "
+                "per-op spawns scale with ring steps — route sends "
+                "through the mesh's persistent sender lanes "
+                "(PeerMesh.send_async) instead")
         self.generic_visit(node)
 
     def _check_collective(self, node: ast.Call, name: str) -> None:

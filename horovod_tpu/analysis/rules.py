@@ -50,6 +50,12 @@ _RULE_LIST = [
          "hvdlint suppression without a '-- <justification>' comment."),
     Rule("HVD902", "syntax-error",
          "File could not be parsed; nothing in it was analyzed."),
+    Rule("HVD1001", "thread-spawn-in-backend",
+         "threading.Thread constructed inside a backend/ hot path: "
+         "per-op thread spawn scales with ring steps (the regression the "
+         "pipelined data plane removed); use the transport's persistent "
+         "per-peer sender lanes (runner/network.py PeerMesh.send_async) "
+         "instead."),
 ]
 
 RULES: dict[str, Rule] = {}

@@ -83,11 +83,21 @@ class CollectiveBackend(ABC):
     # reference: timeline activities emitted from inside ops, e.g.
     # nccl_operations.cc:143).
     timeline = None
+    # Multi-stream dispatch contract (core._dispatch_cycle): True means
+    # independent responses may execute concurrently on per-stream
+    # instances of this backend, each over its own channel set.  Planes
+    # with process-global protocol state (shm lockstep, XLA program
+    # order, the hierarchical sub-meshes) stay False and always run on
+    # stream 0.
+    stream_safe = False
+    # Which dispatch stream this instance serves (annotates timeline
+    # activities; per-stream instances are built by core.init).
+    stream = 0
 
     def _act_start(self, entries, activity: str) -> None:
         tl = self.timeline
         if tl is not None and tl.enabled:
-            tl.activity_start_all(entries, activity)
+            tl.activity_start_all(entries, activity, stream=self.stream)
 
     def _act_end(self, entries) -> None:
         tl = self.timeline
@@ -378,15 +388,26 @@ class OperationManager:
     def backends(self) -> list[CollectiveBackend]:
         return list(self._backends)
 
+    def resolve(self, response: Response,
+                entries: list[TensorTableEntry]) -> CollectiveBackend | None:
+        """First enabled backend for this response, or None.  Every
+        enabled() check is rank-symmetric by contract (world size, knob
+        env, unanimous KV-store formation), so all ranks resolve the same
+        plane — the invariant the multi-stream assignment relies on."""
+        for backend in self._backends:
+            if backend.enabled(response, entries):
+                return backend
+        return None
+
     def execute_operation(self, response: Response,
                           entries: list[TensorTableEntry]) -> Status:
         if response.response_type == ResponseType.ERROR:
             return Status.precondition_error(response.error_message)
         if response.response_type == ResponseType.JOIN:
             return Status.ok()
-        for backend in self._backends:
-            if backend.enabled(response, entries):
-                return backend.execute(response, entries)
+        backend = self.resolve(response, entries)
+        if backend is not None:
+            return backend.execute(response, entries)
         return Status.unknown_error(
             f"No enabled backend for response type "
             f"{response.response_type.name}")

@@ -17,6 +17,9 @@ from .base import CollectiveBackend
 
 class BasicBackend(CollectiveBackend):
     name = "basic"
+    # Purely rank-local (no shared wire/protocol state beyond the
+    # per-instance fusion buffers core.init builds per stream).
+    stream_safe = True
 
     def __init__(self, size: int = 1) -> None:
         self._size = size

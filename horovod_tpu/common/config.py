@@ -77,6 +77,12 @@ SHM_CAPACITY = register(
     "HOROVOD_SHM_CAPACITY", 0, int,
     "Per-rank shm region bytes (0 = max(fusion threshold, 64MB)); "
     "payloads above it fall through to the TCP plane.")
+SEGMENT_BYTES = register(
+    "HOROVOD_SEGMENT_BYTES", 256 * 1024, int,
+    "TCP ring pipeline segment: the receiver consumes each ring chunk in "
+    "segments of this many bytes, accumulating segment k while the NIC "
+    "streams segment k+1 (comm/compute overlap; bit-identical numerics). "
+    "0 disables segmentation (one monolithic receive+add per chunk).")
 BATCH_D2D_MEMCOPIES = register(
     "HOROVOD_BATCH_D2D_MEMCOPIES", True, _parse_bool,
     "Fuse gather/scatter staging copies into batched device ops.")
@@ -196,8 +202,19 @@ XLA_DONATE = register(
     "Donate input buffers to fused XLA collectives (in-place on HBM).")
 NUM_STREAMS = register(
     "HOROVOD_NUM_STREAMS", 1, int,
-    "Parallel dispatch lanes for fused collective programs "
-    "(analogue of HOROVOD_NUM_NCCL_STREAMS).")
+    "Parallel response-dispatch streams (analogue of "
+    "HOROVOD_NUM_NCCL_STREAMS): N worker threads execute independent "
+    "responses of one cycle concurrently, each over its own dedicated "
+    "TCP channel set so streams never interleave bytes on a shared "
+    "socket.  Stream assignment is round-robin over the coordinator-"
+    "ordered ResponseList (identical on every rank).  1 = the serial "
+    "background-loop dispatch, unchanged.")
+AUTOTUNE_PIPELINE = register(
+    "HOROVOD_AUTOTUNE_PIPELINE", False, _parse_bool,
+    "Let the autotuner sweep the TCP pipeline knobs (segment bytes x "
+    "active streams, bounded by HOROVOD_NUM_STREAMS) by measured "
+    "allreduce throughput before the Bayesian phase, broadcasting the "
+    "winner to every rank.")
 TRACK_ACCURACY = register(
     "HOROVOD_TRACK_ACCURACY", True, _parse_bool,
     "Compute the per-step training-accuracy metric in Trainer.step. "

@@ -142,6 +142,8 @@ class Controller:
         # Autotuner proposals awaiting broadcast (coordinator only).
         self.pending_tuned_params: tuple[int, float] | None = None
         self.pending_tuned_codec: int | None = None
+        # (segment_bytes, num_streams) TCP-pipeline proposal.
+        self.pending_tuned_pipeline: tuple[int, int] | None = None
         # Last request params per tensor, for cache insertion on every rank.
         self._last_request_params: dict[str, Request] = {}
 
@@ -211,7 +213,8 @@ class Controller:
                         coordinator.record_invalid(pos)
             if self.is_coordinator and (
                     self.pending_tuned_params is not None
-                    or self.pending_tuned_codec is not None):
+                    or self.pending_tuned_codec is not None
+                    or self.pending_tuned_pipeline is not None):
                 # Force one negotiation cycle so autotuned parameters reach
                 # every rank even in cache steady state.
                 coordinator.uncached_in_queue = True
@@ -378,6 +381,11 @@ class Controller:
             if self.pending_tuned_codec is not None:
                 response_list.tuned_codec = self.pending_tuned_codec
                 self.pending_tuned_codec = None
+            if self.pending_tuned_pipeline is not None:
+                segment, streams = self.pending_tuned_pipeline
+                response_list.tuned_segment_bytes = segment
+                response_list.tuned_num_streams = streams
+                self.pending_tuned_pipeline = None
             self.transport.broadcast_responses(response_list)
         else:
             self.transport.gather_requests(my_list)

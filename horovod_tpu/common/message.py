@@ -214,6 +214,14 @@ class ResponseList:
     # manager flip HOROVOD_COMPRESSION at runtime on every rank in the
     # same cycle.
     tuned_codec: int = -1
+    # Autotuned TCP-pipeline knobs (-1 = unchanged): segment granularity
+    # for the ring's segmented receive+accumulate, and the number of
+    # active dispatch streams (capped by HOROVOD_NUM_STREAMS, whose
+    # channel sets were formed at init).  Applied by every rank BEFORE
+    # executing this list's responses so stream assignment stays
+    # rank-symmetric.
+    tuned_segment_bytes: int = -1
+    tuned_num_streams: int = -1
 
     def to_bytes(self) -> bytes:
         enc = Encoder()
@@ -221,6 +229,8 @@ class ResponseList:
         enc.svarint(self.tuned_fusion_threshold)
         enc.f64(self.tuned_cycle_time_ms)
         enc.svarint(self.tuned_codec)
+        enc.svarint(self.tuned_segment_bytes)
+        enc.svarint(self.tuned_num_streams)
         enc.uvarint(len(self.responses))
         for r in self.responses:
             r.encode(enc)
@@ -233,9 +243,13 @@ class ResponseList:
         threshold = dec.svarint()
         cycle = dec.f64()
         codec = dec.svarint()
+        segment = dec.svarint()
+        streams = dec.svarint()
         n = dec.uvarint()
         return cls(responses=[Response.decode(dec) for _ in range(n)],
                    shutdown=shutdown,
                    tuned_fusion_threshold=threshold,
                    tuned_cycle_time_ms=cycle,
-                   tuned_codec=codec)
+                   tuned_codec=codec,
+                   tuned_segment_bytes=segment,
+                   tuned_num_streams=streams)

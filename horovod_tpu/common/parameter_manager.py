@@ -56,6 +56,23 @@ class ParameterManager:
         self._codec_scores: dict[str, float] = {}
         self._codec_index = 0
 
+        # TCP-pipeline sweep (HOROVOD_AUTOTUNE_PIPELINE): after the codec
+        # sweep, score (segment bytes x active streams) combinations one
+        # sample window each — the same logical-bytes/sec metric — and
+        # broadcast the winner through ResponseList.tuned_segment_bytes /
+        # tuned_num_streams.  Stream width can only be swept up to
+        # HOROVOD_NUM_STREAMS (the per-stream channel sets were formed at
+        # init; activation is the runtime knob).
+        self._pipeline_candidates: list[tuple[int, int]] = []
+        if active and config.AUTOTUNE_PIPELINE.get():
+            max_streams = max(config.NUM_STREAMS.get(), 1)
+            segments = [0, 1 << 16, 1 << 18, 1 << 20]
+            self._pipeline_candidates = [
+                (seg, s) for s in range(1, max_streams + 1)
+                for seg in segments]
+        self._pipeline_scores: dict[tuple[int, int], float] = {}
+        self._pipeline_index = 0
+
     def observe(self, tensor_names: list[str], nbytes: int) -> None:
         """Called once per background cycle with the allreduced bytes."""
         if not self._active or self._done:
@@ -99,6 +116,28 @@ class ParameterManager:
             logger.info("autotune codec sweep: %s -> %s",
                         self._codec_scores, best)
             self._codec_candidates = []
+            return
+
+        if self._pipeline_candidates:
+            if self._pipeline_index > 0:
+                measured = self._pipeline_candidates[
+                    self._pipeline_index - 1]
+                self._pipeline_scores[measured] = score
+                self._log(*self._current, score,
+                          event=f"pipeline-{measured[0]}x{measured[1]}")
+            if self._pipeline_index < len(self._pipeline_candidates):
+                seg, streams = self._pipeline_candidates[
+                    self._pipeline_index]
+                self._pipeline_index += 1
+                self._controller.pending_tuned_pipeline = (seg, streams)
+                return
+            best = max(self._pipeline_scores, key=self._pipeline_scores.get)
+            self._controller.pending_tuned_pipeline = best
+            self._log(*self._current, self._pipeline_scores[best],
+                      event=f"pipeline-winner-{best[0]}x{best[1]}")
+            logger.info("autotune pipeline sweep: %s -> segment=%d "
+                        "streams=%d", self._pipeline_scores, *best)
+            self._pipeline_candidates = []
             return
 
         import math

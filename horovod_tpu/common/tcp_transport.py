@@ -41,8 +41,12 @@ class TcpTransport(Transport):
         if self.size == 1:
             return and_word, or_word
         if self.rank == 0:
-            for peer in range(1, self.size):
-                a, o = _unpack_words(self.mesh.recv(peer))
+            # Drain peers in ARRIVAL order (selectors), not rank order:
+            # AND/OR are commutative, and one slow rank no longer stalls
+            # the reads of every faster rank queued behind it.
+            for _, raw in self.mesh.recv_in_arrival_order(
+                    range(1, self.size)):
+                a, o = _unpack_words(raw)
                 and_word &= a
                 or_word |= o
             payload = _pack_words(and_word, or_word)
@@ -57,9 +61,15 @@ class TcpTransport(Transport):
         if self.size == 1:
             return [request_list]
         if self.rank == 0:
-            lists = [request_list]
-            for peer in range(1, self.size):
-                lists.append(RequestList.from_bytes(self.mesh.recv(peer)))
+            # Arrival-order drain (selectors): decode each rank's list
+            # while slower peers are still sending, cutting the
+            # negotiation tail when one rank lags.  The result stays
+            # rank-indexed — arrival order never leaks downstream.
+            lists: list[RequestList | None] = [None] * self.size
+            lists[0] = request_list
+            for peer, raw in self.mesh.recv_in_arrival_order(
+                    range(1, self.size)):
+                lists[peer] = RequestList.from_bytes(raw)
             return lists
         self.mesh.send(0, request_list.to_bytes())
         return None
@@ -79,8 +89,8 @@ class TcpTransport(Transport):
         if self.size == 1:
             return
         if self.rank == 0:
-            for peer in range(1, self.size):
-                self.mesh.recv(peer)
+            for _ in self.mesh.recv_in_arrival_order(range(1, self.size)):
+                pass
             for peer in range(1, self.size):
                 self.mesh.send(peer, b"\x01")
         else:

@@ -120,13 +120,21 @@ class Timeline:
         self._emit({"name": "", "ph": "E", "ts": self._ts(), "pid": 0,
                     "tid": self._tid(tensor_name)})
 
-    def activity_start(self, tensor_name: str, activity: str) -> None:
+    def activity_start(self, tensor_name: str, activity: str,
+                       stream: int = 0) -> None:
+        """Open an activity span; a nonzero multi-stream dispatch lane is
+        recorded in the event args so traces show which channel set a
+        fused response rode (stream 0 events stay byte-identical to the
+        single-stream format)."""
         if not self._active:
             return
         self._open_acts[tensor_name] = \
             self._open_acts.get(tensor_name, 0) + 1
-        self._emit({"name": activity, "ph": "B", "ts": self._ts(),
-                    "pid": 0, "tid": self._tid(tensor_name)})
+        event = {"name": activity, "ph": "B", "ts": self._ts(),
+                 "pid": 0, "tid": self._tid(tensor_name)}
+        if stream:
+            event["args"] = {"stream": stream}
+        self._emit(event)
 
     def activity_end(self, tensor_name: str) -> None:
         if not self._active:
@@ -138,7 +146,8 @@ class Timeline:
         self._emit({"name": "", "ph": "E", "ts": self._ts(), "pid": 0,
                     "tid": self._tid(tensor_name)})
 
-    def activity_start_all(self, entries, activity: str) -> None:
+    def activity_start_all(self, entries, activity: str,
+                           stream: int = 0) -> None:
         """Open one ``activity`` span per entry of a (possibly fused)
         response — the reference's ActivityStartAll (timeline.cc), called
         from inside ops so pack/collective/unpack phases are separable in
@@ -146,7 +155,7 @@ class Timeline:
         if not self._active:
             return
         for e in entries:
-            self.activity_start(e.tensor_name, activity)
+            self.activity_start(e.tensor_name, activity, stream=stream)
 
     def activity_end_all(self, entries) -> None:
         if not self._active:

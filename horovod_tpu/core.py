@@ -15,6 +15,7 @@ callbacks into Handle futures, never blocking the background thread.
 from __future__ import annotations
 
 import os
+import queue
 import threading
 import time
 from dataclasses import dataclass, field
@@ -111,6 +112,68 @@ class HandleManager:
             self._handles.pop(hid, None)
 
 
+class StreamDispatcher:
+    """HOROVOD_NUM_STREAMS persistent worker threads executing the
+    independent responses of one cycle concurrently — the multi-stream
+    analogue of the reference's per-stream NCCL queues
+    (HOROVOD_NUM_NCCL_STREAMS).  Workers live for the whole run (no
+    per-cycle/per-response thread spawn); the background loop enqueues a
+    cycle's responses with their deterministic stream assignment and
+    blocks on the cycle latch, so the controller protocol still advances
+    one fully-executed cycle at a time."""
+
+    def __init__(self, num_streams: int) -> None:
+        self.num_streams = num_streams
+        self._queues: list[queue.Queue] = [queue.Queue()
+                                           for _ in range(num_streams)]
+        self._threads = [
+            threading.Thread(target=self._worker, args=(k,), daemon=True,
+                             name=f"hvd-stream-{k}")
+            for k in range(num_streams)]
+        for t in self._threads:
+            t.start()
+
+    def run_cycle(self, work: list[tuple[int, Any]]) -> None:
+        """Execute [(stream, thunk)] concurrently across the stream
+        workers; returns when every thunk finished."""
+        if not work:
+            return
+        remaining = len(work)
+        lock = threading.Lock()
+        done = threading.Event()
+
+        def _count_down() -> None:
+            nonlocal remaining
+            with lock:
+                remaining -= 1
+                if remaining == 0:
+                    done.set()
+
+        for stream, thunk in work:
+            self._queues[stream].put((thunk, _count_down))
+        done.wait()
+
+    def _worker(self, k: int) -> None:
+        q = self._queues[k]
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            thunk, count_down = item
+            try:
+                thunk()
+            except Exception as exc:  # noqa: BLE001 - entry.finish reports
+                logger.error("stream %d execution failed: %s", k, exc)
+            finally:
+                count_down()
+
+    def stop(self) -> None:
+        for q in self._queues:
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+
+
 @dataclass
 class GlobalState:
     rank: int = 0
@@ -126,6 +189,15 @@ class GlobalState:
     group_table: GroupTable = field(default_factory=GroupTable)
     controller: Controller | None = None
     op_manager: OperationManager | None = None
+    # Multi-stream response dispatch (HOROVOD_NUM_STREAMS): op_managers[k]
+    # is stream k's backend chain (stream 0 = the full chain above;
+    # streams 1.. carry per-stream TCP/basic instances over their own
+    # PeerMesh channel sets).  active_streams <= len(op_managers) is the
+    # runtime width (autotuner-adjustable through the ResponseList).
+    op_managers: list[OperationManager] = field(default_factory=list)
+    stream_dispatcher: StreamDispatcher | None = None
+    tcp_collectives: list[Any] = field(default_factory=list)
+    active_streams: int = 1
     handle_manager: HandleManager = field(default_factory=HandleManager)
     timeline: Timeline | None = None
     parameter_manager: Any = None
@@ -196,6 +268,9 @@ def init(*, rank: int | None = None, size: int | None = None,
         _global.tensor_queue.reset()
         _global.joined = False
         _global.elastic_enabled = config.ELASTIC.get()
+        _global.tcp_collectives = []
+        _global.stream_dispatcher = None
+        _global.active_streams = 1
 
         timeline_path = config.TIMELINE.get()
         _global.timeline = Timeline(
@@ -328,13 +403,39 @@ def init(*, rank: int | None = None, size: int | None = None,
                         TcpCollectives(cross_mesh),
                         allreduce_on=hier_ar, allgather_on=hier_ag,
                         shm_local=hier_shm))
-            tcp_backend = TcpBackend(TcpCollectives(data_mesh))
+            tcp_coll = TcpCollectives(data_mesh)
+            tcp_backend = TcpBackend(tcp_coll)
+            _global.tcp_collectives = [tcp_coll]
             if shm_backend is not None:
                 shm_backend.tcp = tcp_backend   # oversized-alltoall delegate
                 backends.append(shm_backend)
             backends.append(tcp_backend)
+            # Multi-stream response dispatch (HOROVOD_NUM_STREAMS): one
+            # additional PeerMesh channel set + TCP backend chain per
+            # stream, so concurrent responses never interleave bytes on a
+            # shared socket and fusion staging buffers are per-stream.
+            # Mesh formation is collective — the knob is launcher-set and
+            # identical on every rank.
+            num_streams = max(config.NUM_STREAMS.get(), 1)
+            stream_managers: list[OperationManager] = []
+            for s in range(1, num_streams):
+                stream_mesh = PeerMesh(rank, size, kv,
+                                       scope=f"data{epoch}.s{s}",
+                                       timeout=timeout)
+                _global.resources.append(stream_mesh)
+                coll_s = TcpCollectives(stream_mesh)
+                _global.tcp_collectives.append(coll_s)
+                tcp_s = TcpBackend(coll_s)
+                basic_s = BasicBackend(size)
+                tcp_s.stream = basic_s.stream = s
+                tcp_s.timeline = basic_s.timeline = _global.timeline
+                stream_managers.append(OperationManager([tcp_s, basic_s]))
+            _global.active_streams = num_streams
+            if num_streams > 1:
+                _global.stream_dispatcher = StreamDispatcher(num_streams)
         else:
             transport = LocalTransport()
+            stream_managers = []
         backends.append(BasicBackend(size))
 
         # Runtime collective-symmetry fingerprinting (HOROVOD_FINGERPRINT;
@@ -354,6 +455,7 @@ def init(*, rank: int | None = None, size: int | None = None,
         for backend in backends:
             backend.timeline = _global.timeline
         _global.op_manager = OperationManager(backends)
+        _global.op_managers = [_global.op_manager] + stream_managers
 
         if config.AUTOTUNE.get():
             from .common.parameter_manager import ParameterManager
@@ -386,6 +488,9 @@ def shutdown() -> None:
         thread.join(timeout=60)
     with _init_lock:
         _global.tensor_queue.finalize()
+        if _global.stream_dispatcher is not None:
+            _global.stream_dispatcher.stop()
+            _global.stream_dispatcher = None
         if _global.timeline is not None:
             _global.timeline.stop()
         for res in _global.resources:
@@ -473,10 +578,27 @@ def _background_loop() -> None:
         if st.timeline is not None:
             st.timeline.mark_cycle()
 
+        # Pipeline autotune parameters apply BEFORE this cycle's dispatch:
+        # they ride the identical broadcast ResponseList, so every rank
+        # flips segment size / stream width on the same cycle and the
+        # round-robin stream assignment below stays rank-symmetric.
+        if response_list.tuned_segment_bytes >= 0:
+            for coll in st.tcp_collectives:
+                coll.segment_bytes = response_list.tuned_segment_bytes
+        if response_list.tuned_num_streams > 0:
+            st.active_streams = min(response_list.tuned_num_streams,
+                                    max(len(st.op_managers), 1))
+
+        if st.stream_dispatcher is not None \
+                and len(response_list.responses) > 1:
+            _dispatch_cycle(st, response_list.responses)
+        else:
+            for response in response_list.responses:
+                _perform_operation(st, response)
+
         total_bytes = 0
         tensor_names: list[str] = []
         for response in response_list.responses:
-            _perform_operation(st, response)
             if response.response_type in (ResponseType.ALLREDUCE,
                                           ResponseType.ADASUM):
                 from .common.dtypes import element_size
@@ -515,37 +637,54 @@ def _background_loop() -> None:
                 time.sleep(min(0.0003, st.cycle_time_ms / 5000.0))
 
 
-def _perform_operation(st: GlobalState, response: Response) -> None:
-    """Reference: operations.cc:256-329 PerformOperation."""
-    if response.response_type == ResponseType.JOIN:
-        st.joined = False
-        if st.tensor_queue.has_tensor_entry(JOIN_TENSOR_NAME):
-            entry = st.tensor_queue.pop_tensor_entry(JOIN_TENSOR_NAME)
-            entry.output = np.int32(response.last_joined_rank)
-            entry.finish(Status.ok())
-        return
+def _perform_join(st: GlobalState, response: Response) -> None:
+    st.joined = False
+    if st.tensor_queue.has_tensor_entry(JOIN_TENSOR_NAME):
+        entry = st.tensor_queue.pop_tensor_entry(JOIN_TENSOR_NAME)
+        entry.output = np.int32(response.last_joined_rank)
+        entry.finish(Status.ok())
 
+
+def _pop_entries(st: GlobalState,
+                 response: Response) -> list[TensorTableEntry]:
+    """Pop the response's entries from the tensor table (background
+    thread only — the queue has a single consumer) and close their
+    negotiation spans."""
     entries: list[TensorTableEntry] = []
-    for i, name in enumerate(response.tensor_names):
+    for name in response.tensor_names:
         if st.tensor_queue.has_tensor_entry(name):
             entries.append(st.tensor_queue.pop_tensor_entry(name))
         else:
             # Joined rank: participate with a zero stand-in
             # (reference: controller.cc:254-308 joined-rank handling).
             entries.append(TensorTableEntry(tensor_name=name))
-
     timeline = st.timeline
     if timeline is not None and timeline.enabled:
         for e in entries:
             timeline.negotiate_end(e.tensor_name)
+    return entries
+
+
+def _execute_response(st: GlobalState, response: Response,
+                      entries: list[TensorTableEntry],
+                      stream: int = 0) -> None:
+    """Execute one response on stream `stream`'s backend chain and finish
+    its entries (runs on the background thread when streams == 1, on a
+    stream worker otherwise)."""
+    timeline = st.timeline
+    if timeline is not None and timeline.enabled:
+        for e in entries:
             timeline.activity_start(e.tensor_name,
-                                    response.response_type.name)
+                                    response.response_type.name,
+                                    stream=stream)
 
     if response.response_type == ResponseType.ERROR:
         status = Status.precondition_error(response.error_message)
     else:
         try:
-            status = st.op_manager.execute_operation(response, entries)
+            manager = st.op_managers[stream] if st.op_managers \
+                else st.op_manager
+            status = manager.execute_operation(response, entries)
         except Exception as exc:  # noqa: BLE001 - backend failure
             logger.error("collective execution failed: %s", exc)
             status = Status.unknown_error(str(exc))
@@ -561,6 +700,47 @@ def _perform_operation(st: GlobalState, response: Response) -> None:
 
     for e in entries:
         e.finish(status)
+
+
+def _perform_operation(st: GlobalState, response: Response) -> None:
+    """Reference: operations.cc:256-329 PerformOperation."""
+    if response.response_type == ResponseType.JOIN:
+        _perform_join(st, response)
+        return
+    _execute_response(st, response, _pop_entries(st, response), stream=0)
+
+
+def _dispatch_cycle(st: GlobalState, responses: list[Response]) -> None:
+    """Multi-stream dispatch of one cycle's responses.
+
+    Stream assignment is round-robin over the coordinator-ordered
+    ResponseList, counting only stream-safe responses — both the order
+    and each response's resolved backend are identical on every rank
+    (enabled() checks are rank-symmetric by contract), so rank R's
+    stream-k worker exchanges bytes exactly with every peer's stream-k
+    worker and hvdlint's symmetric-call contract holds.  Responses whose
+    plane keeps process-global protocol state (shm lockstep, XLA program
+    order, hierarchical sub-meshes) all ride stream 0, preserving their
+    relative execution order."""
+    work: list[tuple[int, Any]] = []
+    rr = 0
+    for response in responses:
+        if response.response_type == ResponseType.JOIN:
+            _perform_join(st, response)
+            continue
+        entries = _pop_entries(st, response)
+        stream = 0
+        if response.response_type != ResponseType.ERROR:
+            backend = st.op_managers[0].resolve(response, entries)
+            if backend is not None and backend.stream_safe:
+                stream = rr % max(st.active_streams, 1)
+                rr += 1
+
+        def _thunk(response=response, entries=entries, stream=stream):
+            _execute_response(st, response, entries, stream=stream)
+
+        work.append((stream, _thunk))
+    st.stream_dispatcher.run_cycle(work)
 
 
 # ---------------------------------------------------------------------------

@@ -5,10 +5,19 @@ horovod/runner/http/http_server.py:35-241 (rendezvous KV server), and the
 point-to-point plumbing under runner/common/service/.  Framing is a 4-byte
 big-endian length prefix; payloads are opaque bytes (wire.py messages or raw
 numpy buffers).
+
+Bulk transfers ride persistent per-peer duplex channels (`_PeerChannel`):
+one long-lived sender thread + bounded queue per neighbor drains
+scatter-gather `sendmsg` frames, and receives land in a reusable per-peer
+scratch pool via `recv_into` — no per-step thread spawn, no bytes copies
+on either direction (the reference keeps Gloo's persistent pair
+connections alive the same way).
 """
 from __future__ import annotations
 
 import os
+import queue
+import selectors
 import socket
 import struct
 import threading
@@ -18,6 +27,11 @@ from urllib import error as urlerror
 from urllib import request as urlrequest
 
 _LEN = struct.Struct(">I")
+
+# Depth of a channel's outbound queue.  Collective schedules keep at most
+# one or two sends in flight per peer; the bound only exists so a runaway
+# producer backpressures instead of buffering unbounded payload refs.
+_SEND_QUEUE_DEPTH = 8
 
 
 def send_msg(sock: socket.socket, payload: bytes) -> None:
@@ -29,6 +43,30 @@ def send_msg(sock: socket.socket, payload: bytes) -> None:
         # a multi-MB gradient buffer per send).
         sock.sendall(_LEN.pack(len(payload)))
         sock.sendall(payload)
+
+
+def send_msg_gather(sock: socket.socket, view: memoryview) -> None:
+    """Frame + send in one scatter-gather syscall (`sendmsg`): the header
+    never gets concatenated onto a multi-MB payload, and the payload is
+    consumed straight from the caller's buffer (numpy slice, bytes, ...).
+    Handles partial sends — sendmsg may stop at any byte boundary."""
+    n = view.nbytes
+    hdr = _LEN.pack(n)
+    sent = sock.sendmsg([hdr, view])
+    while sent < 4 + n:
+        if sent < 4:
+            sent += sock.send(memoryview(hdr)[sent:])
+        else:
+            sent += sock.send(view[sent - 4:])
+
+
+def _as_byte_view(payload) -> memoryview:
+    """A flat uint8 memoryview over bytes/bytearray/memoryview/ndarray
+    without copying (C-contiguous buffers only — all our payloads are)."""
+    view = payload if isinstance(payload, memoryview) else memoryview(payload)
+    if view.format != "B" or view.ndim != 1:
+        view = view.cast("B")
+    return view
 
 
 def recv_exact(sock: socket.socket, n: int) -> bytearray:
@@ -216,6 +254,130 @@ class RendezvousClient:
 
 
 # ---------------------------------------------------------------------------
+# Persistent duplex channel to one peer
+# ---------------------------------------------------------------------------
+class _PeerChannel:
+    """One long-lived socket to a peer with a persistent sender lane.
+
+    Sends enqueue onto a bounded queue drained by ONE daemon thread that
+    lives as long as the channel (spawned lazily on the first async send,
+    so control-plane meshes that never bulk-send cost zero threads).
+    Receives go through `recv_begin` (framing) + `recv_exact_into`
+    (straight into the caller's buffer) or the reusable scratch pool —
+    the zero-copy replacement for the old alloc-per-message recv.
+    """
+
+    __slots__ = ("sock", "peer", "_queue", "_sender", "_error",
+                 "_scratch", "_hdr", "_on_sent")
+
+    def __init__(self, sock: socket.socket, peer: int, on_sent) -> None:
+        self.sock = sock
+        self.peer = peer
+        self._queue: queue.Queue | None = None
+        self._sender: threading.Thread | None = None
+        self._error: BaseException | None = None
+        self._scratch = bytearray(0)
+        self._hdr = bytearray(4)
+        self._on_sent = on_sent    # bytes counter callback (mesh-level)
+
+    # -- sending ----------------------------------------------------------
+    def send_async(self, payload) -> None:
+        """Enqueue one framed message on the persistent sender lane.  The
+        caller must not mutate `payload`'s buffer until the channel is
+        flushed (collectives flush before returning results)."""
+        if self._error is not None:
+            raise self._error
+        if self._sender is None:
+            self._queue = queue.Queue(maxsize=_SEND_QUEUE_DEPTH)
+            self._sender = threading.Thread(
+                target=self._send_loop, daemon=True,
+                name=f"hvd-send-{self.peer}")
+            self._sender.start()
+        self._queue.put(_as_byte_view(payload))
+
+    def send_sync(self, payload) -> int:
+        """Blocking framed send; routed through the sender lane when one
+        exists so sync and async frames never interleave on the wire.
+        Returns the bytes to account (0 when the lane already counted
+        them through its completion callback)."""
+        view = _as_byte_view(payload)
+        if self._sender is not None:
+            self.send_async(view)
+            self.flush()
+            return 0
+        send_msg_gather(self.sock, view)
+        return view.nbytes
+
+    def _send_loop(self) -> None:
+        while True:
+            view = self._queue.get()
+            try:
+                if view is None:
+                    return
+                send_msg_gather(self.sock, view)
+                self._on_sent(view.nbytes)
+            except BaseException as e:  # noqa: BLE001 - surfaced to caller
+                if self._error is None:
+                    self._error = e
+                # Wake a peer blocked in recv on the dead channel.
+                try:
+                    self.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            finally:
+                self._queue.task_done()
+
+    def flush(self) -> None:
+        """Block until every queued frame has been handed to the kernel
+        (the pre-channel code's per-step join gave the same guarantee)."""
+        if self._queue is not None:
+            self._queue.join()
+        if self._error is not None:
+            raise self._error
+
+    # -- receiving --------------------------------------------------------
+    def recv_exact_into(self, view: memoryview) -> None:
+        got, n = 0, view.nbytes
+        while got < n:
+            r = self.sock.recv_into(view[got:], n - got)
+            if r == 0:
+                raise ConnectionError("socket closed mid-message")
+            got += r
+
+    def recv_begin(self) -> int:
+        """Read one frame header; the next `nbytes` on the wire are the
+        payload, consumed by the caller via recv_exact_into/scratch."""
+        if self._error is not None:
+            raise self._error
+        hv = memoryview(self._hdr)
+        self.recv_exact_into(hv)
+        return _LEN.unpack(self._hdr)[0]
+
+    def scratch(self, nbytes: int) -> memoryview:
+        """A reusable receive buffer of at least `nbytes` (grown
+        geometrically, never shrunk): steady-state receives allocate
+        nothing.  Contents are valid until the next scratch recv on this
+        channel — consume before receiving again."""
+        if len(self._scratch) < nbytes:
+            self._scratch = bytearray(max(nbytes, 2 * len(self._scratch)))
+        return memoryview(self._scratch)[:nbytes]
+
+    def close(self) -> None:
+        if self._sender is not None:
+            try:
+                self.flush()
+            except BaseException:  # noqa: BLE001 - already torn down
+                pass
+            self._queue.put(None)
+            self._sender.join(timeout=5)
+            self._sender = None
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
 # Full-mesh point-to-point connections between ranks
 # ---------------------------------------------------------------------------
 class PeerMesh:
@@ -231,6 +393,7 @@ class PeerMesh:
         self.rank = rank
         self.size = size
         self._socks: dict[int, socket.socket] = {}
+        self._channels: dict[int, _PeerChannel] = {}
         self._lock = threading.Lock()
         # Payload byte counters (framing excluded): the observability the
         # compression subsystem's bandwidth claims are asserted against
@@ -296,6 +459,8 @@ class PeerMesh:
                 f"inbound peers connected")
         self._socks.update(accepted)
         listener.close()
+        for peer, sock in self._socks.items():
+            self._channels[peer] = _PeerChannel(sock, peer, self._count_sent)
 
     @staticmethod
     def _advertised_host() -> str:
@@ -308,21 +473,82 @@ class PeerMesh:
             return candidate_addresses(iface)[0]
         return socket.gethostbyname(socket.gethostname())
 
+    def _count_sent(self, nbytes: int) -> None:
+        with self._lock:   # sender lanes run concurrently with the ring
+            self.bytes_sent += nbytes
+
+    def _count_received(self, nbytes: int) -> None:
+        with self._lock:
+            self.bytes_received += nbytes
+
     def send(self, peer: int, payload: bytes) -> None:
-        send_msg(self._socks[peer], payload)
-        with self._lock:   # sender threads run concurrently with the ring
-            self.bytes_sent += len(payload)
+        self._count_sent(self._channels[peer].send_sync(payload))
+
+    def send_async(self, peer: int, payload) -> None:
+        """Enqueue a framed message on the peer's persistent sender lane
+        (counted by the lane on completion).  Zero-copy: the payload
+        buffer must stay unmutated until `flush()`."""
+        self._channels[peer].send_async(payload)
 
     def recv(self, peer: int) -> bytearray:
         data = recv_msg(self._socks[peer])
-        with self._lock:
-            self.bytes_received += len(data)
+        self._count_received(len(data))
         return data
 
+    # -- zero-copy receive surface (bulk data plane) --------------------
+    def recv_begin(self, peer: int) -> int:
+        """Read one frame header from `peer`; returns the payload length
+        the caller must now consume via recv_raw_into/scratch."""
+        n = self._channels[peer].recv_begin()
+        self._count_received(n)
+        return n
+
+    def recv_raw_into(self, peer: int, view: memoryview) -> None:
+        """Receive exactly len(view) payload bytes straight into the
+        caller's buffer (no staging copy)."""
+        self._channels[peer].recv_exact_into(view)
+
+    def scratch(self, peer: int, nbytes: int) -> memoryview:
+        """The peer channel's reusable receive scratch (see
+        _PeerChannel.scratch for the validity contract)."""
+        return self._channels[peer].scratch(nbytes)
+
+    def recv_in_arrival_order(self, peers):
+        """Yield (peer, message) for one framed message from each of
+        `peers`, draining whichever peer's bytes arrive first (selectors)
+        instead of fixed rank order — one slow rank no longer serializes
+        the drain behind the sockets after it."""
+        remaining = list(peers)
+        if not remaining:
+            return
+        with selectors.DefaultSelector() as sel:
+            for p in remaining:
+                sel.register(self._socks[p], selectors.EVENT_READ, p)
+            pending = len(remaining)
+            while pending:
+                for key, _ in sel.select():
+                    peer = key.data
+                    sel.unregister(key.fileobj)
+                    pending -= 1
+                    yield peer, self.recv(peer)
+
+    def flush(self, peer: int | None = None) -> None:
+        """Wait until queued sends (to `peer`, or everyone) reached the
+        kernel.  Collectives flush before returning so callers may mutate
+        result buffers; direct-fd paths (native ring) flush first so raw
+        writes never interleave with queued frames."""
+        channels = [self._channels[peer]] if peer is not None \
+            else self._channels.values()
+        for ch in channels:
+            ch.flush()
+
     def close(self) -> None:
-        for sock in self._socks.values():
+        for ch in self._channels.values():
+            ch.close()
+        for sock in self._socks.values():   # size-1 meshes have no channels
             try:
                 sock.close()
             except OSError:
                 pass
+        self._channels.clear()
         self._socks.clear()

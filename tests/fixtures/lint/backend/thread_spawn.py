@@ -1,0 +1,31 @@
+"""Seeded HVD1001 fixture: thread construction in a backend/ hot path.
+
+Lives under a `backend/` directory on purpose — the rule is scoped to
+data-plane modules (the persistent channel workers in runner/network.py
+are outside that scope, which is the allowlist).
+"""
+import threading
+
+
+def sendrecv(mesh, to_rank, payload, from_rank):
+    t = threading.Thread(target=mesh.send, args=(to_rank, payload))  # HVD1001
+    t.start()
+    data = mesh.recv(from_rank)
+    t.join()
+    return data
+
+
+def broadcast_star(mesh, size, payload):
+    threads = [threading.Thread(target=mesh.send, args=(p, payload))  # HVD1001
+               for p in range(size)]
+    for t in threads:
+        t.start()
+
+
+def fine_async(mesh, to_rank, payload):
+    # The persistent-lane API is the sanctioned path — no violation.
+    mesh.send_async(to_rank, payload)
+
+
+def fine_suppressed(mesh, fn):
+    return threading.Thread(target=fn)  # hvdlint: disable=thread-spawn-in-backend -- channel worker test double, constructed once

@@ -1609,8 +1609,77 @@ def battery_compress_xla(hvd, rank, size):
                   <= bound)
 
 
+def battery_streams(hvd, rank, size):
+    """Multi-stream response dispatch (HOROVOD_NUM_STREAMS=2, fusion off
+    so a burst of async allreduces becomes several responses round-robined
+    across streams): exact results, per-stream channel traffic, mixed
+    codecs, and a steady-state thread census."""
+    import threading
+
+    from horovod_tpu import core
+    from horovod_tpu.compress import CompressionCodec
+    st = core.global_state()
+    assert st.stream_dispatcher is not None, "dispatcher not formed"
+    assert st.stream_dispatcher.num_streams == 2
+    assert len(st.op_managers) == 2 and len(st.tcp_collectives) == 2
+
+    def burst(tag):
+        handles = [hvd.allreduce_async(
+            np.arange(4096, dtype=np.float32) * (i + 1) + rank,
+            op=hvd.Sum, name=f"{tag}{i}") for i in range(6)]
+        for i, h in enumerate(handles):
+            out = hvd.synchronize(h)
+            expected = np.arange(4096, dtype=np.float32) * (i + 1) * size \
+                + sum(range(size))
+            np.testing.assert_array_equal(out, expected)
+
+    burst("first")           # negotiated path
+    for cycle in range(3):   # response-cache steady state
+        burst(f"c{cycle}")
+
+    # Stream isolation: BOTH per-stream channel sets carried payload.
+    for s, coll in enumerate(st.tcp_collectives):
+        assert coll.mesh.bytes_received > 0, f"stream {s} never used"
+
+    # Mixed ops across streams in one cycle (broadcast is stream-safe on
+    # the TCP plane; values exact).
+    handles = [hvd.allreduce_async(np.full(1024, float(rank + i),
+                                           np.float32),
+                                   op=hvd.Sum, name=f"mix_ar{i}")
+               for i in range(2)]
+    bh = hvd.broadcast_async(np.arange(64, dtype=np.float64) * (rank + 1),
+                             root_rank=0, name="mix_bc")
+    for i, h in enumerate(handles):
+        np.testing.assert_array_equal(
+            hvd.synchronize(h),
+            np.full(1024, float(sum(range(size)) + size * i), np.float32))
+    np.testing.assert_array_equal(hvd.synchronize(bh),
+                                  np.arange(64, dtype=np.float64))
+
+    # Cast + quantized codecs ride the per-stream channels too; small
+    # integer values are exact through the bf16 wire, int8 within the
+    # block-quantization bound.
+    v = np.arange(2048, dtype=np.float32) % 97
+    out = hvd.allreduce(v, op=hvd.Sum, name="s_bf16", compression="bf16")
+    np.testing.assert_array_equal(out, v * size)
+    data = np.stack([(np.arange(2048, dtype=np.float32) % 53) + r
+                     for r in range(size)])
+    out_q = hvd.allreduce(data[rank].copy(), op=hvd.Sum, name="s_int8",
+                          compression="int8")
+    bound = _compress_error_bound(data, CompressionCodec.INT8, 256)
+    assert np.all(np.abs(np.asarray(out_q, np.float64) - data.sum(0))
+                  <= bound)
+
+    # Steady-state census: cached multi-stream cycles spawn no threads.
+    before = threading.active_count()
+    burst("census")
+    assert threading.active_count() <= before, \
+        (before, threading.active_count())
+
+
 BATTERIES = {
     "collectives": battery_collectives,
+    "streams": battery_streams,
     "matrix": battery_matrix,
     "autotune": battery_autotune,
     "stall": battery_stall,
@@ -1669,6 +1738,13 @@ def main() -> int:
         os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"
         os.environ["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = "2"
         os.environ["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = "3"
+    if battery == "streams":
+        # Two dispatch streams over the TCP plane; fusion off so async
+        # bursts negotiate into SEVERAL responses per cycle (the unit the
+        # round-robin stream assignment distributes).
+        os.environ["HOROVOD_NUM_STREAMS"] = "2"
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+        os.environ["HOROVOD_FUSION_THRESHOLD"] = "0"
     if battery == "shm":
         os.environ["HOROVOD_SHM_OPERATIONS"] = "1"   # require formation
         os.environ["HOROVOD_SHM_CAPACITY"] = str(1 << 20)

@@ -81,6 +81,15 @@ def test_join_uneven_data():
     _run_world(2, "join")
 
 
+@pytest.mark.parametrize("size", [2, 4])
+def test_multistream_dispatch(size):
+    """HOROVOD_NUM_STREAMS=2 over the TCP plane: independent responses
+    of one cycle execute concurrently on per-stream channel sets with
+    deterministic rank-symmetric assignment (ISSUE 3 tentpole); results
+    exact, both streams carry traffic, steady state spawns no threads."""
+    _run_world(size, "streams", timeout=120.0)
+
+
 @pytest.mark.parametrize("size", [2, 3])
 def test_shm_data_plane(size):
     """Same-host shared-memory allreduce plane: selection, flat-path

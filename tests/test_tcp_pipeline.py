@@ -1,0 +1,459 @@
+"""Pipelined zero-copy TCP data plane battery (ISSUE 3).
+
+Covers the three tentpole layers and their contracts:
+
+- segmented comm/compute overlap is BIT-IDENTICAL to the monolithic path
+  for every codec (fp32 ring, bf16 cast, int8/uint4 quantized) on 2- and
+  4-rank worlds — same elementwise adds, same rank-order accumulation;
+- the transport spawns NO per-step threads: sender lanes are persistent
+  per-peer workers (census counts every Thread constructed while a
+  12-op mixed workload runs);
+- per-stream channel isolation: concurrent responses on separate meshes
+  account their bytes on their own counters, exactly;
+- the binomial broadcast delivers from every root at every world size;
+- the selectors-based arrival-order drain returns the fast peer first;
+- (slow) the 4-rank >=1 MiB fused-allreduce A/B: pipelined wall clock
+  beats the pre-pipeline thread-per-step/tobytes path.
+
+Multi-stream dispatch through the full core runtime rides the
+`streams` battery in tests/test_multiprocess.py / mp_worker.py.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu.native as native
+from horovod_tpu.backend.tcp import TcpCollectives
+from horovod_tpu.compress import CompressionCodec
+from horovod_tpu.runner.network import PeerMesh
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def kv():
+    from horovod_tpu.runner.network import (RendezvousClient,
+                                            RendezvousServer)
+    server = RendezvousServer()
+    port = server.start()
+    yield RendezvousClient("127.0.0.1", port, 15.0)
+    server.stop()
+
+
+def _threaded(n, fn, timeout=90.0):
+    results: list = [None] * n
+    errors: list = []
+
+    def worker(r):
+        try:
+            results[r] = fn(r)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "rank thread hung"
+    if errors:
+        raise errors[0]
+    return results
+
+
+def _world(kv, size, scope, fn, meshes=None, timeout=90.0):
+    """Form a PeerMesh world and run fn(coll, rank) on every rank."""
+    owned = meshes is None
+    meshes = meshes if meshes is not None else [None] * size
+
+    def worker(r):
+        if meshes[r] is None:
+            meshes[r] = PeerMesh(r, size, kv, scope=scope, timeout=15.0)
+        return fn(TcpCollectives(meshes[r]), r)
+
+    try:
+        return _threaded(size, worker, timeout=timeout)
+    finally:
+        if owned:
+            for m in meshes:
+                if m is not None:
+                    m.close()
+
+
+# ---------------------------------------------------------------------------
+# Segmented pipeline parity: bit-identical to the monolithic path
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("size", [2, 4])
+@pytest.mark.parametrize("codec", ["fp32", "bf16", "int8", "uint4"])
+def test_segmented_parity_bitwise(kv, codec, size, monkeypatch):
+    """The acceptance contract: segmented allreduce == serial ring,
+    bitwise, for every codec on 2- and 4-rank worlds.  The fp32 case
+    pins the Python ring (the native kernel has its own internal
+    segmentation and handles fp32 otherwise)."""
+    monkeypatch.setattr(native, "ring_allreduce", lambda *a, **k: False)
+    rng = np.random.default_rng(1234 + size)
+    n = 12345            # odd => uneven chunk split exercised
+    data = (rng.standard_normal((size, n)) * 5).astype(np.float32)
+
+    def op(coll, r):
+        if codec == "fp32":
+            return coll.allreduce(data[r].copy())
+        if codec == "bf16":
+            import ml_dtypes
+            return coll.cast_allreduce(data[r].copy(),
+                                       np.dtype(ml_dtypes.bfloat16))
+        qc = CompressionCodec.INT8 if codec == "int8" \
+            else CompressionCodec.UINT4
+        return coll.quantized_allreduce(data[r].copy(), qc, 128)
+
+    def run(scope, segment_bytes):
+        def fn(coll, r):
+            coll.segment_bytes = segment_bytes
+            return op(coll, r)
+        return _world(kv, size, scope, fn)
+
+    mono = run(f"par-{codec}-{size}-m", 0)       # today's monolithic path
+    seg = run(f"par-{codec}-{size}-s", 128)      # many tiny segments
+    for r in range(size):
+        np.testing.assert_array_equal(np.asarray(mono[r]),
+                                      np.asarray(seg[r]))
+    # All ranks agree with each other too (the symmetric-result contract).
+    for r in range(1, size):
+        np.testing.assert_array_equal(np.asarray(mono[0]),
+                                      np.asarray(mono[r]))
+
+
+def test_segmented_reduce_scatter_parity(kv, monkeypatch):
+    monkeypatch.setattr(native, "ring_allreduce", lambda *a, **k: False)
+    from horovod_tpu.backend.base import dim0_row_bounds
+    size, n = 3, 10007
+    rng = np.random.default_rng(7)
+    data = rng.standard_normal((size, n)).astype(np.float32)
+    bounds = np.asarray(dim0_row_bounds(n, size))
+
+    def run(scope, segment_bytes):
+        def fn(coll, r):
+            coll.segment_bytes = segment_bytes
+            return coll.reduce_scatter(data[r].copy(), bounds)
+        return _world(kv, size, scope, fn)
+
+    mono = run("rs-par-m", 0)
+    seg = run("rs-par-s", 256)
+    for r in range(size):
+        np.testing.assert_array_equal(mono[r], seg[r])
+
+
+# ---------------------------------------------------------------------------
+# Thread census: persistent lanes only, zero per-step spawn
+# ---------------------------------------------------------------------------
+def test_no_per_step_thread_spawn(kv, monkeypatch):
+    """Every Thread constructed anywhere in the process is counted while
+    a 12-op mixed workload runs: after the warmup op has spun up the
+    persistent per-peer sender lanes, the count must not move (the old
+    _sendrecv spawned 2(N-1) threads per fused buffer per allreduce)."""
+    size = 3
+    spawned: list[str] = []
+    orig_init = threading.Thread.__init__
+
+    def counting_init(self, *args, **kwargs):
+        spawned.append(kwargs.get("name") or "anon")
+        orig_init(self, *args, **kwargs)
+
+    monkeypatch.setattr(threading.Thread, "__init__", counting_init)
+
+    sync = threading.Barrier(size)
+    marker: dict[str, int] = {}
+    rng = np.random.default_rng(3)
+    data = rng.standard_normal((size, 50000)).astype(np.float32)
+
+    def fn(coll, r):
+        coll.segment_bytes = 4096
+        # Warmup touches EVERY peer channel (quantized is all-pairs), so
+        # all lazy sender lanes exist before the census window opens.
+        coll.quantized_allreduce(data[r].copy(), CompressionCodec.INT8, 128)
+        sync.wait()
+        if r == 0:
+            marker["before"] = len(spawned)
+        sync.wait()
+        for i in range(4):
+            coll.allreduce(data[r].copy())
+            coll.quantized_allreduce(data[r].copy(),
+                                     CompressionCodec.INT8, 128)
+            coll.broadcast(data[r][:1000].copy(), i % size, 4000,
+                           np.dtype(np.float32), (1000,))
+        sync.wait()
+        if r == 0:
+            marker["after"] = len(spawned)
+        return True
+
+    _world(kv, size, "census", fn)
+    assert marker["after"] == marker["before"], \
+        (f"{marker['after'] - marker['before']} thread(s) spawned during "
+         f"steady-state collectives: {spawned[marker['before']:]}")
+    # The lanes themselves are named and bounded: at most one per peer.
+    lanes = [n for n in spawned if n.startswith("hvd-send-")]
+    assert 0 < len(lanes) <= size * (size - 1)
+
+
+# ---------------------------------------------------------------------------
+# Stream isolation: concurrent ops on separate channel sets
+# ---------------------------------------------------------------------------
+def test_stream_isolation_byte_counters(kv, monkeypatch):
+    """Two concurrent allreduces on two per-stream meshes: both produce
+    exact results and each mesh's counters account exactly its own ring
+    volume — streams never interleave bytes on a shared socket."""
+    monkeypatch.setattr(native, "ring_allreduce", lambda *a, **k: False)
+    size, n = 2, 40000                      # even => equal n/2 chunks
+    meshes = [[None] * size for _ in range(2)]
+
+    def form(r):
+        meshes[0][r] = PeerMesh(r, size, kv, scope="iso-s0", timeout=15.0)
+        meshes[1][r] = PeerMesh(r, size, kv, scope="iso-s1", timeout=15.0)
+
+    _threaded(size, form)
+    data = [(np.arange(n, dtype=np.float32) + 10 * s) for s in range(2)]
+
+    def fn(r):
+        outs = [None, None]
+
+        def run_stream(s):
+            outs[s] = TcpCollectives(meshes[s][r]).allreduce(
+                data[s].copy())
+
+        # Two streams live on two threads per rank, exactly like the
+        # dispatcher's stream workers.
+        ts = [threading.Thread(target=run_stream, args=(s,), daemon=True)
+              for s in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+            assert not t.is_alive()
+        return outs
+
+    try:
+        results = _threaded(size, fn)
+        for s in range(2):
+            expected = data[s] * size
+            for r in range(size):
+                np.testing.assert_array_equal(results[r][s], expected)
+        # Exact per-channel accounting: a 2-rank ring moves 2(N-1)/N =
+        # one full payload per rank per op on each stream's own mesh.
+        for s in range(2):
+            for r in range(size):
+                assert meshes[s][r].bytes_sent == n * 4, \
+                    (s, r, meshes[s][r].bytes_sent)
+                assert meshes[s][r].bytes_received == n * 4
+    finally:
+        for row in meshes:
+            for m in row:
+                if m is not None:
+                    m.close()
+
+
+# ---------------------------------------------------------------------------
+# Binomial broadcast
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("size", [2, 3, 4, 5])
+def test_binomial_broadcast_all_roots(kv, size):
+    payload = np.arange(4097, dtype=np.float64)   # odd length, > 1 chunk
+
+    def fn(coll, r):
+        outs = []
+        for root in range(size):
+            buf = payload * (r + 1)
+            outs.append(coll.broadcast(buf, root, payload.nbytes,
+                                       payload.dtype, payload.shape))
+        return outs
+
+    results = _world(kv, size, f"btree{size}", fn)
+    for r in range(size):
+        for root in range(size):
+            np.testing.assert_array_equal(results[r][root],
+                                          payload * (root + 1))
+
+
+# ---------------------------------------------------------------------------
+# Arrival-order negotiation drain
+# ---------------------------------------------------------------------------
+def test_recv_in_arrival_order_fast_peer_first(kv):
+    """Rank 0 must see the fast peer's message while the slow peer is
+    still asleep — the fixed rank-order drain would block on rank 1."""
+    size = 3
+    order: list[int] = []
+
+    def fn(coll, r):
+        if r == 0:
+            for peer, raw in coll.mesh.recv_in_arrival_order([1, 2]):
+                order.append(peer)
+                assert raw == bytes([peer])
+            return order
+        if r == 1:
+            time.sleep(0.5)                  # the slow rank
+        coll.mesh.send(0, bytes([r]))
+        return None
+
+    _world(kv, size, "arrival", fn)
+    assert order == [2, 1], order
+
+
+# ---------------------------------------------------------------------------
+# Autotuner pipeline sweep + wire plumbing
+# ---------------------------------------------------------------------------
+def test_autotune_pipeline_sweep(monkeypatch):
+    """HOROVOD_AUTOTUNE_PIPELINE: every (segment x streams) candidate is
+    proposed for one sample window, then the best-scoring one is pinned
+    through controller.pending_tuned_pipeline."""
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "0")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_PIPELINE", "1")
+    monkeypatch.setenv("HOROVOD_NUM_STREAMS", "2")
+    from horovod_tpu.common.parameter_manager import ParameterManager
+
+    class Ctrl:
+        tensor_fusion_threshold = 1 << 26
+        pending_tuned_params = None
+        pending_tuned_codec = None
+        pending_tuned_pipeline = None
+
+    ctrl = Ctrl()
+    pm = ParameterManager(ctrl, active=True)
+    candidates = list(pm._pipeline_candidates)
+    assert len(candidates) == 8              # 4 segment sizes x 2 widths
+    proposals = []
+    for _ in range(len(candidates) + 1):
+        pm.observe(["t"], 1 << 20)
+        assert ctrl.pending_tuned_pipeline is not None
+        proposals.append(ctrl.pending_tuned_pipeline)
+        ctrl.pending_tuned_pipeline = None
+    assert proposals[:-1] == candidates      # each swept exactly once
+    assert proposals[-1] in candidates       # then the winner re-pinned
+    assert not pm._pipeline_candidates
+
+
+def test_tuned_pipeline_rides_response_list_wire():
+    from horovod_tpu.common.message import ResponseList
+    rl = ResponseList(tuned_segment_bytes=1 << 18, tuned_num_streams=3)
+    decoded = ResponseList.from_bytes(rl.to_bytes())
+    assert decoded.tuned_segment_bytes == 1 << 18
+    assert decoded.tuned_num_streams == 3
+    # Defaults mean "unchanged" on every rank.
+    empty = ResponseList.from_bytes(ResponseList().to_bytes())
+    assert empty.tuned_segment_bytes == -1
+    assert empty.tuned_num_streams == -1
+
+
+# ---------------------------------------------------------------------------
+# The 4-rank fused-allreduce microbenchmark (acceptance item)
+# ---------------------------------------------------------------------------
+def _serial_allreduce(coll, buf):
+    """The pre-pipeline data path, verbatim: thread-per-ring-step
+    send+recv, tobytes/frombuffer staging on both directions.  Kept here
+    as the A/B baseline the pipelined plane is measured against."""
+    n, rank, size = buf.size, coll.rank, coll.size
+    acc = buf.astype(np.float32, copy=True)
+    base, rem = divmod(n, size)
+    sizes = [base + (1 if i < rem else 0) for i in range(size)]
+    bounds = np.cumsum([0] + sizes)
+    nxt, prv = (rank + 1) % size, (rank - 1) % size
+
+    def sendrecv(payload):
+        err: list[BaseException] = []
+
+        def _send():
+            try:
+                coll.mesh.send(nxt, payload)
+            except BaseException as e:  # noqa: BLE001
+                err.append(e)
+
+        t = threading.Thread(target=_send, daemon=True)
+        t.start()
+        data = coll.mesh.recv(prv)
+        t.join()
+        if err:
+            raise err[0]
+        return data
+
+    for step in range(size - 1):
+        send_idx = (rank - step) % size
+        recv_idx = (rank - step - 1) % size
+        data = sendrecv(acc[bounds[send_idx]:bounds[send_idx + 1]].tobytes())
+        acc[bounds[recv_idx]:bounds[recv_idx + 1]] += \
+            np.frombuffer(data, dtype=acc.dtype)
+    for step in range(size - 1):
+        send_idx = (rank + 1 - step) % size
+        recv_idx = (rank - step) % size
+        data = sendrecv(acc[bounds[send_idx]:bounds[send_idx + 1]].tobytes())
+        acc[bounds[recv_idx]:bounds[recv_idx + 1]] = \
+            np.frombuffer(data, dtype=acc.dtype)
+    return acc
+
+
+@pytest.mark.slow
+def test_pipelined_beats_serial_4rank_4mib(kv, monkeypatch):
+    """4 ranks, 4 MiB fp32 fused buffer: the pipelined zero-copy ring
+    must finish in measurably fewer wall-clock seconds than the serial
+    thread-per-step path, with a steady-state thread count independent
+    of ring steps."""
+    monkeypatch.setattr(native, "ring_allreduce", lambda *a, **k: False)
+    size, n, reps = 4, 1 << 20, 5            # 4 MiB per rank
+    rng = np.random.default_rng(42)
+    data = rng.standard_normal((size, n)).astype(np.float32)
+    spawned: list[str] = []
+    orig_init = threading.Thread.__init__
+
+    def counting_init(self, *args, **kwargs):
+        spawned.append(kwargs.get("name") or "anon")
+        orig_init(self, *args, **kwargs)
+
+    sync = threading.Barrier(size)
+    timings: dict[str, list[float]] = {"serial": [], "pipelined": []}
+    census: dict[str, int] = {}
+
+    def fn(coll, r):
+        coll.segment_bytes = 256 * 1024
+        # Warm both paths (lane spawn, scratch growth, cache effects).
+        _serial_allreduce(coll, data[r])
+        coll.allreduce(data[r].copy())
+        for mode in ("serial", "pipelined"):
+            for _ in range(reps):
+                sync.wait()
+                t0 = time.perf_counter()
+                if mode == "serial":
+                    out = _serial_allreduce(coll, data[r])
+                else:
+                    out = coll.allreduce(data[r].copy())
+                sync.wait()
+                if r == 0:
+                    timings[mode].append(time.perf_counter() - t0)
+            np.testing.assert_allclose(out, data.sum(0), atol=1e-3)
+        sync.wait()
+        if r == 0:
+            census["baseline"] = len(spawned)
+        sync.wait()
+        coll.allreduce(data[r].copy())       # steady-state op
+        sync.wait()
+        if r == 0:
+            census["after_op"] = len(spawned)
+        return True
+
+    monkeypatch.setattr(threading.Thread, "__init__", counting_init)
+    _world(kv, size, "bench4", fn, timeout=300.0)
+
+    serial_t = sorted(timings["serial"])[reps // 2]
+    pipe_t = sorted(timings["pipelined"])[reps // 2]
+    print(f"\n4-rank 4 MiB fused allreduce: serial {serial_t * 1e3:.1f} ms "
+          f"-> pipelined {pipe_t * 1e3:.1f} ms "
+          f"({serial_t / pipe_t:.2f}x)")
+    assert pipe_t < serial_t, (pipe_t, serial_t)
+    # Ring steps spawn nothing: the steady-state op created zero threads
+    # (the serial baseline above spawned 2(N-1) per op per rank).
+    assert census["after_op"] == census["baseline"], \
+        spawned[census["baseline"]:]
