@@ -539,6 +539,10 @@ def _eager_worker(payload_mb: int, cycles: int) -> dict:
 
     import horovod_tpu as hvd
 
+    # Telemetry rides along so the perf trajectory records counters
+    # (bytes on wire, cache hit rate, stream utilization) next to the
+    # latency numbers (docs/observability.md).
+    os.environ["HOROVOD_METRICS"] = "on"
     hvd.init()
     try:
         small = np.ones(64, dtype=np.float32)
@@ -560,8 +564,10 @@ def _eager_worker(payload_mb: int, cycles: int) -> dict:
         # Ring allreduce moves 2*(n-1)/n of the payload per rank each op.
         n = hvd.size()
         moved = reps * payload_mb * (1 << 20) * 2 * (n - 1) / n
+        from horovod_tpu import telemetry
         return {"cycles_per_sec": cycles_per_sec,
-                "ring_gbyte_per_sec": moved / dt / 1e9}
+                "ring_gbyte_per_sec": moved / dt / 1e9,
+                "metrics": telemetry.summary()}
     finally:
         hvd.shutdown()
 
@@ -586,6 +592,10 @@ def bench_eager(args) -> int:
         "unit": "cycles/sec (2 ranks, localhost)",
         "vs_baseline": 0.0,
         "ring_gbyte_per_sec": round(r["ring_gbyte_per_sec"], 2),
+        # End-of-run telemetry snapshot: the trajectory records counters
+        # (wire bytes, cache hit rate, stream utilization) alongside
+        # the latency headline.
+        "metrics": r.get("metrics", {}),
     })
     return 0
 

@@ -29,6 +29,12 @@ collectives in the same order (SURVEY §5.2):
   inside a ``backend/`` module — data-plane hot paths must ride the
   transport's persistent per-peer sender lanes, not per-op threads (the
   2(N-1)-spawns-per-ring regression the pipelined plane removed).
+- ``HVD1002 blocking-io-in-hot-path``: blocking I/O
+  (``open``/``print``/``sendall``/``sendmsg``) inside a dispatch/backend
+  hot-path function (op methods, ring helpers, the dispatch loops), or
+  anywhere inside a ``telemetry/`` module — per-op file/terminal I/O
+  perturbs the very latencies the observability layer measures (the
+  timeline's own writer batches+flushes off-thread for this reason).
 
 Heuristics are deliberately lexical (no type inference): a flagged line
 that is provably safe carries ``# hvdlint: disable=<rule> -- <why>``;
@@ -89,6 +95,29 @@ DEFAULT_OWNER_BASENAMES = frozenset({
 # (The persistent channel workers live in runner/network.py — outside
 # this directory by design, which IS the allowlist.)
 THREAD_HOT_DIRS = frozenset({"backend"})
+
+# HVD1002: blocking-I/O call names that stall a dispatch thread (file
+# open, terminal write, raw socket sends that bypass the persistent
+# lanes).  Flagged inside hot-path FUNCTIONS (below) anywhere in the
+# tree, and inside ANY function of a telemetry/ module — telemetry ships
+# in-process with the data plane, so its threads must prove their I/O is
+# off the hot loop (one justified suppression: the exporter's shutdown
+# dump).
+BLOCKING_IO_NAMES = frozenset({"open", "print", "sendall", "sendmsg"})
+# Dispatch/backend hot-path function names (leading underscores are
+# stripped before matching): the per-response execution surface — op
+# methods, ring/exchange helpers, and the dispatch loops that drive them.
+HOT_IO_FUNCS = frozenset({
+    "allreduce", "grouped_allreduce", "allgather", "allgatherv",
+    "broadcast", "alltoall", "alltoallv", "reducescatter",
+    "reduce_scatter", "adasum", "execute", "execute_operation",
+    "quantized_allreduce", "cast_allreduce", "allreduce_locked",
+    "allreduce_quantized", "full_sum", "sendrecv", "recv_accum",
+    "recv_into", "recv_scratch", "pack_fusion_buffer",
+    "unpack_fusion_buffer", "execute_response", "perform_operation",
+    "dispatch_cycle", "background_loop", "run_cycle",
+})
+TELEMETRY_DIRS = frozenset({"telemetry"})
 
 
 @dataclass
@@ -159,6 +188,10 @@ class _Analyzer(ast.NodeVisitor):
         self._in_hot_dir = bool(
             THREAD_HOT_DIRS
             & set(os.path.normpath(path).split(os.sep)[:-1]))
+        self._in_telemetry_dir = bool(
+            TELEMETRY_DIRS
+            & set(os.path.normpath(path).split(os.sep)[:-1]))
+        self._func_stack: list[str] = []
         self._rank_gate_depth = 0
         self._gate_lines: list[int] = []     # lineno of each active gate
         self._lock_lines: list[int] = []     # lineno of each held lock
@@ -190,7 +223,9 @@ class _Analyzer(ast.NodeVisitor):
     # --- functions ---------------------------------------------------------
     def _visit_function(self, node) -> None:
         self._func_exits.append([])
+        self._func_stack.append(node.name)
         self.generic_visit(node)
+        self._func_stack.pop()
         self._func_exits.pop()
 
     visit_FunctionDef = _visit_function
@@ -282,7 +317,28 @@ class _Analyzer(ast.NodeVisitor):
                 "per-op spawns scale with ring steps — route sends "
                 "through the mesh's persistent sender lanes "
                 "(PeerMesh.send_async) instead")
+        if name in BLOCKING_IO_NAMES:
+            self._check_blocking_io(node, name)
         self.generic_visit(node)
+
+    def _check_blocking_io(self, node: ast.Call, name: str) -> None:
+        hot_fn = next((fn for fn in self._func_stack
+                       if fn.lstrip("_") in HOT_IO_FUNCS), None)
+        if hot_fn is not None:
+            self._report(
+                "blocking-io-in-hot-path", node,
+                f"blocking I/O call '{name}' inside hot-path function "
+                f"'{hot_fn}': file/terminal I/O on the dispatch path "
+                f"perturbs the latencies being measured — emit through "
+                f"the timeline's async writer or a telemetry metric "
+                f"instead")
+        elif self._in_telemetry_dir and self._func_stack:
+            self._report(
+                "blocking-io-in-hot-path", node,
+                f"blocking I/O call '{name}' in a telemetry/ module "
+                f"(ships in-process with the data plane): justify that "
+                f"it runs off the hot loop with a suppression, or route "
+                f"it through the exporter thread")
 
     def _check_collective(self, node: ast.Call, name: str) -> None:
         if self._rank_gate_depth > 0:

@@ -56,6 +56,13 @@ _RULE_LIST = [
          "pipelined data plane removed); use the transport's persistent "
          "per-peer sender lanes (runner/network.py PeerMesh.send_async) "
          "instead."),
+    Rule("HVD1002", "blocking-io-in-hot-path",
+         "Blocking I/O (open/print/socket send*) inside a dispatch/"
+         "backend hot-path function (or anywhere in telemetry/, which "
+         "ships in-process with the data plane): file and terminal I/O "
+         "on the dispatch path perturbs the very latencies the "
+         "observability layer measures — route output through the "
+         "timeline's async writer or the telemetry exporter thread."),
 ]
 
 RULES: dict[str, Rule] = {}

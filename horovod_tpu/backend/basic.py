@@ -23,6 +23,12 @@ class BasicBackend(CollectiveBackend):
 
     def __init__(self, size: int = 1) -> None:
         self._size = size
+        # Telemetry (no-op when HOROVOD_METRICS=off): single-rank worlds
+        # still show their degenerate collectives in the same counters.
+        from ..telemetry import metrics as _tm_metrics
+        self._m_ops = _tm_metrics().counter(
+            "horovod_basic_ops_total",
+            "Degenerate single-rank collectives executed locally")
 
     def enabled(self, response, entries) -> bool:
         return self._size == 1
@@ -33,6 +39,7 @@ class BasicBackend(CollectiveBackend):
         factor = response.prescale_factor * response.postscale_factor
         buf = self.scale_buffer(buf, factor)
         self.unpack_fusion_buffer(buf, response, entries)
+        self._m_ops.inc()
         return Status.ok()
 
     def allgather(self, response, entries) -> Status:

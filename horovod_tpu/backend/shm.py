@@ -386,6 +386,16 @@ class ShmBackend(CollectiveBackend):
     def __init__(self, world: ShmWorld) -> None:
         self.world = world
         self.ops_executed = 0   # observability for tests/PERFORMANCE.md
+        # Telemetry (no-op metrics when HOROVOD_METRICS=off): ops claimed
+        # by this plane and bytes staged through the shared region.
+        from ..telemetry import metrics as _tm_metrics
+        _tm = _tm_metrics()
+        self._m_ops = _tm.counter(
+            "horovod_shm_ops_total",
+            "Collectives executed on the shared-memory plane")
+        self._m_staged = _tm.counter(
+            "horovod_shm_staged_bytes_total",
+            "Payload bytes staged into /dev/shm regions")
         # TcpBackend delegate for alltoall payloads that exceed the
         # region capacity: per-rank dim-0 sizes are not in the response,
         # so the fit decision can only be made mid-protocol — an
@@ -503,6 +513,8 @@ class ShmBackend(CollectiveBackend):
         my_region[:] = packed.astype(np_dtype, copy=False)
         w.publish(3 * t + 1)
         nbytes = n * np_dtype.itemsize
+        self._m_ops.inc()
+        self._m_staged.inc(nbytes)
 
         if size == 2:
             # Two ranks: one fused full-sum pass per rank beats the

@@ -55,6 +55,19 @@ class TcpCollectives:
         # ResponseList.tuned_segment_bytes); 0 = monolithic receives.
         self.segment_bytes = config.SEGMENT_BYTES.get() \
             if segment_bytes is None else int(segment_bytes)
+        # Segment-overlap efficiency (telemetry/): bytes whose fp32
+        # accumulate overlapped the wire (segmented path) vs bytes that
+        # arrived monolithically.  No-op metrics when HOROVOD_METRICS=off.
+        from ..telemetry import metrics as _tm_metrics
+        _tm = _tm_metrics()
+        self._m_seg_bytes = _tm.counter(
+            "horovod_tcp_segmented_recv_bytes_total",
+            "Ring-chunk bytes consumed through the segmented "
+            "receive+accumulate (comm/compute overlapped)")
+        self._m_mono_bytes = _tm.counter(
+            "horovod_tcp_monolithic_recv_bytes_total",
+            "Ring-chunk bytes consumed in one monolithic receive "
+            "(chunk below segment size, or segmentation off)")
 
     # -- helpers --------------------------------------------------------
     def _sendrecv(self, to_rank: int, payload: bytes,
@@ -81,7 +94,9 @@ class TcpCollectives:
             view = self.mesh.scratch(frm, nbytes)
             self.mesh.recv_raw_into(frm, view)
             acc_slice += np.frombuffer(view, dtype=acc_slice.dtype)
+            self._m_mono_bytes.inc(nbytes)
             return
+        self._m_seg_bytes.inc(nbytes)
         scratch = self.mesh.scratch(frm, seg_elems * itemsize)
         pos = 0
         while pos < total:
@@ -144,6 +159,9 @@ class TcpCollectives:
             with self.mesh._lock:
                 self.mesh.bytes_sent += sent
                 self.mesh.bytes_received += rcvd
+            if self.mesh._tm_on:   # per-peer attribution for the raw-fd ring
+                self.mesh._tm_count_sent(nxt, sent)
+                self.mesh._tm_count_recv(prv, rcvd)
             return acc.astype(buf.dtype, copy=False)
 
         # Reduce-scatter: after step s, rank owns-partial chunk

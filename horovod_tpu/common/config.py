@@ -138,6 +138,35 @@ TIMELINE_MARK_CYCLES = register(
     "HOROVOD_TIMELINE_MARK_CYCLES", False, _parse_bool,
     "Mark background-loop cycles in the timeline.")
 
+# --- Telemetry (telemetry/ subsystem; docs/observability.md) ----------------
+METRICS = register(
+    "HOROVOD_METRICS", False, _parse_bool,
+    "Per-rank metrics registry + cross-rank straggler aggregation "
+    "(on|off).  Off (the default) keeps every hot path free of new "
+    "locks and syscalls: all instrumentation resolves to shared no-op "
+    "metrics.")
+METRICS_PORT = register(
+    "HOROVOD_METRICS_PORT", 0, int,
+    "Base port for the Prometheus text exposition endpoint; rank r "
+    "serves on port+r (ephemeral fallback if taken).  0 disables the "
+    "HTTP server (the registry still records).")
+METRICS_FILE = register(
+    "HOROVOD_METRICS_FILE", "", str,
+    "Path for the shutdown JSON metrics dump; '{rank}' substitutes the "
+    "rank, otherwise '.r<rank>' is inserted before the extension.  "
+    "Empty disables the dump.  Summarize with "
+    "python -m horovod_tpu.telemetry.report.")
+METRICS_WINDOW = register(
+    "HOROVOD_METRICS_WINDOW", 32, int,
+    "Negotiated tensors per straggler-aggregation window: the "
+    "coordinator publishes min/mean/max/p99 cross-rank arrival lag and "
+    "names the slowest rank once per window.")
+STRAGGLER_THRESHOLD_MS = register(
+    "HOROVOD_STRAGGLER_THRESHOLD_MS", 5.0, float,
+    "Mean arrival lag (ms behind the fastest rank, per window) above "
+    "which the coordinator logs a structured straggler warning and sets "
+    "the straggler-rank gauge.")
+
 # --- Collective fingerprinting (analysis/fingerprint.py) --------------------
 FINGERPRINT = register(
     "HOROVOD_FINGERPRINT", "off", str,

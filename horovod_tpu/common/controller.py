@@ -25,6 +25,7 @@ multi-process worlds over the DCN control plane.
 """
 from __future__ import annotations
 
+import time
 from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
 
@@ -56,6 +57,9 @@ class _TensorCount:
     """Coordinator-side readiness record for one tensor name."""
     requests: dict[int, Request] = field(default_factory=dict)  # rank -> req
     arrival: int = 0   # order in which the tensor was first requested
+    # rank -> monotonic time its request arrived (telemetry straggler
+    # signal; only populated when HOROVOD_METRICS is on).
+    times: dict[int, float] = field(default_factory=dict)
 
 
 class Transport(ABC):
@@ -147,6 +151,39 @@ class Controller:
         # Last request params per tensor, for cache insertion on every rank.
         self._last_request_params: dict[str, Request] = {}
 
+        # Telemetry (HOROVOD_METRICS; telemetry/): controller-plane
+        # counters + the coordinator's cross-rank straggler aggregation.
+        # The Null registry makes every call below a no-op when off.
+        from ..telemetry import metrics as _tm_metrics
+        self.metrics = _tm_metrics()
+        self._m_cache_hit = self.metrics.counter(
+            "horovod_controller_cache_hit_total",
+            "Requests answered from the response cache at controller pop")
+        self._m_cache_miss = self.metrics.counter(
+            "horovod_controller_cache_miss_total",
+            "Requests that needed (re-)negotiation")
+        self._m_negotiations = self.metrics.counter(
+            "horovod_controller_negotiations_total",
+            "Full RequestList gather/broadcast cycles")
+        self._m_negotiation_ms = self.metrics.histogram(
+            "horovod_controller_negotiation_ms",
+            "Wall time of one gather+broadcast negotiation round")
+        self._m_sync_wait_ms = self.metrics.histogram(
+            "horovod_controller_sync_wait_ms",
+            "Wall time blocked in the per-cycle bitvector sync (a fast "
+            "rank's wait here is a slow peer's lag)")
+        self.straggler = None
+        if self.metrics.enabled and self.is_coordinator and size > 1:
+            from ..telemetry.straggler import StragglerAggregator
+            self.straggler = StragglerAggregator(size, self.metrics)
+        # Worker-side window accumulators for the RequestList tm_*
+        # snapshot (core's background loop feeds record_cycle).
+        self._tm_cycles = 0
+        self._tm_cycle_ms = 0.0
+        self._tm_sync_wait_ms = 0.0
+        # Within-round per-rank arrival times of the current gather.
+        self._gather_arrivals: dict[int, float] = {}
+
     # ------------------------------------------------------------------
     @property
     def is_coordinator(self) -> bool:
@@ -233,6 +270,7 @@ class Controller:
                     coordinator.record_hit(pos)
                     self._local_hits[req.tensor_name] = req
                     self.stall_inspector.record_cached_tensor(req.tensor_name)
+                    self._m_cache_hit.inc()
                 else:
                     if state == CacheState.INVALID:
                         pos = self.response_cache.peek_cache_position(
@@ -240,6 +278,7 @@ class Controller:
                         coordinator.record_invalid(pos)
                     coordinator.uncached_in_queue = True
                     uncached.append(req)
+                    self._m_cache_miss.inc()
             coordinator.shutdown = shutdown_requested
             self.stall_inspector.invalidate_stalled_cached_tensors(
                 coordinator, self.response_cache)
@@ -248,7 +287,16 @@ class Controller:
             # that keeps all ranks advancing together (reference:
             # controller.cc:751-776 CoordinateCacheAndState).
             and_word, or_word = coordinator.pack()
-            and_word, or_word = self.transport.bitwise_sync(and_word, or_word)
+            if self.metrics.enabled:
+                t0 = time.monotonic()
+                and_word, or_word = self.transport.bitwise_sync(and_word,
+                                                                or_word)
+                wait_ms = (time.monotonic() - t0) * 1e3
+                self._m_sync_wait_ms.observe(wait_ms)
+                self._tm_sync_wait_ms += wait_ms
+            else:
+                and_word, or_word = self.transport.bitwise_sync(and_word,
+                                                                or_word)
             coordinator.unpack(and_word, or_word)
 
             if coordinator.shutdown:
@@ -339,6 +387,23 @@ class Controller:
         self.response_cache.put(resp, req)
 
     # ------------------------------------------------------------------
+    def record_cycle(self, cycle_ms: float) -> None:
+        """Fold one background-loop cycle's wall time into the window
+        snapshot the next negotiation ships (core._background_loop calls
+        this only when metrics are on)."""
+        self._tm_cycles += 1
+        self._tm_cycle_ms += cycle_ms
+
+    def _attach_telemetry_snapshot(self, my_list: RequestList,
+                                   queue_depth: int) -> None:
+        my_list.tm_cycles = self._tm_cycles
+        my_list.tm_cycle_ms = self._tm_cycle_ms
+        my_list.tm_sync_wait_ms = self._tm_sync_wait_ms
+        my_list.tm_queue_depth = queue_depth
+        self._tm_cycles = 0
+        self._tm_cycle_ms = 0.0
+        self._tm_sync_wait_ms = 0.0
+
     def _negotiate(self, message_queue: list[Request],
                    shutdown_requested: bool) -> ResponseList:
         for req in message_queue:
@@ -351,9 +416,21 @@ class Controller:
             my_list.fp_tail_seqs = [rec.seq for rec in tail]
             my_list.fp_tail_digests = [rec.digest for rec in tail]
             my_list.fp_tail_descs = [rec.descriptor for rec in tail]
+        tm_on = self.metrics.enabled
+        if tm_on:
+            self._attach_telemetry_snapshot(my_list, len(message_queue))
+            t_neg = time.monotonic()
         if self.is_coordinator:
             gathered = self.transport.gather_requests(my_list)
             assert gathered is not None
+            if self.straggler is not None:
+                self.straggler.observe_snapshots(gathered)
+                # Within-round arrival times from the transport (absent on
+                # LocalTransport; _handle_request then stamps on handle,
+                # which still carries the cross-round signal — requests
+                # completing a tensor in a LATER round arrive later).
+                self._gather_arrivals = dict(getattr(
+                    self.transport, "last_gather_arrivals", {}) or {})
             shutdown = False
             for rank_list in gathered:
                 shutdown = shutdown or rank_list.shutdown
@@ -395,6 +472,10 @@ class Controller:
                     self.joined_ranks.clear()
                     self.last_joined_rank = -1
                     self.local_joined = False
+        if tm_on:
+            self._m_negotiation_ms.observe(
+                (time.monotonic() - t_neg) * 1e3)
+            self._m_negotiations.inc()
         return response_list
 
     # ------------------------------------------------------------------
@@ -440,6 +521,9 @@ class Controller:
             self._arrival_counter += 1
             self._message_table[req.tensor_name] = rec
         rec.requests[req.request_rank] = req
+        if self.straggler is not None:
+            rec.times[req.request_rank] = self._gather_arrivals.get(
+                req.request_rank, time.monotonic())
         self.stall_inspector.record_uncached_tensor(req.tensor_name,
                                                     req.request_rank)
 
@@ -509,6 +593,11 @@ class Controller:
     def _construct_single(self, name: str) -> Response:
         rec = self._message_table.pop(name)
         self.stall_inspector.remove_uncached_tensor(name)
+        if self.straggler is not None and rec.times:
+            # The tensor just became globally ready: the spread of its
+            # request arrivals IS the negotiation skew, and the last
+            # arrival names the straggler (telemetry/straggler.py).
+            self.straggler.observe_tensor(rec.times)
         reqs = [rec.requests[r] for r in sorted(rec.requests)]
         first = reqs[0]
 
@@ -741,3 +830,7 @@ class Controller:
         self._last_request_params.clear()
         self.response_cache.clear()
         self.fingerprint.reset()
+        self._tm_cycles = 0
+        self._tm_cycle_ms = 0.0
+        self._tm_sync_wait_ms = 0.0
+        self._gather_arrivals.clear()

@@ -115,6 +115,15 @@ class RequestList:
     fp_tail_seqs: list[int] = field(default_factory=list)
     fp_tail_digests: list[int] = field(default_factory=list)
     fp_tail_descs: list[str] = field(default_factory=list)
+    # Bounded telemetry snapshot (telemetry/straggler.py; HOROVOD_METRICS).
+    # Four scalars — cycles in the window, summed cycle wall time, summed
+    # control-plane sync wait, queue depth at negotiation — ride every
+    # gathered RequestList so the coordinator can export per-rank gauges
+    # without any extra collective.  All zero when metrics are off.
+    tm_cycles: int = 0
+    tm_cycle_ms: float = 0.0
+    tm_sync_wait_ms: float = 0.0
+    tm_queue_depth: int = 0
 
     def to_bytes(self) -> bytes:
         enc = Encoder()
@@ -124,6 +133,10 @@ class RequestList:
         enc.uvarint_list(self.fp_tail_seqs)
         enc.uvarint_list(self.fp_tail_digests)
         enc.string_list(self.fp_tail_descs)
+        enc.uvarint(self.tm_cycles)
+        enc.f64(self.tm_cycle_ms)
+        enc.f64(self.tm_sync_wait_ms)
+        enc.uvarint(self.tm_queue_depth)
         enc.uvarint(len(self.requests))
         for r in self.requests:
             r.encode(enc)
@@ -138,12 +151,19 @@ class RequestList:
         fp_tail_seqs = dec.uvarint_list()
         fp_tail_digests = dec.uvarint_list()
         fp_tail_descs = dec.string_list()
+        tm_cycles = dec.uvarint()
+        tm_cycle_ms = dec.f64()
+        tm_sync_wait_ms = dec.f64()
+        tm_queue_depth = dec.uvarint()
         n = dec.uvarint()
         return cls(requests=[Request.decode(dec) for _ in range(n)],
                    shutdown=shutdown, fp_seq=fp_seq, fp_digest=fp_digest,
                    fp_tail_seqs=fp_tail_seqs,
                    fp_tail_digests=fp_tail_digests,
-                   fp_tail_descs=fp_tail_descs)
+                   fp_tail_descs=fp_tail_descs,
+                   tm_cycles=tm_cycles, tm_cycle_ms=tm_cycle_ms,
+                   tm_sync_wait_ms=tm_sync_wait_ms,
+                   tm_queue_depth=tm_queue_depth)
 
 
 @dataclass

@@ -9,6 +9,7 @@ from the bulk data-plane mesh so control never queues behind tensor bytes).
 from __future__ import annotations
 
 import struct
+import time
 
 from .controller import Transport
 from .message import RequestList, ResponseList
@@ -35,6 +36,10 @@ class TcpTransport(Transport):
         self.mesh = mesh
         self.rank = mesh.rank
         self.size = mesh.size
+        # Coordinator-side: monotonic arrival time of each rank's last
+        # gathered RequestList (telemetry straggler signal; the controller
+        # reads it via getattr so LocalTransport needs no counterpart).
+        self.last_gather_arrivals: dict[int, float] = {}
 
     # -- bitvector sync (reference: gloo_controller.cc bitwise ops) ------
     def bitwise_sync(self, and_word: int, or_word: int) -> tuple[int, int]:
@@ -67,9 +72,12 @@ class TcpTransport(Transport):
             # rank-indexed — arrival order never leaks downstream.
             lists: list[RequestList | None] = [None] * self.size
             lists[0] = request_list
+            arrivals = {0: time.monotonic()}
             for peer, raw in self.mesh.recv_in_arrival_order(
                     range(1, self.size)):
+                arrivals[peer] = time.monotonic()
                 lists[peer] = RequestList.from_bytes(raw)
+            self.last_gather_arrivals = arrivals
             return lists
         self.mesh.send(0, request_list.to_bytes())
         return None

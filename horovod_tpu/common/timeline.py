@@ -76,8 +76,15 @@ class Timeline:
             self._negotiating.clear()
             self._open_acts.clear()
             self._queue.put(None)
-        if self._writer is not None:
-            self._writer.join(timeout=5)
+            writer, self._writer = self._writer, None
+        if writer is not None:
+            # Unbounded join AFTER poisoning the queue: the writer exits
+            # as soon as it drains to the sentinel, and the file below is
+            # only closed once it has — a bounded join could return with
+            # the writer mid-drain and close the file under its write
+            # (the pre-fix race; the writer's own closed-file guard in
+            # _flush_pending is defense in depth, not the contract).
+            writer.join()
         if self._file is not None:
             self._file.write("\n]\n")
             self._file.close()
@@ -168,16 +175,51 @@ class Timeline:
             self._emit({"name": "CYCLE", "ph": "i", "ts": self._ts(),
                         "pid": 0, "s": "g"})
 
+    def counter(self, name: str, values: dict) -> None:
+        """Chrome-trace counter track ("ph":"C"): queue depth, wire
+        bytes, ... render as stacked area series alongside the spans
+        (telemetry layer; docs/observability.md)."""
+        if not self._active:
+            return
+        self._emit({"name": name, "ph": "C", "ts": self._ts(), "pid": 0,
+                    "args": dict(values)})
+
     # -- writer thread --------------------------------------------------
+    # Flush policy: the pre-batching writer flushed after EVERY event, so
+    # heavy tracing perturbed the data plane it was measuring.  Events now
+    # accumulate and hit the file when a batch fills, on CYCLE marks
+    # (a consistent cut point for live tailing), or when the queue goes
+    # momentarily idle — so a reader after stop() still sees everything
+    # (stop() joins the drained writer before closing the file).
+    _WRITE_BATCH = 64
+
     def _write_loop(self) -> None:
         first = True
+        pending: list[str] = []
         while True:
             event = self._queue.get()
             if event is None:
                 break
             line = json.dumps(event)
-            if not first:
-                line = ",\n" + line
+            pending.append(line if first else ",\n" + line)
             first = False
-            self._file.write(line)
-            self._file.flush()
+            if (len(pending) >= self._WRITE_BATCH
+                    or event.get("name") == "CYCLE"
+                    or self._queue.empty()):
+                self._flush_pending(pending)
+        self._flush_pending(pending)
+
+    def _flush_pending(self, pending: list[str]) -> None:
+        if not pending:
+            return
+        f = self._file
+        if f is None:
+            return
+        try:
+            f.write("".join(pending))
+            f.flush()
+        except ValueError:
+            # File closed under us: only reachable if stop()'s join
+            # contract is violated; drop rather than crash the writer.
+            pass
+        pending.clear()

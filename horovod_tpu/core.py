@@ -200,6 +200,9 @@ class GlobalState:
     active_streams: int = 1
     handle_manager: HandleManager = field(default_factory=HandleManager)
     timeline: Timeline | None = None
+    # Metrics registry (telemetry/; HOROVOD_METRICS).  Null when off so
+    # hot paths test one attribute and skip all instrumentation.
+    telemetry: Any = None
     parameter_manager: Any = None
     cycle_time_ms: float = 1.0
     joined: bool = False
@@ -260,6 +263,10 @@ def init(*, rank: int | None = None, size: int | None = None,
         cross_size = _resolve(cross_size, config.CROSS_SIZE, 1)
 
         configure_logging(rank)
+        # Telemetry registry BEFORE any mesh/controller construction —
+        # they cache metric handles from the configured registry.
+        from . import telemetry as _telemetry
+        _global.telemetry = _telemetry.configure(rank)
         _global.rank, _global.size = rank, size
         _global.local_rank, _global.local_size = local_rank, local_size
         _global.cross_rank, _global.cross_size = cross_rank, cross_size
@@ -462,6 +469,11 @@ def init(*, rank: int | None = None, size: int | None = None,
             _global.parameter_manager = ParameterManager(
                 _global.controller, rank == 0)
 
+        if _global.telemetry.enabled and config.METRICS_PORT.get() > 0:
+            from .telemetry import MetricsExporter
+            _global.resources.append(MetricsExporter(
+                _global.telemetry, rank, config.METRICS_PORT.get()))
+
         _global.background_thread = threading.Thread(
             target=_background_loop, daemon=True, name="hvd-background")
         _global.initialized = True
@@ -493,6 +505,16 @@ def shutdown() -> None:
             _global.stream_dispatcher = None
         if _global.timeline is not None:
             _global.timeline.stop()
+        if _global.telemetry is not None and _global.telemetry.enabled:
+            metrics_file = config.METRICS_FILE.get()
+            if metrics_file:
+                from .telemetry import dump_json
+                try:
+                    dump_json(_global.telemetry, metrics_file,
+                              _global.rank)
+                except OSError as exc:
+                    logger.warning("telemetry: metrics dump to %s "
+                                   "failed: %s", metrics_file, exc)
         for res in _global.resources:
             try:
                 res.close()
@@ -566,6 +588,20 @@ def stop_timeline() -> None:
 # ---------------------------------------------------------------------------
 def _background_loop() -> None:
     st = _global
+    tm = st.telemetry
+    tm_on = tm is not None and tm.enabled
+    if tm_on:
+        # Metric handles resolved once — the per-cycle cost is the update
+        # itself (one uncontended per-metric lock), nothing else.
+        m_cycle = tm.histogram(
+            "horovod_controller_cycle_ms",
+            "Background-loop cycle wall time (pop + sync + dispatch)")
+        m_qdepth = tm.gauge(
+            "horovod_controller_tensor_queue_depth",
+            "Pending tensor-table entries after dispatch")
+        m_fill = tm.histogram(
+            "horovod_fusion_fill_ratio",
+            "Fused-response payload bytes / fusion threshold")
     while True:
         t0 = time.monotonic()
         try:
@@ -598,13 +634,19 @@ def _background_loop() -> None:
 
         total_bytes = 0
         tensor_names: list[str] = []
+        fusion_threshold = st.controller.fusion_threshold_bytes() \
+            if tm_on else 0
         for response in response_list.responses:
             if response.response_type in (ResponseType.ALLREDUCE,
                                           ResponseType.ADASUM):
                 from .common.dtypes import element_size
-                total_bytes += sum(response.tensor_sizes) * \
+                resp_bytes = sum(response.tensor_sizes) * \
                     element_size(response.tensor_type)
+                total_bytes += resp_bytes
                 tensor_names.extend(response.tensor_names)
+                if tm_on and fusion_threshold > 0 and \
+                        len(response.tensor_names) > 1:
+                    m_fill.observe(resp_bytes / fusion_threshold)
 
         # Autotune: coordinator scores the window and proposes new params;
         # every rank applies parameters broadcast through the ResponseList.
@@ -626,6 +668,24 @@ def _background_loop() -> None:
             return
 
         elapsed = time.monotonic() - t0
+        if tm_on:
+            m_cycle.observe(elapsed * 1e3)
+            st.controller.record_cycle(elapsed * 1e3)
+            m_qdepth.set(st.tensor_queue.size())
+        timeline = st.timeline
+        if timeline is not None and timeline.enabled \
+                and response_list.responses:
+            # Counter tracks ("ph":"C") render queue depth and cumulative
+            # wire bytes as series in the trace, next to the op spans.
+            timeline.counter("tensor_queue_depth",
+                             {"depth": st.tensor_queue.size()})
+            if st.tcp_collectives:
+                timeline.counter(
+                    "wire_bytes",
+                    {"sent": sum(c.mesh.bytes_sent
+                                 for c in st.tcp_collectives),
+                     "received": sum(c.mesh.bytes_received
+                                     for c in st.tcp_collectives)})
         sleep_s = st.cycle_time_ms / 1000.0 - elapsed
         if sleep_s > 0:
             # Wake early on fresh enqueues (cached single-op latency is
@@ -681,10 +741,19 @@ def _execute_response(st: GlobalState, response: Response,
     if response.response_type == ResponseType.ERROR:
         status = Status.precondition_error(response.error_message)
     else:
+        tm = st.telemetry
+        tm_on = tm is not None and tm.enabled
         try:
             manager = st.op_managers[stream] if st.op_managers \
                 else st.op_manager
+            if tm_on:
+                backend = manager.resolve(response, entries)
+                plane = backend.name if backend is not None else "none"
+                t0 = time.monotonic()
             status = manager.execute_operation(response, entries)
+            if tm_on:
+                _observe_collective(tm, response, plane, stream,
+                                    (time.monotonic() - t0) * 1e3)
         except Exception as exc:  # noqa: BLE001 - backend failure
             logger.error("collective execution failed: %s", exc)
             status = Status.unknown_error(str(exc))
@@ -700,6 +769,34 @@ def _execute_response(st: GlobalState, response: Response,
 
     for e in entries:
         e.finish(status)
+
+
+def _observe_collective(tm, response: Response, plane: str, stream: int,
+                        latency_ms: float) -> None:
+    """Per-plane/per-codec collective latency+bytes and per-stream busy
+    time (registry lookups are dict hits; metric objects are cached by
+    the registry itself)."""
+    from .common.dtypes import element_size
+    from .compress import CompressionCodec, codec_name
+    op = response.response_type.name.lower()
+    codec = codec_name(CompressionCodec(response.codec))
+    tm.histogram(
+        "horovod_collective_latency_ms",
+        "End-to-end latency of one executed response, by data plane, "
+        "op and wire codec",
+        labels={"plane": plane, "op": op, "codec": codec}
+    ).observe(latency_ms)
+    tm.counter(
+        "horovod_collective_bytes_total",
+        "Uncompressed payload bytes of executed responses (allgather "
+        "counts per-rank first dims as elements)",
+        labels={"plane": plane, "op": op}
+    ).inc(sum(response.tensor_sizes)
+          * element_size(response.tensor_type))
+    tm.counter(
+        "horovod_stream_busy_ms_total",
+        "Cumulative execution time on each dispatch stream",
+        labels={"stream": str(stream)}).inc(latency_ms)
 
 
 def _perform_operation(st: GlobalState, response: Response) -> None:

@@ -392,6 +392,7 @@ class PeerMesh:
                  scope: str = "mesh", timeout: float = 30.0) -> None:
         self.rank = rank
         self.size = size
+        self.scope = scope
         self._socks: dict[int, socket.socket] = {}
         self._channels: dict[int, _PeerChannel] = {}
         self._lock = threading.Lock()
@@ -400,6 +401,20 @@ class PeerMesh:
         # (tests/test_compress.py) and PERFORMANCE.md numbers come from.
         self.bytes_sent = 0
         self.bytes_received = 0
+        # Telemetry (HOROVOD_METRICS): per-peer wire counters + send-queue
+        # depth, labelled by mesh scope so control/data/stream meshes stay
+        # distinguishable.  Null registry when off — per-call cost is one
+        # attribute test on _tm_on.
+        from ..telemetry import metrics as _tm_metrics
+        self._tm = _tm_metrics()
+        self._tm_on = self._tm.enabled
+        self._tm_sent: dict[int, object] = {}
+        self._tm_recv: dict[int, object] = {}
+        self._tm_qdepth = self._tm.histogram(
+            "horovod_tcp_send_queue_depth",
+            "Outbound frames queued on a peer's persistent sender lane "
+            "at enqueue time", labels={"mesh": scope}) if self._tm_on \
+            else None
         if size == 1:
             return
 
@@ -481,18 +496,47 @@ class PeerMesh:
         with self._lock:
             self.bytes_received += nbytes
 
+    # -- per-peer telemetry counters (lazily created per peer) ----------
+    def _tm_peer(self, table: dict, name: str, peer: int):
+        c = table.get(peer)
+        if c is None:
+            c = self._tm.counter(
+                name, "Payload bytes on the wire by peer rank "
+                "(framing excluded)",
+                labels={"mesh": self.scope, "peer": str(peer)})
+            table[peer] = c
+        return c
+
+    def _tm_count_sent(self, peer: int, nbytes: int) -> None:
+        self._tm_peer(self._tm_sent,
+                      "horovod_tcp_bytes_sent_total", peer).inc(nbytes)
+
+    def _tm_count_recv(self, peer: int, nbytes: int) -> None:
+        self._tm_peer(self._tm_recv,
+                      "horovod_tcp_bytes_received_total", peer).inc(nbytes)
+
     def send(self, peer: int, payload: bytes) -> None:
         self._count_sent(self._channels[peer].send_sync(payload))
+        if self._tm_on:
+            self._tm_count_sent(peer, len(payload))
 
     def send_async(self, peer: int, payload) -> None:
         """Enqueue a framed message on the peer's persistent sender lane
         (counted by the lane on completion).  Zero-copy: the payload
         buffer must stay unmutated until `flush()`."""
-        self._channels[peer].send_async(payload)
+        ch = self._channels[peer]
+        ch.send_async(payload)
+        if self._tm_on:
+            # Depth AFTER the put: what's now waiting on the lane.
+            if ch._queue is not None:
+                self._tm_qdepth.observe(ch._queue.qsize())
+            self._tm_count_sent(peer, _as_byte_view(payload).nbytes)
 
     def recv(self, peer: int) -> bytearray:
         data = recv_msg(self._socks[peer])
         self._count_received(len(data))
+        if self._tm_on:
+            self._tm_count_recv(peer, len(data))
         return data
 
     # -- zero-copy receive surface (bulk data plane) --------------------
@@ -501,6 +545,8 @@ class PeerMesh:
         the caller must now consume via recv_raw_into/scratch."""
         n = self._channels[peer].recv_begin()
         self._count_received(n)
+        if self._tm_on:
+            self._tm_count_recv(peer, n)
         return n
 
     def recv_raw_into(self, peer: int, view: memoryview) -> None:

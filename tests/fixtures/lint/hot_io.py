@@ -1,0 +1,20 @@
+"""Seeded HVD1002 fixture: blocking I/O inside dispatch/backend hot-path
+functions (and a clean control in a non-hot function)."""
+
+
+def allreduce(response, entries):
+    print("executing", response)            # HVD1002: terminal write
+    with open("/tmp/hvd_trace.log", "a") as f:   # HVD1002: file open
+        f.write("allreduce\n")
+    return entries
+
+
+def _execute_response(state, response):
+    state.sock.sendall(b"payload")          # HVD1002: raw socket send
+    return response
+
+
+def load_config(path):
+    # Not a hot-path function: formation/CLI I/O stays legal.
+    with open(path) as f:
+        return f.read()

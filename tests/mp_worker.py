@@ -1677,8 +1677,70 @@ def battery_streams(hvd, rank, size):
         (before, threading.active_count())
 
 
+def battery_telemetry(hvd, rank, size):
+    """Observability layer end-to-end (ISSUE 4 acceptance): a 4-rank
+    HOROVOD_METRICS=on world serves a real Prometheus scrape with
+    per-plane latency histograms and per-peer byte counters, and with
+    rank size-1 delayed 50 ms per step the coordinator names that rank
+    as the straggler within two aggregation windows (window=8 via env)."""
+    import time as _time
+    import urllib.request
+
+    from horovod_tpu.core import _global
+    from horovod_tpu.telemetry import MetricsExporter
+
+    assert _global.telemetry.enabled
+    delayed = size - 1
+
+    # Unique names force one negotiation per step — the wire the arrival
+    # times and per-rank snapshots ride.  The delayed rank submits 50 ms
+    # behind its peers every step.
+    for step in range(20):
+        if rank == delayed:
+            _time.sleep(0.05)
+        out = hvd.allreduce(np.ones(64, np.float32), op=hvd.Sum,
+                            name=f"tm_{step}")
+        np.testing.assert_allclose(out, np.full(64, float(size)))
+
+    if rank == 0:
+        agg = _global.controller.straggler
+        assert agg is not None
+        assert agg.windows_completed >= 2, agg.windows_completed
+        assert agg.last_straggler == delayed, \
+            (agg.last_straggler, agg.last_skew_ms)
+        assert agg.last_skew_ms > 20.0, agg.last_skew_ms
+        g = _global.telemetry.gauge("horovod_controller_straggler_rank")
+        assert g.value == float(delayed), g.value
+
+    # Cached steady state exercises the hit counter + per-plane latency.
+    for _ in range(5):
+        hvd.allreduce(np.ones(32, np.float32), op=hvd.Sum,
+                      name="tm_steady")
+    assert _global.controller._m_cache_hit.value >= 3
+
+    # Real HTTP scrape of this rank's exporter.
+    exporter = next(r for r in _global.resources
+                    if isinstance(r, MetricsExporter))
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{exporter.port}/metrics",
+        timeout=10).read().decode()
+    assert "horovod_collective_latency_ms_bucket" in body
+    assert 'plane="tcp"' in body, body[:2000]
+    assert "horovod_tcp_bytes_sent_total" in body
+    assert "horovod_tcp_bytes_received_total" in body
+    if rank == 0:
+        # Coordinator re-exports every rank's snapshot + the straggler.
+        assert "horovod_controller_straggler_rank" in body
+        assert "horovod_rank_cycle_ms" in body
+    hvd.barrier()
+    # The JSON dump itself is written at shutdown; the parent test
+    # (test_multiprocess.test_telemetry_observability_4rank) asserts its
+    # contents after the world exits.
+
+
 BATTERIES = {
     "collectives": battery_collectives,
+    "telemetry": battery_telemetry,
     "streams": battery_streams,
     "matrix": battery_matrix,
     "autotune": battery_autotune,
@@ -1738,6 +1800,15 @@ def main() -> int:
         os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"
         os.environ["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = "2"
         os.environ["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = "3"
+    if battery == "telemetry":
+        os.environ["HOROVOD_METRICS"] = "on"
+        os.environ["HOROVOD_METRICS_WINDOW"] = "8"
+        os.environ["HOROVOD_STRAGGLER_THRESHOLD_MS"] = "10"
+        os.environ["HOROVOD_METRICS_PORT"] = "19730"   # +rank; ephemeral fallback
+        os.environ["HOROVOD_METRICS_FILE"] = \
+            f"/tmp/hvd_tm_{os.environ['HOROVOD_RENDEZVOUS_EPOCH']}.json"
+        # Pin the TCP plane so the per-peer byte counters see the traffic.
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
     if battery == "streams":
         # Two dispatch streams over the TCP plane; fusion off so async
         # bursts negotiate into SEVERAL responses per cycle (the unit the

@@ -73,6 +73,28 @@ def test_fixture_shared_state_write():
     assert _slugs(out) == ["shared-state-write"] * 2
 
 
+def test_fixture_hot_io():
+    """HVD1002: blocking I/O inside dispatch/backend hot-path functions
+    (ISSUE 4 satellite); the non-hot helper stays clean."""
+    out = lint_paths([os.path.join(FIXTURES, "hot_io.py")])
+    assert _slugs(out) == ["blocking-io-in-hot-path"] * 3
+    assert {"print", "open", "sendall"} == {
+        v.message.split("'")[1] for v in out}
+
+
+def test_telemetry_dir_blocking_io_needs_justification():
+    """Any function in a telemetry/ module must justify blocking I/O —
+    the tree's single justified suppression (the exporter's shutdown
+    dump) is covered by test_horovod_tpu_tree_is_clean."""
+    src = ("def serve(path):\n"
+           "    with open(path) as f:\n"
+           "        return f.read()\n")
+    out = lint_source(src, "horovod_tpu/telemetry/fake.py")
+    assert _slugs(out) == ["blocking-io-in-hot-path"]
+    # Same code outside telemetry/ and outside hot functions: clean.
+    assert lint_source(src, "horovod_tpu/runner/fake.py") == []
+
+
 def test_fixture_clean_has_zero_false_positives():
     out = lint_paths([os.path.join(FIXTURES, "clean.py")])
     assert out == [], "\n".join(v.text() for v in out)

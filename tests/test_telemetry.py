@@ -1,0 +1,330 @@
+"""telemetry/ unit tests (ISSUE 4): registry semantics + thread safety,
+Prometheus exposition golden file, straggler aggregation, exporter HTTP
+endpoint, JSON dump + report CLI, wire snapshot round-trip, timeline
+counter events, and the HOROVOD_METRICS=off no-op contract."""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.message import RequestList
+from horovod_tpu.common.timeline import Timeline
+from horovod_tpu.telemetry import (NULL_METRIC, NULL_REGISTRY,
+                                   MetricsExporter, MetricsRegistry,
+                                   StragglerAggregator, dump_json,
+                                   resolve_dump_path)
+from horovod_tpu.telemetry.registry import bucket_upper_bound
+from horovod_tpu.telemetry.report import (summarize_dump, summarize_file,
+                                          summarize_timeline)
+
+import os
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "telemetry")
+
+
+# --- registry ---------------------------------------------------------------
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry(0)
+    c = reg.counter("c_total", "help")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    # Same (name, labels) -> same object; different labels -> different.
+    assert reg.counter("c_total") is c
+    assert reg.counter("c_total", labels={"x": "1"}) is not c
+
+    g = reg.gauge("g")
+    g.set(2.5)
+    g.set(1.0)
+    assert g.value == 1.0
+
+    h = reg.histogram("h_ms")
+    for v in (0.5, 1.5, 3.0, 12.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(17.0)
+    assert h.mean == pytest.approx(4.25)
+    # log2 buckets: p50 falls in the <=2 bucket, p99 in the <=16 bucket.
+    assert h.percentile(50) == 2.0
+    assert h.percentile(99) == 16.0
+    bounds = [b for b, _ in h.nonzero_buckets()]
+    assert bounds == [0.5, 2.0, 4.0, 16.0]
+
+
+def test_histogram_bucket_edges():
+    reg = MetricsRegistry(0)
+    h = reg.histogram("edges")
+    h.observe(0.0)       # non-positive -> bucket 0
+    h.observe(-3.0)
+    h.observe(2.0 ** 50)  # beyond the top bound -> clamped to last bucket
+    assert h.count == 3
+    top = h.nonzero_buckets()[-1][0]
+    assert top == bucket_upper_bound(63)
+
+
+def test_registry_thread_safety_under_concurrent_workers():
+    """The stream-worker scenario: N threads hammering one counter and
+    one histogram concurrently must lose no updates."""
+    reg = MetricsRegistry(0)
+    c = reg.counter("hits_total")
+    h = reg.histogram("lat_ms")
+    n_threads, per_thread = 8, 5000
+
+    def worker(k):
+        for i in range(per_thread):
+            c.inc()
+            h.observe(float(i % 7) + 0.5)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per_thread
+    assert h.count == n_threads * per_thread
+    assert sum(n for _, n in h.nonzero_buckets()) == h.count
+
+
+def test_prometheus_exposition_golden_file():
+    reg = MetricsRegistry(0)
+    reg.counter("hvd_test_bytes_total", "Bytes moved",
+                labels={"peer": "1"}).inc(2048)
+    reg.counter("hvd_test_bytes_total", labels={"peer": "2"}).inc(1024)
+    reg.gauge("hvd_test_depth", "Queue depth").set(7)
+    h = reg.histogram("hvd_test_latency_ms", "Latency")
+    for v in (0.5, 1.5, 3.0, 12.0):
+        h.observe(v)
+    with open(os.path.join(FIXTURES, "exposition.prom")) as f:
+        golden = f.read()
+    assert reg.render_prometheus() == golden
+
+
+def test_null_registry_is_inert():
+    assert NULL_REGISTRY.enabled is False
+    assert NULL_REGISTRY.counter("x") is NULL_METRIC
+    assert NULL_REGISTRY.histogram("y") is NULL_METRIC
+    NULL_METRIC.inc(5)
+    NULL_METRIC.observe(1.0)
+    NULL_METRIC.set(2.0)
+    assert NULL_METRIC.value == 0.0
+    assert NULL_REGISTRY.snapshot()["metrics"] == []
+    assert NULL_REGISTRY.render_prometheus() == ""
+
+
+# --- straggler aggregation --------------------------------------------------
+def test_straggler_window_names_slowest_rank():
+    reg = MetricsRegistry(0)
+    agg = StragglerAggregator(4, reg, window=4, threshold_ms=5.0)
+    t0 = 1000.0
+    for _ in range(4):
+        agg.observe_tensor({0: t0, 1: t0 + 0.001, 2: t0 + 0.002,
+                            3: t0 + 0.050})
+        t0 += 1.0
+    assert agg.windows_completed == 1
+    assert agg.last_straggler == 3
+    assert 45.0 < agg.last_skew_ms < 55.0
+    assert reg.gauge("horovod_controller_straggler_rank").value == 3.0
+    assert reg.gauge("horovod_controller_straggler_lag_ms").value > 45.0
+    assert reg.counter(
+        "horovod_controller_straggler_windows_total").value == 1
+    p99 = reg.gauge("horovod_controller_negotiation_lag_ms",
+                    labels={"stat": "p99"}).value
+    assert 45.0 < p99 < 55.0
+
+
+def test_straggler_below_threshold_clears_gauge():
+    reg = MetricsRegistry(0)
+    agg = StragglerAggregator(2, reg, window=2, threshold_ms=5.0)
+    for _ in range(2):
+        agg.observe_tensor({0: 1.0, 1: 1.0 + 0.0005})   # 0.5 ms skew
+    assert agg.windows_completed == 1
+    assert reg.gauge("horovod_controller_straggler_rank").value == -1.0
+    assert reg.counter(
+        "horovod_controller_straggler_windows_total").value == 0
+
+
+def test_straggler_snapshot_gauges():
+    reg = MetricsRegistry(0)
+    agg = StragglerAggregator(2, reg, window=8)
+    gathered = [
+        RequestList(tm_cycles=10, tm_cycle_ms=25.0, tm_sync_wait_ms=5.0,
+                    tm_queue_depth=3),
+        RequestList(tm_cycles=5, tm_cycle_ms=50.0, tm_sync_wait_ms=0.5,
+                    tm_queue_depth=0),
+    ]
+    agg.observe_snapshots(gathered)
+    assert reg.gauge("horovod_rank_cycle_ms",
+                     labels={"rank": "0"}).value == pytest.approx(2.5)
+    assert reg.gauge("horovod_rank_cycle_ms",
+                     labels={"rank": "1"}).value == pytest.approx(10.0)
+    assert reg.gauge("horovod_rank_sync_wait_ms",
+                     labels={"rank": "1"}).value == pytest.approx(0.1)
+    assert reg.gauge("horovod_rank_queue_depth",
+                     labels={"rank": "0"}).value == 3.0
+
+
+# --- wire snapshot ----------------------------------------------------------
+def test_request_list_tm_fields_roundtrip():
+    rl = RequestList(tm_cycles=17, tm_cycle_ms=42.5,
+                     tm_sync_wait_ms=3.25, tm_queue_depth=9)
+    decoded = RequestList.from_bytes(rl.to_bytes())
+    assert decoded.tm_cycles == 17
+    assert decoded.tm_cycle_ms == 42.5
+    assert decoded.tm_sync_wait_ms == 3.25
+    assert decoded.tm_queue_depth == 9
+    # Defaults stay zero (metrics off ships an all-zero snapshot).
+    empty = RequestList.from_bytes(RequestList().to_bytes())
+    assert (empty.tm_cycles, empty.tm_cycle_ms,
+            empty.tm_sync_wait_ms, empty.tm_queue_depth) == (0, 0.0, 0.0, 0)
+
+
+# --- exporter + dump + report ----------------------------------------------
+def test_exporter_scrape_and_close():
+    from horovod_tpu.runner.network import free_port
+    reg = MetricsRegistry(0)
+    reg.counter("x_total", "help").inc(3)
+    exp = MetricsExporter(reg, rank=0, base_port=free_port())
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{exp.port}/metrics", timeout=10
+        ).read().decode()
+        assert "x_total 3" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/nope", timeout=10)
+    finally:
+        exp.close()
+
+
+def test_exporter_port_conflict_falls_back_to_ephemeral():
+    reg = MetricsRegistry(0)
+    a = MetricsExporter(reg, rank=0, base_port=0)   # ephemeral
+    try:
+        b = MetricsExporter(reg, rank=0, base_port=a.port)  # busy -> fallback
+        try:
+            assert b.port != a.port and b.port > 0
+        finally:
+            b.close()
+    finally:
+        a.close()
+
+
+def test_resolve_dump_path():
+    assert resolve_dump_path("/tmp/m_{rank}.json", 3) == "/tmp/m_3.json"
+    assert resolve_dump_path("/tmp/m.json", 2) == "/tmp/m.r2.json"
+    assert resolve_dump_path("/tmp/m", 1) == "/tmp/m.r1"
+
+
+def test_dump_json_and_report_cli(tmp_path):
+    reg = MetricsRegistry(1)
+    reg.counter("bytes_total", labels={"peer": "0"}).inc(100)
+    reg.histogram("lat_ms").observe(2.0)
+    path = dump_json(reg, str(tmp_path / "m.json"), 1)
+    assert path.endswith("m.r1.json")
+    out = summarize_file(path)
+    assert "bytes_total" in out and "lat_ms" in out
+    # Dump payload carries full histogram detail.
+    snap = json.loads((tmp_path / "m.r1.json").read_text())
+    hist = next(m for m in snap["metrics"] if m["name"] == "lat_ms")
+    assert hist["count"] == 1 and hist["buckets"] == [[2.0, 1]]
+
+
+def test_report_summarizes_timeline_spans():
+    events = [
+        {"ph": "B", "name": "ALLREDUCE", "ts": 0, "pid": 0, "tid": 0},
+        {"ph": "B", "name": "TCP_RING_ALLREDUCE", "ts": 100, "pid": 0,
+         "tid": 0},
+        {"ph": "E", "name": "", "ts": 4100, "pid": 0, "tid": 0},
+        {"ph": "E", "name": "", "ts": 5000, "pid": 0, "tid": 0},
+        {"ph": "C", "name": "tensor_queue_depth", "ts": 5000, "pid": 0,
+         "args": {"depth": 2}},
+    ]
+    out = summarize_timeline(events)
+    assert "ALLREDUCE" in out and "TCP_RING_ALLREDUCE" in out
+    assert "5.00" in out      # ALLREDUCE total 5 ms
+    assert "4.00" in out      # nested ring span 4 ms
+    assert "tensor_queue_depth" in out
+
+
+def test_report_summarizes_empty_dump():
+    out = summarize_dump({"rank": 0, "metrics": []})
+    assert "HOROVOD_METRICS=on" in out
+
+
+# --- timeline counter events + batched writer -------------------------------
+def test_timeline_counter_events_and_batched_writer(tmp_path):
+    path = tmp_path / "tl.json"
+    tl = Timeline(str(path))
+    # Well past the write batch size: the writer must batch without
+    # losing events, and stop() must drain everything (unbounded join).
+    for i in range(200):
+        tl.activity_start(f"t{i % 5}", "OP")
+        tl.activity_end(f"t{i % 5}")
+    tl.counter("tensor_queue_depth", {"depth": 3})
+    tl.counter("wire_bytes", {"sent": 10, "received": 20})
+    tl.stop()
+    events = json.loads(path.read_text())
+    assert sum(1 for e in events if e.get("ph") == "B") == 200
+    assert sum(1 for e in events if e.get("ph") == "E") == 200
+    counters = [e for e in events if e.get("ph") == "C"]
+    assert len(counters) == 2
+    assert counters[0]["args"] == {"depth": 3}
+    assert counters[1]["args"] == {"sent": 10, "received": 20}
+    assert all("ts" in e for e in counters)
+
+
+# --- HOROVOD_METRICS=off no-op contract -------------------------------------
+def test_metrics_off_world_is_noop(monkeypatch):
+    """With the knob off: Null registry, no exporter thread, no metrics
+    anywhere — the thread census is exactly the no-telemetry baseline."""
+    monkeypatch.delenv("HOROVOD_METRICS", raising=False)
+    monkeypatch.delenv("HOROVOD_METRICS_PORT", raising=False)
+    monkeypatch.delenv("HOROVOD_METRICS_FILE", raising=False)
+    import horovod_tpu as hvd
+    from horovod_tpu import core
+
+    before = {t.name for t in threading.enumerate()}
+    hvd.init()
+    try:
+        st = core.global_state()
+        assert st.telemetry is NULL_REGISTRY
+        out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                            name="tm_off")
+        np.testing.assert_allclose(out, np.ones(4))
+        after = {t.name for t in threading.enumerate()}
+        assert "hvd-metrics" not in after
+        # Only the background loop was added to the census.
+        assert after - before <= {"hvd-background"}, after - before
+        assert st.telemetry.snapshot()["metrics"] == []
+    finally:
+        hvd.shutdown()
+
+
+def test_metrics_on_world_records(monkeypatch):
+    monkeypatch.setenv("HOROVOD_METRICS", "on")
+    monkeypatch.delenv("HOROVOD_METRICS_PORT", raising=False)
+    import horovod_tpu as hvd
+    from horovod_tpu import core, telemetry
+
+    hvd.init()
+    try:
+        st = core.global_state()
+        assert st.telemetry.enabled
+        for i in range(3):
+            hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum,
+                          name="tm_on")
+        names = {m["name"] for m in st.telemetry.snapshot()["metrics"]}
+        assert "horovod_controller_cycle_ms" in names
+        assert "horovod_collective_latency_ms" in names
+        assert "horovod_controller_cache_hit_total" in names
+        summ = telemetry.summary()
+        assert summ["cache_hit_rate"] > 0.0
+        assert "stream_busy_ms" in summ
+    finally:
+        hvd.shutdown()
