@@ -22,7 +22,7 @@ import numpy as np
 
 from . import core
 from .common.exceptions import (HorovodInternalError, HorovodTpuError,
-                                HostsUpdatedInterrupt)
+                                HostsUpdatedInterrupt, RanksFailedError)
 from .common.status import Status
 from .core import (Handle, init, is_initialized, shutdown, rank, size,
                    local_rank, local_size, cross_rank, cross_size,
@@ -367,3 +367,13 @@ def mpi_enabled() -> bool:
 
 def mpi_threads_supported() -> bool:
     return False
+
+
+# --- Resilience surface (resilience/; docs/resilience.md) -------------------
+def run_with_recovery(fn, *, policy=None, max_retries=None,
+                      base_backoff=None):
+    """Run an idempotent eager collective under HOROVOD_ON_FAILURE
+    (raise | retry-with-rebuilt-channels | shrink-via-elastic)."""
+    from .resilience import run_with_recovery as _rwr
+    return _rwr(fn, policy=policy, max_retries=max_retries,
+                base_backoff=base_backoff)

@@ -35,6 +35,14 @@ collectives in the same order (SURVEY §5.2):
   anywhere inside a ``telemetry/`` module — per-op file/terminal I/O
   perturbs the very latencies the observability layer measures (the
   timeline's own writer batches+flushes off-thread for this reason).
+- ``HVD1003 unbounded-blocking-wait``: ``recv``/``join``/``wait``/
+  ``urlopen`` without a timeout/deadline argument (keyword, or a
+  positional whose name carries ``timeout``/``deadline``/``poll``) in
+  ``backend/``, ``common/tcp_transport.py`` or ``runner/network.py`` —
+  the exact waits a dead or wedged peer turns into a whole-job
+  deadlock; the resilience/ subsystem bounds them (docs/resilience.md),
+  and every surviving unbounded wait must justify its bound with a
+  suppression.  ``str.join``/``os.path.join`` are lexically exempt.
 
 Heuristics are deliberately lexical (no type inference): a flagged line
 that is provably safe carries ``# hvdlint: disable=<rule> -- <why>``;
@@ -119,6 +127,15 @@ HOT_IO_FUNCS = frozenset({
 })
 TELEMETRY_DIRS = frozenset({"telemetry"})
 
+# HVD1003: blocking primitives that must carry a timeout/deadline (or a
+# justified suppression) inside the transport/backend modules — the
+# layers where an unbounded wait on a dead/wedged peer deadlocks the
+# whole job (resilience/ converts them into RanksFailedError instead).
+WAIT_NAMES = frozenset({"recv", "recv_into", "join", "wait", "urlopen"})
+WAIT_DIRS = frozenset({"backend"})
+WAIT_BASENAMES = frozenset({"tcp_transport.py", "network.py"})
+_BOUND_HINTS = ("timeout", "deadline", "poll")
+
 
 @dataclass
 class LintConfig:
@@ -191,6 +208,9 @@ class _Analyzer(ast.NodeVisitor):
         self._in_telemetry_dir = bool(
             TELEMETRY_DIRS
             & set(os.path.normpath(path).split(os.sep)[:-1]))
+        self._in_wait_scope = bool(
+            WAIT_DIRS & set(os.path.normpath(path).split(os.sep)[:-1])
+        ) or os.path.basename(path) in WAIT_BASENAMES
         self._func_stack: list[str] = []
         self._rank_gate_depth = 0
         self._gate_lines: list[int] = []     # lineno of each active gate
@@ -319,7 +339,56 @@ class _Analyzer(ast.NodeVisitor):
                 "(PeerMesh.send_async) instead")
         if name in BLOCKING_IO_NAMES:
             self._check_blocking_io(node, name)
+        if name in WAIT_NAMES and self._in_wait_scope:
+            self._check_unbounded_wait(node, name)
         self.generic_visit(node)
+
+    # --- HVD1003: unbounded blocking waits ---------------------------------
+    @staticmethod
+    def _wait_is_exempt(node: ast.Call, name: str) -> bool:
+        """str.join / os.path.join etc. are not waits: exempt a `join`
+        whose receiver is a string literal or an attribute spine through
+        `path`/`sep` (lexical, like every other rule here)."""
+        if name != "join" or not isinstance(node.func, ast.Attribute):
+            return False
+        base = node.func.value
+        if isinstance(base, ast.Constant) and isinstance(base.value, str):
+            return True
+        spine = set()
+        while isinstance(base, ast.Attribute):
+            spine.add(base.attr)
+            base = base.value
+        if isinstance(base, ast.Name):
+            spine.add(base.id)
+        return bool(spine & {"path", "sep", "pathsep", "linesep",
+                             "os", "posixpath", "ntpath"})
+
+    @staticmethod
+    def _call_is_bounded(node: ast.Call) -> bool:
+        for kw in node.keywords:
+            if kw.arg and any(h in kw.arg.lower() for h in _BOUND_HINTS):
+                return True
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                ident = sub.id if isinstance(sub, ast.Name) else (
+                    sub.attr if isinstance(sub, ast.Attribute) else None)
+                if ident and any(h in ident.lower()
+                                 for h in _BOUND_HINTS):
+                    return True
+        return False
+
+    def _check_unbounded_wait(self, node: ast.Call, name: str) -> None:
+        if self._wait_is_exempt(node, name):
+            return
+        if self._call_is_bounded(node):
+            return
+        self._report(
+            "unbounded-blocking-wait", node,
+            f"blocking call '{name}' has no timeout/deadline argument; "
+            f"in a transport/backend module an unbounded wait turns a "
+            f"dead or wedged peer into a whole-job deadlock — pass a "
+            f"timeout, derive a deadline from the ResilienceContext "
+            f"(resilience/), or justify the bound with a suppression")
 
     def _check_blocking_io(self, node: ast.Call, name: str) -> None:
         hot_fn = next((fn for fn in self._func_stack

@@ -63,6 +63,13 @@ _RULE_LIST = [
          "on the dispatch path perturbs the very latencies the "
          "observability layer measures — route output through the "
          "timeline's async writer or the telemetry exporter thread."),
+    Rule("HVD1003", "unbounded-blocking-wait",
+         "recv/join/wait/urlopen without a timeout/deadline argument in "
+         "a transport or backend module: an unbounded wait is how a "
+         "dead or wedged peer turns into a whole-job deadlock — bound "
+         "it with a timeout, derive a deadline from the "
+         "ResilienceContext (resilience/), or justify why the wait is "
+         "bounded elsewhere with a suppression."),
 ]
 
 RULES: dict[str, Rule] = {}

@@ -133,18 +133,28 @@ class ShmWorld:
     """
 
     def __init__(self, rank: int, size: int, kv, scope: str,
-                 capacity: int, timeout: float = 30.0) -> None:
+                 capacity: int, timeout: float = 30.0,
+                 resilience=None) -> None:
         self.rank = rank
         self.size = size
         self.capacity = capacity
         self.timeout = timeout
+        # Resilience (HOROVOD_FAULT_TOLERANCE): when on, the lockstep
+        # barrier deadline derives from the per-op ResilienceContext
+        # (one fault window) instead of the 600 s default, and the
+        # liveness poll additionally consults the heartbeat monitor so a
+        # WEDGED peer (PID alive, collective abandoned) is detected too.
+        from ..resilience import active_state
+        self._res = resilience if resilience is not None \
+            else active_state()
         # Inter-op barrier deadline is deliberately MUCH larger than the
         # formation timeout: a live-but-slow peer (rank-0 checkpointing,
         # evaluation, CPU starvation) must not kill training — the 0.5 s
         # PID-liveness poll is the fail-fast path for actual death, and
         # one-sided submissions are the stall inspector's job upstream.
         self.barrier_timeout = float(os.environ.get(
-            "HOROVOD_SHM_BARRIER_TIMEOUT_SECONDS", "600"))
+            "HOROVOD_SHM_BARRIER_TIMEOUT_SECONDS", "600")) \
+            if self._res is None else self._res.op_timeout()
         self._maps: list[mmap.mmap | None] = [None] * size
         self._seqs: list[np.ndarray | None] = [None] * size
         self._splits: list[np.ndarray | None] = [None] * size
@@ -332,12 +342,21 @@ class ShmWorld:
                     try:
                         os.kill(pid, 0)
                     except OSError:
-                        raise ConnectionError(
-                            f"shm peer rank {r} (pid {pid}) died")
+                        self._peer_died(r, pid)
+                if self._res is not None:
+                    # Heartbeat-declared failures (a peer wedged with its
+                    # PID alive, or a death another rank witnessed first)
+                    # convert this barrier too — same detection window as
+                    # the socket planes.
+                    failed = self._res.failed_ranks()
+                    if failed:
+                        self.poison()
+                        from ..common.exceptions import RanksFailedError
+                        from ..resilience import current_op
+                        raise RanksFailedError(
+                            failed, op=current_op(), phase="shm_barrier")
                 if now > deadline:
-                    raise TimeoutError(
-                        f"shm barrier target {target} not reached within "
-                        f"{self.barrier_timeout}s")
+                    self._barrier_deadline(target, seqs)
             # Small-op barriers resolve within a scheduling quantum:
             # yield-spin briefly.  Past that, the peer is mid-copy on a
             # core we may share — REALLY sleep (escalating to 1 ms) so it
@@ -347,6 +366,44 @@ class ShmWorld:
                 time.sleep(0)
             else:
                 time.sleep(min(max(waited / 4, 0.0004), 0.001))
+
+    def _peer_died(self, r: int, pid: int) -> None:
+        """PID-liveness verdict: always a RanksFailedError (a
+        ConnectionError subclass, so pre-resilience handlers and the
+        elastic loop both keep working); with fault tolerance on the
+        death is also published to the liveness table so distant ranks
+        attribute their own stalls to rank `r` within one poll."""
+        from ..common.exceptions import RanksFailedError
+        from ..resilience import current_op
+        if self._res is not None:
+            self._res.mark_failed(r, f"shm peer pid {pid} died")
+        raise RanksFailedError(
+            frozenset({r}), op=current_op(), phase="shm_barrier",
+            message=f"shm peer rank {r} (pid {pid}) died")
+
+    def _barrier_deadline(self, target: int, seqs: list[int]) -> None:
+        """Deadline expiry: attribute the stall to the ranks still below
+        the barrier target instead of a bare timeout (with resilience
+        off this keeps the historical TimeoutError type)."""
+        lagging = sorted(
+            r for r, s in enumerate(seqs)
+            if r != self.rank
+            and (s - _POISON if s >= _POISON else s) < target)
+        if self._res is None:
+            raise TimeoutError(
+                f"shm barrier target {target} not reached within "
+                f"{self.barrier_timeout}s (lagging ranks: {lagging})")
+        from ..common.exceptions import RanksFailedError
+        from ..resilience import current_op
+        for r in lagging:
+            self._res.mark_failed(
+                r, f"shm barrier target {target} missed for "
+                   f"{self.barrier_timeout:g}s", confirmed=False)
+        raise RanksFailedError(
+            frozenset(lagging), op=current_op(), phase="shm_barrier",
+            message=f"shm barrier target {target} not reached within "
+                    f"{self.barrier_timeout:g}s; lagging ranks {lagging} "
+                    f"are alive but absent from the collective (wedged).")
 
     def data(self, r: int) -> np.ndarray:
         return self._datas[r]   # type: ignore[return-value]

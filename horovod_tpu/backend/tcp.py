@@ -76,7 +76,7 @@ class TcpCollectives:
         on filled socket buffers: the send streams on the peer's
         persistent sender lane while this thread blocks in recv."""
         self.mesh.send_async(to_rank, payload)
-        return self.mesh.recv(from_rank)
+        return self.mesh.recv(from_rank)  # hvdlint: disable=unbounded-blocking-wait -- bounded inside the peer channel (socket poll timeout + op deadline under HOROVOD_FAULT_TOLERANCE)
 
     def _recv_accum(self, frm: int, acc_slice: np.ndarray) -> None:
         """Receive one ring chunk from `frm`, adding it into `acc_slice`
@@ -140,13 +140,21 @@ class TcpCollectives:
         # Native C++ ring (same schedule, GIL released, SIMD adds); falls
         # through to the Python ring for unsupported dtypes/toolchains.
         # It writes the raw fds directly, so queued frames from a previous
-        # op's final leg must drain first.
+        # op's final leg must drain first.  EXCLUDED under fault
+        # tolerance/chaos: the C loop blocks on raw fds (it cannot honor
+        # the per-op deadline, and the resilience socket timeouts put the
+        # fds in non-blocking mode), and chaos send hooks never see its
+        # traffic — the deadline-bounded Python ring is the resilient
+        # path (docs/resilience.md).
         from .. import native
         acc = np.ascontiguousarray(acc)
         self.mesh.flush()
-        if native.ring_allreduce(self.mesh._socks[nxt].fileno(),
-                                 self.mesh._socks[prv].fileno(),
-                                 acc, rank, size):
+        native_ok = (self.mesh._resilience is None
+                     and self.mesh._chaos is None)
+        if native_ok and \
+                native.ring_allreduce(self.mesh._socks[nxt].fileno(),
+                                      self.mesh._socks[prv].fileno(),
+                                      acc, rank, size):
             # The native path writes the raw fds directly; account its
             # known ring volume so the mesh byte counters stay truthful
             # (2(N-1) chunk sends per rank, uneven chunk split).

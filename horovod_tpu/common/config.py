@@ -167,6 +167,46 @@ STRAGGLER_THRESHOLD_MS = register(
     "which the coordinator logs a structured straggler warning and sets "
     "the straggler-rank gauge.")
 
+# --- Resilience (resilience/ subsystem; docs/resilience.md) -----------------
+FAULT_TOLERANCE = register(
+    "HOROVOD_FAULT_TOLERANCE", False, _parse_bool,
+    "Failure detection + deadline-bounded collectives: heartbeats over "
+    "the rendezvous liveness table, socket-level deadlines on every "
+    "blocking collective wait, and structured RanksFailedError instead "
+    "of a hang when a peer dies or wedges.  Off (the default) keeps "
+    "every hot path byte-identical to the pre-resilience behavior: no "
+    "monitor thread, no socket timeouts, no per-recv branches beyond "
+    "one None test.")
+FAULT_TIMEOUT = register(
+    "HOROVOD_FAULT_TIMEOUT", 30.0, float,
+    "Failure-detection window in seconds: a peer whose heartbeat stops "
+    "advancing for this long is declared failed, and a blocking "
+    "collective wait that exceeds it raises RanksFailedError naming the "
+    "unresponsive peer.  Also the default per-op deadline of the "
+    "ResilienceContext.")
+ON_FAILURE = register(
+    "HOROVOD_ON_FAILURE", "raise", str,
+    "Recovery policy applied by resilience.run_with_recovery when a "
+    "collective raises RanksFailedError: raise (safe default) | retry "
+    "(re-run an idempotent eager collective with exponential backoff "
+    "over rebuilt channels, only while every rank is still live) | "
+    "shrink (hand the surviving-rank set to the elastic driver for a "
+    "world-resize and blacklist the dead host).")
+FAULT_RETRIES = register(
+    "HOROVOD_FAULT_RETRIES", 3, int,
+    "Maximum retry attempts under HOROVOD_ON_FAILURE=retry.")
+FAULT_BACKOFF_SECONDS = register(
+    "HOROVOD_FAULT_BACKOFF_SECONDS", 0.5, float,
+    "Base of the exponential retry backoff (attempt k sleeps "
+    "base * 2**k seconds).")
+CHAOS = register(
+    "HOROVOD_CHAOS", "", str,
+    "Deterministic fault-injection spec (resilience/chaos.py): "
+    "';'-separated actions 'kind:key=val,...' — kill/freeze/fail at a "
+    "global collective index, delay/drop/dup a specific peer-channel "
+    "send.  Empty (the default) installs nothing.  See "
+    "docs/resilience.md for the grammar.")
+
 # --- Collective fingerprinting (analysis/fingerprint.py) --------------------
 FINGERPRINT = register(
     "HOROVOD_FINGERPRINT", "off", str,

@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 from . import config
 from ..analysis.fingerprint import FingerprintTracker, OpRecord
 from .dtypes import element_size
+from .exceptions import RanksFailedError
 from .group_table import GroupTable
 from .message import (Request, RequestList, RequestType, Response,
                       ResponseList, ResponseType)
@@ -287,16 +288,16 @@ class Controller:
             # that keeps all ranks advancing together (reference:
             # controller.cc:751-776 CoordinateCacheAndState).
             and_word, or_word = coordinator.pack()
-            if self.metrics.enabled:
-                t0 = time.monotonic()
+            t0 = time.monotonic() if self.metrics.enabled else 0.0
+            try:
                 and_word, or_word = self.transport.bitwise_sync(and_word,
                                                                 or_word)
+            except RanksFailedError as exc:
+                return self._poison_response_list(exc)
+            if self.metrics.enabled:
                 wait_ms = (time.monotonic() - t0) * 1e3
                 self._m_sync_wait_ms.observe(wait_ms)
                 self._tm_sync_wait_ms += wait_ms
-            else:
-                and_word, or_word = self.transport.bitwise_sync(and_word,
-                                                                or_word)
             coordinator.unpack(and_word, or_word)
 
             if coordinator.shutdown:
@@ -335,6 +336,12 @@ class Controller:
             return ResponseList(responses=self.fuse_responses(cached_responses))
 
         response_list = self._negotiate(message_queue, shutdown_requested)
+        if self._is_poison(response_list):
+            # World poisoned mid-negotiation (resilience/): drop this
+            # cycle's cached hits — their data-plane execution would
+            # block on the dead rank; the poison ERROR already names
+            # every pending tensor, so no waiter is left hanging.
+            return response_list
         response_list.responses = (self.fuse_responses(cached_responses)
                                    + response_list.responses)
 
@@ -346,6 +353,35 @@ class Controller:
         return response_list
 
     # ------------------------------------------------------------------
+    def _poison_response_list(self, exc: RanksFailedError) -> ResponseList:
+        """Convert a detected rank failure into the structured-ERROR
+        shutdown every rank performs locally (resilience/ tentpole): one
+        ERROR response naming EVERY tensor still pending in the local
+        table (so each blocked Handle raises RanksFailedError rather
+        than hanging or getting a generic abort), plus the shutdown
+        flag.  Rank-local tensor naming is safe here precisely because
+        ERROR responses never touch a data plane — nothing about this
+        list has to match across ranks.  The coordinator's transport has
+        already poison-broadcast the same failure to all survivors, so
+        the whole world converges within one detection window."""
+        names = sorted(set(self.tensor_queue.pending_names()))
+        for name in names:
+            self._message_table.pop(name, None)
+            self.stall_inspector.remove_uncached_tensor(name)
+        return ResponseList(
+            responses=[Response(response_type=ResponseType.ERROR,
+                                tensor_names=names,
+                                error_message=exc.to_wire())],
+            shutdown=True)
+
+    @staticmethod
+    def _is_poison(response_list: ResponseList) -> bool:
+        return (response_list.shutdown and bool(response_list.responses)
+                and response_list.responses[0].response_type
+                == ResponseType.ERROR
+                and RanksFailedError.matches(
+                    response_list.responses[0].error_message))
+
     def _maybe_cache(self, resp: Response) -> None:
         """Cache single-tensor non-error responses keyed by their request.
 
@@ -421,7 +457,12 @@ class Controller:
             self._attach_telemetry_snapshot(my_list, len(message_queue))
             t_neg = time.monotonic()
         if self.is_coordinator:
-            gathered = self.transport.gather_requests(my_list)
+            try:
+                gathered = self.transport.gather_requests(my_list)
+            except RanksFailedError as exc:
+                # The transport has already poison-broadcast to the
+                # survivors; this is the coordinator's local half.
+                return self._poison_response_list(exc)
             assert gathered is not None
             if self.straggler is not None:
                 self.straggler.observe_snapshots(gathered)
@@ -463,10 +504,18 @@ class Controller:
                 response_list.tuned_segment_bytes = segment
                 response_list.tuned_num_streams = streams
                 self.pending_tuned_pipeline = None
-            self.transport.broadcast_responses(response_list)
+            try:
+                self.transport.broadcast_responses(response_list)
+            except RanksFailedError as exc:
+                return self._poison_response_list(exc)
         else:
-            self.transport.gather_requests(my_list)
-            response_list = self.transport.broadcast_responses(None)
+            try:
+                self.transport.gather_requests(my_list)
+                response_list = self.transport.broadcast_responses(None)
+            except RanksFailedError as exc:
+                # Local detection (coordinator dead/unreachable) or a
+                # received poison frame: same structured local shutdown.
+                return self._poison_response_list(exc)
             for resp in response_list.responses:
                 if resp.response_type == ResponseType.JOIN:
                     self.joined_ranks.clear()

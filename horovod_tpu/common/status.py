@@ -48,6 +48,15 @@ class Status:
         return Status(StatusType.INVALID_ARGUMENT, msg)
 
     @staticmethod
+    def ranks_failed(exc) -> "Status":
+        """A collective observed dead/unreachable ranks (resilience/).
+        The structured attribution rides the reason string in
+        RanksFailedError wire form so it survives both the in-process
+        Status path and the Response.error_message wire field;
+        raise_if_error re-raises the typed exception."""
+        return Status(StatusType.UNKNOWN_ERROR, exc.to_wire())
+
+    @staticmethod
     def in_progress() -> "Status":
         return _IN_PROGRESS
 
@@ -60,8 +69,10 @@ class Status:
     def raise_if_error(self) -> None:
         if self.type in (StatusType.OK, StatusType.IN_PROGRESS):
             return
-        from .exceptions import HorovodInternalError
+        from .exceptions import HorovodInternalError, RanksFailedError
 
+        if RanksFailedError.matches(self.reason):
+            raise RanksFailedError.from_wire(self.reason)
         raise HorovodInternalError(self.reason or self.type.name)
 
 

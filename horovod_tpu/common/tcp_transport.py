@@ -12,10 +12,30 @@ import struct
 import time
 
 from .controller import Transport
+from .exceptions import RanksFailedError
+from .logging import logger
 from .message import RequestList, ResponseList
 from ..runner.network import PeerMesh
 
 _WORDLEN = struct.Struct(">I")
+
+# Poison/abort frame (resilience/): when the coordinator's bounded drain
+# detects a dead or deadline-missing rank it broadcasts this frame to
+# every surviving peer, whatever recv state that peer is blocked in
+# (bitwise reply, ResponseList broadcast, barrier release) — the leading
+# 0xff byte cannot open any legitimate control frame (bitwise payloads
+# start with a 4-byte big-endian length <= 2^24, Request/ResponseList
+# bytes with a bool), so one prefix test per control recv suffices.
+# The payload is the RanksFailedError wire form, riding the same
+# structured-ERROR path the fingerprint divergence errors use.
+POISON_MAGIC = b"\xffHVDPOISON\xff"
+
+
+def check_poison(raw) -> None:
+    """Raise the carried RanksFailedError when `raw` is a poison frame."""
+    if raw[:len(POISON_MAGIC)] == POISON_MAGIC:
+        raise RanksFailedError.from_wire(
+            bytes(raw[len(POISON_MAGIC):]).decode(errors="replace"))
 
 
 def _pack_words(and_word: int, or_word: int) -> bytes:
@@ -41,6 +61,32 @@ class TcpTransport(Transport):
         # reads it via getattr so LocalTransport needs no counterpart).
         self.last_gather_arrivals: dict[int, float] = {}
 
+    # -- poison broadcast (resilience/) ----------------------------------
+    def broadcast_poison(self, exc: RanksFailedError) -> None:
+        """Best-effort abort frame to every surviving peer: whatever
+        control recv each is blocked in, its next frame is this one, so
+        ALL ranks raise RanksFailedError within one detection window
+        instead of deadlocking behind the dead rank (ISSUE 5 tentpole)."""
+        payload = POISON_MAGIC + exc.to_wire().encode()
+        for peer in range(self.size):
+            if peer == self.rank or peer in exc.failed_ranks:
+                continue
+            try:
+                self.mesh.send(peer, payload)
+            except Exception:  # noqa: BLE001 - peer may be gone too
+                logger.debug("poison frame to rank %d undeliverable",
+                             peer, exc_info=True)
+
+    def _drain_or_poison(self, gen):
+        """Run a coordinator-side arrival-order drain; on a detected
+        rank failure, poison the survivors BEFORE re-raising so the
+        whole world converts the hang into the same structured error."""
+        try:
+            yield from gen
+        except RanksFailedError as exc:
+            self.broadcast_poison(exc)
+            raise
+
     # -- bitvector sync (reference: gloo_controller.cc bitwise ops) ------
     def bitwise_sync(self, and_word: int, or_word: int) -> tuple[int, int]:
         if self.size == 1:
@@ -49,8 +95,8 @@ class TcpTransport(Transport):
             # Drain peers in ARRIVAL order (selectors), not rank order:
             # AND/OR are commutative, and one slow rank no longer stalls
             # the reads of every faster rank queued behind it.
-            for _, raw in self.mesh.recv_in_arrival_order(
-                    range(1, self.size)):
+            for _, raw in self._drain_or_poison(
+                    self.mesh.recv_in_arrival_order(range(1, self.size))):
                 a, o = _unpack_words(raw)
                 and_word &= a
                 or_word |= o
@@ -59,7 +105,9 @@ class TcpTransport(Transport):
                 self.mesh.send(peer, payload)
             return and_word, or_word
         self.mesh.send(0, _pack_words(and_word, or_word))
-        return _unpack_words(self.mesh.recv(0))
+        raw = self.mesh.recv(0)  # hvdlint: disable=unbounded-blocking-wait -- bounded inside the peer channel under fault tolerance; poison frames convert coordinator-detected failures
+        check_poison(raw)
+        return _unpack_words(raw)
 
     # -- RequestList gather (reference: gloo_controller.cc allgatherv) ---
     def gather_requests(self, request_list: RequestList):
@@ -73,8 +121,8 @@ class TcpTransport(Transport):
             lists: list[RequestList | None] = [None] * self.size
             lists[0] = request_list
             arrivals = {0: time.monotonic()}
-            for peer, raw in self.mesh.recv_in_arrival_order(
-                    range(1, self.size)):
+            for peer, raw in self._drain_or_poison(
+                    self.mesh.recv_in_arrival_order(range(1, self.size))):
                 arrivals[peer] = time.monotonic()
                 lists[peer] = RequestList.from_bytes(raw)
             self.last_gather_arrivals = arrivals
@@ -88,19 +136,32 @@ class TcpTransport(Transport):
             return response_list
         if self.rank == 0:
             payload = response_list.to_bytes()
+            failure: RanksFailedError | None = None
             for peer in range(1, self.size):
-                self.mesh.send(peer, payload)
+                try:
+                    self.mesh.send(peer, payload)
+                except RanksFailedError as exc:
+                    # Keep delivering to the SURVIVORS — a peer they can
+                    # still hear from must not strand them — then poison.
+                    failure = exc
+            if failure is not None:
+                self.broadcast_poison(failure)
+                raise failure
             return response_list
-        return ResponseList.from_bytes(self.mesh.recv(0))
+        raw = self.mesh.recv(0)  # hvdlint: disable=unbounded-blocking-wait -- bounded inside the peer channel under fault tolerance; poison frames convert coordinator-detected failures
+        check_poison(raw)
+        return ResponseList.from_bytes(raw)
 
     def barrier(self) -> None:
         if self.size == 1:
             return
         if self.rank == 0:
-            for _ in self.mesh.recv_in_arrival_order(range(1, self.size)):
+            for _ in self._drain_or_poison(
+                    self.mesh.recv_in_arrival_order(range(1, self.size))):
                 pass
             for peer in range(1, self.size):
                 self.mesh.send(peer, b"\x01")
         else:
             self.mesh.send(0, b"\x01")
-            self.mesh.recv(0)
+            raw = self.mesh.recv(0)  # hvdlint: disable=unbounded-blocking-wait -- bounded inside the peer channel under fault tolerance; poison frames convert coordinator-detected failures
+            check_poison(raw)

@@ -71,6 +71,13 @@ class TensorQueue:
             self._work.set()
         return Status.ok()
 
+    def pending_names(self) -> list[str]:
+        """Names of every entry still in the tensor table — the set a
+        poison ERROR response must cover so no local waiter is left
+        hanging when the world aborts (resilience/)."""
+        with self._mutex:
+            return list(self._table)
+
     def wait_for_work(self, timeout: float) -> bool:
         """Block up to ``timeout`` seconds for an enqueue pulse; returns
         True if work arrived.  Resubmissions via push_back_to_queue do

@@ -203,6 +203,9 @@ class GlobalState:
     # Metrics registry (telemetry/; HOROVOD_METRICS).  Null when off so
     # hot paths test one attribute and skip all instrumentation.
     telemetry: Any = None
+    # Chaos engine (resilience/chaos.py; HOROVOD_CHAOS).  None when off;
+    # the background loop fires its deterministic response-level actions.
+    chaos: Any = None
     parameter_manager: Any = None
     cycle_time_ms: float = 1.0
     joined: bool = False
@@ -300,6 +303,14 @@ def init(*, rank: int | None = None, size: int | None = None,
 
             timeout = config.GLOO_TIMEOUT_SECONDS.get()
             kv = RendezvousClient(addr, port, timeout)
+            epoch = os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", "0")
+            # Resilience BEFORE any mesh/shm formation: every PeerMesh
+            # and ShmWorld captures the process ResilienceState (and the
+            # chaos engine) at construction.  None when
+            # HOROVOD_FAULT_TOLERANCE is off — the zero-overhead mode.
+            from . import resilience
+            _global.chaos = resilience.chaos.configure(rank)
+            resilience.configure(rank, size, kv, epoch)
             # Form the multi-process JAX world FIRST (before any backend
             # below touches jax) — the analogue of GlooContext rendezvous
             # at init (reference: gloo/gloo_context.cc:136-152).
@@ -322,7 +333,6 @@ def init(*, rank: int | None = None, size: int | None = None,
             if xla_mode is not False and multihost.is_initialized():
                 from .backend.xla import XlaBackend, XlaCommunicator
                 backends.append(XlaBackend(XlaCommunicator(), size))
-            epoch = os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", "0")
             # Same-host shared-memory plane (reference: Gloo shm transport
             # / MPI shared-memory windows): beats the TCP loopback ring
             # ~2x on intra-host worlds; formation is collective and
@@ -443,6 +453,8 @@ def init(*, rank: int | None = None, size: int | None = None,
         else:
             transport = LocalTransport()
             stream_managers = []
+            from . import resilience
+            _global.chaos = resilience.chaos.configure(rank)
         backends.append(BasicBackend(size))
 
         # Runtime collective-symmetry fingerprinting (HOROVOD_FINGERPRINT;
@@ -523,6 +535,8 @@ def shutdown() -> None:
         _global.resources.clear()
         _global.initialized = False
         _global.background_thread = None
+    from . import resilience
+    resilience.shutdown()   # stop the heartbeat monitor (if any)
     from .parallel import multihost
     multihost.shutdown_jax_distributed()
 
@@ -624,6 +638,28 @@ def _background_loop() -> None:
         if response_list.tuned_num_streams > 0:
             st.active_streams = min(response_list.tuned_num_streams,
                                     max(len(st.op_managers), 1))
+
+        # Chaos harness (HOROVOD_CHAOS): deterministic response-level
+        # fault injection fires HERE, on the coordinator-ordered
+        # ResponseList — the global collective index is identical on
+        # every rank, so a kill/freeze/fail at index N is replayable and
+        # (for rank=*) rank-symmetric.
+        if st.chaos is not None:
+            for i, response in enumerate(response_list.responses):
+                if response.response_type in (ResponseType.JOIN,
+                                              ResponseType.ERROR):
+                    continue
+                if st.chaos.on_response(response.tensor_names) == "fail":
+                    # REPLACE, never mutate: the original Response object
+                    # may be held by the response cache, and an in-place
+                    # flip to ERROR would poison every later cache hit.
+                    response_list.responses[i] = Response(
+                        response_type=ResponseType.ERROR,
+                        tensor_names=list(response.tensor_names),
+                        error_message=(
+                            "chaos: injected collective failure "
+                            f"(HOROVOD_CHAOS, tensors "
+                            f"{response.tensor_names})"))
 
         if st.stream_dispatcher is not None \
                 and len(response_list.responses) > 1:
@@ -743,6 +779,8 @@ def _execute_response(st: GlobalState, response: Response,
     else:
         tm = st.telemetry
         tm_on = tm is not None and tm.enabled
+        from .resilience import active_state, op_scope
+        res = active_state()
         try:
             manager = st.op_managers[stream] if st.op_managers \
                 else st.op_manager
@@ -750,7 +788,17 @@ def _execute_response(st: GlobalState, response: Response,
                 backend = manager.resolve(response, entries)
                 plane = backend.name if backend is not None else "none"
                 t0 = time.monotonic()
-            status = manager.execute_operation(response, entries)
+            if res is not None:
+                # Label the blocking waits below for failure attribution
+                # (RanksFailedError.op); off mode skips the string build.
+                with op_scope(f"{response.response_type.name.lower()}"
+                              f"({response.tensor_names[0]}"
+                              f"{'…' if len(response.tensor_names) > 1 else ''})"
+                              if response.tensor_names else
+                              response.response_type.name.lower()):
+                    status = manager.execute_operation(response, entries)
+            else:
+                status = manager.execute_operation(response, entries)
             if tm_on:
                 _observe_collective(tm, response, plane, stream,
                                     (time.monotonic() - t0) * 1e3)

@@ -149,6 +149,14 @@ class ElasticDriver:
             return {s.rank: f"{s.hostname}[{s.local_rank}]"
                     for s in self._assignments.values()}
 
+    def rank_to_slot(self) -> dict[int, "SlotInfo"]:
+        """rank -> SlotInfo of the most recently formed round — the
+        lookup the resilience shrink policy uses to map a
+        RanksFailedError's failed-rank set onto hosts to blacklist
+        (resilience/policy.py apply_shrink)."""
+        with self._round_cond:
+            return {s.rank: s for s in self._assignments.values()}
+
     # ------------------------------------------------------------------
     # Round formation / rank assignment
     # ------------------------------------------------------------------

@@ -204,14 +204,46 @@ def kv_barrier(tag: str, timeout: float = 300.0) -> None:
             kv.wait("barrier", f"{key}:{r}", timeout)
         except TimeoutError as exc:
             raise TimeoutError(
-                f"kv_barrier timeout: rank {rank}/{size} waited {timeout}s "
-                f"for rank {r} on tag={tag!r} seq={seq} "
-                f"(key barrier/{key}:{r}). Either rank {r} is dead/wedged, "
-                f"or the barrier sequence numbers have diverged — every "
-                f"rank must call kv_barrier symmetrically (same count, "
-                f"same order); check for rank-dependent Trainer "
-                f"construction or JAX_PLATFORMS skew at world formation."
-            ) from exc
+                _barrier_timeout_diagnosis(kv, key, rank, size, tag, seq,
+                                           timeout)) from exc
+
+
+def _barrier_timeout_diagnosis(kv, key: str, rank: int, size: int,
+                               tag: str, seq: int,
+                               timeout: float) -> str:
+    """Name WHICH ranks are missing from the barrier (one probe per
+    rank), cross-checked against the resilience liveness table when
+    fault tolerance is on — the most common multihost debugging session
+    ('who is stuck?') becomes a one-line answer instead of a single
+    anonymous key timeout."""
+    missing: list[int] = []
+    for r in range(size):
+        try:
+            if kv.get("barrier", f"{key}:{r}") is None:
+                missing.append(r)
+        except Exception:  # noqa: BLE001 - KV gone: report what we know
+            missing.append(r)
+    dead: list[int] = []
+    try:
+        from ..resilience import active_state
+        state = active_state()
+        if state is not None:
+            dead = sorted(set(missing) & state.failed_ranks())
+    except Exception:  # noqa: BLE001 - diagnosis must never mask the timeout
+        pass
+    verdict = (f"rank(s) {dead} are DEAD/unreachable per the liveness "
+               f"table — elastic recovery or HOROVOD_ON_FAILURE applies."
+               if dead else
+               "all missing ranks still heartbeat (or fault tolerance is "
+               "off): either they are wedged/slow, or the barrier "
+               "sequence numbers have diverged — every rank must call "
+               "kv_barrier symmetrically (same count, same order); check "
+               "for rank-dependent Trainer construction or JAX_PLATFORMS "
+               "skew at world formation.")
+    return (f"kv_barrier timeout: rank {rank}/{size} waited {timeout}s on "
+            f"tag={tag!r} seq={seq}; missing ranks: "
+            f"{missing or '<none — raced to completion>'} "
+            f"(keys barrier/{key}:<r>). {verdict}")
 
 
 def sync_compile_needed() -> bool:

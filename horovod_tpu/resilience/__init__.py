@@ -1,0 +1,32 @@
+"""resilience/ — failure detection, deadline-bounded collectives, and the
+deterministic fault-injection (chaos) harness (ISSUE 5;
+docs/resilience.md).
+
+Module surface:
+
+- :func:`configure` / :func:`active_state` — process resilience state
+  (heartbeat monitor + deadline policy); None in the zero-overhead off
+  mode (``HOROVOD_FAULT_TOLERANCE`` unset).
+- :class:`~..common.exceptions.RanksFailedError` — the structured,
+  attributed error every survivor raises instead of deadlocking when a
+  peer dies, becomes unreachable, or misses a collective deadline.
+- :func:`run_with_recovery` — applies ``HOROVOD_ON_FAILURE``
+  (raise | retry-with-rebuilt-channels | shrink-via-elastic).
+- :mod:`.chaos` — ``HOROVOD_CHAOS`` deterministic fault injection
+  (kill/freeze/fail at a collective index, delay/drop/dup a specific
+  peer-channel send), seeded and replayable so every failure path above
+  is exercised by ordinary pytest workers.
+"""
+from __future__ import annotations
+
+from ..common.exceptions import RanksFailedError
+from . import chaos
+from .context import (ResilienceState, active_state, configure, current_op,
+                      op_scope, shutdown)
+from .policy import apply_shrink, rebuild_world, run_with_recovery
+
+__all__ = [
+    "RanksFailedError", "ResilienceState", "active_state", "apply_shrink",
+    "chaos", "configure", "current_op", "op_scope", "rebuild_world",
+    "run_with_recovery", "shutdown",
+]

@@ -26,7 +26,26 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib import error as urlerror
 from urllib import request as urlrequest
 
+from ..common.logging import logger
+
 _LEN = struct.Struct(">I")
+
+# Grace for a sender lane to drain after its queue is poisoned at close;
+# past it the socket is shut down under the thread (unblocking a sendmsg
+# wedged on a dead peer) and a structured warning names the peer.
+_CLOSE_JOIN_GRACE = 10.0
+
+
+def _resilience_state():
+    """The process ResilienceState, or None (zero-overhead off mode).
+    Late import: resilience/ sits above the transport layer."""
+    from ..resilience import active_state
+    return active_state()
+
+
+def _chaos_engine():
+    from ..resilience import chaos
+    return chaos.active()
 
 # Depth of a channel's outbound queue.  Collective schedules keep at most
 # one or two sends in flight per peer; the bound only exists so a runaway
@@ -77,7 +96,7 @@ def recv_exact(sock: socket.socket, n: int) -> bytearray:
     view = memoryview(buf)
     got = 0
     while got < n:
-        r = sock.recv_into(view[got:], n - got)
+        r = sock.recv_into(view[got:], n - got)  # hvdlint: disable=unbounded-blocking-wait -- mesh-bootstrap rank-id exchange only; bounded upstream by the formation connect timeout
         if r == 0:
             raise ConnectionError("socket closed mid-message")
         got += r
@@ -268,9 +287,10 @@ class _PeerChannel:
     """
 
     __slots__ = ("sock", "peer", "_queue", "_sender", "_error",
-                 "_scratch", "_hdr", "_on_sent")
+                 "_scratch", "_hdr", "_on_sent", "_res")
 
-    def __init__(self, sock: socket.socket, peer: int, on_sent) -> None:
+    def __init__(self, sock: socket.socket, peer: int, on_sent,
+                 resilience=None) -> None:
         self.sock = sock
         self.peer = peer
         self._queue: queue.Queue | None = None
@@ -279,6 +299,23 @@ class _PeerChannel:
         self._scratch = bytearray(0)
         self._hdr = bytearray(4)
         self._on_sent = on_sent    # bytes counter callback (mesh-level)
+        # Resilience (HOROVOD_FAULT_TOLERANCE): a non-None state installs
+        # a short socket timeout so every blocking wait on this channel
+        # becomes a deadline-bounded poll loop — between slices the state
+        # raises RanksFailedError on peer death or per-op deadline expiry
+        # instead of blocking forever.  None = the exact pre-resilience
+        # syscall pattern (zero-overhead off mode).
+        self._res = resilience
+        if resilience is not None:
+            self.sock.settimeout(resilience.poll_interval)
+
+    def _dead(self, exc: BaseException) -> BaseException:
+        """Latch a failure on the channel: later sends/recvs raise it
+        immediately instead of re-waiting out a deadline on a stream
+        that is already known broken (and possibly desynced)."""
+        if self._error is None:
+            self._error = exc
+        return exc
 
     # -- sending ----------------------------------------------------------
     def send_async(self, payload) -> None:
@@ -305,8 +342,38 @@ class _PeerChannel:
             self.send_async(view)
             self.flush()
             return 0
-        send_msg_gather(self.sock, view)
+        self._send_gather(view)
         return view.nbytes
+
+    def _send_gather(self, view: memoryview) -> None:
+        """Framed scatter-gather send, deadline-bounded when resilience
+        is on: a sendmsg stalled on a wedged peer's zero-window socket
+        polls in slices and raises RanksFailedError at the op deadline
+        instead of blocking the lane forever (progress resets the clock —
+        the deadline bounds silence, not transfer time)."""
+        if self._res is None:
+            send_msg_gather(self.sock, view)
+            return
+        n = view.nbytes
+        hdr = _LEN.pack(n)
+        sent = 0
+        start = time.monotonic()
+        while sent < 4 + n:
+            try:
+                if sent == 0:
+                    sent += self.sock.sendmsg([hdr, view])
+                elif sent < 4:
+                    sent += self.sock.send(memoryview(hdr)[sent:])
+                else:
+                    sent += self.sock.send(view[sent - 4:])
+            except TimeoutError:
+                self._res.check(self.peer, time.monotonic() - start,
+                                "send")
+                continue
+            except (ConnectionResetError, BrokenPipeError) as e:
+                raise self._dead(self._res.peer_connection_lost(
+                    self.peer, "send", str(e))) from e
+            start = time.monotonic()
 
     def _send_loop(self) -> None:
         while True:
@@ -314,7 +381,7 @@ class _PeerChannel:
             try:
                 if view is None:
                     return
-                send_msg_gather(self.sock, view)
+                self._send_gather(view)
                 self._on_sent(view.nbytes)
             except BaseException as e:  # noqa: BLE001 - surfaced to caller
                 if self._error is None:
@@ -329,20 +396,43 @@ class _PeerChannel:
 
     def flush(self) -> None:
         """Block until every queued frame has been handed to the kernel
-        (the pre-channel code's per-step join gave the same guarantee)."""
+        (the pre-channel code's per-step join gave the same guarantee).
+        Bounded indirectly: under fault tolerance every send the lane
+        drains is itself deadline-bounded, so the join below terminates
+        within one op deadline of a peer failure."""
         if self._queue is not None:
-            self._queue.join()
+            self._queue.join()  # hvdlint: disable=unbounded-blocking-wait -- each queued send is deadline-bounded (see _send_gather); the lane always reaches task_done
         if self._error is not None:
             raise self._error
 
     # -- receiving --------------------------------------------------------
     def recv_exact_into(self, view: memoryview) -> None:
         got, n = 0, view.nbytes
+        if self._res is None:   # zero-overhead off mode: original loop
+            while got < n:
+                r = self.sock.recv_into(view[got:], n - got)  # hvdlint: disable=unbounded-blocking-wait -- intentional pre-resilience behavior when HOROVOD_FAULT_TOLERANCE is off
+                if r == 0:
+                    raise ConnectionError("socket closed mid-message")
+                got += r
+            return
+        start = time.monotonic()
         while got < n:
-            r = self.sock.recv_into(view[got:], n - got)
+            try:
+                r = self.sock.recv_into(view[got:], n - got)  # hvdlint: disable=unbounded-blocking-wait -- bounded by the socket poll timeout installed at channel construction; the except arm enforces the op deadline
+            except TimeoutError:
+                # check() raises RanksFailedError on peer death or op-
+                # deadline expiry; otherwise keep polling.
+                self._res.check(self.peer, time.monotonic() - start,
+                                "recv")
+                continue
+            except (ConnectionResetError, BrokenPipeError) as e:
+                raise self._dead(self._res.peer_connection_lost(
+                    self.peer, "recv", str(e))) from e
             if r == 0:
-                raise ConnectionError("socket closed mid-message")
+                raise self._dead(self._res.peer_connection_lost(
+                    self.peer, "recv", "socket closed mid-message"))
             got += r
+            start = time.monotonic()   # progress: deadline bounds silence
 
     def recv_begin(self) -> int:
         """Read one frame header; the next `nbytes` on the wire are the
@@ -363,13 +453,35 @@ class _PeerChannel:
         return memoryview(self._scratch)[:nbytes]
 
     def close(self) -> None:
+        """Shutdown-leak fix (mirrors the Timeline writer fix): poison
+        the queue FIRST, then join.  The old order (bounded join with no
+        poison-first guarantee) could time out silently and leak the
+        sender thread plus its bounded queue — every payload it
+        referenced stayed pinned for the process lifetime.  A sender
+        wedged in sendmsg on a dead peer is woken by shutting the socket
+        down under it; if it STILL survives, a structured warning names
+        the peer instead of hiding the leak."""
         if self._sender is not None:
             try:
                 self.flush()
             except BaseException:  # noqa: BLE001 - already torn down
                 pass
-            self._queue.put(None)
-            self._sender.join(timeout=5)
+            self._queue.put(None)                      # poison first
+            self._sender.join(timeout=_CLOSE_JOIN_GRACE)
+            if self._sender.is_alive():
+                # Unblock a send wedged on a dead/zero-window peer, then
+                # give the lane one more chance to observe the poison.
+                try:
+                    self.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self._sender.join(timeout=1.0)
+            if self._sender.is_alive():
+                logger.warning(
+                    "peer-channel close: sender thread for peer %d "
+                    "survived poison + socket shutdown (queue depth %d); "
+                    "leaking it as daemon", self.peer,
+                    self._queue.qsize() if self._queue is not None else -1)
             self._sender = None
         try:
             self.sock.close()
@@ -389,13 +501,21 @@ class PeerMesh:
     """
 
     def __init__(self, rank: int, size: int, kv: RendezvousClient,
-                 scope: str = "mesh", timeout: float = 30.0) -> None:
+                 scope: str = "mesh", timeout: float = 30.0,
+                 resilience=None) -> None:
         self.rank = rank
         self.size = size
         self.scope = scope
         self._socks: dict[int, socket.socket] = {}
         self._channels: dict[int, _PeerChannel] = {}
         self._lock = threading.Lock()
+        # Resilience (HOROVOD_FAULT_TOLERANCE) + chaos (HOROVOD_CHAOS):
+        # captured at formation.  Both None in the default off mode, so
+        # the per-call cost is one attribute test; tests may inject a
+        # private ResilienceState (the process default is rank-global).
+        self._resilience = resilience if resilience is not None \
+            else _resilience_state()
+        self._chaos = _chaos_engine()
         # Payload byte counters (framing excluded): the observability the
         # compression subsystem's bandwidth claims are asserted against
         # (tests/test_compress.py) and PERFORMANCE.md numbers come from.
@@ -475,7 +595,9 @@ class PeerMesh:
         self._socks.update(accepted)
         listener.close()
         for peer, sock in self._socks.items():
-            self._channels[peer] = _PeerChannel(sock, peer, self._count_sent)
+            self._channels[peer] = _PeerChannel(sock, peer,
+                                                self._count_sent,
+                                                resilience=self._resilience)
 
     @staticmethod
     def _advertised_host() -> str:
@@ -516,6 +638,12 @@ class PeerMesh:
                       "horovod_tcp_bytes_received_total", peer).inc(nbytes)
 
     def send(self, peer: int, payload: bytes) -> None:
+        if self._chaos is not None:
+            act = self._chaos.on_send(self.scope, peer)
+            if act == "drop":
+                return
+            if act == "dup":
+                self._count_sent(self._channels[peer].send_sync(payload))
         self._count_sent(self._channels[peer].send_sync(payload))
         if self._tm_on:
             self._tm_count_sent(peer, len(payload))
@@ -525,6 +653,12 @@ class PeerMesh:
         (counted by the lane on completion).  Zero-copy: the payload
         buffer must stay unmutated until `flush()`."""
         ch = self._channels[peer]
+        if self._chaos is not None:
+            act = self._chaos.on_send(self.scope, peer)
+            if act == "drop":
+                return
+            if act == "dup":
+                ch.send_async(payload)
         ch.send_async(payload)
         if self._tm_on:
             # Depth AFTER the put: what's now waiting on the lane.
@@ -533,7 +667,18 @@ class PeerMesh:
             self._tm_count_sent(peer, _as_byte_view(payload).nbytes)
 
     def recv(self, peer: int) -> bytearray:
-        data = recv_msg(self._socks[peer])
+        """Receive one framed message, allocated fresh.  Routed through
+        the peer channel so the wait is deadline-bounded under fault
+        tolerance (the channel falls back to the original blocking loop
+        when resilience is off)."""
+        ch = self._channels.get(peer)
+        if ch is None:   # size-1 mesh / pre-channel peer: legacy path
+            data = recv_msg(self._socks[peer])
+        else:
+            n = ch.recv_begin()
+            data = bytearray(n)
+            if n:
+                ch.recv_exact_into(memoryview(data))
         self._count_received(len(data))
         if self._tm_on:
             self._tm_count_recv(peer, len(data))
@@ -564,19 +709,31 @@ class PeerMesh:
         `peers`, draining whichever peer's bytes arrive first (selectors)
         instead of fixed rank order — one slow rank no longer serializes
         the drain behind the sockets after it."""
-        remaining = list(peers)
+        remaining = set(peers)
         if not remaining:
             return
+        res = self._resilience
         with selectors.DefaultSelector() as sel:
             for p in remaining:
                 sel.register(self._socks[p], selectors.EVENT_READ, p)
-            pending = len(remaining)
-            while pending:
-                for key, _ in sel.select():
+            start = time.monotonic()
+            while remaining:
+                events = sel.select(None if res is None
+                                    else res.poll_interval)
+                if not events:
+                    if res is not None:
+                        # Deadline-bounded drain: a silent slice checks
+                        # the liveness table and the op deadline,
+                        # attributed to the still-missing peers.
+                        res.check(min(remaining),
+                                  time.monotonic() - start, "gather")
+                    continue
+                for key, _ in events:
                     peer = key.data
                     sel.unregister(key.fileobj)
-                    pending -= 1
-                    yield peer, self.recv(peer)
+                    remaining.discard(peer)
+                    yield peer, self.recv(peer)  # hvdlint: disable=unbounded-blocking-wait -- bounded inside the peer channel (socket poll timeout + op deadline)
+                start = time.monotonic()
 
     def flush(self, peer: int | None = None) -> None:
         """Wait until queued sends (to `peer`, or everyone) reached the

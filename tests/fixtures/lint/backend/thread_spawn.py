@@ -7,11 +7,11 @@ are outside that scope, which is the allowlist).
 import threading
 
 
-def sendrecv(mesh, to_rank, payload, from_rank):
+def sendrecv(mesh, to_rank, payload, from_rank, timeout):
     t = threading.Thread(target=mesh.send, args=(to_rank, payload))  # HVD1001
     t.start()
-    data = mesh.recv(from_rank)
-    t.join()
+    data = mesh.recv(from_rank, timeout=timeout)   # bounded: no HVD1003
+    t.join(timeout)                                # bounded: no HVD1003
     return data
 
 

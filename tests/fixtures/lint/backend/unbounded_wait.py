@@ -1,0 +1,28 @@
+"""Seeded HVD1003 fixture: unbounded blocking waits in a backend/
+module (the deadlock class the resilience/ subsystem converts into
+RanksFailedError), plus bounded/exempt controls that must stay clean.
+"""
+from urllib.request import urlopen
+
+
+def drain(mesh, sock, peer, worker, store):
+    raw = mesh.recv(peer)                       # HVD1003: no deadline
+    sock.recv_into(raw)                         # HVD1003: no deadline
+    worker.join()                               # HVD1003: no deadline
+    store.wait("scope", "key")                  # HVD1003: no deadline
+    urlopen("http://coordinator/health")        # HVD1003: no deadline
+    return raw
+
+
+def drain_bounded(mesh, sock, peer, worker, store, timeout, res):
+    mesh.recv(peer, timeout=timeout)            # keyword bound
+    worker.join(timeout)                        # positional bound by name
+    store.wait("scope", "key", res.op_deadline)  # deadline-named bound
+    urlopen("http://coordinator/health", timeout=5)
+    ", ".join(["strings", "are", "exempt"])
+    import os
+    return os.path.join("path", "join", "is", "exempt")
+
+
+def drain_justified(worker):
+    worker.join()  # hvdlint: disable=unbounded-blocking-wait -- queue poisoned first; worker provably exits on the sentinel

@@ -1309,6 +1309,125 @@ def battery_peerdeath(hvd, rank, size):
 
 
 
+def battery_resilience_kill(hvd, rank, size):
+    """ISSUE 5 acceptance: chaos SIGKILLs rank 2 mid-allreduce (global
+    collective index 3); every survivor must raise RanksFailedError
+    naming rank 2 within 2x HOROVOD_FAULT_TIMEOUT (wall-clock bound
+    asserted) — the deadlock-to-error conversion, end to end."""
+    import time as _time
+
+    small = np.ones(8, np.float32)
+    for i in range(3):   # collectives 0..2: world healthy
+        out = hvd.allreduce(small, op=hvd.Sum, name=f"warm{i}")
+        np.testing.assert_allclose(out, np.full(8, float(size)))
+    fault_timeout = float(os.environ["HOROVOD_FAULT_TIMEOUT"])
+    t0 = _time.monotonic()
+    try:
+        for i in range(50):   # collective 3 kills rank 2 pre-dispatch
+            hvd.allreduce(small, op=hvd.Sum, name=f"after{i}")
+    except hvd.RanksFailedError as e:
+        elapsed = _time.monotonic() - t0
+        assert 2 in e.failed_ranks, e
+        assert elapsed < 2 * fault_timeout, (elapsed, fault_timeout)
+        print(f"survivor {rank}: RanksFailedError("
+              f"{sorted(e.failed_ranks)}) in {elapsed:.2f}s "
+              f"op={e.op!r} phase={e.phase!r}")
+        return
+    raise AssertionError("collectives kept succeeding after chaos kill")
+
+
+def battery_resilience_retry(hvd, rank, size):
+    """Delayed-send chaos (rank 1's first data-mesh send to rank 2 held
+    for longer than the fault timeout) blows the op deadline on attempt
+    0 on EVERY rank; HOROVOD_ON_FAILURE=retry rebuilds all channels
+    under a bumped rendezvous epoch with exponential backoff and the
+    re-run succeeds (the chaos action's count=1 is exhausted)."""
+    from horovod_tpu import resilience
+    from horovod_tpu.resilience import policy as _policy
+
+    ones = np.ones(16, np.float32)
+    out = resilience.run_with_recovery(
+        lambda: hvd.allreduce(ones, op=hvd.Sum, name="retry0"),
+        policy="retry", max_retries=3, base_backoff=0.2)
+    np.testing.assert_allclose(out, np.full(16, float(size)))
+    assert _policy.last_attempts >= 2, \
+        f"chaos delay never triggered a retry (attempts=" \
+        f"{_policy.last_attempts})"
+    # The rebuilt world is fully healthy.
+    out = hvd.allreduce(ones * (rank + 1), op=hvd.Sum, name="after_retry")
+    np.testing.assert_allclose(out, np.full(16, float(sum(
+        r + 1 for r in range(size)))))
+    print(f"rank {rank}: retry converged after {_policy.last_attempts} "
+          f"attempt(s)")
+
+
+def battery_resilience_freeze(hvd, rank, size):
+    """Wedged-rank detection: chaos freezes rank 1 for far longer than
+    the fault timeout at collective 1.  Its PID lives and its heartbeat
+    thread keeps beating — only the per-op DEADLINE can convert rank
+    0's wait, which must raise RanksFailedError naming rank 1 within
+    2x the timeout."""
+    import time as _time
+
+    small = np.ones(4, np.float32)
+    hvd.allreduce(small, op=hvd.Sum, name="fwarm")   # collective 0
+    fault_timeout = float(os.environ["HOROVOD_FAULT_TIMEOUT"])
+    if rank == 1:
+        # This rank freezes pre-dispatch of collective 1; whatever the
+        # world looks like when it thaws (peer may have exited), any
+        # structured error is acceptable — only a hang is a failure.
+        try:
+            hvd.allreduce(small, op=hvd.Sum, name="frozen")
+            hvd.allreduce(small, op=hvd.Sum, name="thawed")
+        except hvd.HorovodInternalError as e:
+            print(f"thawed rank: structured error after freeze: {e}")
+        return
+    t0 = _time.monotonic()
+    try:
+        hvd.allreduce(small, op=hvd.Sum, name="frozen")
+        hvd.allreduce(small, op=hvd.Sum, name="thawed")
+    except hvd.RanksFailedError as e:
+        elapsed = _time.monotonic() - t0
+        assert 1 in e.failed_ranks, e
+        assert elapsed < 2 * fault_timeout, (elapsed, fault_timeout)
+        print(f"rank {rank}: wedged peer converted in {elapsed:.2f}s")
+        return
+    raise AssertionError("frozen peer never converted to an error")
+
+
+def battery_resilience_off(hvd, rank, size):
+    """Zero-overhead off mode: with HOROVOD_FAULT_TOLERANCE unset and
+    HOROVOD_CHAOS unset there must be NO monitor thread, NO chaos
+    engine, NO socket timeouts and NO resilience state captured by the
+    meshes — byte-identical hot paths to the pre-resilience tree."""
+    import threading as _threading
+
+    from horovod_tpu import resilience
+    from horovod_tpu.core import _global
+
+    assert resilience.active_state() is None
+    assert resilience.chaos.active() is None
+    assert _global.chaos is None
+    names = [t.name for t in _threading.enumerate()]
+    assert not any("heartbeat" in n for n in names), names
+    for coll in _global.tcp_collectives:
+        mesh = coll.mesh
+        assert mesh._resilience is None and mesh._chaos is None
+        for ch in mesh._channels.values():
+            assert ch._res is None
+            # Dialed sockets historically keep the formation connect
+            # timeout (create_connection); off mode must only never
+            # install the SHORT resilience poll timeout.
+            t = ch.sock.gettimeout()
+            assert t is None or t >= 10.0, \
+                f"off mode must not install poll timeouts (got {t})"
+    out = hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum, name="off0")
+    np.testing.assert_allclose(out, np.full(8, float(size)))
+    # Still none after traffic (lazy paths must not re-resolve).
+    names = [t.name for t in _threading.enumerate()]
+    assert not any("heartbeat" in n for n in names), names
+
+
 def battery_torch_grid(hvd, rank, size):
     """Torch-binding semantic grid (modeled on the dtype x op x variant
     sweep of /root/reference/test/parallel/test_torch.py): every wire
@@ -1779,6 +1898,13 @@ BATTERIES = {
     "compress_xla": battery_compress_xla,
     "mxnet": battery_mxnet,
     "peerdeath": battery_peerdeath,
+    # resilience/ chaos batteries (ISSUE 5): every one runs under the
+    # hard timeout guard in tests/test_resilience.py so a regression
+    # re-introducing a deadlock fails fast.
+    "resilience_kill": battery_resilience_kill,
+    "resilience_retry": battery_resilience_retry,
+    "resilience_freeze": battery_resilience_freeze,
+    "resilience_off": battery_resilience_off,
 }
 
 
@@ -1819,6 +1945,28 @@ def main() -> int:
     if battery == "shm":
         os.environ["HOROVOD_SHM_OPERATIONS"] = "1"   # require formation
         os.environ["HOROVOD_SHM_CAPACITY"] = str(1 << 20)
+    if battery.startswith("resilience"):
+        # Chaos batteries pin the TCP plane so the socket-level deadline
+        # guards are the ones exercised (the shm plane has its own).
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+    if battery in ("resilience_kill", "resilience_retry",
+                   "resilience_freeze"):
+        os.environ["HOROVOD_FAULT_TOLERANCE"] = "1"
+    if battery == "resilience_kill":
+        os.environ["HOROVOD_FAULT_TIMEOUT"] = "5"
+        # Real SIGKILL mid-allreduce at global collective index 3
+        # (ISSUE 5 acceptance criterion).
+        os.environ["HOROVOD_CHAOS"] = "kill:rank=2,op=3,sig=9"
+    if battery == "resilience_retry":
+        os.environ["HOROVOD_FAULT_TIMEOUT"] = "3"
+        os.environ["HOROVOD_ON_FAILURE"] = "retry"
+        # Hold rank 1's FIRST data-mesh send to rank 2 for 9 s: over the
+        # 3 s deadline on attempt 0, exhausted (count=1) on the retry.
+        os.environ["HOROVOD_CHAOS"] = \
+            "delay:rank=1,mesh=data,peer=2,send=0,ms=9000,count=1"
+    if battery == "resilience_freeze":
+        os.environ["HOROVOD_FAULT_TIMEOUT"] = "3"
+        os.environ["HOROVOD_CHAOS"] = "freeze:rank=1,op=1,ms=12000"
     if battery == "compress":
         # Pin the TCP plane so its byte counters see the traffic.
         os.environ["HOROVOD_SHM_OPERATIONS"] = "0"

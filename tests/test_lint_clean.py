@@ -82,6 +82,32 @@ def test_fixture_hot_io():
         v.message.split("'")[1] for v in out}
 
 
+def test_fixture_unbounded_wait():
+    """HVD1003: recv/join/wait/urlopen without a timeout/deadline in a
+    transport/backend module (ISSUE 5 satellite); bounded calls,
+    str/os.path join and a justified suppression stay clean."""
+    out = lint_paths([os.path.join(FIXTURES, "backend",
+                                   "unbounded_wait.py")])
+    assert _slugs(out) == ["unbounded-blocking-wait"] * 5
+    assert {"recv", "recv_into", "join", "wait", "urlopen"} == {
+        v.message.split("'")[1] for v in out}
+
+
+def test_unbounded_wait_scope_is_transport_modules():
+    """The rule bites in backend/, common/tcp_transport.py and
+    runner/network.py — and nowhere else (formation/CLI code may block
+    on user-facing timeouts of its own)."""
+    src = "def f(mesh):\n    return mesh.recv(0)\n"
+    assert _slugs(lint_source(src, "horovod_tpu/backend/x.py")) == \
+        ["unbounded-blocking-wait"]
+    assert _slugs(lint_source(src, "horovod_tpu/common/tcp_transport.py")) \
+        == ["unbounded-blocking-wait"]
+    assert _slugs(lint_source(src, "horovod_tpu/runner/network.py")) == \
+        ["unbounded-blocking-wait"]
+    assert lint_source(src, "horovod_tpu/runner/launcher.py") == []
+    assert lint_source(src, "horovod_tpu/core.py") == []
+
+
 def test_telemetry_dir_blocking_io_needs_justification():
     """Any function in a telemetry/ module must justify blocking I/O —
     the tree's single justified suppression (the exporter's shutdown

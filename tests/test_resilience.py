@@ -1,0 +1,731 @@
+"""resilience/ battery (ISSUE 5): failure detection, deadline-bounded
+collectives, recovery policies, and the deterministic chaos harness.
+
+Process-level acceptance (4-rank mp_worker batteries, each under the
+hard SIGALRM guard below so a regression re-introducing a deadlock
+fails FAST instead of eating the tier-1 budget):
+
+- chaos SIGKILLs rank 2 mid-allreduce → all three survivors raise
+  RanksFailedError(failed_ranks={2}) within 2x HOROVOD_FAULT_TIMEOUT
+  (wall-clock bound asserted in-battery);
+- delayed-send chaos blows the op deadline → HOROVOD_ON_FAILURE=retry
+  succeeds with exponential backoff over rebuilt channels;
+- frozen (wedged, still-heartbeating) rank → per-op deadline converts
+  the survivor's wait;
+- off mode: zero extra threads, no socket timeouts, no chaos engine.
+
+Unit level: chaos spec grammar + deterministic counters, heartbeat
+monitor staleness/dead-mark propagation, deadline-bounded PeerMesh
+waits, RanksFailedError wire round-trip through Status and the poison
+frame, kv_barrier missing-rank diagnostics, retry policy semantics, and
+the elastic-driver shrink path resuming at world size 3.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_multiprocess import _run_world  # noqa: E402
+
+from horovod_tpu.common.exceptions import RanksFailedError  # noqa: E402
+from horovod_tpu.common.status import Status  # noqa: E402
+from horovod_tpu.resilience import chaos as chaos_mod  # noqa: E402
+from horovod_tpu.resilience import policy as policy_mod  # noqa: E402
+from horovod_tpu.resilience.context import ResilienceState  # noqa: E402
+from horovod_tpu.resilience.heartbeat import HeartbeatMonitor  # noqa: E402
+
+HARD_GUARD_SECONDS = 300
+
+
+@pytest.fixture(autouse=True)
+def hard_timeout_guard():
+    """Every chaos test runs under a hard wall-clock guard (ISSUE 5
+    CI satellite): a re-introduced deadlock fails this test in bounded
+    time instead of stalling the tier-1 run until the outer timeout."""
+    def _expired(signum, frame):
+        raise TimeoutError(
+            f"resilience test exceeded the {HARD_GUARD_SECONDS}s hard "
+            f"guard — a blocking wait has lost its deadline")
+    old = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(HARD_GUARD_SECONDS)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+@pytest.fixture()
+def kv():
+    from horovod_tpu.runner.network import (RendezvousClient,
+                                            RendezvousServer)
+    server = RendezvousServer()
+    port = server.start()
+    yield RendezvousClient("127.0.0.1", port, 10.0)
+    server.stop()
+
+
+class FakeMonitor:
+    """Deterministic monitor for unit-level ResilienceState tests."""
+
+    def __init__(self) -> None:
+        self.failed: set[int] = set()
+        self.confirmed: set[int] = set()
+        self.marks: list[tuple[int, str, bool]] = []
+
+    def failed_ranks(self):
+        return frozenset(self.failed)
+
+    def confirmed_failed_ranks(self):
+        return frozenset(self.confirmed)
+
+    def mark_failed(self, r, reason, confirmed=True):
+        self.marks.append((r, reason, confirmed))
+        self.failed.add(r)
+        if confirmed:
+            self.confirmed.add(r)
+
+    def stop(self):
+        pass
+
+
+def _state(rank=0, size=2, fault_timeout=1.0, monitor=None):
+    return ResilienceState(rank, size, monitor or FakeMonitor(),
+                           fault_timeout=fault_timeout)
+
+
+# ---------------------------------------------------------------------------
+# Process-level acceptance batteries
+# ---------------------------------------------------------------------------
+def test_chaos_sigkill_converts_deadlock_4rank():
+    """ISSUE 5 acceptance: SIGKILL of rank 2 mid-allreduce (HOROVOD_CHAOS
+    kill:rank=2,op=3,sig=9) → all three survivors raise
+    RanksFailedError(failed_ranks={2}) within 2x HOROVOD_FAULT_TIMEOUT;
+    the wall-clock bound is asserted inside each surviving worker."""
+    outputs = _run_world(4, "resilience_kill", timeout=150.0,
+                         expected_rcs={2: -signal.SIGKILL})
+    for r in (0, 1, 3):
+        assert "RanksFailedError" in outputs[r], outputs[r]
+
+
+def test_retry_policy_recovers_over_rebuilt_channels_4rank():
+    """Delayed-send chaos (rank 1 -> rank 2, 9 s against a 3 s deadline)
+    fails attempt 0 on every rank; the retry policy backs off, rebuilds
+    every channel under a bumped rendezvous epoch, and the re-run
+    (chaos count exhausted) produces the exact result."""
+    outputs = _run_world(4, "resilience_retry", timeout=240.0)
+    assert all("retry converged" in o for o in outputs), outputs
+
+
+def test_frozen_rank_detected_by_deadline_2rank():
+    """A wedged rank (chaos freeze, PID alive, heartbeat thread still
+    beating) is only catchable by the per-op deadline — the survivor
+    must convert within 2x the fault timeout."""
+    outputs = _run_world(2, "resilience_freeze", timeout=120.0)
+    assert "wedged peer converted" in outputs[0], outputs[0]
+
+
+def test_off_mode_zero_overhead_2rank():
+    """With HOROVOD_FAULT_TOLERANCE and HOROVOD_CHAOS unset: no monitor
+    thread, no chaos engine, no socket timeouts, no resilience capture
+    on any mesh/channel (asserted in-battery)."""
+    _run_world(2, "resilience_off", timeout=90.0)
+
+
+# ---------------------------------------------------------------------------
+# RanksFailedError + Status + poison frame plumbing
+# ---------------------------------------------------------------------------
+def test_ranks_failed_error_wire_roundtrip():
+    e = RanksFailedError({3, 1}, op="allreduce(grad.0…)", phase="recv",
+                         message="rank 3 went away")
+    w = e.to_wire()
+    assert RanksFailedError.matches(w)
+    back = RanksFailedError.from_wire(w)
+    assert back.failed_ranks == frozenset({1, 3})
+    assert back.op == "allreduce(grad.0…)"
+    assert back.phase == "recv"
+    assert "rank 3 went away" in str(back)
+
+
+def test_ranks_failed_error_is_internal_and_connection_error():
+    import horovod_tpu as hvd
+    e = RanksFailedError({2})
+    assert isinstance(e, hvd.HorovodInternalError)
+    assert isinstance(e, ConnectionError)   # pre-resilience handlers
+
+
+def test_status_reraises_typed_ranks_failed():
+    status = Status.ranks_failed(RanksFailedError({2}, op="bc",
+                                                  phase="send"))
+    with pytest.raises(RanksFailedError) as exc_info:
+        status.raise_if_error()
+    assert exc_info.value.failed_ranks == frozenset({2})
+    # An unrelated error string still raises the generic type.
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    with pytest.raises(HorovodInternalError) as exc_info:
+        Status.unknown_error("boom").raise_if_error()
+    assert not isinstance(exc_info.value, RanksFailedError)
+
+
+def test_poison_frame_prefix_detection():
+    from horovod_tpu.common.tcp_transport import (POISON_MAGIC,
+                                                  check_poison)
+    e = RanksFailedError({1}, op="ar", phase="gather")
+    frame = POISON_MAGIC + e.to_wire().encode()
+    with pytest.raises(RanksFailedError) as exc_info:
+        check_poison(frame)
+    assert exc_info.value.failed_ranks == frozenset({1})
+    check_poison(b"\x00\x00\x00\x02ok")   # ordinary frame: no raise
+    check_poison(bytearray(b"\x01plain"))
+
+
+# ---------------------------------------------------------------------------
+# Chaos spec grammar + determinism
+# ---------------------------------------------------------------------------
+def test_chaos_spec_grammar():
+    acts = chaos_mod.parse_spec(
+        "kill:rank=2,op=5,sig=9; freeze:rank=1,op=3,ms=4000;"
+        "fail:op=7,count=2;delay:rank=1,peer=0,send=3,ms=250,count=1;"
+        "drop:peer=2,send=0;dup:peer=1,send=4,mesh=data")
+    kinds = [a.kind for a in acts]
+    assert kinds == ["kill", "freeze", "fail", "delay", "drop", "dup"]
+    assert acts[0].sig == 9 and acts[0].rank == 2 and acts[0].op == 5
+    assert acts[1].ms == 4000
+    assert acts[2].count == 2 and acts[2].rank is None
+    assert acts[5].mesh == "data"
+
+
+@pytest.mark.parametrize("bad", [
+    "nonsense:op=1", "kill:rank=2", "delay:rank=1,ms=5",
+    "kill:rank2,op=3", "freeze", "fail:op",
+])
+def test_chaos_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        chaos_mod.parse_spec(bad)
+
+
+def test_chaos_fail_action_symmetric_and_counted():
+    eng = chaos_mod.ChaosEngine("fail:op=1,count=2", rank=3)
+    assert eng.on_response(["a"]) is None        # op 0
+    assert eng.on_response(["b"]) == "fail"      # op 1: fires
+    assert eng.on_response(["b"]) is None        # count tracks op index,
+    assert eng.actions[0].count == 1             # one firing consumed
+
+
+def test_chaos_name_prefix_matching():
+    eng = chaos_mod.ChaosEngine("fail:name=grad.,count=2", rank=0)
+    assert eng.on_response(["loss"]) is None
+    assert eng.on_response(["grad.3", "grad.4"]) == "fail"
+    assert eng.on_response(["grad.5"]) == "fail"
+    assert eng.on_response(["grad.6"]) is None   # count exhausted
+
+
+def test_chaos_send_counters_are_per_scope_and_peer():
+    eng = chaos_mod.ChaosEngine("drop:rank=0,peer=1,send=1,mesh=data",
+                                rank=0)
+    assert eng.on_send("data0", 1) is None       # send 0
+    assert eng.on_send("data0", 2) is None       # other peer: own counter
+    assert eng.on_send("ctrl0", 1) is None       # other mesh: no match
+    assert eng.on_send("data0", 1) == "drop"     # send 1 on (data0, 1)
+    assert eng.on_send("data0", 1) is None       # count exhausted
+
+
+def test_chaos_prob_matcher_is_seed_deterministic():
+    def fired(seed):
+        eng = chaos_mod.ChaosEngine(
+            f"drop:peer=0,prob=0.5,seed={seed},count=-1", rank=0)
+        return [eng.on_send("m", 0) == "drop" for _ in range(32)]
+    assert fired(7) == fired(7)                  # replayable
+    assert fired(7) != fired(8)                  # and actually seeded
+    assert any(fired(7)) and not all(fired(7))
+
+
+def test_chaos_engine_survives_reconfigure_with_same_spec(monkeypatch):
+    monkeypatch.setenv("HOROVOD_CHAOS", "fail:op=0,count=1")
+    eng = chaos_mod.configure(0)
+    assert eng.on_response(["x"]) == "fail"
+    # Same spec (a retry's re-init): counters persist, op won't re-fail.
+    eng2 = chaos_mod.configure(0)
+    assert eng2 is eng
+    monkeypatch.setenv("HOROVOD_CHAOS", "")
+    assert chaos_mod.configure(0) is None
+
+
+def test_chaos_fail_does_not_poison_response_cache(monkeypatch):
+    """The fail action must REPLACE the response, never mutate it: the
+    original object lives in the response cache, and an in-place flip
+    to ERROR would fail every later cache hit of that tensor.  Here the
+    re-enqueued op (count exhausted) must succeed from the cache."""
+    import horovod_tpu as hvd
+    monkeypatch.setenv("HOROVOD_CHAOS", "fail:op=0,count=1")
+    hvd.init(rank=0, size=1)
+    try:
+        with pytest.raises(hvd.HorovodInternalError, match="chaos"):
+            hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="cf")
+        for _ in range(3):   # renegotiated AND cache-hit paths both clean
+            out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                                name="cf")
+            np.testing.assert_allclose(out, np.ones(4))
+    finally:
+        hvd.shutdown()
+        monkeypatch.setenv("HOROVOD_CHAOS", "")
+        chaos_mod.configure(0)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat monitor
+# ---------------------------------------------------------------------------
+def test_heartbeat_staleness_declares_failure(kv):
+    a = HeartbeatMonitor(0, 2, kv, "hb-t1", fault_timeout=0.4,
+                         interval=0.1)
+    b = HeartbeatMonitor(1, 2, kv, "hb-t1", fault_timeout=0.4,
+                         interval=0.1)
+    a._publish()
+    b._publish()
+    a._started_at = b._started_at = time.monotonic()
+    a.poll_once()
+    assert a.failed_ranks() == frozenset()
+    # b stops beating; a keeps observing the same value.
+    time.sleep(0.6)
+    a.poll_once()
+    assert a.failed_ranks() == frozenset({1})
+    assert a.confirmed_failed_ranks() == frozenset({1})
+    assert "silent" in a.failure_reason(1)
+
+
+def test_heartbeat_progress_prevents_failure(kv):
+    a = HeartbeatMonitor(0, 2, kv, "hb-t2", fault_timeout=0.4,
+                         interval=0.1)
+    b = HeartbeatMonitor(1, 2, kv, "hb-t2", fault_timeout=0.4,
+                         interval=0.1)
+    a._started_at = time.monotonic() - 10.0   # grace long over
+    deadline = time.monotonic() + 0.9
+    while time.monotonic() < deadline:
+        b._publish()
+        a.poll_once()
+        time.sleep(0.1)
+    assert a.failed_ranks() == frozenset()
+
+
+def test_dead_mark_propagates_between_monitors(kv):
+    a = HeartbeatMonitor(0, 3, kv, "hb-t3", fault_timeout=30.0,
+                         interval=0.1)
+    b = HeartbeatMonitor(1, 3, kv, "hb-t3", fault_timeout=30.0,
+                         interval=0.1)
+    for m in (a, b):
+        m._publish()
+    # a has direct socket evidence that rank 2 died.
+    a.mark_failed(2, "connection lost: reset by peer")
+    b.poll_once()
+    assert b.failed_ranks() == frozenset({2})
+    assert b.confirmed_failed_ranks() == frozenset({2})
+
+
+def test_orderly_departure_bye_is_not_death(kv):
+    """A rank that stops its monitor deliberately (shutdown, or an
+    epoch rebuild mid-retry) leaves a bye stamp; peers must not read
+    the ensuing heartbeat silence as confirmed death — that race made
+    the retry policy refuse legitimate rebuilds."""
+    a = HeartbeatMonitor(0, 2, kv, "hb-bye", fault_timeout=0.3,
+                         interval=0.05)
+    b = HeartbeatMonitor(1, 2, kv, "hb-bye", fault_timeout=0.3,
+                         interval=0.05)
+    for m in (a, b):
+        m._publish()
+    a._started_at = time.monotonic() - 10.0
+    a.poll_once()
+    b.stop()   # publishes the bye stamp
+    time.sleep(0.5)
+    a.poll_once()
+    assert a.failed_ranks() == frozenset()
+    assert "bye|" in (kv.get("hb", "hb-bye:1") or b"").decode()
+
+
+def test_suspect_mark_is_not_confirmed(kv):
+    a = HeartbeatMonitor(0, 3, kv, "hb-t4", fault_timeout=30.0,
+                         interval=0.1)
+    b = HeartbeatMonitor(1, 3, kv, "hb-t4", fault_timeout=30.0,
+                         interval=0.1)
+    for m in (a, b):
+        m._publish()
+    a.mark_failed(2, "deadline expiry", confirmed=False)
+    b.poll_once()
+    assert b.failed_ranks() == frozenset({2})
+    assert b.confirmed_failed_ranks() == frozenset()
+    # Later confirmed evidence upgrades the suspect.
+    a.mark_failed(2, "pid gone", confirmed=True)
+    b.poll_once()
+    assert b.confirmed_failed_ranks() == frozenset({2})
+
+
+def test_monitor_thread_starts_and_stops(kv):
+    m = HeartbeatMonitor(0, 2, kv, "hb-t5", fault_timeout=5.0,
+                         interval=0.05)
+    before = {t.name for t in threading.enumerate()}
+    m.start()
+    assert any(t.name == "hvd-heartbeat" for t in threading.enumerate())
+    m.stop()
+    time.sleep(0.05)
+    after = {t.name for t in threading.enumerate()}
+    assert after <= before | {"hvd-heartbeat"}
+    assert not any(t.is_alive() and t.name == "hvd-heartbeat"
+                   for t in threading.enumerate())
+
+
+def test_configure_off_returns_none(kv, monkeypatch):
+    monkeypatch.delenv("HOROVOD_FAULT_TOLERANCE", raising=False)
+    from horovod_tpu import resilience
+    assert resilience.configure(0, 4, kv, "e") is None
+    assert resilience.active_state() is None
+
+
+# ---------------------------------------------------------------------------
+# Deadline-bounded PeerMesh waits (in-proc two-rank worlds)
+# ---------------------------------------------------------------------------
+def _mesh_pair(kv, scope, states):
+    from horovod_tpu.runner.network import PeerMesh
+    meshes: list = [None, None]
+    errs: list = []
+
+    def form(r):
+        try:
+            meshes[r] = PeerMesh(r, 2, kv, scope=scope, timeout=10.0,
+                                 resilience=states[r])
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=form, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20.0)
+    assert not errs, errs
+    return meshes
+
+
+def test_recv_deadline_raises_ranks_failed(kv):
+    states = [_state(r, 2, fault_timeout=0.8) for r in range(2)]
+    m0, m1 = _mesh_pair(kv, "dl1", states)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RanksFailedError) as exc_info:
+            m0.recv(1)   # rank 1 never sends
+        elapsed = time.monotonic() - t0
+        assert exc_info.value.failed_ranks == frozenset({1})
+        assert exc_info.value.phase == "recv"
+        assert 0.5 < elapsed < 5.0, elapsed
+        # The deadline expiry marked the peer suspect, not confirmed.
+        assert states[0].monitor.marks[-1][2] is False
+    finally:
+        for m in (m0, m1):
+            m.close()
+
+
+def test_recv_converts_closed_socket_to_ranks_failed(kv):
+    states = [_state(r, 2, fault_timeout=5.0) for r in range(2)]
+    m0, m1 = _mesh_pair(kv, "dl2", states)
+    try:
+        m1.close()
+        with pytest.raises(RanksFailedError) as exc_info:
+            m0.recv(1)
+        assert 1 in exc_info.value.failed_ranks
+        # Connection loss is SUSPECT evidence (an errored-but-alive peer
+        # also closes its sockets); only heartbeat silence / PID death
+        # confirm, so the retry policy stays able to rebuild.
+        assert states[0].monitor.failed == {1}
+        assert states[0].monitor.confirmed == set()
+    finally:
+        m0.close()
+
+
+def test_monitor_declared_failure_converts_other_waits(kv):
+    """A failure declared by the monitor (e.g. propagated via a dead
+    mark from a distant rank) converts THIS rank's blocked recv within
+    one poll slice — attribution beats the local deadline."""
+    states = [_state(r, 2, fault_timeout=30.0) for r in range(2)]
+    m0, m1 = _mesh_pair(kv, "dl3", states)
+    try:
+        def declare():
+            time.sleep(0.3)
+            states[0].monitor.failed.add(1)
+            states[0].monitor.confirmed.add(1)
+        threading.Thread(target=declare, daemon=True).start()
+        t0 = time.monotonic()
+        with pytest.raises(RanksFailedError):
+            m0.recv(1)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        for m in (m0, m1):
+            m.close()
+
+
+def test_progress_resets_recv_deadline(kv):
+    """The deadline bounds SILENCE, not transfer time: a sender trickling
+    bytes slower than the whole-payload deadline must not be killed."""
+    states = [_state(r, 2, fault_timeout=0.6) for r in range(2)]
+    m0, m1 = _mesh_pair(kv, "dl4", states)
+    try:
+        payload = np.arange(64, dtype=np.uint8).tobytes()
+
+        def trickle():
+            # Hand-frame the message, 8 bytes per 0.2 s: total 1.6 s+,
+            # every gap well under the 0.6 s deadline.
+            import struct
+            raw = struct.pack(">I", len(payload)) + payload
+            for i in range(0, len(raw), 8):
+                m1._socks[0].sendall(raw[i:i + 8])
+                time.sleep(0.2)
+        th = threading.Thread(target=trickle, daemon=True)
+        th.start()
+        data = m0.recv(1)
+        th.join(10.0)
+        assert bytes(data) == payload
+    finally:
+        for m in (m0, m1):
+            m.close()
+
+
+def test_chaos_drop_then_deadline(kv, monkeypatch):
+    """A chaos-dropped send leaves the receiver silent; the deadline
+    converts the wait — the exact failure mode the drop action exists
+    to exercise."""
+    monkeypatch.setenv("HOROVOD_CHAOS", "drop:rank=1,peer=0,send=0,"
+                                        "mesh=cd1,count=1")
+    chaos_mod.configure(1)
+    try:
+        states = [_state(r, 2, fault_timeout=0.7) for r in range(2)]
+        m0, m1 = _mesh_pair(kv, "cd1", states)
+        try:
+            m1.send(0, b"vanishes")            # dropped
+            with pytest.raises(RanksFailedError):
+                m0.recv(1)
+            m1.send(0, b"arrives")             # count exhausted
+            assert bytes(m0.recv(1)) == b"arrives"
+        finally:
+            for m in (m0, m1):
+                m.close()
+    finally:
+        monkeypatch.setenv("HOROVOD_CHAOS", "")
+        chaos_mod.configure(1)
+
+
+def test_chaos_dup_duplicates_frame(kv, monkeypatch):
+    monkeypatch.setenv("HOROVOD_CHAOS", "dup:rank=1,peer=0,send=0,"
+                                        "mesh=cd2,count=1")
+    chaos_mod.configure(1)
+    try:
+        m0, m1 = _mesh_pair(kv, "cd2", [None, None])
+        try:
+            m1.send(0, b"twice")
+            assert bytes(m0.recv(1)) == b"twice"
+            assert bytes(m0.recv(1)) == b"twice"   # the duplicate
+        finally:
+            for m in (m0, m1):
+                m.close()
+    finally:
+        monkeypatch.setenv("HOROVOD_CHAOS", "")
+        chaos_mod.configure(1)
+
+
+def test_peer_channel_close_poisons_then_warns(kv, caplog):
+    """Satellite: close() poisons the queue first and never silently
+    leaks the sender thread — after close the lane thread is gone."""
+    m0, m1 = _mesh_pair(kv, "cl1", [None, None])
+    try:
+        m1.send_async(0, b"x" * 1024)
+        m1.flush()
+        assert bytes(m0.recv(1)) == b"x" * 1024
+        ch = m1._channels[0]
+        assert ch._sender is not None and ch._sender.is_alive()
+        sender = ch._sender
+        m1.close()
+        sender.join(2.0)
+        assert not sender.is_alive(), "sender lane leaked at close"
+    finally:
+        m0.close()
+        m1.close()
+
+
+# ---------------------------------------------------------------------------
+# kv_barrier missing-rank diagnostics (satellite)
+# ---------------------------------------------------------------------------
+def test_kv_barrier_timeout_names_missing_ranks(kv):
+    from horovod_tpu.parallel import multihost
+    saved = (multihost._initialized_here, multihost._world,
+             multihost._barrier_seq)
+    multihost._initialized_here = True
+    multihost._world = (0, 3, kv, "diag")
+    multihost._barrier_seq = 0
+    try:
+        # Rank 2 "arrives" at the barrier; rank 1 never does.
+        kv.put("barrier", "diag:t:1:2", b"1")
+        with pytest.raises(TimeoutError) as exc_info:
+            multihost.kv_barrier("t", timeout=0.5)
+        msg = str(exc_info.value)
+        assert "missing ranks: [1]" in msg, msg
+        assert "tag='t'" in msg
+    finally:
+        (multihost._initialized_here, multihost._world,
+         multihost._barrier_seq) = saved
+
+
+# ---------------------------------------------------------------------------
+# Recovery policy
+# ---------------------------------------------------------------------------
+def test_run_with_recovery_raise_policy_propagates():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise RanksFailedError({1})
+
+    with pytest.raises(RanksFailedError):
+        policy_mod.run_with_recovery(fn, policy="raise")
+    assert len(calls) == 1
+
+
+def test_run_with_recovery_rejects_unknown_policy():
+    with pytest.raises(ValueError):
+        policy_mod.run_with_recovery(lambda: None, policy="panic")
+
+
+def test_run_with_recovery_retries_with_backoff(monkeypatch):
+    rebuilds = []
+    monkeypatch.setattr(policy_mod, "rebuild_world",
+                        lambda attempt: rebuilds.append(attempt))
+    attempts = []
+
+    def fn():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise RanksFailedError({1}, op="ar", phase="recv")
+        return "ok"
+
+    t0 = time.monotonic()
+    out = policy_mod.run_with_recovery(fn, policy="retry",
+                                       max_retries=5, base_backoff=0.05)
+    elapsed = time.monotonic() - t0
+    assert out == "ok"
+    assert rebuilds == [1, 2]
+    assert policy_mod.last_attempts == 3
+    assert elapsed >= 0.05 + 0.10   # exponential: 0.05, then 0.10
+
+
+def test_run_with_recovery_gives_up_after_max_retries(monkeypatch):
+    monkeypatch.setattr(policy_mod, "rebuild_world", lambda attempt: None)
+
+    def fn():
+        raise RanksFailedError({1})
+
+    with pytest.raises(RanksFailedError):
+        policy_mod.run_with_recovery(fn, policy="retry", max_retries=2,
+                                     base_backoff=0.01)
+    assert policy_mod.last_attempts == 3   # initial + 2 retries
+
+
+def test_run_with_recovery_refuses_confirmed_dead(monkeypatch):
+    """Retry must not spin on a CONFIRMED-dead rank: the world cannot be
+    rebuilt at the same size — that is shrink's job."""
+    from horovod_tpu.resilience import context as ctx
+    fake = FakeMonitor()
+    fake.mark_failed(2, "pid gone", confirmed=True)
+    monkeypatch.setattr(ctx, "_state", _state(0, 4, monitor=fake))
+    monkeypatch.setattr(policy_mod, "rebuild_world",
+                        lambda attempt: pytest.fail("must not rebuild"))
+
+    def fn():
+        raise RanksFailedError({2})
+
+    with pytest.raises(RanksFailedError):
+        policy_mod.run_with_recovery(fn, policy="retry", max_retries=5,
+                                     base_backoff=0.01)
+
+
+def test_retry_epoch_is_deterministic_and_non_accumulating():
+    assert policy_mod._retry_epoch("abc", 1) == "abc~r1"
+    assert policy_mod._retry_epoch("abc~r1", 2) == "abc~r2"
+    assert policy_mod._retry_epoch("abc~r2", 3) == "abc~r3"
+
+
+# ---------------------------------------------------------------------------
+# Shrink policy → elastic driver resumes at world-size 3 (satellite)
+# ---------------------------------------------------------------------------
+def test_shrink_blacklists_host_and_driver_resumes_at_3():
+    from horovod_tpu.elastic.discovery import FixedHostDiscovery
+    from horovod_tpu.elastic.driver import ElasticDriver
+
+    hosts = OrderedDict((f"h{i}", 1) for i in range(4))
+    driver = ElasticDriver(FixedHostDiscovery(hosts), min_np=3, max_np=4,
+                           timeout=20.0)
+    release = threading.Event()
+    driver.start(np=4, create_worker_fn=lambda slot:
+                 0 if release.wait(30.0) else 1)
+    try:
+        assert driver.world_size() == 4
+        epoch0 = driver.current_epoch
+        slots = driver.rank_to_slot()
+
+        # Rank 2 died: the resilience shrink policy maps the failed-rank
+        # set onto hosts, blacklists them, and records the failures.
+        shrunk = policy_mod.apply_shrink(driver, {2})
+        assert shrunk == {2: slots[2].hostname}
+
+        # The three survivors re-rendezvous (what hvd.elastic.run does
+        # after RanksFailedError); the round resolves and the driver
+        # resumes on the surviving host set.
+        for r in (0, 1, 3):
+            driver.record_ready(slots[r].hostname, slots[r].local_rank)
+        deadline = time.monotonic() + 15.0
+        while driver.current_epoch == epoch0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert driver.current_epoch > epoch0, "no new round formed"
+        assert driver.world_size() == 3
+        final_hosts = {s.split("[")[0]
+                       for s in driver.final_slots().values()}
+        assert slots[2].hostname not in final_hosts
+    finally:
+        release.set()
+        driver.stop()
+        driver.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ResilienceState semantics
+# ---------------------------------------------------------------------------
+def test_state_check_prefers_monitor_verdict_over_deadline():
+    fake = FakeMonitor()
+    st = _state(0, 4, fault_timeout=1.0, monitor=fake)
+    st.check(3, waited=0.1, phase="recv")       # quiet: no raise
+    fake.mark_failed(2, "dead")
+    with pytest.raises(RanksFailedError) as exc_info:
+        st.check(3, waited=0.1, phase="recv")
+    assert exc_info.value.failed_ranks == frozenset({2})   # true culprit
+
+
+def test_state_deadline_expiry_names_waited_peer():
+    st = _state(0, 4, fault_timeout=0.5)
+    with pytest.raises(RanksFailedError) as exc_info:
+        st.check(3, waited=0.6, phase="send")
+    assert exc_info.value.failed_ranks == frozenset({3})
+    assert exc_info.value.phase == "send"
+
+
+def test_op_scope_labels_errors():
+    from horovod_tpu.resilience import current_op, op_scope
+    assert current_op() == ""
+    with op_scope("allreduce(x)"):
+        assert current_op() == "allreduce(x)"
+        st = _state(0, 2, fault_timeout=0.1)
+        with pytest.raises(RanksFailedError) as exc_info:
+            st.check(1, waited=1.0, phase="recv")
+        assert exc_info.value.op == "allreduce(x)"
+    assert current_op() == ""
