@@ -208,86 +208,160 @@ def _spawn_inner(args, extra_env: dict, timeout: float
     return out.returncode, payload, out.stderr[-2000:], oom
 
 
-def _orchestrate(args) -> int:
-    """Retry-with-backoff wrapper around the inner accelerator run; CPU
-    fallback keeps the robustness contract (structured line, rc 0) when
-    the accelerator tunnel is down for the whole window.
+_STATE_FILE_DEFAULT = "/tmp/horovod_tpu_bench_probe.json"
 
-    The axon tunnel demonstrably recovers between outage windows (r3:
-    every one-shot 3x10s schedule landed inside a single outage), so the
-    schedule is spread: 6 attempts with exponential backoff capped at
-    5 min (~22 min horizon worst case). Each attempt re-probes in the
-    PARENT first with a short timeout — a wedged tunnel costs 90s, not a
-    full inner spawn — and the inner run still fail-fasts via
-    HVD_BENCH_REQUIRE_ACCEL if the tunnel dies between probe and run.
 
-    HOROVOD_BENCH_PROBE_ATTEMPTS caps the schedule, and a CPU-pinned
-    environment (JAX_PLATFORMS=cpu) skips it outright: the accelerator
-    can never appear there, and the full backoff ladder burned ~13 idle
-    minutes per bench run in CPU-only containers (BENCH_r05)."""
+def _probe_state_path() -> str:
+    return os.environ.get("HOROVOD_BENCH_STATE_FILE", _STATE_FILE_DEFAULT)
+
+
+def _load_probe_state(window: float) -> dict:
+    """Checkpointed watcher state: {"window_start", "attempts"}.  A state
+    older than the window belongs to a previous round — start fresh."""
     try:
-        attempts = int(os.environ.get("HOROVOD_BENCH_PROBE_ATTEMPTS", "")
-                       or 6)
+        with open(_probe_state_path()) as f:
+            state = json.load(f)
+        if time.time() - float(state["window_start"]) <= window:
+            return {"window_start": float(state["window_start"]),
+                    "attempts": int(state.get("attempts", 0))}
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    return {"window_start": time.time(), "attempts": 0}
+
+
+def _save_probe_state(state: dict) -> None:
+    try:
+        tmp = _probe_state_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, _probe_state_path())
+    except OSError as exc:   # checkpointing is best-effort
+        print(f"bench: probe checkpoint failed: {exc}", file=sys.stderr)
+
+
+def _clear_probe_state() -> None:
+    try:
+        os.remove(_probe_state_path())
+    except OSError:
+        pass
+
+
+def _orchestrate(args) -> int:
+    """Resumable probe daemon around the inner accelerator run; CPU
+    fallback keeps the robustness contract (structured line, rc 0) when
+    the accelerator tunnel is down for the whole round window.
+
+    Five rounds of VERDICT.md recorded `backend: "cpu-fallback"` because
+    the old 6-attempt exponential-backoff ladder gave up in ~22 minutes
+    while TPU-tunnel outages last hours.  The ladder is now a WATCHER
+    with CHECKPOINTED state: probes repeat every
+    HOROVOD_BENCH_PROBE_INTERVAL seconds (default 60) across the whole
+    round window (HOROVOD_BENCH_WINDOW_SECONDS, default 3600), and the
+    watcher's state file (HOROVOD_BENCH_STATE_FILE) survives process
+    death — a re-invoked bench RESUMES the same window instead of
+    restarting the schedule, so the round keeps watching for the tunnel
+    to recover for as long as the driver keeps asking.  Each probe runs
+    in the PARENT with a short timeout (a wedged tunnel costs 90 s, not
+    a full inner spawn) and the inner run still fail-fasts via
+    HVD_BENCH_REQUIRE_ACCEL if the tunnel dies between probe and run.
+    A successful capture clears the checkpoint (the next round starts a
+    fresh window); a CPU fallback leaves it (the window is still open
+    for a retry of the same round).
+
+    HOROVOD_BENCH_PROBE_ATTEMPTS still caps the TOTAL probes per window
+    when set, and a CPU-pinned environment (JAX_PLATFORMS=cpu) skips
+    the schedule outright: the accelerator can never appear there, and
+    idle probing burned ~13 minutes per bench run in CPU-only
+    containers (BENCH_r05)."""
+    def _env_float(name: str, default: float) -> float:
+        try:
+            return float(os.environ.get(name, "") or default)
+        except ValueError:
+            return default
+
+    window = _env_float("HOROVOD_BENCH_WINDOW_SECONDS", 3600.0)
+    interval = max(_env_float("HOROVOD_BENCH_PROBE_INTERVAL", 60.0), 1.0)
+    cap_raw = os.environ.get("HOROVOD_BENCH_PROBE_ATTEMPTS", "")
+    try:
+        attempts_cap = int(cap_raw) if cap_raw else None
     except ValueError:
-        attempts = 6
-    attempts = max(attempts, 1)
+        attempts_cap = None
+
     platforms = {p.strip().lower()
                  for p in os.environ.get("JAX_PLATFORMS", "").split(",")
                  if p.strip()}
-    if platforms and platforms <= {"cpu"}:
+    cpu_pinned = bool(platforms) and platforms <= {"cpu"}
+    if cpu_pinned:
         print("bench: JAX_PLATFORMS pins the cpu backend; skipping the "
-              "accelerator probe schedule", file=sys.stderr)
-        attempts = 0
-    for attempt in range(attempts):
-        backoff = min(15.0 * (2 ** attempt), 300.0)
-        if _probe_backend(timeout=90.0) is None:
-            print(f"bench: attempt {attempt + 1}/{attempts}: parent probe "
-                  f"found no accelerator; backing off {backoff:.0f}s",
-                  file=sys.stderr)
-            if attempt + 1 < attempts:
-                time.sleep(backoff)
-            continue
-        # Attempt runs fail fast on probe failure (HVD_BENCH_REQUIRE_ACCEL)
-        # instead of silently completing a CPU benchmark the retry loop
-        # would discard; CPU execution happens only in the final explicit
-        # fallback below.
-        rc, payload, err, oom = _spawn_inner(
-            args, {"HVD_BENCH_REQUIRE_ACCEL": "1"}, timeout=900.0)
-        if rc == 0 and payload and \
-                not str(payload.get("metric", "")).endswith("_failed") and \
-                payload.get("backend") != "cpu-fallback":
-            payload["attempts"] = attempt + 1
-            _emit(payload)
-            return 0
-        print(f"bench: attempt {attempt + 1}/{attempts} failed "
-              f"(rc={rc}): {err}", file=sys.stderr)
-        if oom:
-            # Deterministic config error (XLA's HBM/VMEM OOM signatures,
-            # matched on the full stderr): retrying the same shapes can
-            # only fail identically — report now. (Matching broad gRPC
-            # codes like RESOURCE_EXHAUSTED would misclassify the
-            # tunnel's transient flow-control errors, which the retry
-            # loop exists for.)
-            _emit({"metric": f"{args.model}_failed", "value": 0.0,
-                   "unit": "error", "vs_baseline": 0.0, "backend": "tpu",
-                   "error": ("out of memory (deterministic; if the fp32 "
-                             "logits buffer is the culprit, lower "
-                             "HOROVOD_STREAMING_CE_MIN_ELEMENTS — 0 "
-                             "forces the streaming cross-entropy path): "
-                             f"{err[-300:]}"),
-                   "attempts": attempt + 1})
-            return 0
-        if attempt + 1 < attempts:
-            time.sleep(backoff)
-    print("bench: accelerator attempts exhausted; falling back to CPU",
-          file=sys.stderr)
+              "accelerator probe window", file=sys.stderr)
+
+    state = _load_probe_state(window)
+    deadline = state["window_start"] + window
+    while not cpu_pinned:
+        state["attempts"] += 1
+        _save_probe_state(state)
+        if _probe_backend(timeout=90.0) is not None:
+            # Attempt runs fail fast on probe failure
+            # (HVD_BENCH_REQUIRE_ACCEL) instead of silently completing a
+            # CPU benchmark the watcher would discard; CPU execution
+            # happens only in the final explicit fallback below.
+            rc, payload, err, oom = _spawn_inner(
+                args, {"HVD_BENCH_REQUIRE_ACCEL": "1"}, timeout=900.0)
+            if rc == 0 and payload and \
+                    not str(payload.get("metric", "")
+                            ).endswith("_failed") and \
+                    payload.get("backend") != "cpu-fallback":
+                payload["attempts"] = state["attempts"]
+                payload["probe_window_s"] = round(
+                    time.time() - state["window_start"], 1)
+                _clear_probe_state()
+                _emit(payload)
+                return 0
+            print(f"bench: attempt {state['attempts']} failed "
+                  f"(rc={rc}): {err}", file=sys.stderr)
+            if oom:
+                # Deterministic config error (XLA's HBM/VMEM OOM
+                # signatures, matched on the full stderr): retrying the
+                # same shapes can only fail identically — report now.
+                # (Matching broad gRPC codes like RESOURCE_EXHAUSTED
+                # would misclassify the tunnel's transient flow-control
+                # errors, which the watcher exists for.)
+                _clear_probe_state()
+                _emit({"metric": f"{args.model}_failed", "value": 0.0,
+                       "unit": "error", "vs_baseline": 0.0,
+                       "backend": "tpu",
+                       "error": ("out of memory (deterministic; if the "
+                                 "fp32 logits buffer is the culprit, "
+                                 "lower HOROVOD_STREAMING_CE_MIN_"
+                                 "ELEMENTS — 0 forces the streaming "
+                                 "cross-entropy path): "
+                                 f"{err[-300:]}"),
+                       "attempts": state["attempts"]})
+                return 0
+        else:
+            print(f"bench: probe {state['attempts']}: no accelerator "
+                  f"({max(deadline - time.time(), 0):.0f}s left in the "
+                  f"round window)", file=sys.stderr)
+        if attempts_cap is not None and state["attempts"] >= attempts_cap:
+            print(f"bench: HOROVOD_BENCH_PROBE_ATTEMPTS cap "
+                  f"({attempts_cap}) reached", file=sys.stderr)
+            break
+        if time.time() + interval > deadline:
+            print("bench: round window exhausted", file=sys.stderr)
+            break
+        time.sleep(min(interval, max(deadline - time.time(), 0.0)))
+
+    print("bench: accelerator unavailable; falling back to CPU "
+          "(watcher state is kept — a re-run inside the window resumes "
+          "the probe schedule)", file=sys.stderr)
     rc, payload, err, _ = _spawn_inner(args, {"JAX_PLATFORMS": "cpu"},
                                        timeout=900.0)
     if rc == 0 and payload:
         payload["backend"] = "cpu-fallback"
-        payload["attempts"] = attempts + 1
+        payload["attempts"] = state["attempts"] + 1
         payload["note"] = ("accelerator unavailable after "
-                          f"{attempts} attempts; numbers are CPU-only")
+                           f"{state['attempts']} probe(s); numbers are "
+                           "CPU-only")
         _emit(payload)
         return 0
     # Even CPU died — still one structured line, rc 0 per the contract.
@@ -564,9 +638,44 @@ def _eager_worker(payload_mb: int, cycles: int) -> dict:
         # Ring allreduce moves 2*(n-1)/n of the payload per rank each op.
         n = hvd.size()
         moved = reps * payload_mb * (1 << 20) * 2 * (n - 1) / n
+
+        # Fused-vs-reference codec A/B (ISSUE 6): the same payload
+        # through the int8 quantized plane with the single-pass fused
+        # kernels on, then off (= the PR 3 pipelined reference chain).
+        # The dispatch flip is safe mid-run: both settings move one
+        # frame per peer per leg and reduce bitwise-identically.
+        from horovod_tpu import core as _core
+        st = _core.global_state()
+
+        def _set_fused(on: bool) -> None:
+            for c in st.tcp_collectives:
+                c.fused = on
+            for mgr in (st.op_managers or
+                        ([st.op_manager] if st.op_manager else [])):
+                for be in mgr.backends:
+                    if be.name == "shm":   # localhost worlds ride shm
+                        be.fused = on
+
+        def _time_quantized() -> float:
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                hvd.allreduce(big, op=hvd.Sum, name="qring",
+                              compression="int8")
+            return (time.perf_counter() - t0) / reps
+
+        _set_fused(True)
+        hvd.allreduce(big, op=hvd.Sum, name="qring", compression="int8")
+        codec_fused_s = _time_quantized()
+        _set_fused(False)
+        hvd.allreduce(big, op=hvd.Sum, name="qring", compression="int8")
+        codec_reference_s = _time_quantized()
+        _set_fused(True)
+
         from horovod_tpu import telemetry
         return {"cycles_per_sec": cycles_per_sec,
                 "ring_gbyte_per_sec": moved / dt / 1e9,
+                "codec_fused_ms": codec_fused_s * 1e3,
+                "codec_reference_ms": codec_reference_s * 1e3,
                 "metrics": telemetry.summary()}
     finally:
         hvd.shutdown()
@@ -586,12 +695,21 @@ def bench_eager(args) -> int:
 
     results = horovod_tpu.run(_eager_worker, args=(16, 200), np=2)
     r = results[0]
+    fused_ms = r.get("codec_fused_ms", 0.0)
+    ref_ms = r.get("codec_reference_ms", 0.0)
     _emit({
         "metric": "eager_cached_cycles_per_sec",
         "value": round(r["cycles_per_sec"], 1),
         "unit": "cycles/sec (2 ranks, localhost)",
         "vs_baseline": 0.0,
         "ring_gbyte_per_sec": round(r["ring_gbyte_per_sec"], 2),
+        # ISSUE 6 A/B: int8 quantized allreduce, fused single-pass
+        # kernels vs the PR 3 pipelined reference chain (per-op ms;
+        # ratio > 1 means fused is faster).
+        "codec_fused_ms": round(fused_ms, 2),
+        "codec_reference_ms": round(ref_ms, 2),
+        "codec_fused_speedup": round(ref_ms / fused_ms, 3)
+        if fused_ms > 0 else 0.0,
         # End-of-run telemetry snapshot: the trajectory records counters
         # (wire bytes, cache hit rate, stream utilization) alongside
         # the latency headline.

@@ -43,6 +43,14 @@ collectives in the same order (SURVEY §5.2):
   deadlock; the resilience/ subsystem bounds them (docs/resilience.md),
   and every surviving unbounded wait must justify its bound with a
   suppression.  ``str.join``/``os.path.join`` are lexically exempt.
+- ``HVD1004 per-segment-codec-loop``: a compress/ codec call
+  (``quantize``/``dequantize``/``from_bytes``/``to_bytes`` and the
+  ``*_rows`` jax twins) inside a loop or comprehension in a ``backend/``
+  module — the per-segment Python-level dequant→reduce→requant chain
+  allocates on every leg; route codec math through the single-pass fused
+  kernels (``compress/fused.py`` ``FusedKernels.decode_add``/``encode``)
+  so it executes inside the collective pass.  The kept reference A/B
+  baselines carry justified suppressions.
 
 Heuristics are deliberately lexical (no type inference): a flagged line
 that is provably safe carries ``# hvdlint: disable=<rule> -- <why>``;
@@ -136,6 +144,16 @@ WAIT_DIRS = frozenset({"backend"})
 WAIT_BASENAMES = frozenset({"tcp_transport.py", "network.py"})
 _BOUND_HINTS = ("timeout", "deadline", "poll")
 
+# HVD1004: compress/ codec entry points whose appearance inside a loop in
+# a backend/ module marks a per-segment Python-level dequant/requant
+# chain — the allocation-churn shape the fused single-pass kernels
+# (compress/fused.py) replace.
+CODEC_CALL_NAMES = frozenset({
+    "quantize", "dequantize", "from_bytes", "to_bytes",
+    "quantize_rows", "dequantize_rows",
+})
+CODEC_HOT_DIRS = frozenset({"backend"})
+
 
 @dataclass
 class LintConfig:
@@ -211,7 +229,11 @@ class _Analyzer(ast.NodeVisitor):
         self._in_wait_scope = bool(
             WAIT_DIRS & set(os.path.normpath(path).split(os.sep)[:-1])
         ) or os.path.basename(path) in WAIT_BASENAMES
+        self._in_codec_dir = bool(
+            CODEC_HOT_DIRS
+            & set(os.path.normpath(path).split(os.sep)[:-1]))
         self._func_stack: list[str] = []
+        self._loop_depth = 0
         self._rank_gate_depth = 0
         self._gate_lines: list[int] = []     # lineno of each active gate
         self._lock_lines: list[int] = []     # lineno of each held lock
@@ -272,11 +294,26 @@ class _Analyzer(ast.NodeVisitor):
         dep = _is_rank_dependent(node.test)
         self.visit(node.test)
         bodies = node.body + node.orelse
+        self._loop_depth += 1
         if dep:
             self._visit_gated(bodies, node.lineno)
         else:
             for n in bodies:
                 self.visit(n)
+        self._loop_depth -= 1
+
+    # --- loops (HVD1004 scope: loop bodies + comprehensions) ---------------
+    def _visit_loop(self, node) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_ListComp = _visit_loop
+    visit_SetComp = _visit_loop
+    visit_DictComp = _visit_loop
+    visit_GeneratorExp = _visit_loop
 
     def visit_IfExp(self, node: ast.IfExp) -> None:
         dep = _is_rank_dependent(node.test)
@@ -341,6 +378,17 @@ class _Analyzer(ast.NodeVisitor):
             self._check_blocking_io(node, name)
         if name in WAIT_NAMES and self._in_wait_scope:
             self._check_unbounded_wait(node, name)
+        if name in CODEC_CALL_NAMES and self._in_codec_dir \
+                and self._loop_depth > 0:
+            self._report(
+                "per-segment-codec-loop", node,
+                f"codec call '{name}' inside a loop in a backend/ "
+                f"module: per-segment Python-level dequant/requant "
+                f"chains allocate on every leg — execute the codec "
+                f"math inside the collective pass via the fused "
+                f"single-pass kernels (compress/fused.py "
+                f"FusedKernels.decode_add/decode_into/encode), or "
+                f"justify the reference chain with a suppression")
         self.generic_visit(node)
 
     # --- HVD1003: unbounded blocking waits ---------------------------------

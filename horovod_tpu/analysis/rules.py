@@ -70,6 +70,14 @@ _RULE_LIST = [
          "it with a timeout, derive a deadline from the "
          "ResilienceContext (resilience/), or justify why the wait is "
          "bounded elsewhere with a suppression."),
+    Rule("HVD1004", "per-segment-codec-loop",
+         "compress/ codec call (quantize/dequantize/from_bytes/to_bytes) "
+         "inside a loop in a backend/ module: the per-segment "
+         "Python-level dequant→reduce→requant chain allocates every leg "
+         "and forfeits the single-pass fused kernels "
+         "(compress/fused.py) — consume arriving segments with "
+         "FusedKernels.decode_add and emit wire images with "
+         "FusedKernels.encode instead."),
 ]
 
 RULES: dict[str, Rule] = {}

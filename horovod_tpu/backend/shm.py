@@ -658,7 +658,86 @@ class ShmBackend(CollectiveBackend):
 
         Regions carry ~1/4 (int8) / ~1/8 (uint4) of the fp32 bytes, and
         the reconstruction matches the tcp plane bit-for-bit (identical
-        quantize/dequantize order), so planes stay interchangeable."""
+        quantize/dequantize order — the fused kernels execute the same
+        fp32 ops in the same rank order), so planes stay
+        interchangeable.  Dispatch (HOROVOD_FUSED_KERNELS / the
+        autotuned ``fused`` attribute): single-pass fused kernels
+        (compress/fused.py — requantize straight into the shm region,
+        dequantize+accumulate in place off the staged bytes) vs the
+        reference per-chunk chain.  Bitwise identical either way."""
+        fused = getattr(self, "fused", None)
+        if fused is None:
+            from ..common import config
+            fused = self.fused = bool(config.FUSED_KERNELS.get())
+        if fused:
+            return self._allreduce_quantized_fused(response, entries, t,
+                                                   codec)
+        return self._allreduce_quantized_reference(response, entries, t,
+                                                   codec)
+
+    def _allreduce_quantized_fused(self, response: Response,
+                                   entries: list[TensorTableEntry],
+                                   t: int, codec) -> Status:
+        from ..compress import chunk_bounds, staged_nbytes
+        from ..compress.fused import FusedKernels
+        fk = getattr(self, "_fk", None)
+        if fk is None:
+            fk = self._fk = FusedKernels()
+        w = self.world
+        rank, size = w.rank, w.size
+        result_dtype = to_numpy(response.tensor_type)
+        block_size = self.codec_block_size(response)
+        n = sum(response.tensor_sizes)
+        per_chunk, stage_total = staged_nbytes(n, size, codec, block_size)
+        chunk_off = np.cumsum([0] + per_chunk)
+        bounds = chunk_bounds(n, size)
+
+        w.wait_all(3 * t)
+        packed = self.pack_fusion_buffer(response, entries)
+        packed = self.scale_buffer(packed, response.prescale_factor)
+        x = packed.astype(np.float32, copy=False)
+        region = w.data(rank)
+        for j in range(size):
+            wire = fk.encode(x[bounds[j]:bounds[j + 1]], codec,
+                             block_size, ("enc",))
+            region[int(chunk_off[j]):int(chunk_off[j]) + wire.size] = wire
+        w.publish(3 * t + 1)
+
+        w.wait_all(3 * t + 1)
+        my_len = int(bounds[rank + 1] - bounds[rank])
+        lo = int(chunk_off[rank])
+        acc = fk.f32(("acc",), my_len)
+        acc[:] = 0.0
+        for r in range(size):                  # rank-order accumulate
+            fk.decode_add(w.data(r)[lo:lo + per_chunk[rank]], my_len,
+                          codec, block_size, acc, ("in",))
+        reduced = fk.encode(acc, codec, block_size, ("red",))
+        region[stage_total:stage_total + reduced.size] = reduced
+        w.publish(3 * t + 2)
+
+        w.wait_all(3 * t + 2)
+        out = np.empty(n, np.float32)
+        for r in range(size):
+            fk.decode_into(w.data(r)[stage_total:stage_total
+                                     + per_chunk[r]],
+                           int(bounds[r + 1] - bounds[r]), codec,
+                           block_size, out[bounds[r]:bounds[r + 1]],
+                           ("out",))
+        w.publish(3 * t + 3)
+
+        out = out.astype(result_dtype, copy=False)
+        out = self.scale_buffer(out, response.postscale_factor)
+        self.unpack_fusion_buffer(out, response, entries)
+        self.ops_executed += 1
+        return Status.ok()
+
+    def _allreduce_quantized_reference(self, response: Response,
+                                       entries: list[TensorTableEntry],
+                                       t: int, codec) -> Status:
+        """Reference quantized lockstep (pre-fusion): per-chunk
+        quantize/to_bytes into the region, from_bytes/dequantize out.
+        Kept as the fused-vs-reference A/B baseline and the
+        HOROVOD_FUSED_KERNELS=0 fallback."""
         from ..compress import (chunk_bounds, dequantize, from_bytes,
                                 quantize, staged_nbytes, to_bytes)
         w = self.world
@@ -676,7 +755,7 @@ class ShmBackend(CollectiveBackend):
         x = packed.astype(np.float32, copy=False)
         region = w.data(rank)
         for j in range(size):
-            raw = to_bytes(quantize(x[bounds[j]:bounds[j + 1]], codec,
+            raw = to_bytes(quantize(x[bounds[j]:bounds[j + 1]], codec,  # hvdlint: disable=per-segment-codec-loop -- this IS the reference chain the fused kernels replace; kept for the fused-vs-reference A/B and as the dispatch fallback
                                     block_size))
             region[int(chunk_off[j]):int(chunk_off[j]) + len(raw)] = \
                 np.frombuffer(raw, np.uint8)
@@ -688,7 +767,7 @@ class ShmBackend(CollectiveBackend):
         acc = np.zeros(my_len, np.float32)
         for r in range(size):
             raw = w.data(r)[lo:lo + per_chunk[rank]]
-            acc += dequantize(from_bytes(raw, my_len, codec, block_size))
+            acc += dequantize(from_bytes(raw, my_len, codec, block_size))  # hvdlint: disable=per-segment-codec-loop -- reference A/B baseline (see above)
         reduced = to_bytes(quantize(acc, codec, block_size))
         region[stage_total:stage_total + len(reduced)] = \
             np.frombuffer(reduced, np.uint8)
@@ -698,8 +777,8 @@ class ShmBackend(CollectiveBackend):
         out = np.empty(n, np.float32)
         for r in range(size):
             raw = w.data(r)[stage_total:stage_total + per_chunk[r]]
-            out[bounds[r]:bounds[r + 1]] = dequantize(
-                from_bytes(raw, int(bounds[r + 1] - bounds[r]), codec,
+            out[bounds[r]:bounds[r + 1]] = dequantize(  # hvdlint: disable=per-segment-codec-loop -- reference A/B baseline (see above)
+                from_bytes(raw, int(bounds[r + 1] - bounds[r]), codec,  # hvdlint: disable=per-segment-codec-loop -- reference A/B baseline (see above)
                            block_size))
         w.publish(3 * t + 3)
 

@@ -15,6 +15,15 @@ consumed in HOROVOD_SEGMENT_BYTES slices so the fp32 accumulate of segment
 k overlaps the wire time of segment k+1 (numerics bit-identical to the
 monolithic path — same elementwise adds, same order).
 
+Fused computation-collective kernels (compress/fused.py): the quantized
+and cast codec legs dispatch per codec between the single-pass fused
+kernels (decode+accumulate straight off the wire into the fp32
+accumulator, requantize straight into a persistent wire image — zero
+steady-state allocations) and the reference per-chunk
+dequantize/from_bytes/add/quantize chain kept as the A/B baseline and
+fallback (HOROVOD_FUSED_KERNELS; the autotuner sweeps it).  Both paths
+are bitwise identical: same IEEE fp32 ops, same rank-order accumulation.
+
 Algorithms:
 - allreduce: ring reduce-scatter + ring allgather (bandwidth-optimal,
   2(N-1)/N · bytes per link) with fp32 accumulation for 16-bit dtypes;
@@ -23,6 +32,8 @@ Algorithms:
 - alltoall: pairwise exchange over the sender lanes (cycle-deadlock free).
 """
 from __future__ import annotations
+
+import time
 
 import numpy as np
 
@@ -46,7 +57,8 @@ class TcpCollectives:
     """Raw collective algorithms over a PeerMesh (rank-symmetric calls)."""
 
     def __init__(self, mesh: PeerMesh,
-                 segment_bytes: int | None = None) -> None:
+                 segment_bytes: int | None = None,
+                 fused: bool | None = None) -> None:
         self.mesh = mesh
         self.rank = mesh.rank
         self.size = mesh.size
@@ -55,6 +67,18 @@ class TcpCollectives:
         # ResponseList.tuned_segment_bytes); 0 = monolithic receives.
         self.segment_bytes = config.SEGMENT_BYTES.get() \
             if segment_bytes is None else int(segment_bytes)
+        # Fused single-pass codec kernels (compress/fused.py) vs the
+        # reference per-chunk dequant/requant chain — runtime-tunable
+        # through ResponseList.tuned_fused, swept by the autotuner.
+        self.fused = config.FUSED_KERNELS.get() if fused is None \
+            else bool(fused)
+        from ..compress.fused import FusedKernels
+        self._fk = FusedKernels()
+        # Per-(peer, dtype) ndarray views over the channels' scratch
+        # bytearrays: the segmented accumulate reuses ONE typed view per
+        # channel instead of a fresh np.frombuffer wrapper per segment
+        # (allocation churn visible in the per-plane latency histograms).
+        self._seg_views: dict = {}
         # Segment-overlap efficiency (telemetry/): bytes whose fp32
         # accumulate overlapped the wire (segmented path) vs bytes that
         # arrived monolithically.  No-op metrics when HOROVOD_METRICS=off.
@@ -68,6 +92,19 @@ class TcpCollectives:
             "horovod_tcp_monolithic_recv_bytes_total",
             "Ring-chunk bytes consumed in one monolithic receive "
             "(chunk below segment size, or segmentation off)")
+        # Per-leg fused-vs-reference latency histograms: the codec legs
+        # record wall time under {leg, fused} labels so the fusion win
+        # (or regression) is visible straight in the metrics dump.
+        self._tm_on = getattr(_tm, "enabled", False)
+        self._m_leg = {
+            (leg, fused): _tm.histogram(
+                "horovod_tcp_codec_leg_ms",
+                "Wall time of one codec-collective leg (gather = "
+                "contributions in + fp32 accumulate, return = reduced "
+                "chunks out), split by fused-kernel vs reference "
+                "dispatch",
+                labels={"leg": leg, "fused": "on" if fused else "off"})
+            for leg in ("gather", "return") for fused in (True, False)}
 
     # -- helpers --------------------------------------------------------
     def _sendrecv(self, to_rank: int, payload: bytes,
@@ -77,6 +114,23 @@ class TcpCollectives:
         persistent sender lane while this thread blocks in recv."""
         self.mesh.send_async(to_rank, payload)
         return self.mesh.recv(from_rank)  # hvdlint: disable=unbounded-blocking-wait -- bounded inside the peer channel (socket poll timeout + op deadline under HOROVOD_FAULT_TOLERANCE)
+
+    def _scratch_view(self, frm: int, view: memoryview,
+                      dtype: np.dtype) -> np.ndarray:
+        """Persistent typed ndarray over the peer channel's scratch
+        bytearray (satellite of the fused-kernel work: one cached view
+        per (peer, dtype) instead of an np.frombuffer wrapper per
+        segment).  Invalidated automatically when the channel grows its
+        scratch — the underlying bytearray object changes identity."""
+        base = view.obj
+        key = (frm, dtype.str)
+        cached = self._seg_views.get(key)
+        if cached is None or cached[0] is not base:
+            arr = np.frombuffer(base, dtype=dtype,
+                                count=len(base) // dtype.itemsize)
+            self._seg_views[key] = (base, arr)
+            return arr
+        return cached[1]
 
     def _recv_accum(self, frm: int, acc_slice: np.ndarray) -> None:
         """Receive one ring chunk from `frm`, adding it into `acc_slice`
@@ -93,18 +147,20 @@ class TcpCollectives:
         if seg_elems <= 0 or seg_elems >= total:
             view = self.mesh.scratch(frm, nbytes)
             self.mesh.recv_raw_into(frm, view)
-            acc_slice += np.frombuffer(view, dtype=acc_slice.dtype)
+            arr = self._scratch_view(frm, view, acc_slice.dtype)
+            np.add(acc_slice, arr[:total], out=acc_slice)
             self._m_mono_bytes.inc(nbytes)
             return
         self._m_seg_bytes.inc(nbytes)
         scratch = self.mesh.scratch(frm, seg_elems * itemsize)
+        arr = self._scratch_view(frm, scratch, acc_slice.dtype)
         pos = 0
         while pos < total:
             k = min(seg_elems, total - pos)
             view = scratch[:k * itemsize]
             self.mesh.recv_raw_into(frm, view)
-            acc_slice[pos:pos + k] += np.frombuffer(
-                view, dtype=acc_slice.dtype, count=k)
+            np.add(acc_slice[pos:pos + k], arr[:k],
+                   out=acc_slice[pos:pos + k])
             pos += k
 
     def _recv_into(self, frm: int, arr: np.ndarray) -> None:
@@ -208,10 +264,77 @@ class TcpCollectives:
         each rank ships its wire-cast chunks to their owners, owners
         accumulate in fp32 and round ONCE, reduced chunks return in the
         wire dtype — so numerics match the planes' one-rounding contract
-        instead of the reference's per-hop fp16 rounding."""
-        n, rank, size = buf.size, self.rank, self.size
-        if size == 1:
+        instead of the reference's per-hop fp16 rounding.
+
+        Dispatch: fused single-pass widen+accumulate kernels
+        (compress/fused.py) when enabled, else the reference per-chunk
+        astype chain.  Bitwise-identical results either way."""
+        if self.size == 1:
             return buf
+        if self.fused:
+            return self._cast_allreduce_fused(buf, wire_dtype)
+        return self._cast_allreduce_reference(buf, wire_dtype)
+
+    def _cast_allreduce_fused(self, buf: np.ndarray,
+                              wire_dtype: np.dtype) -> np.ndarray:
+        """Fused gather leg: every destination chunk is posted on the
+        persistent sender lanes UP FRONT (one frame per peer — far below
+        the lane queue bound), which frees this thread to receive
+        contributions in ASCENDING RANK ORDER and fold each one into the
+        fp32 accumulator the moment it arrives (compress/fused.py
+        cast_add: one widening pass in scratch + one in-place add).
+        Accumulation order is therefore exactly the reference path's
+        rank-order sum — bitwise identical — without the per-peer
+        astype allocations or the deferred contribution list."""
+        n, rank, size = buf.size, self.rank, self.size
+        from ..compress import chunk_bounds
+        fk = self._fk
+        wire_dtype = np.dtype(wire_dtype)
+        x = np.ascontiguousarray(buf).astype(wire_dtype, copy=False)
+        bounds = chunk_bounds(n, size)
+        my_len = int(bounds[rank + 1] - bounds[rank])
+
+        t0 = time.perf_counter() if self._tm_on else 0.0
+        for offset in range(1, size):
+            to = (rank + offset) % size
+            self.mesh.send_async(to, _bv(x[bounds[to]:bounds[to + 1]]))
+        acc = fk.f32(("cacc",), my_len)
+        acc[:] = 0.0
+        for j in range(size):                  # rank-order accumulate
+            if j == rank:
+                fk.cast_add(_bv(x[bounds[rank]:bounds[rank + 1]]),
+                            wire_dtype, acc, ("cin",))
+            else:
+                view = self._recv_scratch(j)
+                fk.cast_add(view, wire_dtype, acc, ("cin",))
+        reduced = acc.astype(wire_dtype)       # the ONE rounding
+        if self._tm_on:
+            self._m_leg[("gather", True)].observe(
+                (time.perf_counter() - t0) * 1e3)
+
+        # Return leg: reduced chunks land straight in their output slice
+        # (already zero-copy in the reference shape).
+        t0 = time.perf_counter() if self._tm_on else 0.0
+        out = np.empty(n, dtype=wire_dtype)
+        out[bounds[rank]:bounds[rank + 1]] = reduced
+        payload = _bv(reduced)
+        for offset in range(1, size):
+            to = (rank + offset) % size
+            frm = (rank - offset) % size
+            self.mesh.send_async(to, payload)
+            self._recv_into(frm, out[bounds[frm]:bounds[frm + 1]])
+        self.mesh.flush()
+        if self._tm_on:
+            self._m_leg[("return", True)].observe(
+                (time.perf_counter() - t0) * 1e3)
+        return out.astype(buf.dtype, copy=False)
+
+    def _cast_allreduce_reference(self, buf: np.ndarray,
+                                  wire_dtype: np.dtype) -> np.ndarray:
+        """Reference cast path (pre-fusion): per-peer astype widening into
+        a deferred contribution list, rank-order sum at the end.  Kept as
+        the A/B baseline and the HOROVOD_FUSED_KERNELS=0 fallback."""
+        n, rank, size = buf.size, self.rank, self.size
         from ..compress import chunk_bounds
         wire_dtype = np.dtype(wire_dtype)
         x = np.ascontiguousarray(buf).astype(wire_dtype, copy=False)
@@ -222,6 +345,7 @@ class TcpCollectives:
         # widened to fp32 AS IT ARRIVES (the decode overlaps the next
         # peer's in-flight bytes); the accumulation below stays in rank
         # order, so numerics are bit-identical to decode-after-gather.
+        t0 = time.perf_counter() if self._tm_on else 0.0
         contrib32: list = [None] * size
         contrib32[rank] = x[bounds[rank]:bounds[rank + 1]].astype(
             np.float32)
@@ -236,8 +360,12 @@ class TcpCollectives:
         for c in contrib32:                    # rank order (see above)
             acc += c
         reduced = acc.astype(wire_dtype)
+        if self._tm_on:
+            self._m_leg[("gather", False)].observe(
+                (time.perf_counter() - t0) * 1e3)
 
         # Return leg: reduced chunks land straight in their output slice.
+        t0 = time.perf_counter() if self._tm_on else 0.0
         out = np.empty(n, dtype=wire_dtype)
         out[bounds[rank]:bounds[rank + 1]] = reduced
         payload = _bv(reduced)
@@ -247,6 +375,9 @@ class TcpCollectives:
             self.mesh.send_async(to, payload)
             self._recv_into(frm, out[bounds[frm]:bounds[frm + 1]])
         self.mesh.flush()
+        if self._tm_on:
+            self._m_leg[("return", False)].observe(
+                (time.perf_counter() - t0) * 1e3)
         return out.astype(buf.dtype, copy=False)
 
     # -- quantized allreduce (compress/ subsystem) ----------------------
@@ -264,16 +395,95 @@ class TcpCollectives:
           4. requantize the reduced chunk ONCE and exchange it pairwise.
 
         Wire bytes: 2(N-1)/N · quantized-size — the ring-allreduce
-        structure at ~1/4 (int8) / ~1/8 (uint4) of the fp32 volume."""
+        structure at ~1/4 (int8) / ~1/8 (uint4) of the fp32 volume.
+
+        Dispatch: single-pass fused dequant+accumulate+requant kernels
+        (compress/fused.py) when enabled, else the reference per-chunk
+        chain.  Bitwise-identical results either way (same fp32 ops,
+        same rank-order accumulation), so fused and reference ranks even
+        interoperate — both sides move one frame per peer per leg."""
+        if self.size == 1:
+            return buf
+        if self.fused:
+            return self._quantized_allreduce_fused(buf, codec, block_size)
+        return self._quantized_allreduce_reference(buf, codec, block_size)
+
+    def _quantized_allreduce_fused(self, buf: np.ndarray, codec,
+                                   block_size: int) -> np.ndarray:
+        """Fused EQuARX legs: requantize straight into persistent wire
+        images (no QuantizedBlocks objects, no to_bytes copies), each
+        destination chunk posted on its sender lane the moment it is
+        encoded — the encode of chunk k+1 overlaps the wire of chunk k.
+        With every send in flight, contributions are received in
+        ASCENDING RANK ORDER and folded into the fp32 accumulator the
+        moment their bytes land (decode_add: one fused dequant in
+        scratch + one in-place add), so accumulation order is exactly
+        the reference path's rank-order sum — bitwise identical.  The
+        return leg decodes the owners' reduced chunks straight into
+        their final output slices — no deferred part list, no
+        concatenate."""
+        n, rank, size = buf.size, self.rank, self.size
+        from ..compress import chunk_bounds
+        fk = self._fk
+        x = np.ascontiguousarray(buf).astype(np.float32, copy=False)
+        bounds = chunk_bounds(n, size)
+        my_len = int(bounds[rank + 1] - bounds[rank])
+
+        t0 = time.perf_counter() if self._tm_on else 0.0
+        for offset in range(1, size):          # encode k+1 overlaps wire k
+            to = (rank + offset) % size
+            self.mesh.send_async(
+                to, fk.encode(x[bounds[to]:bounds[to + 1]], codec,
+                              block_size, ("enc", to)))
+        my_wire = fk.encode(x[bounds[rank]:bounds[rank + 1]], codec,
+                            block_size, ("enc", rank))
+        acc = fk.f32(("qacc",), my_len)
+        acc[:] = 0.0
+        for j in range(size):                  # rank-order accumulate
+            if j == rank:
+                fk.decode_add(my_wire, my_len, codec, block_size,
+                              acc, ("qin",))
+            else:
+                view = self._recv_scratch(j)
+                fk.decode_add(view, my_len, codec, block_size,
+                              acc, ("qin",))
+        reduced = fk.encode(acc, codec, block_size, ("red",))
+        if self._tm_on:
+            self._m_leg[("gather", True)].observe(
+                (time.perf_counter() - t0) * 1e3)
+
+        t0 = time.perf_counter() if self._tm_on else 0.0
+        out = np.empty(n, np.float32)
+        fk.decode_into(reduced, my_len, codec, block_size,
+                       out[bounds[rank]:bounds[rank + 1]], ("qout",))
+        for offset in range(1, size):
+            to = (rank + offset) % size
+            frm = (rank - offset) % size
+            self.mesh.send_async(to, reduced)
+            view = self._recv_scratch(frm)
+            fk.decode_into(view, int(bounds[frm + 1] - bounds[frm]),
+                           codec, block_size,
+                           out[bounds[frm]:bounds[frm + 1]], ("qout",))
+        self.mesh.flush()
+        if self._tm_on:
+            self._m_leg[("return", True)].observe(
+                (time.perf_counter() - t0) * 1e3)
+        return out.astype(buf.dtype, copy=False)
+
+    def _quantized_allreduce_reference(self, buf: np.ndarray, codec,
+                                       block_size: int) -> np.ndarray:
+        """Reference quantized path (pre-fusion): per-chunk
+        quantize/to_bytes on the way out, from_bytes/dequantize + a
+        deferred rank-order sum on the way in.  Kept as the A/B baseline
+        and the HOROVOD_FUSED_KERNELS=0 fallback."""
         from ..compress import (chunk_bounds, dequantize, from_bytes,
                                 quantize, to_bytes)
         n, rank, size = buf.size, self.rank, self.size
-        if size == 1:
-            return buf
         x = np.ascontiguousarray(buf).astype(np.float32, copy=False)
         bounds = chunk_bounds(n, size)
 
-        my_chunks = [quantize(x[bounds[j]:bounds[j + 1]], codec,
+        t0 = time.perf_counter() if self._tm_on else 0.0
+        my_chunks = [quantize(x[bounds[j]:bounds[j + 1]], codec,  # hvdlint: disable=per-segment-codec-loop -- this IS the reference chain the fused kernels replace; kept for the fused-vs-reference A/B and as the dispatch fallback
                               block_size) for j in range(size)]
         my_len = int(bounds[rank + 1] - bounds[rank])
         # Gather leg: dequantize each contribution AS IT ARRIVES (the
@@ -287,15 +497,19 @@ class TcpCollectives:
         for offset in range(1, size):
             to = (rank + offset) % size
             frm = (rank - offset) % size
-            self.mesh.send_async(to, to_bytes(my_chunks[to]))
+            self.mesh.send_async(to, to_bytes(my_chunks[to]))  # hvdlint: disable=per-segment-codec-loop -- reference A/B baseline (see above)
             view = self._recv_scratch(frm)
-            contrib32[frm] = dequantize(from_bytes(
+            contrib32[frm] = dequantize(from_bytes(  # hvdlint: disable=per-segment-codec-loop -- reference A/B baseline (see above)
                 np.frombuffer(view, np.uint8), my_len, codec, block_size))
         acc = np.zeros(my_len, np.float32)
         for c in contrib32:
             acc += c
         reduced = quantize(acc, codec, block_size)
+        if self._tm_on:
+            self._m_leg[("gather", False)].observe(
+                (time.perf_counter() - t0) * 1e3)
 
+        t0 = time.perf_counter() if self._tm_on else 0.0
         out_parts: list = [None] * size
         out_parts[rank] = dequantize(reduced)
         payload = to_bytes(reduced)
@@ -304,10 +518,13 @@ class TcpCollectives:
             frm = (rank - offset) % size
             self.mesh.send_async(to, payload)
             view = self._recv_scratch(frm)
-            out_parts[frm] = dequantize(from_bytes(
+            out_parts[frm] = dequantize(from_bytes(  # hvdlint: disable=per-segment-codec-loop -- reference A/B baseline (see above)
                 np.frombuffer(view, np.uint8),
                 int(bounds[frm + 1] - bounds[frm]), codec, block_size))
         self.mesh.flush()
+        if self._tm_on:
+            self._m_leg[("return", False)].observe(
+                (time.perf_counter() - t0) * 1e3)
         out = np.concatenate(out_parts) if size > 1 else out_parts[0]
         return out.astype(buf.dtype, copy=False)
 

@@ -37,6 +37,8 @@ class XlaCommunicator:
         self._mesh = None
         self._cache: dict = {}
         self._shardings: dict = {}
+        # Fused host-side encode kernels (lazy; compress/fused.py).
+        self._fk = None
 
     def _world_sharding(self):
         """Cached NamedSharding(mesh, P("world")) — rebuilding these
@@ -156,27 +158,54 @@ class XlaCommunicator:
         int8/uint4 payloads device-side, dequantize+sum in fp32.  Unlike
         the socket planes there is no output requantization — the reduced
         fp32 values come straight off the device — so this plane's error
-        is strictly within the shared bound."""
+        is strictly within the shared bound.
+
+        The device half is already fused (dequant+sum is one jitted
+        program — on TPU, XLA/Mosaic fuses the codec math into the
+        collective pass itself); the host half dispatches between the
+        single-pass fused encode (compress/fused.py, persistent scratch,
+        byte-identical wire image) and the reference quantize() chain
+        (HOROVOD_FUSED_KERNELS=0)."""
         import jax
 
+        from ..common import config
         from ..compress import CompressionCodec, num_blocks, quantize
 
         mesh = self._world_mesh()
         size = mesh.shape["world"]
         n = buf.size
-        qb = quantize(buf, codec, block_size)
         nb = num_blocks(n, block_size)
         m = nb * block_size
         pb = m // 2 if codec == CompressionCodec.UINT4 else m
-        payload = np.zeros(pb, np.uint8)
-        payload[:qb.payload.size] = qb.payload
+        if config.FUSED_KERNELS.get():
+            from ..compress.fused import FusedKernels
+            fk = self._fk
+            if fk is None:
+                fk = self._fk = FusedKernels()
+            wire = fk.encode(buf.reshape(-1), codec, block_size,
+                             ("xla",))
+            meta = nb * 4
+            scales = wire[:meta].view(np.float32)
+            zps = wire[meta:2 * meta].view(np.float32)
+            payload = fk.u8(("xla", "pad"), pb)
+            pv = wire[2 * meta:]
+            payload[:pv.size] = pv
+            payload[pv.size:] = 0
+        else:
+            qb = quantize(buf, codec, block_size)
+            scales, zps = qb.scales, qb.zero_points
+            payload = np.zeros(pb, np.uint8)
+            payload[:qb.payload.size] = qb.payload
         sharding = self._world_sharding()
+        # make_array_from_process_local_data device_puts a COPY of each
+        # host row, so the persistent fused scratch is safe to reuse on
+        # the next op.
         g_q = jax.make_array_from_process_local_data(
             sharding, payload[None, :], global_shape=(size, pb))
         g_s = jax.make_array_from_process_local_data(
-            sharding, qb.scales[None, :], global_shape=(size, nb))
+            sharding, scales[None, :], global_shape=(size, nb))
         g_z = jax.make_array_from_process_local_data(
-            sharding, qb.zero_points[None, :], global_shape=(size, nb))
+            sharding, zps[None, :], global_shape=(size, nb))
         out = self._quantized_reduce_fn(codec, size, n, block_size)(
             g_q, g_s, g_z)
         return np.asarray(out).astype(buf.dtype, copy=False)

@@ -109,6 +109,15 @@ AUTOTUNE_COMPRESSION = register(
     "HOROVOD_AUTOTUNE_COMPRESSION", False, _parse_bool,
     "Let the autotuner sweep wire codecs (none/fp16/int8) by measured "
     "allreduce throughput and broadcast the winner to every rank.")
+FUSED_KERNELS = register(
+    "HOROVOD_FUSED_KERNELS", True, _parse_bool,
+    "Single-pass fused codec kernels on the quantized/cast collective "
+    "legs (compress/fused.py): dequantize+accumulate straight off the "
+    "wire, requantize straight into a persistent wire image.  Bitwise "
+    "identical to the reference chain; 0 restores the per-chunk "
+    "dequant/requant path (the fused-vs-reference A/B baseline).  Must "
+    "be set identically on every rank; the autotuner can retune it at "
+    "runtime (ResponseList.tuned_fused).")
 
 # --- Autotune (reference: common/parameter_manager.cc) ----------------------
 AUTOTUNE = register(

@@ -149,6 +149,8 @@ class Controller:
         self.pending_tuned_codec: int | None = None
         # (segment_bytes, num_streams) TCP-pipeline proposal.
         self.pending_tuned_pipeline: tuple[int, int] | None = None
+        # Fused-codec-kernel proposal (0/1; compress/fused.py dispatch).
+        self.pending_tuned_fused: int | None = None
         # Last request params per tensor, for cache insertion on every rank.
         self._last_request_params: dict[str, Request] = {}
 
@@ -252,7 +254,8 @@ class Controller:
             if self.is_coordinator and (
                     self.pending_tuned_params is not None
                     or self.pending_tuned_codec is not None
-                    or self.pending_tuned_pipeline is not None):
+                    or self.pending_tuned_pipeline is not None
+                    or self.pending_tuned_fused is not None):
                 # Force one negotiation cycle so autotuned parameters reach
                 # every rank even in cache steady state.
                 coordinator.uncached_in_queue = True
@@ -504,6 +507,9 @@ class Controller:
                 response_list.tuned_segment_bytes = segment
                 response_list.tuned_num_streams = streams
                 self.pending_tuned_pipeline = None
+            if self.pending_tuned_fused is not None:
+                response_list.tuned_fused = self.pending_tuned_fused
+                self.pending_tuned_fused = None
             try:
                 self.transport.broadcast_responses(response_list)
             except RanksFailedError as exc:

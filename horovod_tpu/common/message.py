@@ -242,6 +242,10 @@ class ResponseList:
     # rank-symmetric.
     tuned_segment_bytes: int = -1
     tuned_num_streams: int = -1
+    # Autotuned fused-codec-kernel dispatch (-1 = unchanged, else 0/1):
+    # flips HOROVOD_FUSED_KERNELS at runtime on every rank in the same
+    # cycle (compress/fused.py single-pass legs vs the reference chain).
+    tuned_fused: int = -1
 
     def to_bytes(self) -> bytes:
         enc = Encoder()
@@ -251,6 +255,7 @@ class ResponseList:
         enc.svarint(self.tuned_codec)
         enc.svarint(self.tuned_segment_bytes)
         enc.svarint(self.tuned_num_streams)
+        enc.svarint(self.tuned_fused)
         enc.uvarint(len(self.responses))
         for r in self.responses:
             r.encode(enc)
@@ -265,6 +270,7 @@ class ResponseList:
         codec = dec.svarint()
         segment = dec.svarint()
         streams = dec.svarint()
+        fused = dec.svarint()
         n = dec.uvarint()
         return cls(responses=[Response.decode(dec) for _ in range(n)],
                    shutdown=shutdown,
@@ -272,4 +278,5 @@ class ResponseList:
                    tuned_cycle_time_ms=cycle,
                    tuned_codec=codec,
                    tuned_segment_bytes=segment,
-                   tuned_num_streams=streams)
+                   tuned_num_streams=streams,
+                   tuned_fused=fused)

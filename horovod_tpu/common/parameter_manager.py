@@ -73,6 +73,18 @@ class ParameterManager:
         self._pipeline_scores: dict[tuple[int, int], float] = {}
         self._pipeline_index = 0
 
+        # Fused-kernel sweep (rides HOROVOD_AUTOTUNE_PIPELINE): after the
+        # pipeline sweep, score the single-pass fused codec legs against
+        # the reference dequant/requant chain for one window each and pin
+        # the winner through ResponseList.tuned_fused.  Both settings are
+        # bitwise identical, so the sweep is purely a speed question —
+        # fused wins on codec-heavy wires, and on pure-fp32 rings the two
+        # are the same code path (sweeping stays cheap either way).
+        self._fused_candidates: list[int] = \
+            [1, 0] if active and config.AUTOTUNE_PIPELINE.get() else []
+        self._fused_scores: dict[int, float] = {}
+        self._fused_index = 0
+
     def observe(self, tensor_names: list[str], nbytes: int) -> None:
         """Called once per background cycle with the allreduced bytes."""
         if not self._active or self._done:
@@ -138,6 +150,26 @@ class ParameterManager:
             logger.info("autotune pipeline sweep: %s -> segment=%d "
                         "streams=%d", self._pipeline_scores, *best)
             self._pipeline_candidates = []
+            return
+
+        if self._fused_candidates:
+            if self._fused_index > 0:
+                measured = self._fused_candidates[self._fused_index - 1]
+                self._fused_scores[measured] = score
+                self._log(*self._current, score,
+                          event=f"fused-{measured}")
+            if self._fused_index < len(self._fused_candidates):
+                nxt = self._fused_candidates[self._fused_index]
+                self._fused_index += 1
+                self._controller.pending_tuned_fused = nxt
+                return
+            best = max(self._fused_scores, key=self._fused_scores.get)
+            self._controller.pending_tuned_fused = best
+            self._log(*self._current, self._fused_scores[best],
+                      event=f"fused-winner-{best}")
+            logger.info("autotune fused-kernel sweep: %s -> fused=%d",
+                        self._fused_scores, best)
+            self._fused_candidates = []
             return
 
         import math

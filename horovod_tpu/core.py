@@ -638,6 +638,18 @@ def _background_loop() -> None:
         if response_list.tuned_num_streams > 0:
             st.active_streams = min(response_list.tuned_num_streams,
                                     max(len(st.op_managers), 1))
+        if response_list.tuned_fused >= 0:
+            # Fused-kernel dispatch flips on the same cycle on every rank
+            # (both settings are bitwise identical AND frame-compatible,
+            # so even a straggling flip cannot corrupt a reduce).  The
+            # shm plane carries the same dispatch attribute.
+            for coll in st.tcp_collectives:
+                coll.fused = bool(response_list.tuned_fused)
+            for mgr in (st.op_managers or
+                        ([st.op_manager] if st.op_manager else [])):
+                for be in mgr.backends:
+                    if be.name == "shm":
+                        be.fused = bool(response_list.tuned_fused)
 
         # Chaos harness (HOROVOD_CHAOS): deterministic response-level
         # fault injection fires HERE, on the coordinator-ordered

@@ -68,8 +68,12 @@ def _build() -> str | None:
     if os.path.exists(so_path):
         return so_path
     tmp = tempfile.mktemp(suffix=".so", dir=_cache_dir())
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           "-pthread", _SRC, "-o", tmp]
+    # -ffp-contract=off: the fused codec kernels must round between the
+    # q*scale multiply and the +zero_point add exactly like numpy does —
+    # an FMA contraction would break their bitwise-parity contract with
+    # compress/quantize.py (tests/test_fused.py pins it).
+    cmd = ["g++", "-O3", "-march=native", "-ffp-contract=off", "-shared",
+           "-fPIC", "-std=c++17", "-pthread", _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, so_path)   # atomic: concurrent builders race safely
@@ -116,6 +120,14 @@ def _load() -> ctypes.CDLL | None:
                                           ctypes.c_float]
             lib.hvd_scale_f64.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                           ctypes.c_double]
+            lib.hvd_qencode.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p]
+            lib.hvd_qencode.restype = ctypes.c_int32
+            lib.hvd_qdecode.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_int32]
+            lib.hvd_qdecode.restype = ctypes.c_int32
             _lib = lib
         except OSError:
             _lib = None
@@ -140,6 +152,38 @@ def ring_allreduce(send_fd: int, recv_fd: int, buf: np.ndarray,
     if rc == -1:
         raise ConnectionError("native ring allreduce: peer socket failed")
     return rc == 0
+
+
+def qencode(x: np.ndarray, block_size: int, levels: int, pack4: bool,
+            wire: np.ndarray) -> bool:
+    """Single-pass blockwise quantize of contiguous fp32 ``x`` straight
+    into the wire image ``scales || zero_points || payload`` (the
+    compress/quantize.py layout, byte-identical).  Returns False when the
+    native library is unavailable (caller falls back to numpy)."""
+    lib = _load()
+    if lib is None:
+        return False
+    lib.hvd_qencode(x.ctypes.data_as(ctypes.c_void_p), x.size,
+                    block_size, levels, 1 if pack4 else 0,
+                    wire.ctypes.data_as(ctypes.c_void_p))
+    return True
+
+
+def qdecode(wire: np.ndarray, n: int, block_size: int, pack4: bool,
+            dst: np.ndarray, accumulate: bool) -> bool:
+    """Single-pass fused dequantize of a wire image into contiguous fp32
+    ``dst`` — with ``accumulate`` the kernel performs
+    ``dst += q·scale + zp`` in ONE loop over the payload (the fused
+    computation-collective inner loop).  Returns False when the native
+    library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return False
+    lib.hvd_qdecode(wire.ctypes.data_as(ctypes.c_void_p), n, block_size,
+                    1 if pack4 else 0,
+                    dst.ctypes.data_as(ctypes.c_void_p),
+                    1 if accumulate else 0)
+    return True
 
 
 def pack(parts: list[np.ndarray | None], sizes: list[int],

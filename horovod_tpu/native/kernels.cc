@@ -16,6 +16,7 @@
 // Exposed as a plain C ABI for ctypes (the reference loads its core the same
 // way: horovod/common/basics.py ctypes.CDLL).
 #include <cerrno>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <memory>
@@ -262,6 +263,122 @@ int32_t hvd_ring_allreduce(int32_t send_fd, int32_t recv_fd, void* buf,
     default: return -2;
   }
 }
+
+// ---------------------------------------------------------------------------
+// Fused codec kernels (compress/fused.py native half; EQuARX-style
+// blockwise affine quantization, arXiv:2506.17615 + arXiv:2305.06942).
+//
+// THE single-pass computation-collective kernels: hvd_qdecode with
+// accumulate=1 consumes an arriving wire segment and updates the fp32
+// accumulator in place — dequantize and reduce in ONE loop over the
+// payload — and hvd_qencode requantizes an accumulator straight into a
+// contiguous wire image (scales || zero_points || payload, the exact
+// compress/quantize.py layout).
+//
+// Bit-exactness contract with the numpy reference (compress/quantize.py):
+// identical IEEE fp32 operations in identical order — subtract, divide,
+// rintf (round-half-even, = np.rint), clip, truncating uint8 cast on the
+// way in; multiply, add, accumulate-add on the way out.  The build passes
+// -ffp-contract=off so the compiler cannot fuse the q*scale+zp
+// multiply-add into an FMA (numpy rounds between the two ops; an FMA
+// would not).  Tail blocks follow the same pad rule (padding repeats the
+// block's own last element, so min/max are unchanged and only `count`
+// real elements are coded); odd-length uint4 payloads zero the pad
+// nibble, byte-identical to the numpy packer.
+// ---------------------------------------------------------------------------
+extern "C" {
+
+int32_t hvd_qencode(const float* x, int64_t n, int32_t block_size,
+                    int32_t levels, int32_t pack4, uint8_t* wire) {
+  if (n <= 0 || block_size <= 0) return 0;
+  int64_t nb = (n + block_size - 1) / block_size;
+  uint8_t* sp = wire;                 // per-block scales   (fp32)
+  uint8_t* zpp = wire + nb * 4;       // per-block zero pts (fp32)
+  uint8_t* pl = wire + nb * 8;        // packed levels
+  const float maxq = (float)(levels - 1);
+  for (int64_t b = 0; b < nb; ++b) {
+    int64_t start = b * block_size;
+    int64_t count = n - start;
+    if (count > block_size) count = block_size;
+    float lo = x[start], hi = x[start];
+    for (int64_t i = 1; i < count; ++i) {
+      float v = x[start + i];
+      if (v < lo) lo = v;
+      if (v > hi) hi = v;
+    }
+    float scale = (hi - lo) / maxq;
+    if (!(scale > 0.0f)) scale = 1.0f;   // flat (or NaN) block
+    std::memcpy(sp + b * 4, &scale, 4);
+    std::memcpy(zpp + b * 4, &lo, 4);
+    if (!pack4) {
+      for (int64_t i = 0; i < count; ++i) {
+        float q = rintf((x[start + i] - lo) / scale);
+        if (q < 0.0f) q = 0.0f;
+        else if (q > maxq) q = maxq;
+        pl[start + i] = (uint8_t)q;
+      }
+    } else {
+      // block_size is even by config validation, so nibble pairs never
+      // straddle blocks; an odd GLOBAL tail zeroes its pad nibble.
+      int64_t i = 0;
+      for (; i + 1 < count; i += 2) {
+        float qa = rintf((x[start + i] - lo) / scale);
+        float qb = rintf((x[start + i + 1] - lo) / scale);
+        if (qa < 0.0f) qa = 0.0f; else if (qa > maxq) qa = maxq;
+        if (qb < 0.0f) qb = 0.0f; else if (qb > maxq) qb = maxq;
+        pl[(start + i) >> 1] =
+            (uint8_t)(((uint8_t)qa << 4) | (uint8_t)qb);
+      }
+      if (i < count) {
+        float qa = rintf((x[start + i] - lo) / scale);
+        if (qa < 0.0f) qa = 0.0f; else if (qa > maxq) qa = maxq;
+        pl[(start + i) >> 1] = (uint8_t)((uint8_t)qa << 4);
+      }
+    }
+  }
+  return 0;
+}
+
+int32_t hvd_qdecode(const uint8_t* wire, int64_t n, int32_t block_size,
+                    int32_t pack4, float* dst, int32_t accumulate) {
+  if (n <= 0 || block_size <= 0) return 0;
+  int64_t nb = (n + block_size - 1) / block_size;
+  const uint8_t* sp = wire;
+  const uint8_t* zpp = wire + nb * 4;
+  const uint8_t* pl = wire + nb * 8;
+  for (int64_t b = 0; b < nb; ++b) {
+    int64_t start = b * block_size;
+    int64_t count = n - start;
+    if (count > block_size) count = block_size;
+    float scale, zp;
+    std::memcpy(&scale, sp + b * 4, 4);   // wire may be unaligned (shm
+    std::memcpy(&zp, zpp + b * 4, 4);     // regions slice at odd offsets)
+    if (accumulate) {
+      for (int64_t i = 0; i < count; ++i) {
+        int64_t g = start + i;
+        uint8_t q = pack4 ? (uint8_t)((g & 1) ? pl[g >> 1] & 0x0F
+                                              : pl[g >> 1] >> 4)
+                          : pl[g];
+        float v = (float)q * scale;       // separate mul + add: numpy
+        v = v + zp;                       // rounds between them (no FMA)
+        dst[g] += v;
+      }
+    } else {
+      for (int64_t i = 0; i < count; ++i) {
+        int64_t g = start + i;
+        uint8_t q = pack4 ? (uint8_t)((g & 1) ? pl[g >> 1] & 0x0F
+                                              : pl[g >> 1] >> 4)
+                          : pl[g];
+        float v = (float)q * scale;
+        v = v + zp;
+        dst[g] = v;
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"
 
 // ---------------------------------------------------------------------------
 // Adasum primitives (reference: ops/adasum/adasum.h ComputeDotAndNormSqrds

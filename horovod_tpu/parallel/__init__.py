@@ -17,7 +17,8 @@ from .mesh import MeshSpec, build_mesh, axis_size, data_axes, DEFAULT_AXES
 from .collectives import (allreduce, allgather, alltoall, broadcast,
                           reduce_scatter, adasum_allreduce, device_collective)
 from .grad_sync import (GradSyncConfig, build_grad_sync,
-                        init_error_feedback, sync_gradients,
+                        init_error_feedback, init_ring_optimizer_state,
+                        ring_chunk_size, sync_and_apply, sync_gradients,
                         sync_gradients_ef)
 from .sharding import (ShardingRules, shard_params, named_sharding,
                        constrain, replicated)
@@ -30,7 +31,8 @@ __all__ = [
     "allreduce", "allgather", "alltoall", "broadcast", "reduce_scatter",
     "adasum_allreduce", "device_collective",
     "GradSyncConfig", "build_grad_sync", "sync_gradients",
-    "sync_gradients_ef", "init_error_feedback",
+    "sync_gradients_ef", "init_error_feedback", "sync_and_apply",
+    "init_ring_optimizer_state", "ring_chunk_size",
     "ShardingRules", "shard_params", "named_sharding", "constrain",
     "replicated",
 ]

@@ -26,7 +26,8 @@ from .common.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .parallel.collectives import allreduce
-from .parallel.grad_sync import GradSyncConfig, sync_gradients
+from .parallel.grad_sync import (GradSyncConfig, init_ring_optimizer_state,
+                                 sync_and_apply, sync_gradients)
 from .parallel.mesh import data_axes
 from .parallel.sharding import ShardingRules
 
@@ -161,20 +162,48 @@ class Trainer:
             params = variables["params"]
             batch_stats = variables.get("batch_stats", {})
             return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                              opt_state=self.tx.init(params),
+                              opt_state=self._init_opt_state(params),
                               batch_stats=batch_stats)
 
+        if self.sync.optimizer_in_ring:
+            opt_specs = _ring_opt_state_specs(
+                self.tx, variables["params"], self._ring_world(),
+                self.sync)
+        else:
+            opt_specs = _opt_state_specs(self.tx, variables["params"],
+                                         param_specs)
         shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(self.mesh, s),
             TrainState(step=P(),
                        params=param_specs,
-                       opt_state=_opt_state_specs(self.tx, variables["params"],
-                                                  param_specs),
+                       opt_state=opt_specs,
                        batch_stats=jax.tree_util.tree_map(
                            lambda _: P(),
                            variables.get("batch_stats", {}))),
             is_leaf=lambda x: isinstance(x, P))
         return jax.jit(_init, out_shardings=shardings)()
+
+    def _ring_world(self) -> int:
+        """World size of the optimizer-in-ring shard layout: the product
+        of the sync axes' mesh sizes."""
+        world = 1
+        for a in self.sync.axes:
+            world *= int(self.mesh.shape[a])
+        return world
+
+    def _init_opt_state(self, params):
+        """Optimizer state: replicated tx.init(params) normally; with
+        optimizer_in_ring, per-rank flat-shard states stacked on a
+        leading world axis (sharded over the sync axes — ZeRO-style,
+        each rank physically holds 1/world of the moments)."""
+        if not self.sync.optimizer_in_ring:
+            return self.tx.init(params)
+        world = self._ring_world()
+        base = init_ring_optimizer_state(self.tx, params, world,
+                                         self.sync)
+        return jax.tree_util.tree_map(
+            lambda l: jnp.broadcast_to(l, (world,) + l.shape)
+            if getattr(l, "ndim", 0) >= 1 else l, base)
 
     # -- the compiled step -------------------------------------------------
     def _build(self, state: TrainState) -> Callable:
@@ -185,6 +214,21 @@ class Trainer:
         # shardings (set at init).
         manual_axes = frozenset(sync_cfg.axes)
         state_specs = jax.tree_util.tree_map(lambda _: P(), state)
+        if sync_cfg.optimizer_in_ring:
+            if not manual_axes:
+                raise ValueError(
+                    "optimizer_in_ring needs explicit sync axes "
+                    "(pure-GSPMD mode has no manual axis to shard the "
+                    "update over)")
+            # Stacked opt-state leaves ride sharded over the sync axes:
+            # inside the manual region each rank sees its (1, chunk)
+            # shard — the ZeRO layout sync_and_apply updates in place.
+            state_specs = dataclasses.replace(
+                state_specs,
+                opt_state=jax.tree_util.tree_map(
+                    lambda l: P(sync_cfg.axes)
+                    if getattr(l, "ndim", 0) >= 2 else P(),
+                    state.opt_state))
 
         def local_step(state: TrainState, batch: dict):
             def loss_of(params):
@@ -202,13 +246,28 @@ class Trainer:
             grad_fn = jax.value_and_grad(loss_of, has_aux=True)
             (loss, (logits, updated)), grads = grad_fn(state.params)
 
-            # The horovod moment: fused, compressed allreduce of the
-            # gradient pytree over the data axes.
-            grads = sync_gradients(grads, sync_cfg)
+            if sync_cfg.optimizer_in_ring:
+                # The fused horovod moment: reduce-scatter the gradient
+                # pytree, apply the optax update on this rank's shard
+                # (optimizer state sharded ZeRO-style), and all-gather
+                # the UPDATED PARAMS instead of gradients.
+                opt_local = jax.tree_util.tree_map(
+                    lambda l: l[0] if getattr(l, "ndim", 0) >= 2 else l,
+                    state.opt_state)
+                params, opt_local = sync_and_apply(
+                    self.tx, grads, state.params, opt_local, sync_cfg)
+                opt_state = jax.tree_util.tree_map(
+                    lambda l: l[None] if getattr(l, "ndim", 0) >= 1
+                    else l, opt_local)
+            else:
+                # The horovod moment: fused, compressed allreduce of the
+                # gradient pytree over the data axes.
+                grads = sync_gradients(grads, sync_cfg)
 
-            updates, opt_state = self.tx.update(grads, state.opt_state,
-                                                state.params)
-            params = optax.apply_updates(state.params, updates)
+                updates, opt_state = self.tx.update(grads,
+                                                    state.opt_state,
+                                                    state.params)
+                params = optax.apply_updates(state.params, updates)
 
             metrics = {"loss": allreduce(loss, sync_cfg.axes, "average")}
             if _track_accuracy():
@@ -369,6 +428,20 @@ def _opt_state_specs(tx: optax.GradientTransformation, params: Any,
         return by_shape.get(getattr(leaf, "shape", ()), P())
 
     return jax.tree_util.tree_map(spec_for, shapes)
+
+
+def _ring_opt_state_specs(tx: optax.GradientTransformation, params: Any,
+                          world: int, sync: GradSyncConfig) -> Any:
+    """PartitionSpecs for the stacked optimizer-in-ring state: leaves
+    stacked on the leading world axis shard over the sync axes, scalars
+    (step counts) replicate."""
+    shapes = jax.eval_shape(
+        lambda: jax.tree_util.tree_map(
+            lambda l: jnp.broadcast_to(l, (world,) + l.shape)
+            if getattr(l, "ndim", 0) >= 1 else l,
+            init_ring_optimizer_state(tx, params, world, sync)))
+    return jax.tree_util.tree_map(
+        lambda l: P(sync.axes) if len(l.shape) >= 2 else P(), shapes)
 
 
 def _model_input(batch: dict):

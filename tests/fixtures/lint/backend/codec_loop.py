@@ -1,0 +1,34 @@
+"""HVD1004 fixture: per-segment Python-level codec chains in a backend/
+module.  Lines flagged: the loop-body dequantize/from_bytes chain, the
+list-comprehension quantize, and the loop-body to_bytes; the fused-kernel
+call and the straight-line (non-loop) codec call stay clean."""
+import numpy as np
+
+from horovod_tpu.compress import dequantize, from_bytes, quantize, to_bytes
+
+
+def gather_leg_reference(chunks, n, codec, block_size):
+    acc = np.zeros(n, np.float32)
+    for raw in chunks:
+        acc += dequantize(from_bytes(raw, n, codec, block_size))
+    return acc
+
+
+def scatter_leg_reference(x, bounds, codec, block_size):
+    wires = [to_bytes(quantize(x[bounds[j]:bounds[j + 1]], codec,
+                               block_size))
+             for j in range(len(bounds) - 1)]
+    return wires
+
+
+def fused_leg_ok(fk, chunks, n, codec, block_size, acc):
+    # Fused single-pass kernels inside the loop are the fix, not a hit.
+    for raw in chunks:
+        fk.decode_add(raw, n, codec, block_size, acc, ("in",))
+    return acc
+
+
+def straight_line_ok(x, codec, block_size):
+    # A one-shot codec call outside any loop is fine (e.g. the xla
+    # plane's single input quantization).
+    return to_bytes(quantize(x, codec, block_size))
