@@ -451,6 +451,9 @@ def main() -> int:
 
 
 def bench_resnet(args, info: dict) -> int:
+    # Telemetry on for the multichip payload (same contract as the eager
+    # payload): the trajectory records counters next to the throughput.
+    os.environ.setdefault("HOROVOD_METRICS", "on")
     import jax
     import optax
 
@@ -510,14 +513,30 @@ def bench_resnet(args, info: dict) -> int:
         "vs_baseline": round(per_chip / baseline, 3) if baseline else 0.0,
         "mfu": mfu,
         "n_devices": n_dev,
+        # Observability rides the multichip payload like the eager one:
+        # wire bytes / cache hit rate / stream utilization (empty-ish on
+        # the pure-SPMD path, populated whenever the eager runtime is in
+        # the loop) — docs/observability.md.
+        "metrics": _telemetry_summary(),
         **info,
     })
     return 0
 
 
+def _telemetry_summary() -> dict:
+    try:
+        from horovod_tpu import telemetry
+        return telemetry.summary()
+    except Exception as exc:  # best-effort: never fail a bench for metrics
+        print(f"bench: telemetry summary unavailable: {exc}",
+              file=sys.stderr)
+        return {}
+
+
 def bench_gpt(args, info: dict) -> int:
     """Transformer LM throughput (tokens/sec/chip) with the Pallas flash
     attention kernel; secondary benchmark covering the long-context path."""
+    os.environ.setdefault("HOROVOD_METRICS", "on")
     import jax
     import optax
 
@@ -601,6 +620,7 @@ def bench_gpt(args, info: dict) -> int:
         "vs_baseline": 0.0,   # no reference LM baseline exists
         "mfu": mfu,
         "n_devices": n_dev,
+        "metrics": _telemetry_summary(),
         **info,
     })
     return 0

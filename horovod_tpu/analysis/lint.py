@@ -52,6 +52,16 @@ collectives in the same order (SURVEY §5.2):
   so it executes inside the collective pass.  The kept reference A/B
   baselines carry justified suppressions.
 
+- ``HVD1005 unbalanced-span``: a Timeline span-open call
+  (``activity_start``/``activity_start_all``/``_act_start``) in a
+  ``backend/`` module with no finally-guarded close on the path — an
+  exception mid-op leaves the span open, every later span on that
+  tensor's lane nests wrongly, and the merged cross-rank trace
+  (``telemetry/trace.py``) misattributes the time.  Wrap the op body in
+  ``try/finally`` with the end call in the ``finally`` block (the
+  forwarding helper ``_act_start`` itself is exempt: its callers own
+  the balance).
+
 Heuristics are deliberately lexical (no type inference): a flagged line
 that is provably safe carries ``# hvdlint: disable=<rule> -- <why>``;
 the justification is mandatory (``HVD901``).
@@ -154,6 +164,21 @@ CODEC_CALL_NAMES = frozenset({
 })
 CODEC_HOT_DIRS = frozenset({"backend"})
 
+# HVD1005: Timeline span-open calls in backend/ modules must be paired
+# with a finally-guarded close — an exception on the op path otherwise
+# leaves the span open and every later span on the lane nests wrongly
+# (the merged cross-rank trace then lies about where time went).  A
+# call inside a function whose OWN (underscore-stripped) name is a
+# span-open primitive is exempt: that is the forwarding helper
+# (CollectiveBackend._act_start), whose callers own the balance.
+SPAN_START_NAMES = frozenset({
+    "activity_start", "activity_start_all", "act_start",
+})
+SPAN_END_NAMES = frozenset({
+    "activity_end", "activity_end_all", "act_end",
+})
+SPAN_HOT_DIRS = frozenset({"backend"})
+
 
 @dataclass
 class LintConfig:
@@ -232,6 +257,16 @@ class _Analyzer(ast.NodeVisitor):
         self._in_codec_dir = bool(
             CODEC_HOT_DIRS
             & set(os.path.normpath(path).split(os.sep)[:-1]))
+        self._in_span_dir = bool(
+            SPAN_HOT_DIRS
+            & set(os.path.normpath(path).split(os.sep)[:-1]))
+        # Depth of enclosing try-blocks whose finally contains a span
+        # close, plus the linenos of span-open statements IMMEDIATELY
+        # followed by such a try — the tree's idiom
+        # (`_act_start(...)` then `try: ... finally: _act_end(...)`),
+        # precomputed in visit_Module (HVD1005).
+        self._span_guard_depth = 0
+        self._span_guarded_lines: set[int] = set()
         self._func_stack: list[str] = []
         self._loop_depth = 0
         self._rank_gate_depth = 0
@@ -343,6 +378,66 @@ class _Analyzer(ast.NodeVisitor):
                 (node.lineno, node.end_lineno or node.lineno))
         self.generic_visit(node)
 
+    # --- try/finally (HVD1005 span balance) ---------------------------------
+    @staticmethod
+    def _finally_closes_span(try_node) -> bool:
+        return any(
+            isinstance(sub, ast.Call)
+            and (_terminal_name(sub) or "").lstrip("_") in SPAN_END_NAMES
+            for stmt in try_node.finalbody for sub in ast.walk(stmt))
+
+    @staticmethod
+    def _is_span_start_stmt(stmt: ast.stmt) -> bool:
+        return (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and (_terminal_name(stmt.value) or "").lstrip("_")
+                in SPAN_START_NAMES)
+
+    @classmethod
+    def _span_start_stmt_lines(cls, stmt: ast.stmt) -> list[int]:
+        """Linenos of span-open calls this statement contributes to the
+        followed-by-a-guarded-try idiom: a bare start statement, or an
+        `if cond: start(...)` whose body holds only start calls (the
+        conditional-span idiom, e.g. fused-only MEMCPY spans)."""
+        if cls._is_span_start_stmt(stmt):
+            return [stmt.lineno]
+        if isinstance(stmt, ast.If):
+            lines: list[int] = []
+            for sub in stmt.body + stmt.orelse:
+                if cls._is_span_start_stmt(sub):
+                    lines.append(sub.lineno)
+                elif not isinstance(sub, ast.Pass):
+                    return []
+            return lines
+        return []
+
+    def visit_Module(self, node: ast.Module) -> None:
+        if self._in_span_dir:
+            for sub in ast.walk(node):
+                for fname in ("body", "orelse", "finalbody"):
+                    stmts = getattr(sub, fname, None)
+                    if not isinstance(stmts, list):
+                        continue
+                    for s, nxt in zip(stmts, stmts[1:]):
+                        if isinstance(nxt, ast.Try) \
+                                and self._finally_closes_span(nxt):
+                            self._span_guarded_lines.update(
+                                self._span_start_stmt_lines(s))
+        self.generic_visit(node)
+
+    def visit_Try(self, node) -> None:
+        guarded = self._finally_closes_span(node)
+        if guarded:
+            self._span_guard_depth += 1
+        for n in node.body + node.handlers + node.orelse:
+            self.visit(n)
+        if guarded:
+            self._span_guard_depth -= 1
+        for n in node.finalbody:
+            self.visit(n)
+
+    visit_TryStar = visit_Try
+
     # --- locks -------------------------------------------------------------
     def visit_With(self, node: ast.With) -> None:
         lockish = False
@@ -378,6 +473,20 @@ class _Analyzer(ast.NodeVisitor):
             self._check_blocking_io(node, name)
         if name in WAIT_NAMES and self._in_wait_scope:
             self._check_unbounded_wait(node, name)
+        if name and name.lstrip("_") in SPAN_START_NAMES \
+                and self._in_span_dir \
+                and self._span_guard_depth == 0 \
+                and node.lineno not in self._span_guarded_lines \
+                and not (self._func_stack and
+                         self._func_stack[-1].lstrip("_")
+                         in SPAN_START_NAMES):
+            self._report(
+                "unbalanced-span", node,
+                f"span-open call '{name}' has no finally-guarded "
+                f"activity_end on this path: an exception before the "
+                f"end call leaves the span open and corrupts the "
+                f"tensor's trace lane — wrap the body in try/finally "
+                f"with the matching end call in the finally block")
         if name in CODEC_CALL_NAMES and self._in_codec_dir \
                 and self._loop_depth > 0:
             self._report(

@@ -70,6 +70,13 @@ _RULE_LIST = [
          "it with a timeout, derive a deadline from the "
          "ResilienceContext (resilience/), or justify why the wait is "
          "bounded elsewhere with a suppression."),
+    Rule("HVD1005", "unbalanced-span",
+         "Timeline activity_start in a backend/ module without a "
+         "finally-guarded activity_end: an exception between the two "
+         "leaves the span open, corrupting every later span on that "
+         "tensor's trace lane (and the merged cross-rank trace built "
+         "from it) — wrap the op body in try/finally with the end call "
+         "in the finally block."),
     Rule("HVD1004", "per-segment-codec-loop",
          "compress/ codec call (quantize/dequantize/from_bytes/to_bytes) "
          "inside a loop in a backend/ module: the per-segment "

@@ -203,19 +203,24 @@ class CollectiveBackend(ABC):
         owned = self.fusion_buffers.owns(buf)
         if len(entries) > 1:
             self._act_start(entries, "MEMCPY_OUT_FUSION_BUFFER")
-        offset = 0
-        for i, e in enumerate(entries):
-            n = response.tensor_sizes[i]
-            chunk = buf[offset:offset + n]
-            offset += n
-            if e.tensor is not None:
-                shape = np.asarray(e.tensor).shape
-                out = chunk.reshape(shape)
-            else:
-                out = chunk
-            e.output = out.copy() if owned else out
-        if len(entries) > 1:
-            self._act_end(entries)
+        try:
+            offset = 0
+            for i, e in enumerate(entries):
+                n = response.tensor_sizes[i]
+                chunk = buf[offset:offset + n]
+                offset += n
+                if e.tensor is not None:
+                    shape = np.asarray(e.tensor).shape
+                    out = chunk.reshape(shape)
+                else:
+                    out = chunk
+                e.output = out.copy() if owned else out
+        finally:
+            # finally-guarded end (hvdlint HVD1005): a reshape error here
+            # must not leave the MEMCPY span open — an unbalanced B
+            # corrupts every later span on the tensor's trace lane.
+            if len(entries) > 1:
+                self._act_end(entries)
 
     @staticmethod
     def resolve_alltoall_splits(entry: TensorTableEntry, dim0: int,

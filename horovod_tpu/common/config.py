@@ -165,6 +165,12 @@ METRICS_FILE = register(
     "rank, otherwise '.r<rank>' is inserted before the extension.  "
     "Empty disables the dump.  Summarize with "
     "python -m horovod_tpu.telemetry.report.")
+METRICS_BIND = register(
+    "HOROVOD_METRICS_BIND", "127.0.0.1", str,
+    "Bind address for the Prometheus exposition endpoint.  Defaults to "
+    "localhost: metrics name tensors, hosts and failure details, so "
+    "off-host exposure must be an explicit decision ('' or 0.0.0.0 "
+    "binds all interfaces for real scrape deployments).")
 METRICS_WINDOW = register(
     "HOROVOD_METRICS_WINDOW", 32, int,
     "Negotiated tensors per straggler-aggregation window: the "
@@ -175,6 +181,27 @@ STRAGGLER_THRESHOLD_MS = register(
     "Mean arrival lag (ms behind the fastest rank, per window) above "
     "which the coordinator logs a structured straggler warning and sets "
     "the straggler-rank gauge.")
+
+# --- Flight recorder (telemetry/flight.py; docs/observability.md) -----------
+FLIGHT = register(
+    "HOROVOD_FLIGHT", True, _parse_bool,
+    "Always-on flight recorder: a lock-light bounded ring of recent "
+    "trace events per rank (enqueue, dispatch, completion, failure "
+    "conversions), dumped as rank-stamped JSON when a structured "
+    "failure fires (RanksFailedError, fingerprint divergence, deadline "
+    "poison, SIGTERM) — trace evidence without HOROVOD_TIMELINE.  "
+    "0 restores the exact zero-overhead posture: a shared no-op "
+    "recorder, no ring, no signal handler, no threads either way.")
+FLIGHT_EVENTS = register(
+    "HOROVOD_FLIGHT_EVENTS", 256, int,
+    "Ring capacity of the flight recorder: the last N trace events per "
+    "rank survive into a failure dump.")
+FLIGHT_FILE = register(
+    "HOROVOD_FLIGHT_FILE", "horovod_flight.json", str,
+    "Path of the flight-recorder failure dump; '{rank}' substitutes, "
+    "otherwise '.r<rank>' is inserted before the extension (the "
+    "HOROVOD_METRICS_FILE convention).  Written only when a structured "
+    "failure fires.")
 
 # --- Resilience (resilience/ subsystem; docs/resilience.md) -----------------
 FAULT_TOLERANCE = register(

@@ -187,6 +187,19 @@ class Controller:
         # Within-round per-rank arrival times of the current gather.
         self._gather_arrivals: dict[int, float] = {}
 
+        # Distributed-trace cycle counter (telemetry/trace.py): advances
+        # once per compute_response_list call.  Cycles are lockstep
+        # across ranks — every cycle either runs the bitvector sync or a
+        # full negotiation round — so a locally-incremented counter is
+        # identical on every rank, which is what lets cache-steady
+        # responses (which never ride the wire) be stamped locally while
+        # negotiated responses carry the coordinator's id on the wire.
+        self._trace_cycle = 0
+        # Flight recorder (telemetry/flight.py): Null when HOROVOD_FLIGHT
+        # is off, so every hook below is one attribute test.
+        from ..telemetry import flight as _flight
+        self.flight = _flight.recorder()
+
     # ------------------------------------------------------------------
     @property
     def is_coordinator(self) -> bool:
@@ -197,6 +210,7 @@ class Controller:
 
     # ------------------------------------------------------------------
     def compute_response_list(self, shutdown_requested: bool = False) -> ResponseList:
+        self._trace_cycle += 1
         message_queue = self.tensor_queue.pop_messages_from_queue()
         if self.fingerprint.enabled:
             # Fold every locally-submitted op into this rank's rolling
@@ -335,18 +349,21 @@ class Controller:
             # still participates so the coordinator can make progress.
             need_negotiation = True
 
+        fused_cached = self.fuse_responses(cached_responses)
         if not need_negotiation:
-            return ResponseList(responses=self.fuse_responses(cached_responses))
+            return self._stamp_trace_ids(
+                ResponseList(responses=fused_cached))
 
-        response_list = self._negotiate(message_queue, shutdown_requested)
+        response_list = self._negotiate(message_queue, shutdown_requested,
+                                        trace_offset=len(fused_cached))
         if self._is_poison(response_list):
             # World poisoned mid-negotiation (resilience/): drop this
             # cycle's cached hits — their data-plane execution would
             # block on the dead rank; the poison ERROR already names
             # every pending tensor, so no waiter is left hanging.
             return response_list
-        response_list.responses = (self.fuse_responses(cached_responses)
-                                   + response_list.responses)
+        response_list.responses = fused_cached + response_list.responses
+        self._stamp_trace_ids(response_list)
 
         if self.response_cache.enabled():
             for resp in response_list.responses:
@@ -356,6 +373,19 @@ class Controller:
         return response_list
 
     # ------------------------------------------------------------------
+    def _stamp_trace_ids(self, response_list: ResponseList) -> ResponseList:
+        """Assign the monotone (cycle, seq) trace id to every response
+        that does not already carry one from the wire.  Negotiated
+        responses arrive stamped by the coordinator (seq offset past
+        this cycle's cached hits); cache-steady responses are stamped
+        here — the final list is identical on every rank, so the local
+        stamp is rank-identical too."""
+        for seq, resp in enumerate(response_list.responses):
+            if resp.trace_seq < 0:
+                resp.trace_cycle = self._trace_cycle
+                resp.trace_seq = seq
+        return response_list
+
     def _poison_response_list(self, exc: RanksFailedError) -> ResponseList:
         """Convert a detected rank failure into the structured-ERROR
         shutdown every rank performs locally (resilience/ tentpole): one
@@ -371,6 +401,13 @@ class Controller:
         for name in names:
             self._message_table.pop(name, None)
             self.stall_inspector.remove_uncached_tensor(name)
+        if self.flight.enabled:
+            # Every structured failure ships the last N trace events:
+            # the dump's tail names the op the world died inside
+            # (telemetry/flight.py; docs/observability.md).
+            self.flight.record("ranks-failed", exc.op,
+                               detail=exc.to_wire()[:200])
+            self.flight.dump(reason=exc.to_wire())
         return ResponseList(
             responses=[Response(response_type=ResponseType.ERROR,
                                 tensor_names=names,
@@ -444,7 +481,8 @@ class Controller:
         self._tm_sync_wait_ms = 0.0
 
     def _negotiate(self, message_queue: list[Request],
-                   shutdown_requested: bool) -> ResponseList:
+                   shutdown_requested: bool,
+                   trace_offset: int = 0) -> ResponseList:
         for req in message_queue:
             self._last_request_params[req.tensor_name] = req
         my_list = RequestList(requests=list(message_queue),
@@ -510,6 +548,12 @@ class Controller:
             if self.pending_tuned_fused is not None:
                 response_list.tuned_fused = self.pending_tuned_fused
                 self.pending_tuned_fused = None
+            # Coordinator-assigned trace ids ride the broadcast wire
+            # (the fp_* pattern): seq is offset past this cycle's cached
+            # hits, which every rank prepends in the same order.
+            for i, resp in enumerate(response_list.responses):
+                resp.trace_cycle = self._trace_cycle
+                resp.trace_seq = trace_offset + i
             try:
                 self.transport.broadcast_responses(response_list)
             except RanksFailedError as exc:
@@ -553,6 +597,10 @@ class Controller:
             for rl in gathered])
         if divergence is None:
             return None
+        if self.flight.enabled:
+            self.flight.record("fingerprint-divergence", "",
+                               detail=divergence.message()[:200])
+            self.flight.dump(reason=divergence.message())
         names = divergence.tensor_names()
         for name in names:
             # Divergent tensors will never become globally ready: drop
@@ -889,3 +937,4 @@ class Controller:
         self._tm_cycle_ms = 0.0
         self._tm_sync_wait_ms = 0.0
         self._gather_arrivals.clear()
+        self._trace_cycle = 0

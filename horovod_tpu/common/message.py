@@ -187,6 +187,17 @@ class Response:
     # (identical on every rank by construction — see Request.codec).
     codec: int = 0
     codec_block_size: int = 0
+    # Distributed-trace id (telemetry/trace.py; mirrors the PR 2 fp_*
+    # wire-field pattern): the coordinator assigns a monotone
+    # (cycle, seq) pair to every negotiated collective so each rank's
+    # Timeline spans — and the flight-recorder events — for the SAME
+    # collective carry the SAME id and can be stitched into one
+    # cross-rank flow.  -1 = unassigned (legacy frames, unit fixtures).
+    # Cache-steady-state responses never ride the wire; they are stamped
+    # locally from counters that advance in lockstep on every rank (the
+    # deadlock-freedom invariant makes the local stamp rank-identical).
+    trace_cycle: int = -1
+    trace_seq: int = -1
 
     def encode(self, enc: Encoder) -> None:
         (enc.uvarint(int(self.response_type))
@@ -201,7 +212,9 @@ class Response:
             .svarint(self.root_rank)
             .bool_(self.grouped)
             .uvarint(self.codec)
-            .uvarint(self.codec_block_size))
+            .uvarint(self.codec_block_size)
+            .svarint(self.trace_cycle)
+            .svarint(self.trace_seq))
 
     @classmethod
     def decode(cls, dec: Decoder) -> "Response":
@@ -219,7 +232,16 @@ class Response:
             grouped=dec.bool_(),
             codec=dec.uvarint(),
             codec_block_size=dec.uvarint(),
+            trace_cycle=dec.svarint(),
+            trace_seq=dec.svarint(),
         )
+
+    def trace_id(self) -> str | None:
+        """Compact "cycle.seq" form used in Timeline span args and flow
+        events, or None while unassigned."""
+        if self.trace_cycle < 0 or self.trace_seq < 0:
+            return None
+        return f"{self.trace_cycle}.{self.trace_seq}"
 
 
 @dataclass

@@ -100,10 +100,15 @@ class ResponseCache:
             else:
                 pos = self._free_positions.pop()
         # Store a private copy — the caller's object flows on into fusion
-        # and execution and may be mutated there.
+        # and execution and may be mutated there.  The trace id is reset:
+        # it names ONE negotiated instance, and every later cache hit is
+        # a new collective that gets a fresh id at assembly
+        # (controller._stamp_trace_ids) — a stale id would alias two
+        # different steps in the merged cross-rank trace.
         stored = replace(response, tensor_names=list(response.tensor_names),
                          tensor_sizes=list(response.tensor_sizes),
-                         devices=list(response.devices))
+                         devices=list(response.devices),
+                         trace_cycle=-1, trace_seq=-1)
         self._entries[name] = (pos, stored, _params_of(request, joined_size))
         self._by_position[pos] = name
 

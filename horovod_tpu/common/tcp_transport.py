@@ -87,6 +87,46 @@ class TcpTransport(Transport):
             self.broadcast_poison(exc)
             raise
 
+    # -- clock-offset probes (telemetry/trace.py cross-rank stitching) ---
+    def estimate_clock_offset(self, rounds: int = 5) -> tuple[float, float]:
+        """Estimate this rank's monotonic-clock offset against the
+        coordinator via NTP-style round-trip probes: the worker stamps
+        t0, the coordinator answers with its own monotonic time tc, the
+        worker stamps t1; the minimum-RTT round gives
+        ``offset = tc - (t0 + t1) / 2`` with error bounded by rtt/2.
+
+        Runs ONCE at init, before the background loop touches the ctrl
+        mesh — the probe frames are the first bytes on every ctrl
+        channel, so they can never interleave with protocol frames.
+        The estimate is recorded as trace METADATA (Timeline
+        ``horovod_clock_sync``) and never applied destructively: raw
+        per-rank files keep their own clock, the merge tool aligns.
+        Returns ``(offset_us, rtt_us)``; the coordinator is the
+        reference clock and returns ``(0.0, 0.0)``."""
+        if self.size == 1:
+            return 0.0, 0.0
+        if self.rank == 0:
+            for _ in range(rounds):
+                for peer, _raw in self.mesh.recv_in_arrival_order(
+                        range(1, self.size)):
+                    self.mesh.send(peer,
+                                   struct.pack("<d", time.monotonic()))
+            return 0.0, 0.0
+        best_rtt = float("inf")
+        best_offset = 0.0
+        for _ in range(rounds):
+            t0 = time.monotonic()
+            self.mesh.send(0, b"\x01")
+            raw = self.mesh.recv(0)  # hvdlint: disable=unbounded-blocking-wait -- init-time probe; bounded inside the peer channel under fault tolerance like every ctrl recv
+            t1 = time.monotonic()
+            check_poison(raw)
+            (tc,) = struct.unpack("<d", bytes(raw))
+            rtt = t1 - t0
+            if rtt < best_rtt:
+                best_rtt = rtt
+                best_offset = tc - (t0 + t1) / 2.0
+        return best_offset * 1e6, best_rtt * 1e6
+
     # -- bitvector sync (reference: gloo_controller.cc bitwise ops) ------
     def bitwise_sync(self, and_word: int, or_word: int) -> tuple[int, int]:
         if self.size == 1:

@@ -34,6 +34,11 @@ class TensorTableEntry:
     splits: list[int] = field(default_factory=list)
     received_splits: list[int] = field(default_factory=list)
     context: Any = None                    # framework op context (allocator)
+    # Cross-rank trace id ("cycle.seq") of the response this entry rode,
+    # stamped at pop by core so Timeline sub-activity spans and the
+    # flight recorder can correlate one collective across ranks
+    # (telemetry/trace.py); None until dispatched.
+    trace: str | None = None
 
     def finish(self, status: Status) -> None:
         cb, self.callback = self.callback, None

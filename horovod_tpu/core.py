@@ -203,6 +203,10 @@ class GlobalState:
     # Metrics registry (telemetry/; HOROVOD_METRICS).  Null when off so
     # hot paths test one attribute and skip all instrumentation.
     telemetry: Any = None
+    # Flight recorder (telemetry/flight.py; HOROVOD_FLIGHT).  Null when
+    # off; records a bounded ring of trace events and dumps it on every
+    # structured failure.
+    flight: Any = None
     # Chaos engine (resilience/chaos.py; HOROVOD_CHAOS).  None when off;
     # the background loop fires its deterministic response-level actions.
     chaos: Any = None
@@ -270,6 +274,7 @@ def init(*, rank: int | None = None, size: int | None = None,
         # they cache metric handles from the configured registry.
         from . import telemetry as _telemetry
         _global.telemetry = _telemetry.configure(rank)
+        _global.flight = _telemetry.flight.configure(rank)
         _global.rank, _global.size = rank, size
         _global.local_rank, _global.local_size = local_rank, local_size
         _global.cross_rank, _global.cross_size = cross_rank, cross_size
@@ -283,9 +288,14 @@ def init(*, rank: int | None = None, size: int | None = None,
         _global.active_streams = 1
 
         timeline_path = config.TIMELINE.get()
+        # EVERY rank records its own trace file (cross-rank stitching,
+        # telemetry/trace.py): rank 0 keeps the exact configured path,
+        # ranks > 0 get the '.r<rank>' suffix (timeline.rank_path) —
+        # pre-PR behavior gave only rank 0 a file, so a merged trace and
+        # critical-path attribution were structurally impossible.
         _global.timeline = Timeline(
-            timeline_path if rank == 0 else "",
-            mark_cycles=config.TIMELINE_MARK_CYCLES.get())
+            timeline_path,
+            mark_cycles=config.TIMELINE_MARK_CYCLES.get(), rank=rank)
 
         backends = []
         if size > 1:
@@ -362,6 +372,15 @@ def init(*, rank: int | None = None, size: int | None = None,
                                  timeout=timeout)
             _global.resources.extend([ctrl_mesh, data_mesh])
             transport = TcpTransport(ctrl_mesh)
+            # Per-rank clock-offset estimate against the coordinator
+            # (round-trip probes; the FIRST frames on the ctrl mesh, so
+            # they precede every protocol frame on all ranks).  Recorded
+            # as trace metadata — never applied to live timestamps.
+            clock_offset_us, clock_rtt_us = transport.estimate_clock_offset()
+            _global.timeline.set_clock_sync(clock_offset_us, clock_rtt_us)
+            _global.flight.set_metadata(
+                rank=rank, size=size, clock_offset_us=clock_offset_us,
+                clock_rtt_us=clock_rtt_us)
             # Two-level eager path (reference: NCCLHierarchicalAllreduce,
             # nccl_operations.cc:187-398): refine the TCP plane with
             # local/cross sub-meshes when the knobs are on and the rank
@@ -455,6 +474,10 @@ def init(*, rank: int | None = None, size: int | None = None,
             stream_managers = []
             from . import resilience
             _global.chaos = resilience.chaos.configure(rank)
+            _global.timeline.set_clock_sync(0.0, 0.0)
+            _global.flight.set_metadata(rank=rank, size=size,
+                                        clock_offset_us=0.0,
+                                        clock_rtt_us=0.0)
         backends.append(BasicBackend(size))
 
         # Runtime collective-symmetry fingerprinting (HOROVOD_FINGERPRINT;
@@ -751,6 +774,9 @@ def _perform_join(st: GlobalState, response: Response) -> None:
         entry = st.tensor_queue.pop_tensor_entry(JOIN_TENSOR_NAME)
         entry.output = np.int32(response.last_joined_rank)
         entry.finish(Status.ok())
+        if st.timeline is not None and st.timeline.enabled:
+            st.timeline.queue_end(JOIN_TENSOR_NAME,
+                                  trace=response.trace_id())
 
 
 def _pop_entries(st: GlobalState,
@@ -766,10 +792,16 @@ def _pop_entries(st: GlobalState,
             # Joined rank: participate with a zero stand-in
             # (reference: controller.cc:254-308 joined-rank handling).
             entries.append(TensorTableEntry(tensor_name=name))
+    # Stamp the response's cross-rank trace id on every entry: backend
+    # sub-activity spans and the flight recorder read it from there, so
+    # the planes need no extra plumbing (telemetry/trace.py).
+    trace = response.trace_id()
+    for e in entries:
+        e.trace = trace
     timeline = st.timeline
     if timeline is not None and timeline.enabled:
         for e in entries:
-            timeline.negotiate_end(e.tensor_name)
+            timeline.negotiate_end(e.tensor_name, trace=trace)
     return entries
 
 
@@ -780,11 +812,19 @@ def _execute_response(st: GlobalState, response: Response,
     its entries (runs on the background thread when streams == 1, on a
     stream worker otherwise)."""
     timeline = st.timeline
+    trace = response.trace_id()
     if timeline is not None and timeline.enabled:
         for e in entries:
             timeline.activity_start(e.tensor_name,
                                     response.response_type.name,
-                                    stream=stream)
+                                    stream=stream, trace=trace)
+    fl = st.flight
+    fl_on = fl is not None and fl.enabled
+    if fl_on:
+        head = response.tensor_names[0] if response.tensor_names else ""
+        fl.record("dispatch", head, trace=trace,
+                  detail=f"{response.response_type.name.lower()}"
+                         f" x{len(entries)} stream={stream}")
 
     if response.response_type == ResponseType.ERROR:
         status = Status.precondition_error(response.error_message)
@@ -817,10 +857,23 @@ def _execute_response(st: GlobalState, response: Response,
         except Exception as exc:  # noqa: BLE001 - backend failure
             logger.error("collective execution failed: %s", exc)
             status = Status.unknown_error(str(exc))
+            from .common.exceptions import RanksFailedError
+            if fl_on and isinstance(exc, RanksFailedError):
+                # A data-plane wait converted a dead/wedged peer into
+                # the structured error: ship the evidence — the dump's
+                # tail is the "dispatch" event of this in-flight op.
+                fl.record("ranks-failed", head, trace=trace,
+                          detail=str(exc)[:200])
+                fl.dump(reason=str(exc))
 
     if timeline is not None and timeline.enabled:
         for e in entries:
             timeline.activity_end(e.tensor_name)
+
+    if fl_on:
+        fl.record("done" if status.ok_p() else "error", head,
+                  trace=trace,
+                  detail="" if status.ok_p() else status.reason[:200])
 
     # Release explicit groups everywhere — the coordinator deregisters
     # during response construction, but worker ranks would otherwise leak
@@ -829,6 +882,11 @@ def _execute_response(st: GlobalState, response: Response,
 
     for e in entries:
         e.finish(status)
+    if timeline is not None and timeline.enabled:
+        # Close the enqueue->callback spans AFTER the callbacks ran —
+        # the span covers the waiter's full latency, not just dispatch.
+        for e in entries:
+            timeline.queue_end(e.tensor_name, trace=trace)
 
 
 def _observe_collective(tm, response: Response, plane: str, stream: int,
@@ -917,11 +975,24 @@ def _enqueue(entries: list[TensorTableEntry],
     cb = st.mark_done_callback(handle)
     for e in entries:
         e.callback = cb
+    # Open the enqueue->callback trace span BEFORE submission: the
+    # background loop may pop and finish an entry before this thread
+    # runs again, and a queue_end without its begin would be dropped.
+    timeline = st.timeline
+    tl_on = timeline is not None and timeline.enabled
+    fl = st.flight
+    for e in entries:
+        if tl_on:
+            timeline.queue_start(e.tensor_name)
+        if fl is not None and fl.enabled:
+            fl.record("enqueue", e.tensor_name)
     status = st.tensor_queue.add_to_tensor_queue_multi(entries, requests)
     if not status.ok_p():
         # Fail synchronously (duplicate name / shut down).
         for e in entries:
             e.callback = None
+            if tl_on:
+                timeline.queue_end(e.tensor_name)
         handle.status = status
         st.handle_manager.release(hid)
         handle._event.set()

@@ -70,6 +70,11 @@ class ResilienceState:
         self.rank = rank
         self.size = size
         self.monitor = monitor          # HeartbeatMonitor (never None here)
+        # Flight recorder (telemetry/flight.py): failure observations
+        # land in the ring so the eventual RanksFailedError dump shows
+        # WHEN this rank first suspected whom (Null when off).
+        from ..telemetry import flight as _flight
+        self.flight = _flight.recorder()
         self.fault_timeout = config.FAULT_TIMEOUT.get() \
             if fault_timeout is None else float(fault_timeout)
         # Transport waits poll in slices of this size between liveness
@@ -100,6 +105,11 @@ class ResilienceState:
 
     def mark_failed(self, r: int, reason: str,
                     confirmed: bool = True) -> None:
+        if self.flight.enabled:
+            self.flight.record(
+                "mark-failed", f"rank {r}",
+                detail=f"{'confirmed' if confirmed else 'suspect'}: "
+                       f"{reason[:160]}")
         self.monitor.mark_failed(r, reason, confirmed=confirmed)
 
     # -- the bounded-wait probe -----------------------------------------
@@ -112,6 +122,11 @@ class ResilienceState:
         from the collective)."""
         failed = self.monitor.failed_ranks()
         if failed:
+            if self.flight.enabled:
+                self.flight.record(
+                    "deadline-convert", current_op(),
+                    detail=f"phase={phase} failed="
+                           f"{sorted(failed)} after {waited:.1f}s")
             raise RanksFailedError(failed, op=current_op(), phase=phase)
         if waited >= self.op_timeout():
             self.mark_failed(peer, f"unresponsive for {waited:.1f}s in "

@@ -17,10 +17,17 @@ Module surface:
   negotiation-skew statistics naming the slowest rank.
 - ``python -m horovod_tpu.telemetry.report`` — offline summarizer for
   dumps and timeline traces.
+- :mod:`.flight` — the always-on failure flight recorder
+  (``HOROVOD_FLIGHT``): bounded ring of recent trace events dumped on
+  every structured failure (ISSUE 7).
+- ``python -m horovod_tpu.telemetry.trace`` — cross-rank trace merge
+  (flow-linked Perfetto output, clock offsets applied) and
+  ``--critical-path`` step attribution.
 """
 from __future__ import annotations
 
 from ..common import config
+from . import flight
 from .exporter import MetricsExporter, dump_json, resolve_dump_path
 from .registry import (NULL_METRIC, NULL_REGISTRY, Counter, Gauge,
                        Histogram, MetricsRegistry, NullRegistry)

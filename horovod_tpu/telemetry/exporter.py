@@ -9,6 +9,12 @@ Port layout: each rank tries ``HOROVOD_METRICS_PORT + rank`` (launchers
 ship one identical environment to every rank on a host); if that port is
 taken it falls back to an ephemeral port and logs the actual one.  The
 bound port is always available as ``MetricsExporter.port``.
+
+Bind address: ``HOROVOD_METRICS_BIND``, default ``127.0.0.1`` — metrics
+name tensors, hosts, and failure details, so serving them off-host must
+be an explicit decision (the pre-fix ``("", port)`` bind silently
+exposed every rank's registry on all interfaces).  Set it to ``0.0.0.0``
+(or empty) for a real Prometheus scrape deployment.
 """
 from __future__ import annotations
 
@@ -16,6 +22,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..common import config
 from ..common.logging import logger
 
 
@@ -43,16 +50,21 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 class MetricsExporter:
     """Prometheus text-format endpoint for one rank's registry."""
 
-    def __init__(self, registry, rank: int, base_port: int) -> None:
+    def __init__(self, registry, rank: int, base_port: int,
+                 bind: str | None = None) -> None:
         self.registry = registry
         self.rank = rank
+        if bind is None:
+            bind = config.METRICS_BIND.get()
+        self.bind = bind
         want = base_port + rank
         try:
-            self._httpd = ThreadingHTTPServer(("", want), _MetricsHandler)
+            self._httpd = ThreadingHTTPServer((bind, want),
+                                              _MetricsHandler)
         except OSError:
             # Port taken (another world on this host, or a low base):
             # fall back to an ephemeral port rather than failing init.
-            self._httpd = ThreadingHTTPServer(("", 0), _MetricsHandler)
+            self._httpd = ThreadingHTTPServer((bind, 0), _MetricsHandler)
             logger.info("telemetry: port %d busy; metrics for rank %d on "
                         "port %d instead", want, rank,
                         self._httpd.server_address[1])

@@ -44,6 +44,13 @@ if os.environ.get("HOROVOD_TEST_COMPILE_CACHE", "1") != "0":
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES",
                           "-1")
 
+# Flight-recorder failure dumps (HOROVOD_FLIGHT, on by default) resolve
+# relative to the cwd: point them at /tmp so a fault-injection test can
+# never litter the repo working tree.  Tests that assert on dumps set
+# their own explicit paths (and inherit this default in workers).
+os.environ.setdefault("HOROVOD_FLIGHT_FILE",
+                      "/tmp/horovod_tpu_test_flight.json")
+
 try:
     import jax
     jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,61 @@
+# Seeded HVD1005 fixture: Timeline span-open calls in a backend/ module
+# without a finally-guarded close.  The clean shapes below (start inside
+# a guarded try, start immediately followed by a guarded try, the
+# conditional-start idiom, and the forwarding helper) must stay silent.
+
+
+def bad_unguarded(self, entries, buf):
+    self._act_start(entries, "TCP_RING_ALLREDUCE")   # flagged: no finally
+    out = buf.sum()
+    self._act_end(entries)
+    return out
+
+
+def bad_except_only(self, entries, buf):
+    self._act_start(entries, "SHM_ALLREDUCE")   # flagged: end not in finally
+    try:
+        return buf.sum()
+    except ValueError:
+        self._act_end(entries)
+        raise
+
+
+def bad_direct_timeline(self, tl, buf):
+    tl.activity_start("t0", "XLA_ALLREDUCE")   # flagged: no finally
+    return buf.sum()
+
+
+def good_start_then_try(self, entries, buf):
+    self._act_start(entries, "TCP_RING_ALLREDUCE")
+    try:
+        return buf.sum()
+    finally:
+        self._act_end(entries)
+
+
+def good_start_inside_try(self, entries, buf):
+    try:
+        self._act_start(entries, "SHM_ALLGATHER")
+        return buf.sum()
+    finally:
+        self._act_end(entries)
+
+
+def good_conditional_start(self, entries, buf):
+    if len(entries) > 1:
+        self._act_start(entries, "MEMCPY_OUT_FUSION_BUFFER")
+    try:
+        return buf.sum()
+    finally:
+        if len(entries) > 1:
+            self._act_end(entries)
+
+
+def _act_start(self, entries, activity):
+    # The forwarding helper is the primitive: callers own the balance.
+    self.timeline.activity_start_all(entries, activity)
+
+
+def good_suppressed(self, entries, buf):
+    self._act_start(entries, "TCP_BCAST")  # hvdlint: disable=unbalanced-span -- fixture: the next ring step's recv closes the span
+    return buf.sum()

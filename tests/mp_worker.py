@@ -1329,9 +1329,36 @@ def battery_resilience_kill(hvd, rank, size):
         elapsed = _time.monotonic() - t0
         assert 2 in e.failed_ranks, e
         assert elapsed < 2 * fault_timeout, (elapsed, fault_timeout)
+        # ISSUE 7 acceptance: every survivor's conversion dumped the
+        # flight recorder, and the dump's tail names the in-flight op
+        # (the 'after*' allreduce this rank dispatched and never
+        # completed).
+        import json as _json
+        from horovod_tpu.telemetry import flight as _flight
+        rec = _flight.recorder()
+        assert rec.enabled and rec.dumps >= 1, \
+            (rec.enabled, getattr(rec, "dumps", None))
+        payload = _json.load(open(rec.last_dump_path))
+        assert payload["rank"] == rank
+        events = payload["events"]
+        kinds = [ev["kind"] for ev in events]
+        assert "ranks-failed" in kinds, kinds
+        dispatched = [ev for ev in events if ev["kind"] == "dispatch"
+                      and ev["name"].startswith("after")]
+        assert dispatched, kinds
+        assert dispatched[-1]["trace"], dispatched[-1]
+        # The tail IS the failure: nothing after the last in-flight
+        # dispatch except failure records (no 'done' for it).
+        last_dispatch = max(i for i, ev in enumerate(events)
+                            if ev["kind"] == "dispatch"
+                            and ev["name"].startswith("after"))
+        assert not any(ev["kind"] == "done"
+                       and ev["name"] == events[last_dispatch]["name"]
+                       for ev in events[last_dispatch:]), events[-4:]
         print(f"survivor {rank}: RanksFailedError("
               f"{sorted(e.failed_ranks)}) in {elapsed:.2f}s "
-              f"op={e.op!r} phase={e.phase!r}")
+              f"op={e.op!r} phase={e.phase!r} "
+              f"flight={rec.last_dump_path}")
         return
     raise AssertionError("collectives kept succeeding after chaos kill")
 
@@ -1857,8 +1884,38 @@ def battery_telemetry(hvd, rank, size):
     # contents after the world exits.
 
 
+def battery_trace(hvd, rank, size):
+    """ISSUE 7 acceptance (4-rank, in-battery half): uniquely-named
+    allreduces under per-rank HOROVOD_TIMELINE files while chaos
+    freezes rank size-1 for 120 ms before dispatching every tr_*
+    collective (the PR 5 deterministic delay injection).  The parent
+    test (test_multiprocess.test_trace_merge_and_critical_path_4rank)
+    merges the four files and asserts flow-linked spans + critical-path
+    attribution naming the delayed rank."""
+    from horovod_tpu.core import _global
+
+    assert _global.timeline is not None and _global.timeline.enabled
+    assert _global.flight.enabled   # default-on flight recorder
+    delayed = size - 1
+    if rank != 0:
+        # Worker ranks probed a real clock offset against rank 0.
+        assert _global.timeline._clock_offset_us is not None
+        assert _global.timeline._clock_rtt_us > 0.0
+    for step in range(12):
+        out = hvd.allreduce(np.ones(32, np.float32), op=hvd.Sum,
+                            name=f"tr_{step}")
+        np.testing.assert_allclose(out, np.full(32, float(size)))
+    # Trace ids advanced monotonically with the lockstep cycles.
+    assert _global.controller._trace_cycle > 0
+    if rank == delayed:
+        assert _global.chaos is not None
+        assert any(a.fired for a in _global.chaos.actions)
+    hvd.barrier()
+
+
 BATTERIES = {
     "collectives": battery_collectives,
+    "trace": battery_trace,
     "telemetry": battery_telemetry,
     "streams": battery_streams,
     "matrix": battery_matrix,
@@ -1945,10 +2002,23 @@ def main() -> int:
     if battery == "shm":
         os.environ["HOROVOD_SHM_OPERATIONS"] = "1"   # require formation
         os.environ["HOROVOD_SHM_CAPACITY"] = str(1 << 20)
+    if battery == "trace":
+        epoch = os.environ["HOROVOD_RENDEZVOUS_EPOCH"]
+        os.environ["HOROVOD_TIMELINE"] = f"/tmp/hvd_trace_{epoch}.json"
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+        # PR 5 deterministic delay injection: the last rank freezes
+        # 120 ms before dispatching every tr_* collective.
+        os.environ["HOROVOD_CHAOS"] = \
+            f"freeze:rank={size - 1},name=tr_,ms=120"
+        os.environ["HOROVOD_FLIGHT_FILE"] = \
+            f"/tmp/hvd_flight_{epoch}.json"
     if battery.startswith("resilience"):
         # Chaos batteries pin the TCP plane so the socket-level deadline
         # guards are the ones exercised (the shm plane has its own).
         os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+        # Flight dumps land in /tmp, not the repo working directory.
+        os.environ["HOROVOD_FLIGHT_FILE"] = \
+            f"/tmp/hvd_flight_{os.environ['HOROVOD_RENDEZVOUS_EPOCH']}.json"
     if battery in ("resilience_kill", "resilience_retry",
                    "resilience_freeze"):
         os.environ["HOROVOD_FAULT_TOLERANCE"] = "1"

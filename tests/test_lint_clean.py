@@ -108,6 +108,37 @@ def test_unbounded_wait_scope_is_transport_modules():
     assert lint_source(src, "horovod_tpu/core.py") == []
 
 
+def test_fixture_unbalanced_span():
+    """HVD1005: activity_start in backend/ without a finally-guarded
+    activity_end (ISSUE 7 satellite); the guarded shapes — start inside
+    a try/finally, start immediately followed by one, the
+    conditional-start idiom, the forwarding helper, a justified
+    suppression — stay clean."""
+    out = lint_paths([os.path.join(FIXTURES, "backend",
+                                   "unbalanced_span.py")])
+    assert _slugs(out) == ["unbalanced-span"] * 3
+    assert {v.line for v in out} == {8, 15, 24}
+
+
+def test_unbalanced_span_scope_is_backend():
+    """The rule bites only in backend/ modules — core's op spans close
+    in the dispatch epilogue, outside any single lexical scope."""
+    src = ("def allreduce(self, entries, buf):\n"
+           "    self._act_start(entries, 'X_ALLREDUCE')\n"
+           "    return buf.sum()\n")
+    assert _slugs(lint_source(src, "horovod_tpu/backend/x.py")) == \
+        ["unbalanced-span"]
+    assert lint_source(src, "horovod_tpu/core.py") == []
+    # start inside a guarded try is the other accepted shape
+    good = ("def allreduce(self, entries, buf):\n"
+            "    try:\n"
+            "        self._act_start(entries, 'X_ALLREDUCE')\n"
+            "        return buf.sum()\n"
+            "    finally:\n"
+            "        self._act_end(entries)\n")
+    assert lint_source(good, "horovod_tpu/backend/x.py") == []
+
+
 def test_telemetry_dir_blocking_io_needs_justification():
     """Any function in a telemetry/ module must justify blocking I/O —
     the tree's single justified suppression (the exporter's shutdown

@@ -126,6 +126,59 @@ def test_telemetry_observability_4rank():
     assert "horovod_controller_straggler_rank" in proc.stdout
 
 
+def test_trace_merge_and_critical_path_4rank():
+    """ISSUE 7 acceptance: a 4-rank world writes one timeline file per
+    rank; the merged trace contains flow-linked spans for the same
+    collective on all four ranks with per-rank clock-offset metadata,
+    and --critical-path names the chaos-delayed rank (freeze injection,
+    PR 5) and its dominant phase."""
+    import glob
+    import json
+
+    from horovod_tpu.telemetry import trace as trace_mod
+
+    for stale in glob.glob("/tmp/hvd_trace_trace4*.json"):
+        os.unlink(stale)
+    _run_world(4, "trace", timeout=240.0)
+    base = "/tmp/hvd_trace_trace4.json"
+    paths = [base] + [f"/tmp/hvd_trace_trace4.r{r}.json"
+                      for r in (1, 2, 3)]
+    for p in paths:
+        assert os.path.exists(p), f"missing per-rank timeline {p}"
+
+    traces = trace_mod.load(paths)
+    assert [t.rank for t in traces] == [0, 1, 2, 3]
+    for t in traces[1:]:
+        # Clock-offset metadata from the init-time round-trip probes.
+        assert t.clock_rtt_us > 0.0, (t.rank, t.clock_rtt_us)
+
+    merged = trace_mod.merge(traces)
+    flows: dict = {}
+    for e in merged:
+        if e.get("ph") in ("s", "f"):
+            flows.setdefault(e["id"], []).append(e)
+    # The same collective is flow-linked on ALL four ranks for most of
+    # the tr_* steps (one 's' source + three 'f' bind points).
+    full = [i for i, evs in flows.items()
+            if sorted(e["ph"] for e in evs) == ["f", "f", "f", "s"]]
+    assert len(full) >= 8, {i: len(v) for i, v in flows.items()}
+
+    report = trace_mod.critical_path_report(traces, window=16)
+    assert "critical path: rank 3, phase negotiate" in report, report
+
+    # The report CLI drives the same path end to end.
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.telemetry.trace",
+         *paths, "-o", "/tmp/hvd_trace_trace4_merged.json",
+         "--critical-path", "--window", "16"],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(_WORKER) + "/..")
+    assert proc.returncode == 0, proc.stderr
+    assert "critical path: rank 3" in proc.stdout, proc.stdout
+    merged_file = json.load(open("/tmp/hvd_trace_trace4_merged.json"))
+    assert any(e.get("ph") == "s" for e in merged_file)
+
+
 @pytest.mark.parametrize("size", [2, 4])
 def test_multistream_dispatch(size):
     """HOROVOD_NUM_STREAMS=2 over the TCP plane: independent responses

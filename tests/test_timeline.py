@@ -200,7 +200,7 @@ def test_timeline_unit_events(tmp_path):
     tl.stop()
     events = _events(path)
     metas = [e for e in events if e.get("ph") == "M"]
-    assert any(e["args"]["name"] == "alpha" for e in metas)
+    assert any(e["args"].get("name") == "alpha" for e in metas)
     cycles = [e for e in events if e.get("name") == "CYCLE"]
     assert len(cycles) == 1
     assert all("ts" in e for e in events if e.get("ph") in ("B", "E"))
@@ -225,6 +225,71 @@ def test_timeline_negotiate_state_machine(tmp_path):
     begins = [e for e in events if e.get("ph") == "B"]
     ends = [e for e in events if e.get("ph") == "E"]
     assert len(begins) == 2 and len(ends) == 2, events
+    _assert_balanced(events)
+
+
+def test_timeline_restart_resets_timestamp_origin(tmp_path):
+    """A DYNAMIC stop/start recording window begins at ts~0, not minutes
+    into the process: start() re-anchors _start (ISSUE 7 satellite)."""
+    import time
+
+    tl = Timeline("DYNAMIC")
+    time.sleep(0.12)                       # process runs "for a while"
+    p1 = tmp_path / "w1.json"
+    tl.start(str(p1))
+    tl.activity_start("t", "ALLREDUCE")
+    tl.activity_end("t")
+    tl.stop()
+    first = next(e for e in _events(p1) if e.get("ph") == "B")
+    assert first["ts"] < 100_000, first    # µs; well under the 120ms sleep
+
+    # Second window after more wall time: origin resets again.
+    time.sleep(0.12)
+    p2 = tmp_path / "w2.json"
+    tl.start(str(p2))
+    tl.activity_start("t", "ALLREDUCE")
+    tl.activity_end("t")
+    tl.stop()
+    first = next(e for e in _events(p2) if e.get("ph") == "B")
+    assert first["ts"] < 100_000, first
+    # The window's monotonic base is carried in the clock-sync metadata
+    # so cross-rank stitching still has the absolute anchor.
+    sync = [e for e in _events(p2)
+            if e.get("name") == "horovod_clock_sync"]
+    assert sync and sync[-1]["args"]["start_us"] > 0
+
+
+def test_timeline_rank_suffix_and_trace_args(tmp_path):
+    """Rank r > 0 writes path.r<r>.json (rank 0 keeps the exact path);
+    span args carry the trace id and queue spans are async b/e pairs."""
+    from horovod_tpu.common.timeline import rank_path
+
+    assert rank_path("/x/t.json", 0) == "/x/t.json"
+    assert rank_path("/x/t.json", 3) == "/x/t.r3.json"
+    assert rank_path("/x/t_{rank}.json", 2) == "/x/t_2.json"
+    assert rank_path("/x/t", 1) == "/x/t.r1"
+
+    p = tmp_path / "tr.json"
+    tl = Timeline(str(p), rank=1)
+    assert tl._path == str(tmp_path / "tr.r1.json")
+    tl.set_clock_sync(1500.0, 80.0)
+    tl.queue_start("g")
+    tl.activity_start("g", "ALLREDUCE", trace="7.0")
+    tl.activity_end("g")
+    tl.queue_end("g", trace="7.0")
+    tl.stop()
+    events = _events(tmp_path / "tr.r1.json")
+    op = next(e for e in events
+              if e.get("ph") == "B" and e["name"] == "ALLREDUCE")
+    assert op["args"]["trace"] == "7.0"
+    qb = [e for e in events if e.get("ph") == "b"]
+    qe = [e for e in events if e.get("ph") == "e"]
+    assert len(qb) == 1 and len(qe) == 1
+    assert qb[0]["id"] == qe[0]["id"]
+    assert qe[0]["args"]["trace"] == "7.0"
+    sync = [e for e in events if e.get("name") == "horovod_clock_sync"]
+    assert sync[-1]["args"]["clock_offset_us"] == 1500.0
+    assert sync[-1]["args"]["rank"] == 1
     _assert_balanced(events)
 
 

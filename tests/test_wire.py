@@ -74,6 +74,24 @@ def test_response_list_roundtrip():
     assert decoded.shutdown is False
 
 
+def test_response_trace_id_roundtrip():
+    """ISSUE 7: the coordinator-assigned (cycle, seq) trace id rides the
+    Response wire like the fp_* fields; unassigned stays -1/-1."""
+    resp = Response(response_type=ResponseType.ALLREDUCE,
+                    tensor_names=["g"], tensor_sizes=[8],
+                    trace_cycle=12345, trace_seq=7)
+    rl = ResponseList(responses=[resp])
+    decoded = ResponseList.from_bytes(rl.to_bytes()).responses[0]
+    assert decoded.trace_cycle == 12345
+    assert decoded.trace_seq == 7
+    assert decoded.trace_id() == "12345.7"
+    # Defaults survive the wire as "unassigned".
+    empty = ResponseList.from_bytes(
+        ResponseList(responses=[Response()]).to_bytes()).responses[0]
+    assert (empty.trace_cycle, empty.trace_seq) == (-1, -1)
+    assert empty.trace_id() is None
+
+
 @pytest.mark.parametrize("dt,np_dtype", [
     (DataType.FLOAT32, np.float32),
     (DataType.FLOAT16, np.float16),
