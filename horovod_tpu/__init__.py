@@ -16,6 +16,13 @@ out, torch in → torch out, jax in → jax out).
 """
 from __future__ import annotations
 
+# hvdsan runtime witness (HOROVOD_SAN=1; analysis/hvdsan/san.py) must
+# patch the threading factories BEFORE any package module creates a
+# lock — core's module-level _init_lock is born a few imports below.
+from .analysis.hvdsan import maybe_enable as _hvdsan_maybe_enable
+
+_hvdsan_maybe_enable()
+
 from typing import Any, Sequence
 
 import numpy as np

@@ -18,6 +18,15 @@ gap from both ends:
   Request/Response control plane and turns cross-rank divergence into a
   structured ``Response.ERROR`` naming the first divergent op
   (``HOROVOD_FINGERPRINT={off,cycle,strict}``).
+- :mod:`horovod_tpu.analysis.hvdsan` — **hvdsan**, whole-program
+  concurrency verification (CLI:
+  ``python -m horovod_tpu.analysis.hvdsan`` or ``lint --san``): an
+  interprocedural lock-acquisition graph checked for lock-order
+  inversion cycles, locks held across blocking/collective calls and
+  orphan condition waits (HVD501-503); a declarative thread-ownership
+  manifest (HVD504, also feeding hvdlint's HVD401); a wire-schema
+  drift check (HVD505); and a ``HOROVOD_SAN=1`` runtime witness whose
+  observed lock-order graph CI diffs against the static one.
 
 See docs/analysis.md for the rule catalogue and fingerprint modes.
 """

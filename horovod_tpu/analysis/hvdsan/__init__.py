@@ -1,0 +1,29 @@
+"""hvdsan — whole-program concurrency verification (ISSUE 8).
+
+Static half: an interprocedural lock-acquisition graph over the package
+(:mod:`.lockgraph`) checked for lock-order inversion cycles (HVD501),
+locks held across blocking/collective calls (HVD502), orphan condition
+waits (HVD503); a declarative thread-ownership manifest
+(:mod:`.ownership`, HVD504) that also feeds hvdlint's HVD401; and a
+wire-schema drift check between ``common/message.py`` and
+``common/wire.py`` (HVD505, :mod:`.san`).
+
+Runtime half: under ``HOROVOD_SAN=1`` lightweight lock wrappers record
+actual per-thread acquisition orders (:mod:`.san`) and dump the
+observed lock-order graph at shutdown; CI diffs it against the static
+graph — observed edges missing statically fail the build, static
+cycles never observed demote to warnings.
+
+CLI: ``python -m horovod_tpu.analysis.hvdsan`` (report mode) or
+``python -m horovod_tpu.analysis.lint --san`` (alongside the per-file
+rules, sharing one parse per file).  Rule table: docs/analysis.md.
+
+This ``__init__`` stays import-light: :func:`maybe_enable` runs at
+``horovod_tpu`` import before any package lock exists.
+"""
+from .san import (apply_witness, dump_witness, enable,  # noqa: F401
+                  disable, enabled, maybe_enable, witness,
+                  witness_diff)
+
+__all__ = ["maybe_enable", "enable", "disable", "enabled", "witness",
+           "dump_witness", "witness_diff", "apply_witness"]

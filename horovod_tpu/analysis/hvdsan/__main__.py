@@ -1,0 +1,7 @@
+"""``python -m horovod_tpu.analysis.hvdsan`` — standalone report mode."""
+import sys
+
+from .san import main
+
+if __name__ == "__main__":
+    sys.exit(main())

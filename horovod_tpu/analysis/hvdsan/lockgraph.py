@@ -1,0 +1,1395 @@
+"""hvdsan lock graph — whole-program static lock-acquisition analysis.
+
+hvdlint's concurrency rules (HVD301/HVD401) pattern-match single call
+sites; this module builds the *interprocedural* model those rules cannot
+see:
+
+1. every ``threading.Lock/RLock/Condition`` creation site is resolved to
+   a **stable lock identity** (``module.Class.attr`` keyed by its
+   creation ``file:line`` — the same key the runtime witness records, so
+   the two graphs diff exactly);
+2. a **call graph** over the package (self/annotation/constructor-typed
+   receivers resolve confidently; a bounded method-name index fills the
+   gaps at lower confidence);
+3. a fixpoint computes **which locks can be held at each call site**,
+   yielding the lock-order graph: edge ``A → B`` when some thread can
+   acquire ``B`` while holding ``A`` (directly nested ``with`` blocks,
+   or through any call chain).
+
+On top of that model:
+
+- **HVD501 lock-order-inversion** — a cycle in the lock-order graph:
+  two threads taking the same locks in opposite orders deadlock the
+  world the first time their schedules interleave.
+- **HVD502 lock-held-across-blocking** — a lock held across a blocking
+  primitive (socket recv/send, ``urlopen``, thread join, ``wait``, …)
+  or a collective, found through any call depth — the interprocedural
+  generalization of HVD301.  A ``Condition.wait`` on the held
+  condition's own lock is the sanctioned idiom and exempt.
+- **HVD503 orphan-condition-wait** — a ``Condition`` some thread waits
+  on but **no** code path ever notifies: the wait can only ever end by
+  timeout (or never).
+
+Confidence model: edges proven through typed resolution are
+*confident*; edges that needed the name-index fallback are demoted, and
+findings that depend on them report as warnings, not errors.  The
+runtime witness (:mod:`.san`) closes the gap from the other side:
+observed edges missing from this graph fail CI (the analyzer is
+unsound there), and static cycles never observed demote to warnings.
+
+Suppressions reuse hvdlint's comment form at the anchor line::
+
+    with self._lock:  # hvdlint: disable=HVD502 -- <ordering guarantee>
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from ..rules import RULES, Rule, parse_suppressions
+
+# Callables treated as lock constructors (threading module).
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+
+# Blocking primitives for HVD502 (lexical, like hvdlint's HVD1003 —
+# bounded or not, the lock is held for the wait's duration).
+BLOCKING_NAMES = frozenset({
+    "recv", "recv_into", "recv_bytes", "accept", "select", "urlopen",
+    "wait", "wait_for", "join", "sendall", "sendmsg", "connect",
+    "create_connection", "communicate", "sleep", "serve_forever",
+})
+
+# Collective vocabulary (shared with hvdlint's HVD301).
+from ..lint import COLLECTIVE_NAMES  # noqa: E402
+
+# Method-name-index fallback: resolve an untyped `obj.m(...)` to the
+# package's definitions of `m` only when few enough exist to be a
+# plausible bind — anything wider is noise, and the runtime witness
+# covers what the static graph then misses.
+_INDEX_FALLBACK_LIMIT = 3
+
+
+# ---------------------------------------------------------------------------
+# Model dataclasses
+# ---------------------------------------------------------------------------
+@dataclass
+class LockInfo:
+    key: str                 # "core._init_lock", "runner.network.PeerMesh._lock"
+    path: str
+    line: int
+    kind: str                # lock | rlock | condition
+    canonical: str           # != key only for Condition(existing_lock)
+    cond_arg: tuple | None = None   # unresolved wrapped-lock spine
+
+    @property
+    def site(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+@dataclass
+class CallEvent:
+    spine: tuple             # function-expression spine (see _spine)
+    held: tuple              # spines of lexically held locks, outer->inner
+    line: int
+    kwnames: tuple = ()      # keyword argument names (Thread(name=...))
+    thread_target: tuple | None = None   # Thread(target=X) spine
+    thread_name: str | None = None
+
+
+@dataclass
+class AcquireEvent:
+    spine: tuple             # lock expression spine
+    held: tuple
+    line: int
+    via: str                 # "with" | "acquire" | "wait"
+
+
+@dataclass
+class SimpleEvent:
+    name: str
+    held: tuple
+    line: int
+    bounded: bool = False
+
+
+@dataclass
+class WriteEvent:
+    spine: tuple             # full attribute spine of the write target
+    line: int
+
+
+@dataclass
+class FuncRaw:
+    key: str
+    module: str
+    cls: str | None
+    name: str
+    path: str
+    line: int
+    acquires: list = field(default_factory=list)     # [AcquireEvent]
+    calls: list = field(default_factory=list)        # [CallEvent]
+    blocking: list = field(default_factory=list)     # [SimpleEvent]
+    collectives: list = field(default_factory=list)  # [SimpleEvent]
+    writes: list = field(default_factory=list)       # [WriteEvent]
+    local_types: dict = field(default_factory=dict)  # name -> type spine
+    param_types: dict = field(default_factory=dict)
+
+
+@dataclass
+class ClassRaw:
+    module: str
+    name: str
+    bases: list = field(default_factory=list)        # base-class spines
+    methods: dict = field(default_factory=dict)      # name -> funckey
+    attr_types: dict = field(default_factory=dict)   # attr -> type spine
+    attr_elem_types: dict = field(default_factory=dict)  # attr -> dict-value type
+
+
+@dataclass
+class ModuleRaw:
+    label: str
+    path: str
+    is_package: bool
+    aliases: dict = field(default_factory=dict)      # name -> ("mod"|"sym", ...)
+    classes: dict = field(default_factory=dict)      # name -> ClassRaw
+    functions: dict = field(default_factory=dict)    # name -> funckey
+    threading_names: set = field(default_factory=set)  # from threading import X
+    global_types: dict = field(default_factory=dict)   # module var -> type spine
+
+
+@dataclass
+class LockCreation:
+    module: str
+    cls: str | None
+    func: str | None
+    target: tuple
+    kind: str
+    path: str
+    line: int
+    cond_arg: tuple | None
+
+
+@dataclass
+class Finding:
+    rule: Rule
+    severity: str            # "error" | "warning"
+    path: str
+    line: int
+    message: str
+    sites: tuple = ()        # extra (path, line) anchors (cycle edges)
+
+    def text(self) -> str:
+        sev = "" if self.severity == "error" else " (warning)"
+        return (f"{self.path}:{self.line}:1: {self.rule.id} "
+                f"[{self.rule.slug}]{sev} {self.message}")
+
+    def json(self) -> dict:
+        return {"path": self.path, "line": self.line,
+                "rule": self.rule.id, "slug": self.rule.slug,
+                "severity": self.severity, "message": self.message,
+                "sites": [f"{p}:{ln}" for p, ln in self.sites]}
+
+
+@dataclass
+class Edge:
+    src: str
+    dst: str
+    confident: bool
+    sites: list = field(default_factory=list)   # [(path, line, via-label)]
+
+
+# ---------------------------------------------------------------------------
+# Spine extraction
+# ---------------------------------------------------------------------------
+_SUBSCRIPT = "[]"
+_CALLMARK = "()"
+
+# Method names so pervasive on builtins (str/bytes/dict/set) that the
+# name-index fallback would bind them to unrelated package classes —
+# `coordinator_address.encode()` is not `Request.encode`.
+_INDEX_DENY = frozenset({
+    "encode", "decode", "get", "put", "set", "items", "keys", "values",
+    "update", "pop", "append", "extend", "clear", "copy", "split",
+    "strip", "format", "setdefault", "discard", "add", "remove",
+    "read", "write", "close", "open", "sort", "index", "count",
+})
+
+
+def _spine(node: ast.AST) -> tuple | None:
+    """Dotted access chain as a tuple of names, left to right:
+    ``self._channels[peer].send_sync`` -> ("self", "_channels", "[]",
+    "send_sync"); chains through calls keep a "()" marker
+    (``f(...).inc`` -> ("f", "()", "inc")).  None for anything not a
+    plain chain."""
+    parts: list[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            parts.append(_SUBSCRIPT)
+            node = node.value
+        elif isinstance(node, ast.Call):
+            parts.append(_CALLMARK)
+            node = node.func
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return tuple(reversed(parts))
+        else:
+            return None
+
+
+def _ann_spine(node: ast.AST | None) -> tuple | None:
+    """Type spine from an annotation: Name/Attribute directly;
+    ``X | None`` takes X; ``dict[k, v]``/``list[v]`` handled by
+    :func:`_ann_elem_spine`."""
+    if node is None:
+        return None
+    if isinstance(node, ast.BinOp):           # X | None
+        left = _ann_spine(node.left)
+        return left if left else _ann_spine(node.right)
+    if isinstance(node, ast.Subscript):       # Optional[X], dict[...]
+        base = _spine(node.value)
+        if base and base[-1] == "Optional":
+            return _ann_spine(node.slice)
+        return base
+    if isinstance(node, ast.Constant):
+        return None
+    return _spine(node)
+
+
+def _ann_elem_spine(node: ast.AST | None) -> tuple | None:
+    """Container value-type from ``dict[K, V]`` / ``list[V]``."""
+    if not isinstance(node, ast.Subscript):
+        return None
+    base = _spine(node.value)
+    if not base:
+        return None
+    sl = node.slice
+    if base[-1] == "dict" and isinstance(sl, ast.Tuple) and \
+            len(sl.elts) == 2:
+        return _ann_spine(sl.elts[1])
+    if base[-1] in ("list", "deque", "set", "frozenset"):
+        return _ann_spine(sl)
+    return None
+
+
+def module_label(path: str) -> str:
+    """Module label relative to the horovod_tpu package root:
+    horovod_tpu/runner/network.py -> "runner.network"; files outside the
+    package (fixtures) use their basename."""
+    norm = os.path.normpath(path).replace(os.sep, "/")
+    parts = norm.split("/")
+    if "horovod_tpu" in parts:
+        rel = parts[parts.index("horovod_tpu") + 1:]
+    else:
+        rel = parts[-1:]
+    if not rel:
+        return ""
+    rel = list(rel)
+    rel[-1] = rel[-1][:-3] if rel[-1].endswith(".py") else rel[-1]
+    if rel[-1] == "__init__":
+        rel = rel[:-1]
+    return ".".join(rel)
+
+
+def norm_path(path: str) -> str:
+    """Stable display path: from the horovod_tpu component when present
+    (matches the runtime witness's creation-site normalization)."""
+    norm = os.path.normpath(os.path.abspath(path)).replace(os.sep, "/")
+    idx = norm.find("horovod_tpu/")
+    return norm[idx:] if idx >= 0 else os.path.normpath(path)
+
+
+# ---------------------------------------------------------------------------
+# Collection (one AST walk per file)
+# ---------------------------------------------------------------------------
+class Program:
+    """Whole-program raw facts, accumulated one file at a time."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleRaw] = {}
+        self.functions: dict[str, FuncRaw] = {}
+        self.lock_creations: list[LockCreation] = []
+        self.suppressions: dict[str, object] = {}    # path -> Suppressions
+        self.wire_codecs: list = []                  # per-class encode/decode seqs
+        self.wire_prims: dict[str, set] = {}         # Encoder/Decoder method names
+
+    def collect_source(self, path: str, source: str,
+                       tree: ast.AST | None = None) -> None:
+        if tree is None:
+            tree = ast.parse(source, filename=path)
+        disp = norm_path(path)
+        self.suppressions[disp] = parse_suppressions(source)
+        label = module_label(path)
+        mod = ModuleRaw(label=label, path=disp,
+                        is_package=os.path.basename(path) == "__init__.py")
+        self.modules[label] = mod
+        _Collector(self, mod).visit(tree)
+
+    def collect_paths(self, paths) -> None:
+        from ..lint import iter_python_files
+        for p in iter_python_files(list(paths)):
+            try:
+                with open(p, encoding="utf-8") as f:
+                    src = f.read()
+                self.collect_source(p, src)
+            except (OSError, SyntaxError):
+                continue
+
+
+class _Collector(ast.NodeVisitor):
+    """Single-pass per-file fact extractor."""
+
+    def __init__(self, program: Program, mod: ModuleRaw) -> None:
+        self.p = program
+        self.mod = mod
+        self._cls_stack: list[ClassRaw] = []
+        self._fn_stack: list[FuncRaw] = []
+        self._held: list[tuple] = []     # spines of lexically held locks
+
+    # -- context helpers -------------------------------------------------
+    @property
+    def _cls(self) -> ClassRaw | None:
+        return self._cls_stack[-1] if self._cls_stack else None
+
+    @property
+    def _fn(self) -> FuncRaw | None:
+        return self._fn_stack[-1] if self._fn_stack else None
+
+    def _qual(self, name: str) -> str:
+        parts = [self.mod.label] if self.mod.label else []
+        if self._cls_stack:
+            parts.append(self._cls_stack[-1].name)
+        parts.extend(f.name for f in self._fn_stack)
+        parts.append(name)
+        return ".".join(parts)
+
+    # -- imports ---------------------------------------------------------
+    def _module_package(self) -> list[str]:
+        parts = self.mod.label.split(".") if self.mod.label else []
+        return parts if self.mod.is_package else parts[:-1]
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.name
+            asname = alias.asname or name.split(".")[0]
+            if name == "threading":
+                self.mod.aliases.setdefault(asname, ("mod", "~threading"))
+            elif name.startswith("horovod_tpu"):
+                target = name[len("horovod_tpu"):].lstrip(".")
+                self.mod.aliases[alias.asname or name] = ("mod", target)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.level == 0:
+            if node.module == "threading":
+                for alias in node.names:
+                    self.mod.threading_names.add(alias.asname or alias.name)
+            elif node.module and node.module.startswith("horovod_tpu"):
+                base = node.module[len("horovod_tpu"):].lstrip(".")
+                for alias in node.names:
+                    self.mod.aliases[alias.asname or alias.name] = \
+                        ("sym", base, alias.name)
+            return
+        pkg = self._module_package()
+        up = node.level - 1
+        base_parts = pkg[:len(pkg) - up] if up else pkg
+        base = ".".join(base_parts + (node.module.split(".")
+                                      if node.module else []))
+        for alias in node.names:
+            local = alias.asname or alias.name
+            if node.module is None:
+                # from . import x [as y]  -> module alias
+                target = ".".join(filter(None, [base, alias.name]))
+                self.mod.aliases[local] = ("mod", target)
+            else:
+                self.mod.aliases[local] = ("sym", base, alias.name)
+
+    # -- classes / functions ---------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        cls = ClassRaw(module=self.mod.label, name=node.name,
+                       bases=[s for s in map(_spine, node.bases) if s])
+        self.mod.classes[node.name] = cls
+        if node.name in ("Encoder", "Decoder") and \
+                self.mod.label.endswith("wire"):
+            from .san import note_wire_class
+            note_wire_class(self.p, self.mod, node)
+        self._cls_stack.append(cls)
+        # Class-body AnnAssigns type instance attrs via __slots__-style
+        # annotations (dataclasses): X: SomeType
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                t = _ann_spine(stmt.annotation)
+                if t:
+                    cls.attr_types.setdefault(stmt.target.id, t)
+                elem = _ann_elem_spine(stmt.annotation)
+                if elem:
+                    cls.attr_elem_types.setdefault(stmt.target.id, elem)
+        self.generic_visit(node)
+        self._cls_stack.pop()
+
+    def _visit_function(self, node) -> None:
+        key = self._qual(node.name)
+        fn = FuncRaw(key=key, module=self.mod.label,
+                     cls=self._cls.name if (self._cls and
+                                            not self._fn_stack) else None,
+                     name=node.name, path=self.mod.path, line=node.lineno)
+        args = node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            t = _ann_spine(a.annotation)
+            if t:
+                fn.param_types[a.arg] = t
+        if self._cls and not self._fn_stack:
+            self._cls.methods[node.name] = key
+        elif not self._fn_stack:
+            self.mod.functions[node.name] = key
+        self.p.functions[key] = fn
+        # Nested defs execute later, usually on another thread: they get
+        # their own node with an EMPTY lexical held-stack.
+        saved_held, self._held = self._held, []
+        self._fn_stack.append(fn)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+        self._held = saved_held
+        if node.name in ("encode", "decode", "to_bytes", "from_bytes") \
+                and self._cls:
+            from .san import collect_wire_method
+            collect_wire_method(self.p, self.mod, self._cls, node)
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    # -- lock creation + type hints on assignment ------------------------
+    def _lock_ctor(self, value: ast.AST) -> tuple[str, tuple | None] | None:
+        """(kind, condition-arg-spine) when `value` constructs a
+        threading primitive."""
+        if not isinstance(value, ast.Call):
+            return None
+        sp = _spine(value.func)
+        if not sp:
+            return None
+        name = sp[-1]
+        if name not in _LOCK_CTORS:
+            return None
+        ok = (len(sp) >= 2 and sp[-2] == "threading") or \
+            (len(sp) == 1 and name in self.mod.threading_names)
+        if not ok:
+            return None
+        arg = _spine(value.args[0]) if (name == "Condition" and
+                                        value.args) else None
+        return _LOCK_CTORS[name], arg
+
+    def _note_assign(self, target: ast.AST, value: ast.AST,
+                     annotation: ast.AST | None = None) -> None:
+        tsp = _spine(target)
+        if not tsp:
+            return
+        ctor = self._lock_ctor(value) if value is not None else None
+        if ctor is not None:
+            kind, cond_arg = ctor
+            self.p.lock_creations.append(LockCreation(
+                module=self.mod.label,
+                cls=self._cls.name if self._cls else None,
+                func=self._fn.name if self._fn else None,
+                target=tsp, kind=kind, path=self.mod.path,
+                line=target.lineno, cond_arg=cond_arg))
+            return
+        # Type hints: self.x = ClassName(...) / self.x = typed_param /
+        # local = ClassName(...) / annotated targets.
+        tspine = None
+        if isinstance(value, ast.Call):
+            tspine = _spine(value.func)
+            if tspine and not tspine[-1][:1].isupper():
+                tspine = None                    # only Class-looking ctors
+        elif isinstance(value, ast.Name):
+            # st = _global / x = typed_param: propagate the known type.
+            tspine = (self._fn.param_types.get(value.id)
+                      if self._fn else None) or \
+                self.mod.global_types.get(value.id)
+        if tspine is None and annotation is not None:
+            tspine = _ann_spine(annotation)
+        if tspine:
+            if len(tsp) == 2 and tsp[0] == "self" and self._cls:
+                self._cls.attr_types.setdefault(tsp[1], tspine)
+            elif len(tsp) == 1 and self._fn:
+                self._fn.local_types.setdefault(tsp[0], tspine)
+            elif len(tsp) == 1 and self._fn is None and \
+                    self._cls is None:
+                self.mod.global_types.setdefault(tsp[0], tspine)
+        if annotation is not None and len(tsp) == 2 and tsp[0] == "self" \
+                and self._cls:
+            elem = _ann_elem_spine(annotation)
+            if elem:
+                self._cls.attr_elem_types.setdefault(tsp[1], elem)
+        # local = self._attr[k]  -> element type of a typed container
+        if isinstance(value, ast.Subscript) and self._fn and len(tsp) == 1:
+            vs = _spine(value)
+            if vs and vs[0] == "self" and len(vs) == 3 and \
+                    vs[2] == _SUBSCRIPT and self._cls:
+                elem = self._cls.attr_elem_types.get(vs[1])
+                if elem:
+                    self._fn.local_types.setdefault(tsp[0], elem)
+        # local = self._attr.get(k) on a typed container
+        if isinstance(value, ast.Call) and self._fn and len(tsp) == 1:
+            vs = _spine(value.func)
+            if vs and vs[0] == "self" and len(vs) == 3 and \
+                    vs[2] == "get" and self._cls:
+                elem = self._cls.attr_elem_types.get(vs[1])
+                if elem:
+                    self._fn.local_types.setdefault(tsp[0], elem)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._note_assign(t, node.value)
+            self._note_write(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None or True:
+            self._note_assign(node.target, node.value, node.annotation)
+        self._note_write(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._note_write(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def _note_write(self, target: ast.AST, line: int) -> None:
+        if self._fn is None or not isinstance(target, ast.Attribute):
+            return
+        sp = _spine(target)
+        if sp:
+            self._fn.writes.append(WriteEvent(spine=sp, line=line))
+
+    # -- with blocks (lock holds) ----------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            sp = _spine(item.context_expr)
+            if sp and self._fn is not None:
+                self._fn.acquires.append(AcquireEvent(
+                    spine=sp, held=tuple(self._held),
+                    line=node.lineno, via="with"))
+                self._held.append(sp)
+                pushed += 1
+        for n in node.body:
+            self.visit(n)
+        for _ in range(pushed):
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- calls -----------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = self._fn
+        sp = _spine(node.func)
+        if fn is not None and sp:
+            name = sp[-1]
+            held = tuple(self._held)
+            if name == "acquire" and len(sp) >= 2:
+                fn.acquires.append(AcquireEvent(
+                    spine=sp[:-1], held=held, line=node.lineno,
+                    via="acquire"))
+            elif name in ("wait", "wait_for") and len(sp) >= 2:
+                fn.acquires.append(AcquireEvent(
+                    spine=sp[:-1], held=held, line=node.lineno,
+                    via="wait"))
+            if name in ("notify", "notify_all") and len(sp) >= 2:
+                fn.acquires.append(AcquireEvent(
+                    spine=sp[:-1], held=held, line=node.lineno,
+                    via="notify"))
+            if name in BLOCKING_NAMES and not self._join_exempt(node, name):
+                fn.blocking.append(SimpleEvent(
+                    name=name, held=held, line=node.lineno))
+            if name in COLLECTIVE_NAMES:
+                fn.collectives.append(SimpleEvent(
+                    name=name, held=held, line=node.lineno))
+            thread_target = thread_name = None
+            if name == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        thread_target = _spine(kw.value)
+                    elif kw.arg == "name":
+                        thread_name = self._name_literal(kw.value)
+            fn.calls.append(CallEvent(
+                spine=sp, held=held, line=node.lineno,
+                kwnames=tuple(kw.arg for kw in node.keywords if kw.arg),
+                thread_target=thread_target, thread_name=thread_name))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _name_literal(node: ast.AST) -> str | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.JoinedStr):
+            head = ""
+            for v in node.values:
+                if isinstance(v, ast.Constant):
+                    head += str(v.value)
+                else:
+                    return head + "*"
+            return head
+        return None
+
+    @staticmethod
+    def _join_exempt(node: ast.Call, name: str) -> bool:
+        """str.join / os.path.join — not waits (mirrors hvdlint)."""
+        if name != "join" or not isinstance(node.func, ast.Attribute):
+            return name == "join"        # bare join() — not a thread join
+        base = node.func.value
+        if isinstance(base, ast.Constant) and isinstance(base.value, str):
+            return True
+        sp = _spine(node.func)
+        if sp and set(sp[:-1]) & {"path", "sep", "pathsep", "linesep",
+                                  "os", "posixpath", "ntpath"}:
+            return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Resolution + analysis
+# ---------------------------------------------------------------------------
+class Analysis:
+    """Resolved lock identities, lock-order edges, and findings."""
+
+    def __init__(self, program: Program) -> None:
+        self.program = program
+        self.locks: dict[str, LockInfo] = {}
+        self.edges: dict[tuple[str, str], Edge] = {}
+        self.findings: list[Finding] = []
+        # funckey -> {lockkey: confident}
+        self.acquires_closure: dict[str, dict[str, bool]] = {}
+        # funckey -> {prim-label: (confident, (path, line))}
+        self.blocking_closure: dict[str, dict] = {}
+        # thread roots: funckey -> thread name
+        self.thread_roots: dict[str, str] = {}
+        self.thread_reach: dict[str, set] = {}   # funckey -> {thread names}
+        self._attr_index: dict[str, list[str]] = {}
+        self._method_index: dict[str, list[str]] = {}
+        self._cond_waits: dict[str, list] = {}
+        self._cond_notifies: dict[str, list] = {}
+        self._call_targets: dict[int, list] = {}  # id(CallEvent) -> targets
+
+    # -- lock identity resolution ----------------------------------------
+    def _register_locks(self) -> None:
+        for c in self.program.lock_creations:
+            parts = [c.module] if c.module else []
+            if c.cls:
+                parts.append(c.cls)
+            if c.func and c.target[0] != "self":
+                parts.append(c.func)
+            tail = c.target[1:] if c.target[0] in ("self", "cls") \
+                else c.target
+            parts.extend(tail)
+            key = ".".join(parts)
+            info = LockInfo(key=key, path=c.path, line=c.line,
+                            kind=c.kind, canonical=key,
+                            cond_arg=c.cond_arg)
+            self.locks[key] = info
+            self._attr_index.setdefault(tail[-1], []).append(key)
+        # Condition(existing_lock): alias to the wrapped lock.
+        for c in self.program.lock_creations:
+            if c.kind != "condition" or not c.cond_arg:
+                continue
+            parts = [c.module] if c.module else []
+            if c.cls:
+                parts.append(c.cls)
+            if c.func and c.target[0] != "self":
+                parts.append(c.func)
+            tail = c.target[1:] if c.target[0] in ("self", "cls") \
+                else c.target
+            key = ".".join(parts + list(tail))
+            wrapped = self.resolve_lock(c.cond_arg, c.module, c.cls,
+                                        c.func)
+            if wrapped and wrapped != key:
+                self.locks[key].canonical = wrapped
+
+    def resolve_lock(self, spine: tuple, module: str, cls: str | None,
+                     func: str | None) -> str | None:
+        """Creation-site identity for a lock expression spine, or None."""
+        if not spine:
+            return None
+        attr = spine[-1]
+        cand = self._attr_index.get(attr)
+        if not cand:
+            return None
+        if spine[0] in ("self", "cls") and len(spine) == 2 and cls:
+            # own class, then lexical bases, then module, then unique.
+            seen = set()
+            stack = [(module, cls)]
+            while stack:
+                m, cn = stack.pop()
+                if (m, cn) in seen:
+                    continue
+                seen.add((m, cn))
+                key = ".".join(filter(None, [m, cn, attr]))
+                if key in self.locks:
+                    return key
+                craw = self.program.modules.get(m)
+                craw = craw.classes.get(cn) if craw else None
+                if craw:
+                    for b in craw.bases:
+                        bres = self._resolve_class_spine(b, m)
+                        if bres:
+                            stack.append(bres)
+        elif len(spine) == 1:
+            if func:
+                for ctx_cls in (cls, None):
+                    key = ".".join(filter(None, [module, ctx_cls, func,
+                                                 attr]))
+                    if key in self.locks:
+                        return key
+            key = ".".join(filter(None, [module, attr]))
+            if key in self.locks:
+                return key
+            # bare name in a method may still be the module global
+            in_module = [k for k in cand
+                         if k.rsplit(".", 1)[0] == module]
+            if len(in_module) == 1:
+                return in_module[0]
+            return None
+        # final-attr uniqueness fallbacks (module first, then package)
+        in_module = [k for k in cand if k.startswith(module + ".")
+                     or k == f"{module}.{attr}"]
+        if len(in_module) == 1:
+            return in_module[0]
+        if len(cand) == 1:
+            return cand[0]
+        return None
+
+    def canonical(self, key: str) -> str:
+        info = self.locks.get(key)
+        return info.canonical if info else key
+
+    # -- class/symbol resolution -----------------------------------------
+    def _resolve_class_spine(self, spine: tuple, module: str,
+                             _depth: int = 0) -> tuple | None:
+        """(module, classname) for a type spine in `module`'s scope."""
+        if not spine or _depth > 6:
+            return None
+        mod = self.program.modules.get(module)
+        if mod is None:
+            return None
+        name = spine[-1]
+        if len(spine) == 1:
+            if name in mod.classes:
+                return (module, name)
+            alias = mod.aliases.get(name)
+            if alias and alias[0] == "sym":
+                return self._find_class(alias[1], alias[2], _depth + 1)
+            return None
+        # a.b.C through a module alias
+        alias = mod.aliases.get(spine[0])
+        target = self._alias_module(alias)
+        if target is not None and target != "~threading":
+            for part in spine[1:-1]:
+                target = f"{target}.{part}" if target else part
+            return self._find_class(target, name, _depth + 1)
+        return None
+
+    def _find_class(self, module: str, name: str,
+                    _depth: int = 0) -> tuple | None:
+        if _depth > 6:
+            return None
+        mod = self.program.modules.get(module)
+        if mod is None:
+            return None
+        if name in mod.classes:
+            return (module, name)
+        alias = mod.aliases.get(name)
+        if alias and alias[0] == "sym":
+            return self._find_class(alias[1], alias[2], _depth + 1)
+        if alias and alias[0] == "mod":
+            return None
+        return None
+
+    def _find_function(self, module: str, name: str,
+                       _depth: int = 0) -> str | None:
+        if _depth > 6:
+            return None
+        mod = self.program.modules.get(module)
+        if mod is None:
+            return None
+        if name in mod.functions:
+            return mod.functions[name]
+        alias = mod.aliases.get(name)
+        if alias and alias[0] == "sym":
+            return self._find_function(alias[1], alias[2], _depth + 1)
+        return None
+
+    def _class_method(self, module: str, cls: str, meth: str,
+                      _depth: int = 0) -> str | None:
+        if _depth > 8:
+            return None
+        mod = self.program.modules.get(module)
+        craw = mod.classes.get(cls) if mod else None
+        if craw is None:
+            return None
+        if meth in craw.methods:
+            return craw.methods[meth]
+        for b in craw.bases:
+            bres = self._resolve_class_spine(b, module)
+            if bres:
+                hit = self._class_method(bres[0], bres[1], meth,
+                                         _depth + 1)
+                if hit:
+                    return hit
+        return None
+
+    def _ctor(self, module: str, cls: str) -> str | None:
+        return self._class_method(module, cls, "__init__")
+
+    def _receiver_type(self, fn: FuncRaw, spine: tuple) -> tuple | None:
+        """(module, classname) of the receiver `spine` (everything but
+        the final method name), or None."""
+        recv = spine[:-1]
+        if not recv:
+            return None
+        craw = None
+        if fn.cls:
+            mod = self.program.modules.get(fn.module)
+            craw = mod.classes.get(fn.cls) if mod else None
+        if recv[0] in ("self", "cls") and craw is not None:
+            t: tuple | None = (fn.module, fn.cls)
+            i = 1
+            while i < len(recv) and t is not None:
+                attr = recv[i]
+                m, cn = t
+                mod2 = self.program.modules.get(m)
+                c2 = mod2.classes.get(cn) if mod2 else None
+                if c2 is None:
+                    return None
+                if i + 1 < len(recv) and recv[i + 1] == _SUBSCRIPT:
+                    tsp = c2.attr_elem_types.get(attr)
+                    i += 2
+                else:
+                    tsp = c2.attr_types.get(attr)
+                    i += 1
+                t = self._resolve_class_spine(tsp, m) if tsp else None
+            return t
+        # local / param / module-global: walk attr chain through the
+        # classes' attr_types (dataclass annotations cover _global).
+        mod = self.program.modules.get(fn.module)
+        tsp = fn.local_types.get(recv[0]) or \
+            fn.param_types.get(recv[0]) or \
+            (mod.global_types.get(recv[0]) if mod else None)
+        if not tsp:
+            return None
+        t = self._resolve_class_spine(tsp, fn.module)
+        i = 1
+        while i < len(recv) and t is not None:
+            attr = recv[i]
+            m, cn = t
+            mod2 = self.program.modules.get(m)
+            c2 = mod2.classes.get(cn) if mod2 else None
+            if c2 is None:
+                return None
+            if i + 1 < len(recv) and recv[i + 1] == _SUBSCRIPT:
+                nsp = c2.attr_elem_types.get(attr)
+                i += 2
+            else:
+                nsp = c2.attr_types.get(attr)
+                i += 1
+            t = self._resolve_class_spine(nsp, m) if nsp else None
+        return t
+
+    def resolve_call(self, fn: FuncRaw, ev: CallEvent) -> list:
+        """[(funckey, confident)] targets of one call event."""
+        cached = self._call_targets.get(id(ev))
+        if cached is not None:
+            return cached
+        out = self._resolve_call_uncached(fn, ev)
+        self._call_targets[id(ev)] = out
+        return out
+
+    def _resolve_call_uncached(self, fn: FuncRaw, ev: CallEvent) -> list:
+        sp = ev.spine
+        name = sp[-1]
+        mod = self.program.modules.get(fn.module)
+        # 1. bare name: module function / imported symbol / class ctor
+        if len(sp) == 1:
+            if mod and name in mod.functions:
+                return [(mod.functions[name], True)]
+            if mod and name in mod.classes:
+                ctor = self._ctor(fn.module, name)
+                return [(ctor, True)] if ctor else []
+            alias = mod.aliases.get(name) if mod else None
+            if alias and alias[0] == "sym":
+                f = self._find_function(alias[1], alias[2])
+                if f:
+                    return [(f, True)]
+                c = self._find_class(alias[1], alias[2])
+                if c:
+                    ctor = self._ctor(*c)
+                    return [(ctor, True)] if ctor else []
+            # nested function defined in this same function
+            nested = f"{fn.key}.{name}"
+            if nested in self.program.functions:
+                return [(nested, True)]
+            return []
+        # 2. typed receiver (self / annotated / constructed)
+        t = self._receiver_type(fn, sp)
+        if t is not None:
+            hit = self._class_method(t[0], t[1], name)
+            return [(hit, True)] if hit else []
+        # 2b. ClassName.method (static-ish)
+        if len(sp) == 2:
+            c = None
+            if mod and sp[0] in mod.classes:
+                c = (fn.module, sp[0])
+            else:
+                alias = mod.aliases.get(sp[0]) if mod else None
+                if alias and alias[0] == "sym":
+                    c = self._find_class(alias[1], alias[2])
+            if c is not None:
+                hit = self._class_method(c[0], c[1], name)
+                return [(hit, True)] if hit else []
+        # 3. module alias chain: pkg.sub.func / pkg.func — including
+        # modules imported as symbols (`from .parallel import multihost`)
+        alias = mod.aliases.get(sp[0]) if mod else None
+        target = self._alias_module(alias)
+        if target is not None:
+            if target == "~threading":
+                return []
+            for part in sp[1:-1]:
+                nxt = f"{target}.{part}" if target else part
+                if nxt in self.program.modules:
+                    target = nxt
+                else:
+                    c = self._find_class(target, part)
+                    if c:
+                        hit = self._class_method(c[0], c[1], name)
+                        return [(hit, True)] if hit else []
+                    return []
+            f = self._find_function(target, name)
+            if f:
+                return [(f, True)]
+            c = self._find_class(target, name)
+            if c:
+                ctor = self._ctor(*c)
+                return [(ctor, True)] if ctor else []
+            return []
+        # 4. bounded method-name index fallback (low confidence)
+        if name in _INDEX_DENY:
+            return []
+        cands = self._method_index.get(name, [])
+        if 1 <= len(cands) <= _INDEX_FALLBACK_LIMIT:
+            return [(k, False) for k in cands]
+        return []
+
+    def _alias_module(self, alias) -> str | None:
+        """Module label an import alias denotes, for both spellings:
+        `from . import x` and `from .pkg import submodule`."""
+        if not alias:
+            return None
+        if alias[0] == "mod":
+            return alias[1]
+        base, nm = alias[1], alias[2]
+        cand = f"{base}.{nm}" if base else nm
+        return cand if (cand in self.program.modules
+                        or cand == "~threading") else None
+
+    # -- fixpoints --------------------------------------------------------
+    def _build_indexes(self) -> None:
+        for mod in self.program.modules.values():
+            for craw in mod.classes.values():
+                for mname, fkey in craw.methods.items():
+                    if mname.startswith("__"):
+                        continue
+                    self._method_index.setdefault(mname, []).append(fkey)
+
+    def _resolve_all_calls(self) -> None:
+        for fn in self.program.functions.values():
+            for ev in fn.calls:
+                self.resolve_call(fn, ev)
+
+    def _fix_acquires(self) -> None:
+        acq = {k: {} for k in self.program.functions}
+        for fn in self.program.functions.values():
+            for ev in fn.acquires:
+                if ev.via == "notify":
+                    continue
+                key = self.resolve_lock(ev.spine, fn.module, fn.cls,
+                                        fn.name)
+                if key:
+                    acq[fn.key][self.canonical(key)] = True
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.program.functions.values():
+                mine = acq[fn.key]
+                for ev in fn.calls:
+                    for g, confg in self._call_targets.get(id(ev), []):
+                        for b, confb in acq.get(g, {}).items():
+                            conf = confg and confb
+                            if mine.get(b) is None or \
+                                    (conf and not mine[b]):
+                                mine[b] = conf
+                                changed = True
+        self.acquires_closure = acq
+
+    def _fix_blocking(self) -> None:
+        blk: dict[str, dict] = {k: {} for k in self.program.functions}
+        for fn in self.program.functions.values():
+            for ev in fn.blocking:
+                blk[fn.key].setdefault(
+                    ev.name, (True, (fn.path, ev.line)))
+            for ev in fn.collectives:
+                blk[fn.key].setdefault(
+                    f"collective {ev.name}", (True, (fn.path, ev.line)))
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.program.functions.values():
+                mine = blk[fn.key]
+                for ev in fn.calls:
+                    for g, confg in self._call_targets.get(id(ev), []):
+                        for label, (confb, site) in blk.get(g, {}).items():
+                            conf = confg and confb
+                            cur = mine.get(label)
+                            if cur is None or (conf and not cur[0]):
+                                mine[label] = (conf, site)
+                                changed = True
+        self.blocking_closure = blk
+
+    def _fix_threads(self) -> None:
+        for fn in self.program.functions.values():
+            for ev in fn.calls:
+                if ev.spine[-1] != "Thread" or ev.thread_target is None:
+                    continue
+                pseudo = CallEvent(spine=ev.thread_target, held=(),
+                                   line=ev.line)
+                for tkey, _conf in self._resolve_call_uncached(fn, pseudo):
+                    self.thread_roots[tkey] = ev.thread_name or \
+                        f"thread@{fn.path}:{ev.line}"
+        reach: dict[str, set] = {k: set() for k in self.program.functions}
+        for root, tname in self.thread_roots.items():
+            stack = [root]
+            seen = set()
+            while stack:
+                k = stack.pop()
+                if k in seen or k not in reach:
+                    continue
+                seen.add(k)
+                reach[k].add(tname)
+                fn = self.program.functions.get(k)
+                if fn is None:
+                    continue
+                for ev in fn.calls:
+                    for g, _c in self._call_targets.get(id(ev), []):
+                        stack.append(g)
+        self.thread_reach = reach
+
+    # -- edges ------------------------------------------------------------
+    def _add_edge(self, a: str, b: str, confident: bool, path: str,
+                  line: int, label: str) -> None:
+        if a == b:
+            return
+        e = self.edges.get((a, b))
+        if e is None:
+            e = Edge(src=a, dst=b, confident=confident)
+            self.edges[(a, b)] = e
+        elif confident and not e.confident:
+            e.confident = True
+        if len(e.sites) < 8:
+            e.sites.append((path, line, label))
+
+    def _build_edges(self) -> None:
+        for fn in self.program.functions.values():
+            for ev in fn.acquires:
+                if ev.via == "notify" or not ev.held:
+                    continue
+                b = self.resolve_lock(ev.spine, fn.module, fn.cls,
+                                      fn.name)
+                if not b:
+                    continue
+                b = self.canonical(b)
+                for hs in ev.held:
+                    a = self.resolve_lock(hs, fn.module, fn.cls, fn.name)
+                    if a:
+                        self._add_edge(self.canonical(a), b, True,
+                                       fn.path, ev.line,
+                                       f"{fn.key} ({ev.via})")
+            for ev in fn.calls:
+                if not ev.held:
+                    continue
+                held_keys = [self.canonical(k) for k in
+                             (self.resolve_lock(hs, fn.module, fn.cls,
+                                                fn.name)
+                              for hs in ev.held) if k]
+                if not held_keys:
+                    continue
+                for g, confg in self._call_targets.get(id(ev), []):
+                    for b, confb in self.acquires_closure.get(g,
+                                                              {}).items():
+                        for a in held_keys:
+                            self._add_edge(a, b, confg and confb,
+                                           fn.path, ev.line,
+                                           f"{fn.key} -> {g}")
+
+    # -- findings ---------------------------------------------------------
+    def _suppressed(self, path: str, line: int, rule: Rule) -> bool:
+        sup = self.program.suppressions.get(path)
+        return bool(sup and sup.active(line, rule))
+
+    def _emit(self, rule_key: str, severity: str, path: str, line: int,
+              message: str, sites: tuple = ()) -> None:
+        rule = RULES[rule_key]
+        if self._suppressed(path, line, rule):
+            return
+        for p, ln in sites:
+            if self._suppressed(p, ln, rule):
+                return
+        self.findings.append(Finding(rule=rule, severity=severity,
+                                     path=path, line=line,
+                                     message=message, sites=sites))
+
+    def _find_cycles(self) -> None:
+        """HVD501: cycles in the lock-order graph (Tarjan SCCs; one
+        finding per cyclic SCC, anchored at its first edge site)."""
+        for confident_only in (True, False):
+            adj: dict[str, list[str]] = {}
+            for (a, b), e in self.edges.items():
+                if confident_only and not e.confident:
+                    continue
+                adj.setdefault(a, []).append(b)
+            for scc in _tarjan(adj):
+                in_scc = set(scc)
+                cyc_edges = [e for (a, b), e in self.edges.items()
+                             if a in in_scc and b in in_scc
+                             and (e.confident or not confident_only)]
+                if len(scc) == 1:
+                    continue
+                if confident_only:
+                    severity = "error"
+                elif all(e.confident for e in cyc_edges):
+                    continue       # already reported in the error pass
+                else:
+                    severity = "warning"
+                cycle = " -> ".join(sorted(in_scc)) + \
+                    f" -> {sorted(in_scc)[0]}"
+                prov = "; ".join(
+                    f"{e.src}->{e.dst} at {e.sites[0][0]}:{e.sites[0][1]}"
+                    f" ({e.sites[0][2]})" for e in cyc_edges[:6])
+                first = cyc_edges[0].sites[0]
+                self._emit(
+                    "lock-order-inversion", severity, first[0], first[1],
+                    f"lock-order inversion cycle: {cycle}.  Two threads "
+                    f"taking these locks in opposite orders deadlock the "
+                    f"world; impose one global order or drop a lock "
+                    f"before taking the next.  Edges: {prov}",
+                    sites=tuple((e.sites[0][0], e.sites[0][1])
+                                for e in cyc_edges))
+
+    def _find_held_blocking(self) -> None:
+        """HVD502: lock held across a blocking/collective call, direct
+        or through any call chain."""
+        from .ownership import blocking_allowed_under
+        reported: set = set()
+        for fn in self.program.functions.values():
+            for ev in fn.blocking + fn.collectives:
+                if not ev.held:
+                    continue
+                held = self._held_keys(fn, ev.held)
+                label = getattr(ev, "name", "?")
+                if label in ("wait", "wait_for"):
+                    # Condition.wait on the held condition's own lock is
+                    # the sanctioned idiom — it RELEASES that lock.
+                    held = self._drop_cond_self_wait(fn, ev, held)
+                for a in held:
+                    if blocking_allowed_under(a):
+                        continue
+                    key = (fn.key, a, label)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    what = "collective" if ev in fn.collectives \
+                        else "blocking call"
+                    self._emit(
+                        "lock-held-across-blocking", "error", fn.path,
+                        ev.line,
+                        f"{what} '{label}' while holding lock {a} "
+                        f"(in {fn.key}); a peer or callback thread "
+                        f"needing {a} deadlocks for the full wait — "
+                        f"release the lock first or bound and justify "
+                        f"the hold")
+            for ev in fn.calls:
+                if not ev.held:
+                    continue
+                held = self._held_keys(fn, ev.held)
+                if not held:
+                    continue
+                for g, confg in self._call_targets.get(id(ev), []):
+                    for label, (confb, site) in \
+                            self.blocking_closure.get(g, {}).items():
+                        conf = confg and confb
+                        for a in held:
+                            if blocking_allowed_under(a):
+                                continue
+                            key = (fn.key, a, g.rsplit(".", 1)[-1],
+                                   label)
+                            if key in reported:
+                                continue
+                            reported.add(key)
+                            self._emit(
+                                "lock-held-across-blocking",
+                                "error" if conf else "warning",
+                                fn.path, ev.line,
+                                f"call to {g} while holding lock {a} "
+                                f"(in {fn.key}) reaches '{label}' at "
+                                f"{site[0]}:{site[1]}; the lock is held "
+                                f"across that wait — release it first, "
+                                f"or justify the external bound with a "
+                                f"suppression")
+
+    def _held_keys(self, fn: FuncRaw, held) -> list[str]:
+        out = []
+        for hs in held:
+            k = self.resolve_lock(hs, fn.module, fn.cls, fn.name)
+            if k:
+                out.append(self.canonical(k))
+        return out
+
+    def _drop_cond_self_wait(self, fn: FuncRaw, ev, held: list[str]):
+        # ev.line corresponds to a recorded acquire with via="wait";
+        # find its receiver's canonical lock and drop it from held.
+        for acq in fn.acquires:
+            if acq.line == ev.line and acq.via == "wait":
+                k = self.resolve_lock(acq.spine, fn.module, fn.cls,
+                                      fn.name)
+                if k:
+                    c = self.canonical(k)
+                    return [h for h in held if h != c]
+        return held
+
+    def _find_orphan_conditions(self) -> None:
+        """HVD503: Condition with wait sites but no notify anywhere."""
+        waits: dict[str, list] = {}
+        notifies: set[str] = set()
+        for fn in self.program.functions.values():
+            for ev in fn.acquires:
+                if ev.via not in ("wait", "notify"):
+                    continue
+                k = self.resolve_lock(ev.spine, fn.module, fn.cls,
+                                      fn.name)
+                if not k or self.locks[k].kind != "condition":
+                    continue
+                if ev.via == "wait":
+                    waits.setdefault(k, []).append((fn, ev.line))
+                else:
+                    notifies.add(k)
+        for k, sites in waits.items():
+            if k in notifies:
+                continue
+            fn, line = sites[0]
+            self._emit(
+                "orphan-condition-wait", "error", fn.path, line,
+                f"wait on condition {k} but no code path ever calls "
+                f"notify/notify_all on it: the predicate is written by "
+                f"no other thread, so the wait can only end by timeout "
+                f"(or never) — add the notify at the state change, or "
+                f"replace the condition with a timeout poll and justify")
+
+    def analyze(self) -> "Analysis":
+        self._register_locks()
+        self._build_indexes()
+        self._resolve_all_calls()
+        self._fix_acquires()
+        self._fix_blocking()
+        self._fix_threads()
+        self._build_edges()
+        self._find_cycles()
+        self._find_held_blocking()
+        self._find_orphan_conditions()
+        from .ownership import check_ownership
+        check_ownership(self)
+        from .san import check_wire_drift
+        check_wire_drift(self)
+        self.findings.sort(key=lambda f: (f.path, f.line, f.rule.id))
+        return self
+
+    # -- serialization -----------------------------------------------------
+    def graph_json(self) -> dict:
+        return {
+            "locks": {k: {"site": v.site, "kind": v.kind,
+                          "canonical": v.canonical}
+                      for k, v in self.locks.items()},
+            "edges": [{"src": a, "dst": b, "confident": e.confident,
+                       "sites": [f"{p}:{ln}" for p, ln, _ in e.sites]}
+                      for (a, b), e in sorted(self.edges.items())],
+            "threads": dict(sorted(self.thread_roots.items())),
+        }
+
+    def site_to_lock(self) -> dict[str, str]:
+        """creation-site "path:line" -> canonical lock key (the map the
+        runtime witness diff uses)."""
+        return {v.site: v.canonical for v in self.locks.values()}
+
+    def edge_keys(self) -> set[tuple[str, str]]:
+        return set(self.edges.keys())
+
+
+def _tarjan(adj: dict[str, list[str]]) -> list[list[str]]:
+    """Iterative Tarjan SCC over the adjacency dict (includes
+    single-node SCCs; callers filter)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+    nodes = set(adj)
+    for vs in adj.values():
+        nodes.update(vs)
+
+    def strongconnect(root: str) -> None:
+        work = [(root, 0)]
+        while work:
+            v, pi = work[-1]
+            if pi == 0:
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on_stack.add(v)
+            recurse = False
+            succs = adj.get(v, [])
+            for i in range(pi, len(succs)):
+                w = succs[i]
+                if w not in index:
+                    work[-1] = (v, i + 1)
+                    work.append((w, 0))
+                    recurse = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if recurse:
+                continue
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                sccs.append(scc)
+            work.pop()
+            if work:
+                u = work[-1][0]
+                low[u] = min(low[u], low[v])
+
+    for n in sorted(nodes):
+        if n not in index:
+            strongconnect(n)
+    return sccs
+
+
+def analyze_paths(paths) -> Analysis:
+    program = Program()
+    program.collect_paths(paths)
+    return Analysis(program).analyze()

@@ -1,0 +1,210 @@
+"""Declarative thread-ownership manifest for shared runtime state.
+
+Before this module, hvdlint's HVD401 carried a hard-coded list of
+"owner" module basenames; nothing named the *thread* that owns each
+piece of shared state, so a write racing the owning thread from, say,
+the heartbeat monitor looked identical to a legitimate wiring write at
+init.  The manifest below is the single source of truth for both:
+
+- **hvdlint HVD401** reads each domain's ``writer_modules`` (replacing
+  the old hard-coded set): writes to a domain's attributes outside its
+  writer modules are flagged per-file, exactly as before but
+  declaratively.
+- **hvdsan HVD504** (``cross-thread-write``) adds the interprocedural
+  half: a write to a domain's attributes from a function reachable from
+  a *named thread root* other than the domain's ``owner_thread`` is a
+  cross-thread write racing the owner — flagged even inside a writer
+  module.
+
+``LOCK_HOLD_ALLOWED`` is the manifest's second leg: locks that are
+*documented* to be held across blocking calls, each with the external
+ordering guarantee that makes the hold safe.  hvdsan's HVD502 consults
+it so the justification lives here, reviewable in one place, instead of
+scattered across dozens of inline suppressions.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class StateDomain:
+    name: str
+    # Thread that owns mutation of this state at steady state (thread
+    # names as passed to threading.Thread(name=...); "main" = user/init
+    # threads, which the per-module allowlist governs instead).
+    owner_thread: str
+    # Attribute names that mark the state anywhere on a write target's
+    # spine (matching hvdlint HVD401 semantics: the final assigned field
+    # is excluded — `x.controller = c` wires up, `x.controller.f = v`
+    # mutates internals).
+    attrs: frozenset
+    # Module path suffixes allowed to write (init wiring + the owners).
+    writer_modules: frozenset
+    why: str = ""
+
+
+MANIFEST: tuple[StateDomain, ...] = (
+    StateDomain(
+        name="controller",
+        owner_thread="hvd-background",
+        attrs=frozenset({"controller", "_controller"}),
+        writer_modules=frozenset({"core.py", "common/controller.py",
+                                  "common/parameter_manager.py"}),
+        why="the background loop drives the negotiation protocol; all "
+            "controller state mutates on its cycle"),
+    StateDomain(
+        name="tensor-queue",
+        owner_thread="hvd-background",
+        attrs=frozenset({"tensor_queue", "_tensor_queue"}),
+        writer_modules=frozenset({"core.py", "common/tensor_queue.py",
+                                  "common/controller.py"}),
+        why="single-consumer table: the background thread pops; user "
+            "threads only enqueue through add_to_tensor_queue"),
+    StateDomain(
+        name="global-state",
+        owner_thread="main",
+        attrs=frozenset({"_global"}),
+        writer_modules=frozenset({"core.py"}),
+        why="process-wide runtime wiring; mutated only under "
+            "core._init_lock on init/shutdown"),
+    StateDomain(
+        name="timeline",
+        owner_thread="hvd-timeline",
+        attrs=frozenset({"timeline", "_timeline"}),
+        writer_modules=frozenset({"core.py", "common/timeline.py"}),
+        why="the writer thread owns the file; recording state mutates "
+            "under the timeline's own lock"),
+    StateDomain(
+        name="telemetry",
+        owner_thread="main",
+        attrs=frozenset({"telemetry", "_registry"}),
+        writer_modules=frozenset({"core.py", "telemetry/__init__.py",
+                                  "telemetry/registry.py"}),
+        why="registry construction happens at init; metric updates go "
+            "through per-metric locks, never by field assignment"),
+    StateDomain(
+        name="flight",
+        owner_thread="main",
+        attrs=frozenset({"flight", "_recorder"}),
+        writer_modules=frozenset({"core.py", "telemetry/flight.py"}),
+        why="the recorder ring is GIL-atomic append-only; the recorder "
+            "*reference* swaps only at configure time"),
+)
+
+
+def owner_module_suffixes() -> frozenset:
+    """Union of every domain's writer modules — hvdlint HVD401's
+    replacement for its old hard-coded basename list."""
+    out: set = set()
+    for d in MANIFEST:
+        out |= d.writer_modules
+    return frozenset(out)
+
+
+def domain_for_write(spine) -> StateDomain | None:
+    """Domain owning a write-target spine, or None.  HVD401 semantics:
+    domain attrs anywhere on the spine EXCEPT the final assigned field,
+    plus root names (``_global.x = ...``)."""
+    if len(spine) < 2:
+        return None
+    marks = set(spine[:-1])
+    for d in MANIFEST:
+        if marks & d.attrs:
+            return d
+    return None
+
+
+def module_allowed(path: str, domain: StateDomain) -> bool:
+    norm = path.replace("\\", "/")
+    return any(norm.endswith(sfx) for sfx in domain.writer_modules)
+
+
+# ---------------------------------------------------------------------------
+# Documented lock-hold allowances (HVD502 manifest suppressions)
+# ---------------------------------------------------------------------------
+# canonical lock key -> the external ordering guarantee that bounds the
+# hold.  Each entry is a *reviewed* exception: hvdsan reports nothing
+# for these locks being held across blocking calls, and the report mode
+# lists them so the justification stays visible.
+LOCK_HOLD_ALLOWED: dict[str, str] = {
+    "core._init_lock":
+        "one-shot init guard taken only by user threads; the formation "
+        "waits under it are themselves timeout-bounded (rendezvous/"
+        "connect timeouts), the background loop never takes it, and "
+        "shutdown's potentially-wedging teardown (channel-close joins, "
+        "dump file I/O) runs OUTSIDE the lock since the HVD502 pass "
+        "that motivated this manifest",
+    "parallel.multihost._lock":
+        "orders init/shutdown of the JAX world on user threads only; "
+        "the init-time barrier under it carries its own timeout "
+        "(the HVD301 suppression in multihost.py documents the same "
+        "guarantee)",
+    "native._lock":
+        "one-shot native-library build/load guard on the first caller "
+        "thread; the compile it covers is finite and no hot path "
+        "takes the lock",
+    "resilience.context._lock":
+        "configure/shutdown-time guard for the process ResilienceState "
+        "swap; heartbeat start/stop joins under it are bounded by the "
+        "monitor poll interval",
+    "resilience.chaos._lock":
+        "configure-time guard for the chaos-engine swap; never taken "
+        "on the dispatch path",
+    "elastic.driver.ElasticDriver._lock":
+        "the round condition's own lock: waits on _round_cond release "
+        "it (condition idiom), and discovery-thread RPC fan-out under "
+        "it is bounded by the per-client RPC timeout",
+    "elastic.rpc.RpcClient._lock":
+        "BY DESIGN held across one send+recv pair: it serializes whole "
+        "request/response exchanges on the shared persistent socket so "
+        "frames from concurrent callers never interleave; no other "
+        "lock ever nests inside it, and a broken connection raises out",
+    "elastic.worker.WorkerNotificationManager._lock":
+        "one-shot notification-service registration guard; the "
+        "register_worker RPC under it happens once at worker start, "
+        "bounded by the RPC connect timeout, before any listener can "
+        "contend",
+}
+
+
+def blocking_allowed_under(lock_key: str) -> bool:
+    return lock_key in LOCK_HOLD_ALLOWED
+
+
+# ---------------------------------------------------------------------------
+# HVD504 check (called from lockgraph.Analysis.analyze)
+# ---------------------------------------------------------------------------
+def check_ownership(analysis) -> None:
+    """Cross-thread writes: a write to a manifest domain's state from a
+    function reachable from a named thread root other than the domain's
+    owner thread (module allowlist exempts the owners themselves)."""
+    reported = set()
+    for fn in analysis.program.functions.values():
+        threads = analysis.thread_reach.get(fn.key, set())
+        if not threads:
+            continue        # only user/main threads reach it: HVD401's job
+        for ev in fn.writes:
+            domain = domain_for_write(ev.spine)
+            if domain is None:
+                continue
+            if module_allowed(fn.path, domain):
+                continue
+            foreign = sorted(
+                t for t in threads
+                if t != domain.owner_thread)
+            if not foreign:
+                continue
+            key = (fn.key, ev.line, domain.name)
+            if key in reported:
+                continue
+            reported.add(key)
+            analysis._emit(
+                "cross-thread-write", "error", fn.path, ev.line,
+                f"write to {domain.name} state "
+                f"'{'.'.join(ev.spine)}' from {fn.key}, reachable from "
+                f"thread(s) {', '.join(foreign)} — owner thread is "
+                f"'{domain.owner_thread}' ({domain.why}); route the "
+                f"change through the owner (controller protocol / "
+                f"owning module API) or extend the manifest with the "
+                f"guarantee")

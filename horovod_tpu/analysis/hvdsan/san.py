@@ -1,0 +1,670 @@
+"""hvdsan driver + runtime lock-order witness.
+
+Static half (``analyze``/``main``): run the whole-program lock-graph
+analysis (:mod:`.lockgraph`), the ownership manifest check
+(:mod:`.ownership`) and the wire-schema drift check (HVD505, below)
+over a tree, and render text/JSON/SARIF reports.  CLI::
+
+    python -m horovod_tpu.analysis.hvdsan [paths...]
+        [--format text|json|sarif] [--graph] [--witness dump.json ...]
+
+Runtime half (the **witness**): under ``HOROVOD_SAN=1``
+(:func:`maybe_enable`, called at ``horovod_tpu`` import before any
+package lock exists) ``threading.Lock/RLock/Condition`` constructed
+from package code are wrapped in lightweight recording proxies.  Each
+wrapper knows its creation site (``horovod_tpu/...py:line`` — the same
+key the static analysis assigns), every acquisition while other
+package locks are held records ordered edges ``held-site →
+new-site`` per thread (first observation also lands in the flight
+recorder's ring), and :func:`dump_witness` (registered atexit) writes
+the observed lock-order graph as rank-stamped JSON
+(``HOROVOD_SAN_FILE``).
+
+The CI contract (tests/test_multiprocess.py san battery): every edge
+the 2/4-rank worlds *observe* must exist in the static graph —
+otherwise the analyzer is unsound and the build fails; static cycles
+never observed demote to warnings (``apply_witness``).
+"""
+from __future__ import annotations
+
+import ast
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+
+from ..rules import RULES  # noqa: F401  (suppression parsing shares it)
+
+# NOTE: .lockgraph (and through it ..lint) is imported lazily inside
+# the functions that need it: this module loads at `horovod_tpu` import
+# time to install the witness, and must not drag the static-analysis
+# machinery (or pre-import analysis.lint under `python -m`) with it.
+
+# ---------------------------------------------------------------------------
+# HVD505 — wire-schema drift (common/message.py <-> common/wire.py)
+# ---------------------------------------------------------------------------
+# Fallback primitive vocabulary when the analyzed set doesn't include
+# common/wire.py (single-fixture runs).
+_DEFAULT_WIRE_PRIMS = frozenset({
+    "uvarint", "svarint", "f64", "string", "blob", "bool_",
+    "uvarint_list", "svarint_list", "string_list",
+})
+_ENC_METHODS = ("encode", "to_bytes")
+_DEC_METHODS = ("decode", "from_bytes")
+
+
+def collect_wire_method(program, mod, cls, node) -> None:
+    """Extract the ordered primitive-call sequence of one encode/decode
+    method (called from the lockgraph collector's single AST walk)."""
+    side = "enc" if node.name in _ENC_METHODS else "dec"
+    tokens = _wire_tokens(node, side)
+    # A wire codec writes/reads a field *sequence*; a lone primitive hit
+    # (e.g. a compress kernel calling some to_bytes helper) is not one.
+    if len(tokens) < 2:
+        return
+    program.wire_codecs.append({
+        "module": mod.label, "cls": cls.name, "path": mod.path,
+        "method": node.name, "line": node.lineno, "side": side,
+        "tokens": tokens,
+    })
+
+
+def note_wire_class(program, mod, cls_node) -> None:
+    """Record Encoder/Decoder method vocabularies from a wire module."""
+    names = {n.name for n in cls_node.body
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+             and not n.name.startswith("_")}
+    program.wire_prims[cls_node.name] = names
+
+
+def _wire_tokens(node, side: str) -> list:
+    """[(prim|"nested", fieldname|None), ...] in wire order."""
+    from .lockgraph import _spine
+    # Loop-variable -> iterated self-attr (for r in self.requests).
+    loopmap: dict[str, str] = {}
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.For) and isinstance(sub.target, ast.Name):
+            isp = _spine(sub.iter)
+            if isp and isp[0] == "self" and len(isp) == 2:
+                loopmap[sub.target.id] = isp[1]
+    # Enclosing single-Name assign target per contained call.
+    assign_of: dict[int, str] = {}
+    kwarg_of: dict[int, str] = {}
+    kwmap: dict[str, str] = {}      # local name -> ctor kwarg name
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                isinstance(sub.targets[0], ast.Name):
+            tname = sub.targets[0].id
+            for c in ast.walk(sub.value):
+                if isinstance(c, ast.Call):
+                    assign_of[id(c)] = tname
+        if isinstance(sub, ast.Call):
+            fsp = _spine(sub.func)
+            is_ctor = bool(fsp) and (fsp[-1] == "cls" or
+                                     fsp[-1][:1].isupper())
+            if is_ctor and sub.keywords:
+                for kw in sub.keywords:
+                    if kw.arg is None:
+                        continue
+                    if isinstance(kw.value, ast.Name):
+                        kwmap[kw.value.id] = kw.arg
+                    for c in ast.walk(kw.value):
+                        if isinstance(c, ast.Call):
+                            kwarg_of.setdefault(id(c), kw.arg)
+    out = []
+    for call in sorted(
+            (c for c in ast.walk(node) if isinstance(c, ast.Call)
+             and isinstance(c.func, ast.Attribute)),
+            key=lambda c: (c.func.end_lineno or 0,
+                           c.func.end_col_offset or 0)):
+        # The receiver may itself be a chained Call
+        # (enc.uvarint(a).string(b)); only the method name matters.
+        name = call.func.attr
+        recv = _spine(call.func.value)
+        if name in ("encode", "decode"):
+            # nested message: r.encode(enc) / Request.decode(dec)
+            if side == "enc" and name == "encode" and recv:
+                field = loopmap.get(recv[0])
+                out.append(("nested", field, call.lineno))
+            elif side == "dec" and name == "decode":
+                field = kwarg_of.get(id(call))
+                out.append(("nested", field, call.lineno))
+            continue
+        if name not in _DEFAULT_WIRE_PRIMS:
+            continue
+        field = None
+        if side == "enc":
+            for a in call.args:
+                for s in ast.walk(a):
+                    ssp = _spine(s) if isinstance(
+                        s, (ast.Attribute, ast.Name)) else None
+                    if ssp and ssp[0] == "self" and len(ssp) == 2:
+                        field = ssp[1]
+                        break
+                if field:
+                    break
+            # len(self.x) prefixes are counts, not the field itself.
+            if call.args and isinstance(call.args[0], ast.Call):
+                inner = _spine(call.args[0].func)
+                if inner and inner[-1] == "len":
+                    field = None
+        else:
+            field = kwarg_of.get(id(call))
+            if field is None:
+                local = assign_of.get(id(call))
+                if local is not None:
+                    field = kwmap.get(local, local)
+        out.append((name, field, call.lineno))
+    return out
+
+
+def check_wire_drift(analysis: Analysis) -> None:
+    """HVD505: encode/decode primitive sequences must agree per class,
+    and only use primitives both wire codec classes define."""
+    program = analysis.program
+    by_cls: dict = {}
+    for rec in program.wire_codecs:
+        by_cls.setdefault((rec["module"], rec["cls"]), {})[rec["side"]] \
+            = rec
+    enc_prims = program.wire_prims.get("Encoder")
+    dec_prims = program.wire_prims.get("Decoder")
+    known = (enc_prims & dec_prims) if (enc_prims and dec_prims) \
+        else _DEFAULT_WIRE_PRIMS
+    for (modlabel, cls), sides in sorted(by_cls.items()):
+        enc, dec = sides.get("enc"), sides.get("dec")
+        if enc is None or dec is None:
+            rec = enc or dec
+            other = "decode/from_bytes" if dec is None \
+                else "encode/to_bytes"
+            analysis._emit(
+                "wire-schema-drift", "error", rec["path"], rec["line"],
+                f"{cls}.{rec['method']} has no matching {other} in the "
+                f"same class: a one-sided wire schema cannot round-trip "
+                f"— add the counterpart or drop the codec method")
+            continue
+        et, dt = enc["tokens"], dec["tokens"]
+        for rec, toks in ((enc, et), (dec, dt)):
+            for prim, _f, line in toks:
+                if prim != "nested" and prim not in known:
+                    analysis._emit(
+                        "wire-schema-drift", "error", rec["path"], line,
+                        f"{cls}.{rec['method']} uses wire primitive "
+                        f"'{prim}' not defined by both Encoder and "
+                        f"Decoder in common/wire.py — the peer cannot "
+                        f"decode what this side writes")
+        n = min(len(et), len(dt))
+        for i in range(n):
+            ep, ef, eline = et[i]
+            dp, df, dline = dt[i]
+            if ep != dp:
+                analysis._emit(
+                    "wire-schema-drift", "error", dec["path"], dline,
+                    f"{cls} wire drift at field #{i + 1}: "
+                    f"{enc['method']} writes '{ep}'"
+                    f"{f' ({ef})' if ef else ''} but {dec['method']} "
+                    f"reads '{dp}'{f' ({df})' if df else ''} — every "
+                    f"frame after this field decodes garbage on the "
+                    f"peer")
+                break
+            if ef and df and ef != df:
+                analysis._emit(
+                    "wire-schema-drift", "error", dec["path"], dline,
+                    f"{cls} wire field-order drift at position "
+                    f"#{i + 1}: {enc['method']} writes field '{ef}' "
+                    f"where {dec['method']} assigns '{df}' — same "
+                    f"primitive, swapped fields decode silently wrong")
+                break
+        else:
+            if len(et) != len(dt):
+                longer, shorter = (enc, dec) if len(et) > len(dt) \
+                    else (dec, enc)
+                lt = et if len(et) > len(dt) else dt
+                prim, f, line = lt[n]
+                analysis._emit(
+                    "wire-schema-drift", "error", longer["path"], line,
+                    f"{cls} wire drift: {longer['method']} has "
+                    f"{abs(len(et) - len(dt))} trailing field(s) "
+                    f"starting with '{prim}'{f' ({f})' if f else ''} "
+                    f"that {shorter['method']} never "
+                    f"{'reads' if longer is enc else 'writes'} — "
+                    f"fp_*/tm_*/trace_*-style field growth must land "
+                    f"on both sides in the same change")
+
+
+# ---------------------------------------------------------------------------
+# Runtime witness
+# ---------------------------------------------------------------------------
+_orig_lock = threading.Lock
+_orig_rlock = threading.RLock
+_orig_condition = threading.Condition
+_enabled = False
+_witness: "Witness | None" = None
+
+
+class Witness:
+    """Process-wide observed lock-order graph."""
+
+    def __init__(self) -> None:
+        self.edges: dict = {}        # (src, dst) -> [count, set(threads)]
+        self.locks: dict = {}        # site -> kind
+        self._tls = threading.local()
+        self._reg = _orig_lock()
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def note_acquire(self, proxy) -> None:
+        stack = self._stack()
+        if stack:
+            tname = threading.current_thread().name
+            for held in stack:
+                if held.site != proxy.site:
+                    self._note_edge(held.site, proxy.site, tname)
+        stack.append(proxy)
+
+    def note_release(self, proxy, all_levels: bool = False) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is proxy:
+                del stack[i]
+                if not all_levels:
+                    return
+
+    def _note_edge(self, src: str, dst: str, thread: str) -> None:
+        with self._reg:
+            e = self.edges.get((src, dst))
+            fresh = e is None
+            if fresh:
+                e = self.edges[(src, dst)] = [0, set()]
+            e[0] += 1
+            e[1].add(thread)
+        if fresh:
+            self._flight_record(src, dst, thread)
+
+    @staticmethod
+    def _flight_record(src: str, dst: str, thread: str) -> None:
+        """First observation of an edge lands in the flight-recorder
+        ring (direct global read — recorder() would take a lock)."""
+        try:
+            from ...telemetry import flight
+            rec = flight._recorder
+            if rec is not None and rec.enabled:
+                rec.record("lock-order", f"{src} -> {dst}",
+                           detail=f"thread={thread}")
+        except Exception:  # noqa: BLE001 - witness must never break init
+            pass
+
+    def snapshot(self) -> dict:
+        with self._reg:
+            edges = [{"src": s, "dst": d, "count": c,
+                      "threads": sorted(ts)}
+                     for (s, d), (c, ts) in sorted(self.edges.items())]
+        return {"rank": int(os.environ.get("HOROVOD_RANK", "0") or 0),
+                "pid": os.getpid(),
+                "monotonic": time.monotonic(),
+                "locks": dict(sorted(self.locks.items())),
+                "edges": edges}
+
+    def reset(self) -> None:
+        with self._reg:
+            self.edges.clear()
+            self.locks.clear()
+
+
+class _SanLock:
+    """Recording proxy over a real Lock/RLock."""
+
+    def __init__(self, inner, site: str, witness: Witness) -> None:
+        self._inner = inner
+        self.site = site
+        self._w = witness
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._w.note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._w.note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<SanLock {self.site} over {self._inner!r}>"
+
+    # Condition integration: delegate the RLock save/restore protocol so
+    # Condition.wait releases every recursion level (and our per-thread
+    # stack tracks it).
+    def _is_owned(self):
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        self._w.note_release(self, all_levels=True)
+        inner = self._inner
+        if hasattr(inner, "_release_save"):
+            return inner._release_save()
+        inner.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        inner = self._inner
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(state)
+        else:
+            inner.acquire()
+        self._w.note_acquire(self)
+
+
+def _creation_site() -> str | None:
+    """Creation site of the package frame constructing a lock, or None
+    for stdlib/user code (those get raw primitives, zero overhead)."""
+    try:
+        frame = sys._getframe(2)
+    except ValueError:
+        return None
+    fname = (frame.f_code.co_filename or "").replace(os.sep, "/")
+    idx = fname.find("horovod_tpu/")
+    if idx < 0:
+        return None
+    rel = fname[idx:]
+    if rel.endswith("analysis/hvdsan/san.py"):
+        return None
+    return f"{rel}:{frame.f_lineno}"
+
+
+def _san_lock_factory():
+    site = _creation_site()
+    inner = _orig_lock()
+    if site is None or _witness is None:
+        return inner
+    _witness.locks.setdefault(site, "lock")
+    return _SanLock(inner, site, _witness)
+
+
+def _san_rlock_factory():
+    site = _creation_site()
+    inner = _orig_rlock()
+    if site is None or _witness is None:
+        return inner
+    _witness.locks.setdefault(site, "rlock")
+    return _SanLock(inner, site, _witness)
+
+
+def _san_condition_factory(lock=None):
+    site = _creation_site()
+    if lock is None and site is not None and _witness is not None:
+        _witness.locks.setdefault(site, "condition")
+        lock = _SanLock(_orig_rlock(), site, _witness)
+    return _orig_condition(lock) if lock is not None \
+        else _orig_condition()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def witness() -> "Witness | None":
+    return _witness
+
+
+def enable() -> Witness:
+    """Install the recording wrappers (idempotent).  Must run before
+    the package modules that create locks are imported —
+    ``horovod_tpu/__init__`` calls :func:`maybe_enable` first thing."""
+    global _enabled, _witness
+    if _enabled:
+        return _witness
+    _witness = Witness()
+    threading.Lock = _san_lock_factory
+    threading.RLock = _san_rlock_factory
+    threading.Condition = _san_condition_factory
+    _enabled = True
+    atexit.register(dump_witness)
+    return _witness
+
+
+def disable() -> None:
+    """Restore the original factories (tests); existing wrappers keep
+    working, new locks are raw again."""
+    global _enabled
+    threading.Lock = _orig_lock
+    threading.RLock = _orig_rlock
+    threading.Condition = _orig_condition
+    _enabled = False
+
+
+def maybe_enable() -> bool:
+    if os.environ.get("HOROVOD_SAN", "").strip().lower() in (
+            "1", "true", "on", "yes"):
+        enable()
+        return True
+    return False
+
+
+def _rank_path(path: str, rank: int) -> str:
+    if "{rank}" in path:
+        return path.format(rank=rank)
+    if rank == 0:
+        return path
+    root, dot, ext = path.rpartition(".")
+    return f"{root}.r{rank}.{ext}" if dot else f"{path}.r{rank}"
+
+
+def dump_witness(path: str | None = None) -> str | None:
+    """Write the observed lock-order graph as rank-stamped JSON;
+    returns the path (None when the witness is off or unwritable)."""
+    w = _witness
+    if w is None:
+        return None
+    if not w.locks and not w.edges:
+        return None        # nothing observed (witness reset/unused)
+    payload = w.snapshot()
+    path = path or os.environ.get("HOROVOD_SAN_FILE",
+                                  "hvdsan_witness.json")
+    path = _rank_path(path, payload["rank"])
+    try:
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+    except OSError:
+        return None
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Witness <-> static diff
+# ---------------------------------------------------------------------------
+def witness_diff(analysis: Analysis, payloads) -> list[str]:
+    """Soundness check: every observed edge must exist in the static
+    graph.  Returns human-readable problems (empty = sound)."""
+    site_map = analysis.site_to_lock()
+    static_edges = analysis.edge_keys()
+    problems: list[str] = []
+    for payload in payloads:
+        rank = payload.get("rank", "?")
+        for e in payload.get("edges", []):
+            src, dst = e["src"], e["dst"]
+            ks, kd = site_map.get(src), site_map.get(dst)
+            if ks is None or kd is None:
+                missing = src if ks is None else dst
+                problems.append(
+                    f"rank {rank}: observed lock at {missing} has no "
+                    f"static identity — the analyzer missed a "
+                    f"creation site")
+                continue
+            if ks == kd:
+                continue
+            if (ks, kd) not in static_edges:
+                problems.append(
+                    f"rank {rank}: observed order {ks} -> {kd} "
+                    f"({src} -> {dst}, threads "
+                    f"{','.join(e.get('threads', []))}) is missing "
+                    f"from the static graph — the analyzer is unsound "
+                    f"on this path")
+    return sorted(set(problems))
+
+
+def apply_witness(analysis: Analysis, payloads) -> None:
+    """Demote HVD501 cycle findings whose edges were never observed at
+    runtime to warnings (the fixture documenting why lives with the
+    battery; ISSUE 8 tentpole contract)."""
+    observed: set = set()
+    site_map = analysis.site_to_lock()
+    for payload in payloads:
+        for e in payload.get("edges", []):
+            ks, kd = site_map.get(e["src"]), site_map.get(e["dst"])
+            if ks and kd:
+                observed.add((ks, kd))
+    for f in analysis.findings:
+        if f.rule.id != "HVD501" or f.severity != "error":
+            continue
+        edge_pairs = {
+            (e.src, e.dst) for e in analysis.edges.values()
+            if (e.sites[0][0], e.sites[0][1]) in set(f.sites)}
+        if edge_pairs and not (edge_pairs & observed):
+            f.severity = "warning"
+            f.message += (" [demoted: no edge of this cycle was "
+                          "observed by the runtime witness]")
+
+
+# ---------------------------------------------------------------------------
+# Report driver / CLI
+# ---------------------------------------------------------------------------
+def analyze(paths) -> "Analysis":
+    from . import lockgraph
+    return lockgraph.analyze_paths(paths)
+
+
+def sarif_payload(records) -> dict:
+    """SARIF 2.1.0 from hvdlint Violations and/or hvdsan Findings."""
+    rules_seen: dict[str, dict] = {}
+    results = []
+    for r in records:
+        rule = r.rule
+        rules_seen.setdefault(rule.id, {
+            "id": rule.id,
+            "name": rule.slug,
+            "shortDescription": {"text": rule.summary}})
+        level = "warning" if getattr(r, "severity", "error") \
+            == "warning" else "error"
+        results.append({
+            "ruleId": rule.id,
+            "level": level,
+            "message": {"text": r.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": r.path},
+                    "region": {"startLine": r.line,
+                               "startColumn": getattr(r, "col", 1)},
+                }}],
+        })
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "hvdlint",
+                "informationUri":
+                    "https://example.invalid/horovod_tpu/docs/analysis",
+                "rules": list(rules_seen.values())}},
+            "results": results,
+        }],
+    }
+
+
+def report_text(analysis: Analysis, graph: bool = False) -> str:
+    lines: list[str] = []
+    errors = [f for f in analysis.findings if f.severity == "error"]
+    warnings = [f for f in analysis.findings if f.severity == "warning"]
+    lines.append(
+        f"hvdsan: {len(analysis.locks)} lock(s), "
+        f"{len(analysis.edges)} order edge(s), "
+        f"{len(analysis.thread_roots)} thread root(s)")
+    if graph:
+        for key, info in sorted(analysis.locks.items()):
+            alias = "" if info.canonical == key \
+                else f" (aliases {info.canonical})"
+            lines.append(f"  lock {key} [{info.kind}] @ {info.site}"
+                         f"{alias}")
+        for (a, b), e in sorted(analysis.edges.items()):
+            conf = "" if e.confident else " (index-resolved)"
+            p, ln, via = e.sites[0]
+            lines.append(f"  edge {a} -> {b}{conf} @ {p}:{ln} [{via}]")
+        for root, name in sorted(analysis.thread_roots.items()):
+            lines.append(f"  thread {name}: {root}")
+    from .ownership import LOCK_HOLD_ALLOWED
+    for key, why in sorted(LOCK_HOLD_ALLOWED.items()):
+        if key in analysis.locks:
+            lines.append(f"  allowed-hold {key} -- {why}")
+    for f in analysis.findings:
+        lines.append(f.text())
+    lines.append(f"hvdsan: {len(errors)} error(s), "
+                 f"{len(warnings)} warning(s)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis.hvdsan",
+        description="Whole-program concurrency verification: static "
+                    "lock-order/deadlock analysis with a runtime "
+                    "witness (see docs/analysis.md).")
+    parser.add_argument("paths", nargs="*", default=["horovod_tpu"])
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text")
+    parser.add_argument("--graph", action="store_true",
+                        help="include the full lock/edge/thread tables")
+    parser.add_argument("--witness", nargs="*", default=[],
+                        help="runtime witness dumps to diff against "
+                             "the static graph")
+    args = parser.parse_args(argv)
+
+    t0 = time.monotonic()
+    analysis = analyze(args.paths)
+    payloads = []
+    for p in args.witness:
+        with open(p) as f:
+            payloads.append(json.load(f))
+    unsound = witness_diff(analysis, payloads) if payloads else []
+    if payloads:
+        apply_witness(analysis, payloads)
+    wall_ms = (time.monotonic() - t0) * 1e3
+
+    errors = [f for f in analysis.findings if f.severity == "error"]
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.json() for f in analysis.findings],
+            "graph": analysis.graph_json(),
+            "unsound": unsound,
+            "wall_ms": round(wall_ms, 3),
+        }, indent=1))
+    elif args.format == "sarif":
+        print(json.dumps(sarif_payload(analysis.findings), indent=1))
+    else:
+        print(report_text(analysis, graph=args.graph))
+        for p in unsound:
+            print(f"hvdsan: UNSOUND: {p}")
+        print(f"hvdsan: wall {wall_ms:.1f} ms", file=sys.stderr)
+    return 1 if (errors or unsound) else 0

@@ -2,8 +2,17 @@
 
 CLI::
 
-    python -m horovod_tpu.analysis.lint [paths...] [--format text|json]
+    python -m horovod_tpu.analysis.lint [paths...]
+                                        [--format text|json|sarif]
                                         [--select RULES] [--ignore RULES]
+                                        [--changed-only] [--san]
+
+``--changed-only`` lints only files git reports as modified/untracked
+(sub-second gate as the rule count grows; cross-file rules see only the
+changed set).  ``--san`` additionally runs the hvdsan whole-program
+concurrency analysis (HVD501-505, analysis/hvdsan/) over the SAME parse
+of each file — one AST per file serves both rule families.  ``--sarif``
+emits SARIF 2.1.0 so findings annotate PRs.
 
 Walks a Python tree and flags call patterns that break the invariant the
 whole coordination protocol rests on — every rank submits the same
@@ -104,17 +113,34 @@ BARRIER_NAME = "kv_barrier"
 # as holding a lock (threading.Lock/RLock conventions in this tree).
 LOCK_HINTS = ("lock", "mutex")
 
-# Attribute spines that mark background-thread-owned shared state
-# ("_global" covers both the bare name and the `core._global` spelling).
-OWNED_STATE_ATTRS = frozenset({
-    "controller", "_controller", "tensor_queue", "_tensor_queue",
-    "_global"})
+# Attribute spines that mark thread-owned shared state — the union of
+# every manifest domain's attrs ("_global" covers both the bare name
+# and the `core._global` spelling).  The manifest import lives below
+# with the owner-module list it also feeds.
 OWNED_STATE_ROOTS = frozenset({"_global"})
 
-# Modules allowed to write owned state: the owners themselves plus the
-# background loop that drives them.
-DEFAULT_OWNER_BASENAMES = frozenset({
-    "core.py", "controller.py", "tensor_queue.py", "parameter_manager.py"})
+# Modules allowed to write owned state — declared per domain in the
+# hvdsan thread-ownership manifest (analysis/hvdsan/ownership.py), which
+# replaced this rule's old hard-coded basename list; entries are path
+# suffixes ("common/controller.py") so same-named files in other
+# packages stay outside the allowlist.
+from .hvdsan.ownership import MANIFEST as OWNERSHIP_MANIFEST  # noqa: E402
+from .hvdsan.ownership import owner_module_suffixes  # noqa: E402
+
+DEFAULT_OWNER_BASENAMES = owner_module_suffixes()
+OWNED_STATE_ATTRS = frozenset().union(
+    *(d.attrs for d in OWNERSHIP_MANIFEST))
+
+
+def _is_owner_path(path: str, owners) -> bool:
+    """True when `path` matches an owner entry (path suffix, or bare
+    basename for --owner-files compatibility)."""
+    norm = os.path.normpath(path).replace(os.sep, "/")
+    base = os.path.basename(norm)
+    for sfx in owners:
+        if base == sfx or norm.endswith("/" + sfx) or norm == sfx:
+            return True
+    return False
 
 # Directory whose modules are data-plane hot paths: thread construction
 # there is the per-ring-step spawn regression HVD1001 guards against.
@@ -644,7 +670,7 @@ class _Analyzer(ast.NodeVisitor):
         return None
 
     def _check_state_write(self, node, targets: list[ast.AST]) -> None:
-        if os.path.basename(self.path) in self.cfg.owner_basenames:
+        if _is_owner_path(self.path, self.cfg.owner_basenames):
             return
         for target in targets:
             chain = self._owned_state_target(target)
@@ -673,18 +699,22 @@ class _Analyzer(ast.NodeVisitor):
 # Driver
 # ---------------------------------------------------------------------------
 def lint_source(source: str, path: str, cfg: LintConfig | None = None,
-                barrier_sites: dict[str, _BarrierSite] | None = None
-                ) -> list[Violation]:
+                barrier_sites: dict[str, _BarrierSite] | None = None,
+                tree: ast.AST | None = None) -> list[Violation]:
+    """Lint one file's source.  ``tree`` reuses an existing parse —
+    the driver parses each file exactly once and hands the same AST to
+    every rule family (including hvdsan under ``--san``)."""
     cfg = cfg or LintConfig()
     sup = parse_suppressions(source)
     out: list[Violation] = []
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as exc:
-        out.append(Violation(path, exc.lineno or 1, exc.offset or 1,
-                             RULES["syntax-error"],
-                             f"syntax error: {exc.msg}"))
-        return out
+    if tree is None:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            out.append(Violation(path, exc.lineno or 1, exc.offset or 1,
+                                 RULES["syntax-error"],
+                                 f"syntax error: {exc.msg}"))
+            return out
     analyzer = _Analyzer(path, cfg, sup,
                          out, barrier_sites if barrier_sites is not None
                          else {})
@@ -713,21 +743,84 @@ def iter_python_files(paths: list[str]):
                         yield os.path.join(root, f)
 
 
-def lint_paths(paths: list[str],
-               cfg: LintConfig | None = None) -> list[Violation]:
+def changed_py_files(paths: list[str]) -> list[str] | None:
+    """Python files git reports modified/staged/untracked under
+    ``paths`` (--changed-only).  None when git is unavailable — callers
+    fall back to the full walk."""
+    import subprocess
+    try:
+        proc = subprocess.run(
+            ["git", "status", "--porcelain", "--no-renames", "--"]
+            + list(paths),
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    out = []
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        name = line[3:].strip().strip('"')
+        if name.endswith(".py") and os.path.isfile(name):
+            out.append(name)
+    return sorted(set(out))
+
+
+def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
+                     san: bool = False, changed_only: bool = False
+                     ) -> tuple[list[Violation], list, dict]:
+    """One parse + one rule walk per file; hvdsan (``san=True``) rides
+    the SAME trees.  Returns (violations, san findings, stats)."""
+    import time as _time
     cfg = cfg or LintConfig()
     out: list[Violation] = []
     barrier_sites: dict[str, _BarrierSite] = {}
-    for path in iter_python_files(paths):
+    program = None
+    if san:
+        from .hvdsan.lockgraph import Program
+        program = Program()
+    files = list(iter_python_files(paths))
+    if changed_only:
+        changed = changed_py_files(paths)
+        if changed is not None:
+            keep = {os.path.normpath(c) for c in changed}
+            files = [f for f in files if os.path.normpath(f) in keep]
+    t0 = _time.monotonic()
+    nfiles = 0
+    for path in files:
         try:
             with open(path, encoding="utf-8") as f:
                 source = f.read()
         except OSError as exc:
             print(f"hvdlint: cannot read {path}: {exc}", file=sys.stderr)
             continue
-        out.extend(lint_source(source, path, cfg, barrier_sites))
+        nfiles += 1
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            out.append(Violation(path, exc.lineno or 1, exc.offset or 1,
+                                 RULES["syntax-error"],
+                                 f"syntax error: {exc.msg}"))
+            continue
+        out.extend(lint_source(source, path, cfg, barrier_sites,
+                               tree=tree))
+        if program is not None:
+            program.collect_source(path, source, tree)
+    findings: list = []
+    if program is not None:
+        from .hvdsan.lockgraph import Analysis
+        analysis = Analysis(program).analyze()
+        findings = [f for f in analysis.findings if cfg.wants(f.rule)]
+    stats = {"files": nfiles,
+             "wall_ms": round((_time.monotonic() - t0) * 1e3, 3)}
     out.sort(key=lambda v: (v.path, v.line, v.col, v.rule.id))
-    return out
+    return out, findings, stats
+
+
+def lint_paths(paths: list[str],
+               cfg: LintConfig | None = None) -> list[Violation]:
+    return lint_paths_timed(paths, cfg)[0]
 
 
 def _parse_rule_set(raw: str | None) -> set[str]:
@@ -749,15 +842,23 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("paths", nargs="*", default=["horovod_tpu"],
                         help="files or directories to lint "
                              "(default: horovod_tpu)")
-    parser.add_argument("--format", choices=("text", "json"),
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text")
     parser.add_argument("--select", help="comma-separated rule ids/slugs "
                                          "to enable (default: all)")
     parser.add_argument("--ignore", help="comma-separated rule ids/slugs "
                                          "to disable")
     parser.add_argument("--owner-files",
-                        help="extra basenames allowed to write "
-                             "controller/queue shared state (HVD401)")
+                        help="extra basenames/path suffixes allowed to "
+                             "write manifest-owned shared state (HVD401)")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="lint only files git reports as changed "
+                             "(fast CI gate; cross-file rules see only "
+                             "the changed set)")
+    parser.add_argument("--san", action="store_true",
+                        help="also run the hvdsan whole-program "
+                             "concurrency analysis (HVD501-505) over "
+                             "the same parse of each file")
     args = parser.parse_args(argv)
 
     cfg = LintConfig(select=_parse_rule_set(args.select),
@@ -766,15 +867,33 @@ def main(argv: list[str] | None = None) -> int:
         cfg.owner_basenames |= {b.strip()
                                 for b in args.owner_files.split(",")
                                 if b.strip()}
-    violations = lint_paths(args.paths, cfg)
+    violations, findings, stats = lint_paths_timed(
+        args.paths, cfg, san=args.san, changed_only=args.changed_only)
+    errors = [f for f in findings if f.severity == "error"]
     if args.format == "json":
-        print(json.dumps([v.json() for v in violations], indent=2))
+        print(json.dumps({
+            "violations": [v.json() for v in violations],
+            "san": [f.json() for f in findings],
+            "files": stats["files"],
+            "wall_ms": stats["wall_ms"],
+        }, indent=2))
+    elif args.format == "sarif":
+        from .hvdsan.san import sarif_payload
+        print(json.dumps(sarif_payload(list(violations) + findings),
+                         indent=2))
     else:
         for v in violations:
             print(v.text())
-        print(f"hvdlint: {len(violations)} violation(s) in "
-              f"{', '.join(args.paths)}", file=sys.stderr)
-    return 1 if violations else 0
+        for f in findings:
+            print(f.text())
+        print(f"hvdlint: {len(violations)} violation(s)"
+              + (f", {len(errors)} san error(s), "
+                 f"{len(findings) - len(errors)} san warning(s)"
+                 if args.san else "")
+              + f" in {', '.join(args.paths)} "
+              f"({stats['files']} file(s), {stats['wall_ms']:.1f} ms)",
+              file=sys.stderr)
+    return 1 if (violations or errors) else 0
 
 
 if __name__ == "__main__":

@@ -46,6 +46,34 @@ _RULE_LIST = [
          "Write to controller/tensor-queue/global shared state outside "
          "the owning module: the background thread owns that state; "
          "cross-thread writes race the coordination cycle."),
+    Rule("HVD501", "lock-order-inversion",
+         "Cycle in the whole-program lock-acquisition graph (hvdsan): "
+         "two threads taking the same locks in opposite orders deadlock "
+         "the world the first time their schedules interleave — impose "
+         "one global order, or document the external ordering guarantee "
+         "with a suppression on an edge site."),
+    Rule("HVD502", "lock-held-across-blocking",
+         "Lock held across a blocking primitive (socket recv/send, "
+         "urlopen, thread join, wait, ...) or a collective, through any "
+         "call depth (hvdsan's interprocedural generalization of "
+         "HVD301): every thread needing the lock stalls for the full "
+         "wait — release first, or record the bound in the ownership "
+         "manifest's LOCK_HOLD_ALLOWED with its justification."),
+    Rule("HVD503", "orphan-condition-wait",
+         "Condition.wait whose condition is never notified by any code "
+         "path (hvdsan): the predicate is written by no other thread, "
+         "so the wait can only end by timeout — or never."),
+    Rule("HVD504", "cross-thread-write",
+         "Write to manifest-owned shared state (analysis/hvdsan/"
+         "ownership.py) from a function reachable from a thread other "
+         "than the declared owner: the write races the owning thread's "
+         "protocol cycle."),
+    Rule("HVD505", "wire-schema-drift",
+         "Request/Response encode and decode disagree on the wire field "
+         "sequence, or use a primitive common/wire.py does not define "
+         "on both sides: every frame after the drifting field decodes "
+         "garbage on the peer (the fp_*/tm_*/trace_* growth pattern "
+         "with no cross-check)."),
     Rule("HVD901", "bare-suppression",
          "hvdlint suppression without a '-- <justification>' comment."),
     Rule("HVD902", "syntax-error",

@@ -203,6 +203,26 @@ FLIGHT_FILE = register(
     "HOROVOD_METRICS_FILE convention).  Written only when a structured "
     "failure fires.")
 
+# --- hvdsan runtime witness (analysis/hvdsan/; docs/analysis.md) ------------
+# NOTE: san.py reads the raw environment directly (it must run at
+# package import, before this registry is touched); the knobs are
+# registered here so `all_knobs()` documents them.
+SAN = register(
+    "HOROVOD_SAN", False, _parse_bool,
+    "hvdsan runtime lock-order witness: wrap every package "
+    "threading.Lock/RLock/Condition in a recording proxy, record "
+    "per-thread acquisition-order edges (first observations also land "
+    "in the flight-recorder ring), and dump the observed lock-order "
+    "graph as rank-stamped JSON at interpreter exit.  CI diffs it "
+    "against the static graph (python -m horovod_tpu.analysis.hvdsan): "
+    "observed edges missing statically fail the build.  Off (the "
+    "default) patches nothing — zero overhead.")
+SAN_FILE = register(
+    "HOROVOD_SAN_FILE", "hvdsan_witness.json", str,
+    "Path of the hvdsan witness dump; '{rank}' substitutes, otherwise "
+    "'.r<rank>' is inserted before the extension (the "
+    "HOROVOD_METRICS_FILE convention).")
+
 # --- Resilience (resilience/ subsystem; docs/resilience.md) -----------------
 FAULT_TOLERANCE = register(
     "HOROVOD_FAULT_TOLERANCE", False, _parse_bool,

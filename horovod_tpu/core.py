@@ -534,30 +534,43 @@ def shutdown() -> None:
     if thread is not None:
         thread.join(timeout=60)
     with _init_lock:
+        if not _global.initialized:
+            return   # a concurrent shutdown won the race past the join
+        # Under the lock: only state flips and the queue abort (its
+        # callbacks are event sets, never blocking).  The teardown that
+        # can WAIT — stream-worker joins, the timeline writer join,
+        # metrics dump file I/O, channel-close joins on possibly wedged
+        # peers — runs below, outside the lock: hvdsan's HVD502 showed
+        # that holding _init_lock across those joins lets one dead peer
+        # stall every later init()/shutdown() caller for the full
+        # close grace (docs/analysis.md, lock-hold manifest).
         _global.tensor_queue.finalize()
-        if _global.stream_dispatcher is not None:
-            _global.stream_dispatcher.stop()
-            _global.stream_dispatcher = None
-        if _global.timeline is not None:
-            _global.timeline.stop()
-        if _global.telemetry is not None and _global.telemetry.enabled:
-            metrics_file = config.METRICS_FILE.get()
-            if metrics_file:
-                from .telemetry import dump_json
-                try:
-                    dump_json(_global.telemetry, metrics_file,
-                              _global.rank)
-                except OSError as exc:
-                    logger.warning("telemetry: metrics dump to %s "
-                                   "failed: %s", metrics_file, exc)
-        for res in _global.resources:
-            try:
-                res.close()
-            except Exception:  # noqa: BLE001 - best-effort cleanup
-                pass
+        dispatcher = _global.stream_dispatcher
+        _global.stream_dispatcher = None
+        timeline = _global.timeline
+        telemetry = _global.telemetry
+        resources = list(_global.resources)
         _global.resources.clear()
         _global.initialized = False
         _global.background_thread = None
+    if dispatcher is not None:
+        dispatcher.stop()
+    if timeline is not None:
+        timeline.stop()
+    if telemetry is not None and telemetry.enabled:
+        metrics_file = config.METRICS_FILE.get()
+        if metrics_file:
+            from .telemetry import dump_json
+            try:
+                dump_json(telemetry, metrics_file, _global.rank)
+            except OSError as exc:
+                logger.warning("telemetry: metrics dump to %s "
+                               "failed: %s", metrics_file, exc)
+    for res in resources:
+        try:
+            res.close()
+        except Exception:  # noqa: BLE001 - best-effort cleanup
+            pass
     from . import resilience
     resilience.shutdown()   # stop the heartbeat monitor (if any)
     from .parallel import multihost

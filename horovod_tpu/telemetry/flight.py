@@ -30,6 +30,11 @@ Dump triggers (all convert an in-flight failure into evidence):
   (``Controller._check_fingerprints``);
 - SIGTERM (preemption notice), chained in front of any existing
   handler.
+
+Under ``HOROVOD_SAN=1`` the hvdsan runtime witness
+(``analysis/hvdsan/san.py``) also records each first-observed
+lock-acquisition-order edge into this ring (kind ``lock-order``), so a
+failure dump shows which lock orders the dying rank had exercised.
 """
 from __future__ import annotations
 

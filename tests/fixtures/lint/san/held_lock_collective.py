@@ -1,0 +1,26 @@
+"""Seeded HVD502: a lock held across a collective and across a
+blocking socket receive, each through a call — invisible to the
+per-line HVD301 rule, found by hvdsan's interprocedural held-locks
+computation."""
+import threading
+
+_state_lock = threading.Lock()
+
+
+def _sync_helper(tensor):
+    # The collective lives one call away from the lock.
+    return allreduce(tensor, name="fixture")          # noqa: F821
+
+
+def _recv_helper(sock, view):
+    return sock.recv_into(view)
+
+
+def flush_gradients(tensor):
+    with _state_lock:
+        return _sync_helper(tensor)                   # HVD502 (collective)
+
+
+def pull_remote(sock, view):
+    with _state_lock:
+        return _recv_helper(sock, view)               # HVD502 (blocking)

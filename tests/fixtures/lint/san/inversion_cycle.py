@@ -1,0 +1,19 @@
+"""Seeded HVD501: two code paths take the same pair of locks in
+opposite orders — the classic AB/BA deadlock the moment two threads
+interleave.  hvdsan must report one lock-order-inversion cycle."""
+import threading
+
+_submit_lock = threading.Lock()
+_drain_lock = threading.Lock()
+
+
+def submit(item, queue):
+    with _submit_lock:
+        with _drain_lock:            # order: submit -> drain
+            queue.append(item)
+
+
+def drain(queue):
+    with _drain_lock:
+        with _submit_lock:           # order: drain -> submit (inverted)
+            return queue.pop()

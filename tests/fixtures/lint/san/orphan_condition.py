@@ -1,0 +1,21 @@
+"""Seeded HVD503: a Condition some thread waits on but no code path
+ever notifies — the predicate is written by no other thread, so the
+wait can only end by timeout (or never)."""
+import threading
+
+
+class ResultBox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._value = None
+
+    def wait_value(self, timeout=None):
+        with self._cond:
+            while self._value is None:
+                self._cond.wait(timeout)              # HVD503: no notify
+            return self._value
+
+    def set_value(self, value):
+        with self._lock:
+            self._value = value                       # forgot notify_all()

@@ -1,0 +1,21 @@
+"""Seeded HVD504: a background thread (declared via
+threading.Thread(name=...)) writes controller-owned state — the
+manifest (analysis/hvdsan/ownership.py) names hvd-background as that
+domain's owner, so the write races the coordination cycle."""
+import threading
+
+
+class CacheWatcher:
+    def __init__(self, state):
+        self.state = state
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fixture-watcher")
+        self._thread.start()
+
+    def _loop(self):
+        # HVD504: controller state written from the fixture-watcher
+        # thread (owner: hvd-background).
+        self.state.controller.cache_capacity = 0

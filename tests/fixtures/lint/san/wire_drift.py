@@ -1,0 +1,39 @@
+"""Seeded HVD505: wire-schema drift between pack and unpack — the
+fp_*/tm_*/trace_* growth pattern with one side forgotten (trailing
+drift), plus a swapped-field pair (order drift)."""
+
+
+class DriftRequest:
+    """encode writes a trailing field decode never reads."""
+
+    def __init__(self, rank=0, name="", scale=1.0):
+        self.rank = rank
+        self.name = name
+        self.scale = scale
+
+    def encode(self, enc):
+        (enc.uvarint(self.rank)
+            .string(self.name)
+            .f64(self.scale))
+
+    @classmethod
+    def decode(cls, dec):
+        return cls(rank=dec.uvarint(),
+                   name=dec.string())       # HVD505: scale never read
+
+
+class SwappedResponse:
+    """decode reads the same primitives in a different field order."""
+
+    def __init__(self, error="", detail=""):
+        self.error = error
+        self.detail = detail
+
+    def encode(self, enc):
+        (enc.string(self.error)
+            .string(self.detail))
+
+    @classmethod
+    def decode(cls, dec):
+        return cls(detail=dec.string(),     # HVD505: fields swapped
+                   error=dec.string())

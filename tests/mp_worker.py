@@ -1913,8 +1913,40 @@ def battery_trace(hvd, rank, size):
     hvd.barrier()
 
 
+def battery_san(hvd, rank, size):
+    """ISSUE 8 acceptance (in-battery half): the HOROVOD_SAN runtime
+    witness is live, collectives stay exact under the lock wrappers,
+    per-thread acquisition-order edges were recorded — including the
+    init-time controller<->transport edge (core._init_lock held while
+    the clock-offset probes touch the ctrl mesh's counter lock) — and
+    first observations rode the flight-recorder ring.  The parent test
+    (test_multiprocess.test_lock_witness_matches_static_graph) diffs
+    the shutdown dumps against the static lock graph."""
+    from horovod_tpu.analysis.hvdsan import san
+    from horovod_tpu.core import _global
+
+    assert san.enabled(), "HOROVOD_SAN=1 did not enable the witness"
+    w = san.witness()
+    assert w is not None
+    for step in range(6):
+        out = hvd.allreduce(np.ones(16, np.float32), op=hvd.Sum,
+                            name=f"san_{step}")
+        np.testing.assert_allclose(out, np.full(16, float(size)))
+    hvd.barrier()
+    snap = w.snapshot()
+    edges = {(e["src"], e["dst"]) for e in snap["edges"]}
+    assert edges, "witness recorded no acquisition-order edges"
+    assert any(s.startswith("horovod_tpu/core.py:")
+               and d.startswith("horovod_tpu/runner/network.py:")
+               for s, d in edges), sorted(edges)
+    # First edge observations land in the flight ring (ISSUE 8).
+    kinds = {e["kind"] for e in _global.flight.snapshot()}
+    assert "lock-order" in kinds, kinds
+
+
 BATTERIES = {
     "collectives": battery_collectives,
+    "san": battery_san,
     "trace": battery_trace,
     "telemetry": battery_telemetry,
     "streams": battery_streams,
@@ -2002,6 +2034,15 @@ def main() -> int:
     if battery == "shm":
         os.environ["HOROVOD_SHM_OPERATIONS"] = "1"   # require formation
         os.environ["HOROVOD_SHM_CAPACITY"] = str(1 << 20)
+    if battery == "san":
+        # Runtime lock-order witness (ISSUE 8): must be in the env
+        # BEFORE horovod_tpu imports so the wrappers install ahead of
+        # every package lock creation.  TCP plane pinned so the
+        # controller<->transport edge is deterministic.
+        os.environ["HOROVOD_SAN"] = "1"
+        os.environ["HOROVOD_SAN_FILE"] = \
+            f"/tmp/hvd_san_{os.environ['HOROVOD_RENDEZVOUS_EPOCH']}.json"
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
     if battery == "trace":
         epoch = os.environ["HOROVOD_RENDEZVOUS_EPOCH"]
         os.environ["HOROVOD_TIMELINE"] = f"/tmp/hvd_trace_{epoch}.json"

@@ -32,6 +32,18 @@ def test_horovod_tpu_tree_is_clean():
     assert violations == [], "\n".join(v.text() for v in violations)
 
 
+def test_horovod_tpu_tree_is_san_clean():
+    """ISSUE 8 gate: the hvdsan whole-program concurrency rules
+    (HVD501-505) run over the same parse (--san) and report zero
+    unsuppressed errors on the tree."""
+    from horovod_tpu.analysis.lint import lint_paths_timed
+    violations, findings, stats = lint_paths_timed([TREE], san=True)
+    assert violations == [], "\n".join(v.text() for v in violations)
+    errors = [f for f in findings if f.severity == "error"]
+    assert errors == [], "\n".join(f.text() for f in errors)
+    assert stats["files"] > 50 and stats["wall_ms"] > 0.0
+
+
 def test_gate_catches_new_violation_in_tree_context():
     """The gate actually bites: a rank-gated collective added to any
     module under horovod_tpu/ would fail test_horovod_tpu_tree_is_clean."""
@@ -209,9 +221,50 @@ def test_cli_json_format_and_exit_codes(capsys):
                "--format", "json"])
     assert rc == 1
     payload = json.loads(capsys.readouterr().out)
-    assert all(p["rule"] == "HVD101" for p in payload)
+    assert all(p["rule"] == "HVD101" for p in payload["violations"])
+    # ISSUE 8 satellite: the JSON report carries the gate's wall time.
+    assert payload["wall_ms"] > 0.0 and payload["files"] == 1
     rc = main([os.path.join(FIXTURES, "clean.py")])
     assert rc == 0
+
+
+def test_cli_sarif_format(capsys):
+    """--sarif: findings annotate PRs (SARIF 2.1.0, one result per
+    violation with rule metadata)."""
+    rc = main([os.path.join(FIXTURES, "rank_gated.py"),
+               "--format", "sarif"])
+    assert rc == 1
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "hvdlint"
+    assert len(run["results"]) == 3
+    assert all(r["ruleId"] == "HVD101" and r["level"] == "error"
+               for r in run["results"])
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == {"HVD101"}
+
+
+def test_cli_changed_only_smoke(capsys):
+    """--changed-only scopes the walk to git-changed files; on an
+    untouched fixture dir it lints at most the changed subset and must
+    not crash (falls back to the full walk without git)."""
+    rc = main([FIXTURES, "--changed-only", "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc in (0, 1)
+    assert payload["files"] <= len(
+        [f for f in os.listdir(FIXTURES) if f.endswith(".py")]) + len(
+        [f for root, _, fs in os.walk(FIXTURES) for f in fs])
+
+
+def test_cli_san_flag_runs_hvdsan(capsys):
+    """--san rides the same parse: the seeded inversion fixture yields
+    an HVD501 finding through the lint CLI."""
+    rc = main([os.path.join(FIXTURES, "san", "inversion_cycle.py"),
+               "--san", "--format", "json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["violations"] == []
+    assert [f["rule"] for f in payload["san"]] == ["HVD501"]
 
 
 def test_cli_select_and_ignore(capsys):
