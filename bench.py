@@ -375,12 +375,15 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", default="resnet50",
                         choices=["resnet50", "resnet101", "vgg16",
-                                 "inception3", "gpt", "eager"],
+                                 "inception3", "gpt", "eager", "serve"],
                         help="resnet50: headline images/sec benchmark; "
                         "resnet101/vgg16/inception3: the reference's "
                         "other headline CNNs (docs/benchmarks.rst:13-43); "
                         "gpt: transformer tokens/sec (flash attention); "
-                        "eager: controller/TCP eager-core microbenchmark")
+                        "eager: controller/TCP eager-core microbenchmark; "
+                        "serve: serving loadgen smoke (goodput + SLO "
+                        "latency; report to SERVE_r*.json, "
+                        "docs/serving.md)")
     parser.add_argument("--batch-size", type=int, default=128)
     parser.add_argument("--stem", default="conv7",
                         choices=["conv7", "space_to_depth"],
@@ -434,6 +437,16 @@ def main() -> int:
                    "vs_baseline": 0.0,
                    "error": f"{type(exc).__name__}: {exc}"})
             return 1
+    if args.model == "serve":   # CPU/localhost only — no tunnel exposure
+        try:
+            return bench_serve(args)
+        except Exception as exc:
+            import traceback
+            traceback.print_exc()
+            _emit({"metric": "serve_failed", "value": 0.0, "unit": "error",
+                   "vs_baseline": 0.0,
+                   "error": f"{type(exc).__name__}: {exc}"})
+            return 1
     if not args.inner:
         return _orchestrate(args)
     try:
@@ -448,6 +461,36 @@ def main() -> int:
                "unit": "error", "vs_baseline": 0.0,
                "error": f"{type(exc).__name__}: {exc}"})
         return 1
+
+
+def bench_serve(args) -> int:
+    """Serving loadgen smoke (ISSUE 9 CI satellite): the open-loop SLO
+    harness (--requests 64 --duration 5) in a CPU subprocess; the full
+    per-rank report lands in SERVE_r{rank}.json next to the BENCH
+    payloads, and goodput + p50/p99 latency ride the structured line."""
+    out = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.serving.loadgen",
+         "--requests", "64", "--duration", "5", "--rate", "40",
+         "--max-new-tokens", "4", "--prompt-tokens", "8",
+         "--output", "SERVE_r{rank}.json"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    if out.returncode != 0:
+        _emit({"metric": "serve_failed", "value": 0.0, "unit": "error",
+               "vs_baseline": 0.0,
+               "error": out.stderr[-500:] or out.stdout[-500:]})
+        return 1
+    with open("SERVE_r0.json") as f:
+        report = json.load(f)
+    _emit({"metric": "serve_goodput", "value": report["goodput_rps"],
+           "unit": "req/s", "vs_baseline": 0.0, "backend": "cpu-eager",
+           "offered_rps": report["offered_rps"],
+           "served": report["served"], "shed": report["shed"],
+           "expired": report["expired"],
+           "latency_ms": report["latency_ms"],
+           "step_ms": report["step_ms"],
+           "report": "SERVE_r0.json"})
+    return 0
 
 
 def bench_resnet(args, info: dict) -> int:

@@ -190,6 +190,16 @@ CODEC_CALL_NAMES = frozenset({
 })
 CODEC_HOT_DIRS = frozenset({"backend"})
 
+# HVD1006: queue discipline in serving/ modules — the serving hot path
+# must never buffer unboundedly (overload becomes unbounded latency) or
+# block unboundedly on a queue handoff (the serve loop wedges like an
+# unbounded transport wait).  Queue constructors need a maxsize,
+# SimpleQueue has none to give, and blocking put/get need a
+# timeout/deadline or block=False.
+SERVING_DIRS = frozenset({"serving"})
+QUEUE_CTOR_NAMES = frozenset({"Queue", "LifoQueue", "PriorityQueue"})
+QUEUE_BLOCKING_NAMES = frozenset({"put", "get"})
+
 # HVD1005: Timeline span-open calls in backend/ modules must be paired
 # with a finally-guarded close — an exception on the op path otherwise
 # leaves the span open and every later span on the lane nests wrongly
@@ -285,6 +295,9 @@ class _Analyzer(ast.NodeVisitor):
             & set(os.path.normpath(path).split(os.sep)[:-1]))
         self._in_span_dir = bool(
             SPAN_HOT_DIRS
+            & set(os.path.normpath(path).split(os.sep)[:-1]))
+        self._in_serving_dir = bool(
+            SERVING_DIRS
             & set(os.path.normpath(path).split(os.sep)[:-1]))
         # Depth of enclosing try-blocks whose finally contains a span
         # close, plus the linenos of span-open statements IMMEDIATELY
@@ -499,6 +512,8 @@ class _Analyzer(ast.NodeVisitor):
             self._check_blocking_io(node, name)
         if name in WAIT_NAMES and self._in_wait_scope:
             self._check_unbounded_wait(node, name)
+        if self._in_serving_dir:
+            self._check_serving_queue(node, name)
         if name and name.lstrip("_") in SPAN_START_NAMES \
                 and self._in_span_dir \
                 and self._span_guard_depth == 0 \
@@ -572,6 +587,52 @@ class _Analyzer(ast.NodeVisitor):
             f"dead or wedged peer into a whole-job deadlock — pass a "
             f"timeout, derive a deadline from the ResilienceContext "
             f"(resilience/), or justify the bound with a suppression")
+
+    # --- HVD1006: queue discipline in serving/ ------------------------------
+    @staticmethod
+    def _receiver_is_queueish(base: ast.AST) -> bool:
+        """Lexical receiver filter for put/get: dict.get / config
+        knob .get() are everywhere, so the blocking-call half of the
+        rule bites only on receivers that read as queues ('q',
+        '*queue*', '*_q')."""
+        ident = base.attr if isinstance(base, ast.Attribute) else (
+            base.id if isinstance(base, ast.Name) else None)
+        if ident is None or ident.isupper():
+            return False   # ALL-CAPS receiver = a config-knob constant
+        ident = ident.lower()
+        return ident == "q" or "queue" in ident or ident.endswith("_q")
+
+    def _check_serving_queue(self, node: ast.Call, name: str | None) -> None:
+        if name in QUEUE_CTOR_NAMES:
+            bounded = bool(node.args) or any(
+                kw.arg and "maxsize" in kw.arg.lower()
+                for kw in node.keywords)
+            if not bounded:
+                self._report(
+                    "unbounded-queue-in-serving", node,
+                    f"'{name}()' without maxsize in a serving/ module: "
+                    f"an unbounded ingress queue converts overload into "
+                    f"unbounded latency — bound it and shed at the door "
+                    f"(serving/queue.py RequestQueue)")
+        elif name == "SimpleQueue":
+            self._report(
+                "unbounded-queue-in-serving", node,
+                "SimpleQueue in a serving/ module has no capacity bound "
+                "at all — use a bounded queue and shed at the door")
+        elif name in QUEUE_BLOCKING_NAMES \
+                and isinstance(node.func, ast.Attribute) \
+                and self._receiver_is_queueish(node.func.value):
+            nonblocking = any(
+                kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False for kw in node.keywords)
+            if not nonblocking and not self._call_is_bounded(node):
+                self._report(
+                    "unbounded-queue-in-serving", node,
+                    f"blocking '{name}' without a timeout/deadline in a "
+                    f"serving/ module: the serve loop wedges like an "
+                    f"unbounded transport wait (HVD1003) — pass a "
+                    f"timeout derived from the request deadline, or "
+                    f"block=False and shed")
 
     def _check_blocking_io(self, node: ast.Call, name: str) -> None:
         hot_fn = next((fn for fn in self._func_stack

@@ -105,6 +105,15 @@ _RULE_LIST = [
          "tensor's trace lane (and the merged cross-rank trace built "
          "from it) — wrap the op body in try/finally with the end call "
          "in the finally block."),
+    Rule("HVD1006", "unbounded-queue-in-serving",
+         "Unbounded queue construction (Queue() without maxsize, any "
+         "SimpleQueue) or blocking put/get without a timeout/deadline "
+         "in a serving/ module: an unbounded ingress queue converts "
+         "overload into unbounded latency for every later request, and "
+         "an unbounded blocking put/get wedges the serve loop exactly "
+         "like an unbounded transport wait (HVD1003) — bound the queue, "
+         "shed at the door, and pass timeouts derived from request "
+         "deadlines."),
     Rule("HVD1004", "per-segment-codec-loop",
          "compress/ codec call (quantize/dequantize/from_bytes/to_bytes) "
          "inside a loop in a backend/ module: the per-segment "

@@ -263,6 +263,48 @@ CHAOS = register(
     "send.  Empty (the default) installs nothing.  See "
     "docs/resilience.md for the grammar.")
 
+# --- Inference serving (serving/ subsystem; docs/serving.md) ----------------
+SERVE_MAX_BATCH = register(
+    "HOROVOD_SERVE_MAX_BATCH", 8, int,
+    "Decode slots per replica: the continuous batcher admits new "
+    "requests into in-flight decode batches up to this many concurrent "
+    "sequences per replica (the KV cache is allocated for exactly this "
+    "batch).")
+SERVE_TOKEN_BUDGET = register(
+    "HOROVOD_SERVE_TOKEN_BUDGET", 256, int,
+    "Per-replica token budget of one serve step: prefill tokens of "
+    "newly admitted requests plus one decode token per active slot "
+    "must fit; the batcher defers admissions that would exceed it "
+    "(keeps step time — and therefore SLO math — predictable).")
+SERVE_QUEUE_DEPTH = register(
+    "HOROVOD_SERVE_QUEUE_DEPTH", 1024, int,
+    "Front-end ingress queue bound; submissions beyond it are shed at "
+    "the door (never silently buffered — an unbounded queue turns "
+    "overload into unbounded latency, hvdlint HVD1006).")
+SERVE_SLO_MS = register(
+    "HOROVOD_SERVE_SLO_MS", 30000.0, float,
+    "Default per-request SLO in ms, stamped as an absolute deadline at "
+    "ingress; per-request slo_ms overrides.  Flows into "
+    "resilience.context per-op deadlines (deadline_scope) and into "
+    "admission control: a request that cannot finish inside it is shed "
+    "at admission, never executed.")
+SERVE_SHED_QUEUE_FRACTION = register(
+    "HOROVOD_SERVE_SHED_QUEUE_FRACTION", 0.9, float,
+    "Admission sheds new requests while the live queue-depth gauge "
+    "exceeds this fraction of HOROVOD_SERVE_QUEUE_DEPTH (load-based "
+    "shedding keyed off telemetry, not just deadline feasibility).")
+SERVE_MAX_SEQ = register(
+    "HOROVOD_SERVE_MAX_SEQ", 256, int,
+    "KV-cache length per decode slot (prompt + generated tokens).")
+SERVE_GROUP_SIZE = register(
+    "HOROVOD_SERVE_GROUP_SIZE", 1, int,
+    "Ranks per serving replica group: 1 = pure data-parallel (every "
+    "rank an independent replica); N > 1 runs each group's members in "
+    "lockstep on identical batch plans (the sharded-replica posture — "
+    "model-parallel groups reuse parallel/ meshes inside the model).  "
+    "Must divide the world size; falls back to 1 after an elastic "
+    "shrink breaks divisibility.")
+
 # --- Collective fingerprinting (analysis/fingerprint.py) --------------------
 FINGERPRINT = register(
     "HOROVOD_FINGERPRINT", "off", str,

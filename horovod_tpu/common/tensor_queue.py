@@ -39,6 +39,11 @@ class TensorTableEntry:
     # flight recorder can correlate one collective across ranks
     # (telemetry/trace.py); None until dispatched.
     trace: str | None = None
+    # Absolute monotonic deadline propagated from the enqueuing thread
+    # (resilience.deadline_scope — serving per-request SLOs); the
+    # dispatch thread re-raises it through op_scope so transport waits
+    # of this op are bounded by the SLO, not the full fault window.
+    deadline: float | None = None
 
     def finish(self, status: Status) -> None:
         cb, self.callback = self.callback, None

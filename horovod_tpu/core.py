@@ -856,11 +856,18 @@ def _execute_response(st: GlobalState, response: Response,
             if res is not None:
                 # Label the blocking waits below for failure attribution
                 # (RanksFailedError.op); off mode skips the string build.
+                # The tightest propagated request deadline of the fused
+                # entries bounds every transport wait of this op
+                # (resilience.deadline_scope -> entry.deadline).
+                deadlines = [e.deadline for e in entries
+                             if e.deadline is not None]
                 with op_scope(f"{response.response_type.name.lower()}"
                               f"({response.tensor_names[0]}"
                               f"{'…' if len(response.tensor_names) > 1 else ''})"
                               if response.tensor_names else
-                              response.response_type.name.lower()):
+                              response.response_type.name.lower(),
+                              deadline=min(deadlines) if deadlines
+                              else None):
                     status = manager.execute_operation(response, entries)
             else:
                 status = manager.execute_operation(response, entries)
@@ -994,7 +1001,14 @@ def _enqueue(entries: list[TensorTableEntry],
     timeline = st.timeline
     tl_on = timeline is not None and timeline.enabled
     fl = st.flight
+    # Per-request deadline propagation (serving SLOs): the enqueuing
+    # thread's deadline_scope rides the entries to the dispatch thread,
+    # which re-raises it through op_scope around the transport waits.
+    from .resilience.context import pending_deadline
+    deadline = pending_deadline()
     for e in entries:
+        if deadline is not None:
+            e.deadline = deadline
         if tl_on:
             timeline.queue_start(e.tensor_name)
         if fl is not None and fl.enabled:

@@ -19,6 +19,7 @@ never changes the math; all shapes static; per-block ``jax.checkpoint``
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 from typing import Any, Callable
 
@@ -64,6 +65,14 @@ class TransformerConfig:
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     ep_axis: str = "ep"
+    # Incremental (KV-cache) decoding for inference serving: each
+    # Attention layer keeps cached_key/cached_value [B, max_seq_len, H, D]
+    # plus a per-batch-element write index in the mutable "cache"
+    # collection, so continuous batching (serving/batcher.py) pays one
+    # token of compute per step instead of re-running the full forward.
+    # Parameters are identical to the decode=False model; see prefill()
+    # and decode_step() below.  Mutually exclusive with ring/ulysses.
+    decode: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -85,11 +94,15 @@ def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
 
 def apply_rope(x: jax.Array, positions: jax.Array,
                theta: float) -> jax.Array:
-    """x: [B, T, H, D]; positions: [T] global token positions."""
+    """x: [B, T, H, D]; positions: [T] global token positions shared by
+    the batch, or [B, T] per-element positions (KV-cache decode, where
+    every sequence in the continuous batch sits at its own depth)."""
     freqs = rope_frequencies(x.shape[-1], theta)          # [D/2]
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]               # [1,T,1,D/2]
-    sin = jnp.sin(angles)[None, :, None, :]
+    if positions.ndim == 1:
+        positions = positions[None, :]                    # [1,T]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B|1,T,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]                  # [B|1,T,1,D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin,
                            x1 * sin + x2 * cos], axis=-1)
@@ -202,25 +215,77 @@ class Attention(nn.Module):
         k = dense(features=qkv_shape, name="wk")(x)
         v = dense(features=qkv_shape, name="wv")(x)
 
-        if cfg.attention in ("ring", "ulysses") and \
-                _axis_is_manual(cfg.sp_axis) and not self.is_initializing():
-            # Sequence dim is a local shard: RoPE positions are global.
-            positions = jax.lax.axis_index(cfg.sp_axis) * t + jnp.arange(t)
+        if cfg.decode and not self.is_initializing():
+            if cfg.attention in ("ring", "ulysses"):
+                raise ValueError(
+                    "cfg.decode is incompatible with sequence-parallel "
+                    f"attention ('{cfg.attention}'): the KV cache is a "
+                    "whole-sequence structure")
+            out = self._decode_attend(q, k, v)
         else:
-            positions = jnp.arange(t)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+            if cfg.attention in ("ring", "ulysses") and \
+                    _axis_is_manual(cfg.sp_axis) and \
+                    not self.is_initializing():
+                # Sequence dim is a local shard: RoPE positions are global.
+                positions = jax.lax.axis_index(cfg.sp_axis) * t \
+                    + jnp.arange(t)
+            else:
+                positions = jnp.arange(t)
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k = apply_rope(k, positions, cfg.rope_theta)
 
-        if self.is_initializing() and cfg.attention in ("ring", "ulysses"):
-            # Shape-only trace with a tiny batch: parameter shapes don't
-            # depend on the attention execution strategy.
-            attn = _make_attention(
-                dataclasses.replace(cfg, attention="dense"))
-        else:
-            attn = _make_attention(cfg)
-        out = attn(q, k, v)                               # [B,T,H,D]
+            if self.is_initializing() and \
+                    cfg.attention in ("ring", "ulysses"):
+                # Shape-only trace with a tiny batch: parameter shapes
+                # don't depend on the attention execution strategy.
+                attn = _make_attention(
+                    dataclasses.replace(cfg, attention="dense"))
+            else:
+                attn = _make_attention(cfg)
+            out = attn(q, k, v)                           # [B,T,H,D]
         out = out.astype(cfg.dtype)
         return dense(features=cfg.d_model, axis=(-2, -1), name="wo")(out)
+
+    def _decode_attend(self, q: jax.Array, k: jax.Array,
+                       v: jax.Array) -> jax.Array:
+        """Incremental attention over the mutable KV cache: write this
+        call's K/V at each batch element's own cache depth, attend
+        causally over the cached prefix.  Positions are absolute, so the
+        RoPE math matches the full forward pass exactly; fp32 softmax
+        like every other path in this file."""
+        cfg = self.cfg
+        b, t, h, d = q.shape
+        s = cfg.max_seq_len
+        cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                 (b, s, h, d), cfg.dtype)
+        cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                 (b, s, h, d), cfg.dtype)
+        index = self.variable("cache", "cache_index",
+                              lambda: jnp.zeros((b,), jnp.int32))
+        idx = index.value                                   # [B]
+        positions = idx[:, None] + jnp.arange(t)[None, :]   # [B,T]
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        write = jax.vmap(lambda cache, new, i:
+                         jax.lax.dynamic_update_slice(cache, new,
+                                                      (i, 0, 0)))
+        cached_k.value = write(cached_k.value, k.astype(cfg.dtype), idx)
+        cached_v.value = write(cached_v.value, v.astype(cfg.dtype), idx)
+        index.value = idx + t
+        # Causal mask over absolute positions.  Right-padded prefill
+        # garbage always sits at key positions strictly greater than the
+        # current query position (prefill() rewinds the write cursor to
+        # the true length, and decode overwrites forward from there), so
+        # key_pos <= q_pos alone keeps it invisible.
+        key_pos = jnp.arange(s)
+        mask = key_pos[None, None, :] <= positions[:, :, None]  # [B,T,S]
+        qf = q.astype(jnp.float32)
+        kf = cached_k.value.astype(jnp.float32)
+        vf = cached_v.value.astype(jnp.float32)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) / math.sqrt(d)
+        logits = jnp.where(mask[:, None, :, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
 
 
 class MLP(nn.Module):
@@ -289,6 +354,59 @@ class TransformerLM(nn.Module):
         x = RMSNorm(cfg.dtype, cfg.param_dtype, name="final_norm")(x)
         return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype, name="lm_head")(x)
+
+
+# ---------------------------------------------------------------------------
+# KV-cache incremental decoding (inference serving; serving/replica.py)
+# ---------------------------------------------------------------------------
+def _with_cache_index(cache: dict, lengths) -> dict:
+    """Return ``cache`` with every layer's write cursor set to
+    ``lengths`` (scalar or [B] int32) — prefill() rewinds past padding
+    with it, and the serving replica resets recycled batch slots."""
+    lengths = jnp.asarray(lengths, jnp.int32)
+
+    def fix(node):
+        if not isinstance(node, dict):
+            return node
+        return {key: (jnp.broadcast_to(lengths, val.shape).astype(val.dtype)
+                      if key == "cache_index" else fix(val))
+                for key, val in node.items()}
+    from flax.core import unfreeze
+    return fix(unfreeze(cache))
+
+
+def prefill(model: TransformerLM, variables: dict, tokens: jax.Array,
+            lengths=None) -> tuple[jax.Array, dict]:
+    """Run the prompt through a ``decode=True`` model and return
+    ``(logits [B, T, vocab], cache)``.
+
+    ``lengths`` ([B] or scalar) gives each row's true prompt length when
+    ``tokens`` is right-padded to a shared bucket: the KV write cursor
+    rewinds to it so the first decode_step overwrites the pad garbage,
+    and the causal mask keeps the not-yet-overwritten tail invisible
+    (it sits at strictly greater positions than every live query).  The
+    next-token logits of row b are ``logits[b, lengths[b] - 1]``."""
+    from flax.core import unfreeze
+    logits, mut = model.apply(variables, tokens, mutable=["cache"])
+    cache = unfreeze(mut["cache"])
+    if lengths is not None:
+        cache = _with_cache_index(cache, lengths)
+    return logits, cache
+
+
+def decode_step(model: TransformerLM, variables: dict, cache: dict,
+                tokens: jax.Array) -> tuple[jax.Array, dict]:
+    """One incremental step of a ``decode=True`` model: ``tokens``
+    [B, 1] (or [B]) → ``(logits [B, 1, vocab], updated cache)``.  Each
+    batch element advances at its own cache depth, which is what lets
+    continuous batching admit a fresh prefill into a half-decoded
+    batch."""
+    from flax.core import unfreeze
+    if tokens.ndim == 1:
+        tokens = tokens[:, None]
+    logits, mut = model.apply({**variables, "cache": cache}, tokens,
+                              mutable=["cache"])
+    return logits, unfreeze(mut["cache"])
 
 
 # ---------------------------------------------------------------------------

@@ -22,11 +22,13 @@ from __future__ import annotations
 from ..common.exceptions import RanksFailedError
 from . import chaos
 from .context import (ResilienceState, active_state, configure, current_op,
-                      op_scope, shutdown)
+                      current_op_deadline, deadline_scope, op_scope,
+                      pending_deadline, shutdown)
 from .policy import apply_shrink, rebuild_world, run_with_recovery
 
 __all__ = [
     "RanksFailedError", "ResilienceState", "active_state", "apply_shrink",
-    "chaos", "configure", "current_op", "op_scope", "rebuild_world",
+    "chaos", "configure", "current_op", "current_op_deadline",
+    "deadline_scope", "op_scope", "pending_deadline", "rebuild_world",
     "run_with_recovery", "shutdown",
 ]

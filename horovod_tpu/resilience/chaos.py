@@ -228,14 +228,17 @@ def active() -> ChaosEngine | None:
 
 def configure(rank: int) -> ChaosEngine | None:
     """Install the engine from HOROVOD_CHAOS.  Reuses the existing engine
-    when the spec is unchanged (consumed counts must survive the
-    shutdown+init cycle a retry performs); clears it when the spec is."""
+    when the spec is unchanged (consumed counts AND the global collective
+    index must survive the shutdown+init cycle a retry or an elastic
+    shrink performs — and a spec's ``rank=`` refers to the LAUNCH-TIME
+    rank, so a survivor renumbered by a shrink keeps its original chaos
+    identity instead of inheriting a dead rank's); clears it when the
+    spec is."""
     global _engine
     spec = config.CHAOS.get().strip()
     with _lock:
         if not spec:
             _engine = None
-        elif _engine is None or _engine.spec != spec \
-                or _engine.rank != rank:
+        elif _engine is None or _engine.spec != spec:
             _engine = ChaosEngine(spec, rank)
         return _engine

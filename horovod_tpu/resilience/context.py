@@ -27,39 +27,91 @@ and every instrumentation point reduces to one ``is None`` test.
 from __future__ import annotations
 
 import threading
+import time
 
 from ..common import config
 from ..common.exceptions import RanksFailedError
 from ..common.logging import logger
 
 __all__ = ["RanksFailedError", "ResilienceState", "active_state",
-           "configure", "shutdown", "current_op", "op_scope"]
+           "configure", "shutdown", "current_op", "op_scope",
+           "current_op_deadline", "deadline_scope", "pending_deadline"]
 
 # Name of the collective currently blocking this thread, for error
 # attribution (set only when resilience is enabled — see op_scope).
 _current_op = threading.local()
+
+# Deadline a CALLER thread attaches to the collectives it is about to
+# enqueue (serving/ per-request SLOs; see deadline_scope).  Read once at
+# enqueue and stamped on the TensorTableEntry, which carries it to the
+# background/stream thread that actually blocks — thread-locals do not
+# cross that boundary on their own.
+_pending_deadline = threading.local()
 
 
 def current_op() -> str:
     return getattr(_current_op, "name", "")
 
 
+def current_op_deadline() -> float | None:
+    """Absolute monotonic deadline of the op this thread is executing,
+    or None (set by op_scope on the dispatch thread)."""
+    return getattr(_current_op, "deadline", None)
+
+
 class op_scope:
     """Label the collective the calling thread is about to block in, so a
-    RanksFailedError raised from a transport wait names it."""
+    RanksFailedError raised from a transport wait names it.  An optional
+    absolute monotonic ``deadline`` additionally tightens the per-op
+    deadline every bounded wait under this scope consults
+    (:meth:`ResilienceState.op_timeout`) — the serving path's per-request
+    SLO propagation."""
 
-    __slots__ = ("_name", "_prev")
+    __slots__ = ("_name", "_deadline", "_prev", "_prev_deadline")
 
-    def __init__(self, name: str) -> None:
+    def __init__(self, name: str, deadline: float | None = None) -> None:
         self._name = name
+        self._deadline = deadline
 
     def __enter__(self) -> "op_scope":
         self._prev = getattr(_current_op, "name", "")
+        self._prev_deadline = getattr(_current_op, "deadline", None)
         _current_op.name = self._name
+        _current_op.deadline = self._deadline
         return self
 
     def __exit__(self, *exc) -> None:
         _current_op.name = self._prev
+        _current_op.deadline = self._prev_deadline
+
+
+class deadline_scope:
+    """Caller-side half of per-request deadline propagation: collectives
+    enqueued by this thread inside the scope carry ``deadline`` (absolute
+    ``time.monotonic()`` seconds) on their TensorTableEntries; the
+    dispatch thread re-raises it through :class:`op_scope` so every
+    transport wait of that op is bounded by the request SLO instead of
+    the full HOROVOD_FAULT_TIMEOUT.  No-op overhead when fault tolerance
+    is off (the entry field rides along but nothing reads it)."""
+
+    __slots__ = ("_deadline", "_prev")
+
+    def __init__(self, deadline: float | None) -> None:
+        self._deadline = deadline
+
+    def __enter__(self) -> "deadline_scope":
+        self._prev = getattr(_pending_deadline, "value", None)
+        _pending_deadline.value = self._deadline
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _pending_deadline.value = self._prev
+
+
+def pending_deadline() -> float | None:
+    """Deadline the calling thread attached via deadline_scope, if any
+    (read by core at enqueue time)."""
+    return getattr(_pending_deadline, "value", None)
 
 
 class ResilienceState:
@@ -85,9 +137,19 @@ class ResilienceState:
     # -- deadline policy -------------------------------------------------
     def op_timeout(self) -> float:
         """Per-op deadline for one blocking transport wait.  One fault
-        window: a peer that neither completes its part of the op nor is
-        declared dead within it is treated as wedged/unreachable."""
-        return self.fault_timeout
+        window by default: a peer that neither completes its part of the
+        op nor is declared dead within it is treated as wedged or
+        unreachable.  When the executing op carries a propagated request
+        deadline (serving SLOs, op_scope(deadline=...)), the window
+        tightens to the remaining SLO budget — floored at a couple of
+        poll slices so a healthy-but-busy peer is never declared wedged
+        by an already-hopeless request alone."""
+        deadline = current_op_deadline()
+        if deadline is None:
+            return self.fault_timeout
+        remaining = deadline - time.monotonic()
+        return min(self.fault_timeout,
+                   max(remaining, 2.0 * self.poll_interval))
 
     # -- liveness --------------------------------------------------------
     def failed_ranks(self) -> frozenset[int]:

@@ -194,7 +194,14 @@ class HeartbeatMonitor:
                 if confirmed or r not in self._failed:
                     self._declare(r, reason or text, kind="transport",
                                   confirmed=confirmed)
-                continue
+                if confirmed:
+                    continue
+                # Suspect mark only: FALL THROUGH to the staleness check
+                # — heartbeat silence must still be able to upgrade the
+                # suspicion to confirmed death (a SIGKILLed rank whose
+                # socket closed first would otherwise stay suspect
+                # forever, and shrink-style recovery keys on
+                # confirmation).
             try:
                 raw = self.kv.get(_HB_SCOPE, f"{self.epoch}:{r}")
             except Exception:  # noqa: BLE001

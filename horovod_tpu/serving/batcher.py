@@ -1,0 +1,140 @@
+"""Continuous batcher: token-budgeted batch assembly over in-flight
+decode batches.
+
+Classic batching waits for a batch to fill, runs it to completion, and
+only then admits more — tail latency inherits the longest generation in
+every batch.  Continuous batching (Orca-style) instead treats the batch
+as a set of SLOTS: every serve step, finished slots free up and the
+batcher admits queued requests straight into the half-decoded batch.
+The unit of work per step is bounded by a token budget (prefill tokens
+of new admissions + one decode token per active slot), which keeps step
+time — and therefore the admission controller's SLO math — predictable.
+
+The batcher runs on the front-end rank and produces one :class:`BatchPlan`
+per step; the plan is broadcast to every rank (replica.py), which is the
+broadcast-consistent scheduling discipline: replicas never diverge on a
+collective because every rank executes the same plan sequence.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import field
+
+from ..common import config
+from .queue import RequestQueue, ServeRequest
+
+
+@dataclasses.dataclass
+class Assignment:
+    """One request newly admitted into a replica group's decode batch."""
+    rid: int
+    replica: int                       # replica-group index
+    tokens: list[int]
+    max_new_tokens: int
+    age_ms: float                      # ingress age when the plan formed
+    deadline_rel_ms: float             # SLO budget left when it formed
+    slo_ms: float = 0.0
+
+
+@dataclasses.dataclass
+class BatchPlan:
+    """The per-step schedule every rank executes identically (pickled
+    over hvd.broadcast_object)."""
+    step: int
+    assign: list[Assignment] = field(default_factory=list)
+    stop: bool = False
+
+
+class ContinuousBatcher:
+    """Front-end accounting of replica-group slots + plan assembly."""
+
+    def __init__(self, num_replicas: int,
+                 slots_per_replica: int | None = None,
+                 token_budget: int | None = None,
+                 max_prompt_tokens: int | None = None) -> None:
+        self.slots_per_replica = config.SERVE_MAX_BATCH.get() \
+            if slots_per_replica is None else int(slots_per_replica)
+        self.token_budget = config.SERVE_TOKEN_BUDGET.get() \
+            if token_budget is None else int(token_budget)
+        max_seq = config.SERVE_MAX_SEQ.get()
+        self.max_prompt_tokens = max_seq if max_prompt_tokens is None \
+            else int(max_prompt_tokens)
+        # rid -> replica group, the front end's in-flight view (rebuilt
+        # from ground truth after an elastic shrink — see rebuild()).
+        self.inflight: dict[int, int] = {}
+        self._active: list[int] = [0] * num_replicas   # slots in use
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self._active)
+
+    def inflight_count(self) -> int:
+        return len(self.inflight)
+
+    # -- assembly --------------------------------------------------------
+    def assemble(self, step: int, queue: RequestQueue, admission,
+                 stop: bool = False) -> tuple[BatchPlan,
+                                              list[ServeRequest]]:
+        """Build the step's plan: admit queued requests into free slots
+        replica-by-replica (least-loaded first) under the token budget.
+        Returns (plan, expired-in-queue requests).  Requests that fit no
+        slot or budget THIS step are returned to the queue head — that
+        is back-pressure, not a shed; the admission controller decides
+        actual sheds."""
+        now = time.monotonic()
+        plan = BatchPlan(step=step, stop=stop)
+        free_slots = sum(self.slots_per_replica - a for a in self._active)
+        if free_slots <= 0:
+            return plan, []
+        ready, expired = queue.pop_ready(free_slots, now=now)
+        # Decode tokens already claimed this step by in-flight slots.
+        budget = [self.token_budget - a for a in self._active]
+        deferred: list[ServeRequest] = []
+        for req in ready:
+            # Least-loaded replica group with a free slot AND budget for
+            # the prompt's prefill tokens; no candidate is back-pressure
+            # (requeued, no admission verdict yet), not a shed.
+            candidates = [r for r in range(self.num_replicas)
+                          if self._active[r] < self.slots_per_replica
+                          and budget[r] >= len(req.tokens)]
+            if not candidates:
+                deferred.append(req)
+                continue
+            ok, _ = admission.admit(req, queue.depth(), now=now)
+            if not ok:
+                continue
+            r = min(candidates, key=lambda i: self._active[i])
+            self._active[r] += 1
+            budget[r] -= len(req.tokens)
+            self.inflight[req.rid] = r
+            req.replica = r
+            plan.assign.append(Assignment(
+                rid=req.rid, replica=r, tokens=req.tokens,
+                max_new_tokens=req.max_new_tokens,
+                age_ms=(now - req.arrival) * 1e3,
+                deadline_rel_ms=req.remaining_ms(now),
+                slo_ms=req.slo_ms))
+        if deferred:
+            queue.requeue_front(deferred)
+        return plan, expired
+
+    # -- completion / failure accounting ---------------------------------
+    def note_done(self, rid: int) -> None:
+        r = self.inflight.pop(rid, None)
+        if r is not None and 0 <= r < self.num_replicas:
+            self._active[r] = max(0, self._active[r] - 1)
+
+    def rebuild(self, per_replica_rids: list[list[int]]) -> list[int]:
+        """Resynchronize from ground truth after an elastic shrink: slot
+        occupancy and the in-flight map are rebuilt from each surviving
+        replica group's actual resident rids; returns the rids that
+        vanished with dead replicas (lost in-flight work)."""
+        before = set(self.inflight)
+        self.inflight = {}
+        self._active = [0] * len(per_replica_rids)
+        for r, rids in enumerate(per_replica_rids):
+            for rid in rids:
+                self.inflight[rid] = r
+                self._active[r] += 1
+        return sorted(before - set(self.inflight))

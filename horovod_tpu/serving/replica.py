@@ -1,0 +1,430 @@
+"""Replica executor: the serve loop every rank runs, on the same
+core/controller dispatch path training uses.
+
+Execution model (ISSUE 9 tentpole):
+
+- The **front end** (lowest live rank) owns the ingress queue, the
+  continuous batcher and admission control.  Every serve step it
+  assembles one :class:`~.batcher.BatchPlan` and **broadcasts** it
+  (``hvd.broadcast_object`` — a real negotiated collective on the data
+  plane).  Because every rank executes the identical plan sequence,
+  replicas can never diverge on a collective: the broadcast IS the
+  schedule.
+- Each **replica group** (``HOROVOD_SERVE_GROUP_SIZE`` ranks; 1 = pure
+  data-parallel) prefills newly assigned requests into free KV-cache
+  slots and advances every in-flight slot by one greedy decode token per
+  step (models/transformer.py ``prefill``/``decode_step`` — continuous
+  batching, not run-to-completion).
+- Completions ride back on an **allgather** each step, so the front end
+  frees slots and records latencies without any side channel.
+- **Deadline propagation**: the earliest in-flight request deadline
+  bounds the step's collective waits via
+  ``resilience.deadline_scope`` → per-op deadlines
+  (resilience/context.py), so a dead peer surfaces within the SLO
+  budget instead of the full fault window.
+- **Elastic shrink mid-serve**: when a collective raises
+  :class:`RanksFailedError`, every survivor converges on the
+  heartbeat-confirmed dead set, deterministically renumbers itself,
+  rebuilds the world one rank smaller (fresh rendezvous epoch), resyncs
+  the in-flight map from ground truth, and keeps serving.  In-flight
+  requests on surviving replicas are untouched — their KV caches are
+  process-local JAX arrays that do not care about the mesh.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import config
+from ..common.exceptions import RanksFailedError
+from ..common.logging import logger
+from ..models import transformer as tfm
+from .admission import AdmissionController
+from .batcher import Assignment, BatchPlan, ContinuousBatcher
+from .queue import RequestQueue
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Serving knobs (env defaults: the HOROVOD_SERVE_* family)."""
+    max_batch: int = 8
+    token_budget: int = 256
+    max_seq: int = 256
+    group_size: int = 1
+    slo_ms: float = 30000.0
+    queue_depth: int = 1024
+    eos_id: int = -1                   # -1 disables EOS stopping
+    seed: int = 0
+    model_cfg: object | None = None    # TransformerConfig; None = tiny LM
+    # Prefill shape buckets compiled at startup so the first real
+    # requests never stall a broadcast-consistent step on an XLA
+    # compile (a multi-second stall looks exactly like a wedged rank
+    # to a peer's SLO-bounded wait).
+    warmup_buckets: tuple = (8, 16)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        base = dict(
+            max_batch=config.SERVE_MAX_BATCH.get(),
+            token_budget=config.SERVE_TOKEN_BUDGET.get(),
+            max_seq=config.SERVE_MAX_SEQ.get(),
+            group_size=config.SERVE_GROUP_SIZE.get(),
+            slo_ms=config.SERVE_SLO_MS.get(),
+            queue_depth=config.SERVE_QUEUE_DEPTH.get())
+        base.update(overrides)
+        return cls(**base)
+
+
+@dataclasses.dataclass
+class _Slot:
+    """One in-flight sequence in this replica's decode batch."""
+    rid: int
+    remaining: int                     # decode tokens still to produce
+    deadline: float                    # absolute local monotonic
+    assigned_at: float
+    age_ms: float                      # ingress age when assigned
+    slo_ms: float
+    generated: list[int]
+
+
+class ReplicaExecutor:
+    """One rank's half of the data-parallel serving world."""
+
+    def __init__(self, serve_cfg: ServeConfig | None = None,
+                 params=None) -> None:
+        import horovod_tpu as hvd
+        self.hvd = hvd
+        self.cfg = serve_cfg or ServeConfig.from_env()
+        self.rank = hvd.rank()
+        self.size = hvd.size()
+        self.front = 0
+        self._gen = 0                  # shrink generation (name/epoch tag)
+        self._step = 0
+        self._stop_requested = False
+        self._configure_groups()
+
+        model_cfg = self.cfg.model_cfg
+        if model_cfg is None:
+            model_cfg = tfm.gpt_tiny(dtype=jnp.float32)
+        model_cfg = dataclasses.replace(model_cfg, decode=True,
+                                        max_seq_len=self.cfg.max_seq)
+        self.model = tfm.TransformerLM(model_cfg)
+        if params is None:
+            # Seeded, deterministic: every replica materializes identical
+            # weights without a broadcast (replace with a checkpoint
+            # restore or hvd.broadcast_object for real weights).
+            params = self.model.init(
+                jax.random.PRNGKey(self.cfg.seed),
+                jnp.zeros((1, 8), jnp.int32))["params"]
+        self.params = params
+
+        self.slots: list[_Slot | None] = [None] * self.cfg.max_batch
+        self._last_tokens = np.zeros(self.cfg.max_batch, np.int32)
+        self.completed: dict[int, dict] = {}
+        self.prefilled: set[int] = set()
+        # Completions not yet acknowledged by a successful exchange: a
+        # step that fails mid-allgather re-sends them after the shrink,
+        # so a request finished during the failure window is never
+        # misclassified as lost (front dedups via batcher membership).
+        self._unreported: list[dict] = []
+        self.stats = {"offered": 0, "expired": 0, "served": 0,
+                      "served_slo": 0, "lost": 0,
+                      "latencies_ms": [], "shrinks": []}
+
+        self.queue = RequestQueue(maxsize=self.cfg.queue_depth,
+                                  default_slo_ms=self.cfg.slo_ms)
+        self.admission = AdmissionController(
+            queue_depth_limit=self.cfg.queue_depth)
+        self.batcher = ContinuousBatcher(
+            self.num_groups, slots_per_replica=self.cfg.max_batch,
+            token_budget=self.cfg.token_budget)
+
+        self._decode_jit = jax.jit(self._decode_impl)
+        self._prefill_jit = jax.jit(self._prefill_impl)
+        self._init_cache()
+        self._warmup()
+
+    # -- topology --------------------------------------------------------
+    def _configure_groups(self) -> None:
+        gs = self.cfg.group_size
+        if gs <= 0 or self.size % gs:
+            if gs > 1:
+                logger.warning(
+                    "serving: group size %d does not divide world size "
+                    "%d; falling back to per-rank replicas", gs, self.size)
+            gs = 1
+        self.group_size = gs
+        self.group = self.rank // gs
+        self.num_groups = self.size // gs
+        self.group_leader = self.rank % gs == 0
+
+    # -- model plumbing --------------------------------------------------
+    def _decode_impl(self, params, cache, tokens):
+        logits, cache = tfm.decode_step(self.model, {"params": params},
+                                        cache, tokens)
+        return (jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32),
+                cache)
+
+    def _prefill_impl(self, params, tokens, n):
+        logits, cache = tfm.prefill(self.model, {"params": params},
+                                    tokens, lengths=n)
+        return (jnp.argmax(logits[0, n - 1, :]).astype(jnp.int32), cache)
+
+    def _init_cache(self) -> None:
+        zeros = jnp.zeros((self.cfg.max_batch, 1), jnp.int32)
+        _, mut = self.model.apply({"params": self.params}, zeros,
+                                  mutable=["cache"])
+        self._cache = tfm._with_cache_index(mut["cache"], 0)
+
+    def _warmup(self) -> None:
+        for bucket in self.cfg.warmup_buckets:
+            if bucket > self.cfg.max_seq:
+                continue
+            tok, cache1 = self._prefill_jit(
+                self.params, jnp.zeros((1, bucket), jnp.int32),
+                jnp.int32(1))
+            jax.block_until_ready(tok)
+        nxt, _ = self._decode_jit(
+            self.params, self._cache,
+            jnp.asarray(self._last_tokens[:, None]))
+        jax.block_until_ready(nxt)
+        self._init_cache()             # discard warmup cache writes
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        return max(8, 1 << max(0, (n - 1)).bit_length())
+
+    # -- per-step halves -------------------------------------------------
+    def _assemble(self) -> BatchPlan:
+        stop = (self._stop_requested and self.queue.depth() == 0
+                and self.batcher.inflight_count() == 0)
+        plan, expired = self.batcher.assemble(
+            self._step, self.queue, self.admission, stop=stop)
+        for req in expired:
+            # Expired while queued: shed at admission, never executed.
+            self.admission.count("expired")
+            self.stats["expired"] += 1
+        return plan
+
+    def _exchange_plan(self, plan: BatchPlan | None) -> BatchPlan:
+        from ..resilience import deadline_scope
+        deadlines = [s.deadline for s in self.slots if s is not None]
+        with deadline_scope(min(deadlines) if deadlines else None):
+            return self.hvd.broadcast_object(
+                plan, root_rank=self.front,
+                name=f"serve.plan.g{self._gen}.{self._step}")
+
+    def _apply_plan(self, plan: BatchPlan) -> None:
+        now = time.monotonic()
+        for a in plan.assign:
+            if a.replica != self.group:
+                continue
+            slot = next(i for i, s in enumerate(self.slots) if s is None)
+            self._prefill_slot(slot, a, now)
+
+    def _prefill_slot(self, slot: int, a: Assignment, now: float) -> None:
+        # Clamp so prompt + generation always fits the KV cache.
+        limit = self.cfg.max_seq - a.max_new_tokens
+        toks = a.tokens[:max(1, limit)]
+        bucket = min(self._bucket(len(toks)), self.cfg.max_seq)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(toks)] = toks
+        first, cache1 = self._prefill_jit(
+            self.params, jnp.asarray(padded), jnp.int32(len(toks)))
+        self._cache = jax.tree_util.tree_map(
+            lambda big, small: big.at[slot].set(small[0]),
+            self._cache, cache1)
+        first = int(first)
+        self._last_tokens[slot] = first
+        self.slots[slot] = _Slot(
+            rid=a.rid, remaining=a.max_new_tokens - 1,
+            deadline=now + a.deadline_rel_ms / 1e3, assigned_at=now,
+            age_ms=a.age_ms, slo_ms=a.slo_ms, generated=[first])
+        self.prefilled.add(a.rid)
+
+    def _decode_once(self) -> None:
+        active = [i for i, s in enumerate(self.slots)
+                  if s is not None and s.remaining > 0]
+        if not active:
+            return
+        nxt, self._cache = self._decode_jit(
+            self.params, self._cache,
+            jnp.asarray(self._last_tokens[:, None]))
+        nxt = np.asarray(nxt)
+        for i in active:
+            s = self.slots[i]
+            tok = int(nxt[i])
+            s.generated.append(tok)
+            s.remaining -= 1
+            self._last_tokens[i] = tok
+            if self.cfg.eos_id >= 0 and tok == self.cfg.eos_id:
+                s.remaining = 0
+
+    def _collect_completions(self) -> None:
+        now = time.monotonic()
+        for i, s in enumerate(self.slots):
+            if s is None or s.remaining > 0:
+                continue
+            rec = {"rid": s.rid, "replica": self.group,
+                   "latency_ms": s.age_ms + (now - s.assigned_at) * 1e3,
+                   "tokens": len(s.generated),
+                   "slo_met": now <= s.deadline}
+            self.completed[s.rid] = rec
+            if self.group_leader:
+                # Every group member frees slots identically; only the
+                # leader reports, so completions appear exactly once.
+                self._unreported.append(rec)
+            self.slots[i] = None
+
+    def _exchange_completions(self) -> list[dict]:
+        from ..resilience import deadline_scope
+        done = list(self._unreported)
+        deadlines = [s.deadline for s in self.slots if s is not None]
+        with deadline_scope(min(deadlines) if deadlines else None):
+            per_rank = self.hvd.allgather_object(
+                done, name=f"serve.done.g{self._gen}.{self._step}")
+        self._unreported.clear()       # acknowledged by the exchange
+        return [rec for ranklist in per_rank for rec in ranklist]
+
+    def _account(self, completions: list[dict]) -> None:
+        if self.rank != self.front:
+            return
+        for rec in completions:
+            if rec["rid"] not in self.batcher.inflight:
+                continue   # duplicate re-send after a failed exchange
+            self.batcher.note_done(rec["rid"])
+            self.admission.count("served")
+            self.admission.observe_latency_ms(rec["latency_ms"])
+            self.stats["served"] += 1
+            self.stats["served_slo"] += bool(rec["slo_met"])
+            self.stats["latencies_ms"].append(rec["latency_ms"])
+
+    # -- the loop --------------------------------------------------------
+    def _serve_step(self) -> bool:
+        t0 = time.monotonic()
+        plan = self._assemble() if self.rank == self.front else None
+        plan = self._exchange_plan(plan)
+        self._step += 1
+        if plan.stop:
+            return False
+        self._apply_plan(plan)
+        self._decode_once()
+        self._collect_completions()
+        completions = self._exchange_completions()
+        self._account(completions)
+        self.admission.observe_step_ms((time.monotonic() - t0) * 1e3)
+        return True
+
+    def serve_loop(self, *, stop_when=None, max_steps: int | None = None,
+                   idle_sleep: float = 0.002) -> None:
+        """Run serve steps until the front end declares the system
+        drained (``stop_when()`` true on the front end AND queue and
+        in-flight empty), riding elastic shrinks across rank failures.
+        ``max_steps`` is a safety bound for tests."""
+        while max_steps is None or self._step < max_steps:
+            if self.rank == self.front:
+                if stop_when is not None and stop_when():
+                    self._stop_requested = True
+                if (not self._stop_requested
+                        and self.queue.depth() == 0
+                        and self.batcher.inflight_count() == 0):
+                    time.sleep(idle_sleep)   # don't hot-spin empty plans
+            try:
+                if not self._serve_step():
+                    return
+            except RanksFailedError as exc:
+                self._shrink_and_resume(exc)
+
+    # -- elastic shrink --------------------------------------------------
+    def _confirmed_dead(self, exc: RanksFailedError) -> frozenset[int]:
+        """Converge on the heartbeat-CONFIRMED dead set: every survivor
+        must compute the same membership, and suspicion alone (a slow
+        peer) must never shrink the world — an unconfirmable failure
+        re-raises instead."""
+        from ..resilience import active_state
+        state = active_state()
+        if state is None:
+            raise exc
+        suspects = set(exc.failed_ranks)
+        deadline = time.monotonic() + 2.0 * state.fault_timeout
+        confirmed: frozenset[int] = frozenset()
+        while time.monotonic() < deadline:
+            try:
+                state.monitor.poll_once()
+            except Exception:  # noqa: BLE001 - convergence must not mask
+                pass
+            suspects |= state.failed_ranks()
+            now_confirmed = state.confirmed_dead(suspects)
+            if now_confirmed and now_confirmed == confirmed:
+                return confirmed       # stable across two polls
+            confirmed = now_confirmed
+            time.sleep(state.poll_interval)
+        if confirmed:
+            return confirmed
+        raise exc                      # alive-but-wedged: not shrinkable
+
+    def _shrink_and_resume(self, exc: RanksFailedError) -> None:
+        from .. import core
+        dead = self._confirmed_dead(exc)
+        survivors = [r for r in range(self.size) if r not in dead]
+        new_rank = survivors.index(self.rank)
+        new_size = len(survivors)
+        logger.warning(
+            "serving: shrink %d->%d (dead=%s); this rank %d -> %d",
+            self.size, new_size, sorted(dead), self.rank, new_rank)
+        base = os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", "0")
+        self._gen += 1
+        tag = "_".join(str(r) for r in sorted(dead))
+        core.shutdown()
+        os.environ["HOROVOD_RENDEZVOUS_EPOCH"] = \
+            f"{base.split('~', 1)[0]}~sv{self._gen}x{tag}"
+        os.environ["HOROVOD_RANK"] = str(new_rank)
+        os.environ["HOROVOD_SIZE"] = str(new_size)
+        core.init()
+        old = (self.rank, self.size)
+        self.rank, self.size = new_rank, new_size
+        self.front = 0
+        self._configure_groups()
+        self._resync()
+        self.stats["shrinks"].append(
+            {"dead": sorted(dead), "from": old[1], "to": new_size,
+             "step": self._step})
+
+    def _resync(self) -> None:
+        """Rebuild shared state from ground truth after a world rebuild.
+
+        - Survivors may have caught the failure at DIFFERENT steps (a
+          per-rank data-plane error can abort rank A's plan broadcast
+          while rank B fails one exchange later), so the step counter
+          realigns to the maximum — collective names must match again.
+        - Each group leader reports its resident rids (plus completions
+          awaiting re-send); requests that vanished with dead replicas
+          are counted lost.  Nothing on a surviving replica is ever
+          dropped, so the zero-failed-on-survivors invariant holds.
+        """
+        rids = sorted(s.rid for s in self.slots if s is not None)
+        rids += [rec["rid"] for rec in self._unreported]
+        mine = {"step": self._step,
+                "rids": rids if self.group_leader else []}
+        per_rank = self.hvd.allgather_object(
+            mine, name=f"serve.resync.g{self._gen}")
+        self._step = max(p["step"] for p in per_rank)
+        per_group = [per_rank[g * self.group_size]["rids"]
+                     for g in range(self.num_groups)]
+        lost = self.batcher.rebuild(per_group)
+        if self.rank == self.front:
+            for _ in lost:
+                self.admission.count("lost")
+            self.stats["lost"] += len(lost)
+
+    # -- introspection ---------------------------------------------------
+    def inflight_rids(self) -> list[int]:
+        return sorted(s.rid for s in self.slots if s is not None)
+
+    def request_stop(self) -> None:
+        self._stop_requested = True

@@ -138,6 +138,36 @@ class Histogram:
                 return bucket_upper_bound(i)
         return bucket_upper_bound(_NBUCKETS - 1)
 
+    def quantile(self, q: float) -> float:
+        """Interpolated quantile, ``q`` in [0, 1]: geometric (log-space)
+        interpolation within the log2 bucket holding the q-th
+        observation, clamped to the observed min/max so single-bucket
+        histograms and extreme quantiles report a value that was
+        actually plausible rather than a power-of-two bound.  This is
+        the one quantile path serving SLO reports (p50/p99/p999) and
+        training step-time summaries share (ISSUE 9)."""
+        with self._lock:
+            count = self._count
+            buckets = list(self._buckets)
+            lo_obs, hi_obs = self._min, self._max
+        if count == 0:
+            return 0.0
+        q = min(max(q, 0.0), 1.0)
+        target = q * count
+        cum = 0
+        value = bucket_upper_bound(_NBUCKETS - 1)
+        for i, n in enumerate(buckets):
+            if not n:
+                continue
+            prev, cum = cum, cum + n
+            if cum >= target:
+                frac = (target - prev) / n
+                hi = bucket_upper_bound(i)
+                lo = hi / 2.0
+                value = lo * (hi / lo) ** frac
+                break
+        return min(max(value, lo_obs), hi_obs)
+
     def nonzero_buckets(self) -> list[tuple[float, int]]:
         """(upper bound, count) for populated buckets, ascending."""
         return [(bucket_upper_bound(i), n)
@@ -165,6 +195,9 @@ class _NullMetric:
         pass
 
     def percentile(self, p: float) -> float:
+        return 0.0
+
+    def quantile(self, q: float) -> float:
         return 0.0
 
     def nonzero_buckets(self):
@@ -250,6 +283,12 @@ class MetricsRegistry:
                 base = _format_labels(m.labels)
                 out.append(f"{name}_sum{base} {m.sum:g}")
                 out.append(f"{name}_count{base} {m.count}")
+                # Interpolated p50/p99 as summary-style series: serving
+                # SLO dashboards and training step times read the same
+                # quantile path (Histogram.quantile, ISSUE 9).
+                for q in (0.5, 0.99):
+                    lab = _format_labels({**m.labels, "quantile": f"{q:g}"})
+                    out.append(f"{name}{lab} {m.quantile(q):g}")
             else:
                 out.append(
                     f"{name}{_format_labels(m.labels)} {m.value:g}")
@@ -272,8 +311,8 @@ class MetricsRegistry:
                 entry["count"] = m.count
                 entry["sum"] = m.sum
                 entry["mean"] = m.mean
-                entry["p50"] = m.percentile(50)
-                entry["p99"] = m.percentile(99)
+                entry["p50"] = m.quantile(0.5)
+                entry["p99"] = m.quantile(0.99)
                 entry["buckets"] = [[b, n] for b, n in m.nonzero_buckets()]
             metrics.append(entry)
         return {"rank": self.rank, "metrics": metrics}

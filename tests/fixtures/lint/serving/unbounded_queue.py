@@ -1,0 +1,34 @@
+"""Seeded HVD1006 violations: unbounded queues / blocking handoffs in a
+serving/ hot path (tests/test_lint_clean.py asserts exactly these)."""
+import queue
+
+
+def ingress_unbounded():
+    return queue.Queue()                       # line 7: no maxsize
+
+
+def drain_forever(work_queue):
+    return work_queue.get()                    # line 11: no timeout
+
+
+def buffer_forever(q, item):
+    q.put(item)                                # line 15: no timeout
+
+
+def no_bound_at_all():
+    return queue.SimpleQueue()                 # line 19: unboundable
+
+
+def bounded_and_shedding(q, item, deadline):
+    ok = queue.Queue(maxsize=128)              # bounded ctor: clean
+    q.put(item, timeout=deadline)              # deadline-bounded: clean
+    q.put_nowait(item)                         # non-blocking: clean
+    try:
+        return ok, q.get(block=False)          # shedding pop: clean
+    except queue.Empty:
+        return ok, None
+
+
+def not_a_queue(labels, knob):
+    # dict.get / config-knob .get() must never trip the rule.
+    return labels.get("peer", "0"), knob.get()

@@ -1944,8 +1944,87 @@ def battery_san(hvd, rank, size):
     assert "lock-order" in kinds, kinds
 
 
+def battery_serving(hvd, rank, size):
+    """ISSUE 9 acceptance (4-rank): continuous-batching serving with a
+    chaos SIGKILL of rank 2 mid-serve.  The world shrinks 4->3; every
+    survivor finishes every request it had admitted (zero failed
+    in-flight on survivors), the front end's accounting balances
+    (served + lost == offered, bounded shed), and a post-shrink burst
+    of hopeless-SLO requests is shed at admission — never prefilled."""
+    import random as _random
+    import time as _time
+
+    from horovod_tpu.serving import ReplicaExecutor, ServeConfig
+
+    ex = ReplicaExecutor(ServeConfig.from_env(
+        max_batch=4, token_budget=64, max_seq=64, slo_ms=120000.0))
+    assert ex.num_groups == size
+    n_requests = 24
+    if rank == 0:
+        rng = _random.Random(7)
+        for _ in range(n_requests):
+            toks = [rng.randrange(2, ex.model.cfg.vocab_size)
+                    for _ in range(rng.randint(2, 10))]
+            ex.stats["offered"] += 1
+            assert ex.queue.submit(toks, 12) is not None
+
+    t0 = _time.monotonic()
+    ex.serve_loop(stop_when=lambda: True)   # drain then stop
+    phase1_wall = _time.monotonic() - t0
+
+    # --- phase-1 assertions: the kill happened and survivors absorbed it
+    assert ex.size == size - 1, (ex.size, size)
+    assert ex.stats["shrinks"] and \
+        ex.stats["shrinks"][0]["dead"] == [2], ex.stats["shrinks"]
+    missing = ex.prefilled - set(ex.completed)
+    assert not missing, \
+        f"survivor {rank} failed admitted in-flight requests: {missing}"
+    phase1_prefilled = len(ex.prefilled)
+    if rank == 0:
+        st = ex.stats
+        assert st["served"] + st["lost"] == n_requests, st
+        assert st["lost"] <= 4, st          # at most rank 2's slots
+        assert st["expired"] == 0, st       # generous SLOs: bounded shed
+        assert ex.admission._m_outcome["shed"].value == 0
+        lat = st["latencies_ms"]
+        assert len(lat) == st["served"] and min(lat) > 0.0
+        fault_timeout = float(os.environ["HOROVOD_FAULT_TIMEOUT"])
+        # The shrink detour is bounded: detection (<= 2x fault timeout)
+        # + confirmation polling (<= 2x) + rebuild, with wide margin.
+        assert phase1_wall < 10 * fault_timeout, phase1_wall
+        print(f"serving: {st['served']}/{n_requests} served, "
+              f"{st['lost']} lost with rank 2, shrink at step "
+              f"{st['shrinks'][0]['step']} in {phase1_wall:.1f}s")
+
+    # --- phase 2: overload with hopeless SLOs -> shed at admission,
+    # never executed (no new prefill on ANY survivor).
+    served_before = ex.stats["served"]
+    if ex.rank == ex.front:
+        for _ in range(8):
+            # Deadline passes while queued -> 'expired' at pop.
+            assert ex.queue.submit([3, 4, 5], 4, slo_ms=0.5) is not None
+        for _ in range(4):
+            # Feasibility shed: 200 decode steps can never fit 3 ms.
+            assert ex.queue.submit([3] * 8, 200, slo_ms=3.0) is not None
+    ex._stop_requested = False
+    ex.serve_loop(stop_when=lambda: True)
+    assert len(ex.prefilled) == phase1_prefilled, \
+        "hopeless-SLO requests must never be executed"
+    assert ex.stats["served"] == served_before
+    if ex.rank == ex.front:
+        shed_total = (ex.stats["expired"]
+                      + ex.admission._m_outcome["shed"].value)
+        assert shed_total == 12, \
+            (ex.stats["expired"], ex.admission._m_outcome["shed"].value)
+        print(f"serving: post-shrink hopeless burst shed at admission "
+              f"(expired={ex.stats['expired']}, "
+              f"shed={ex.admission._m_outcome['shed'].value:g})")
+    hvd.barrier()
+
+
 BATTERIES = {
     "collectives": battery_collectives,
+    "serving": battery_serving,
     "san": battery_san,
     "trace": battery_trace,
     "telemetry": battery_telemetry,
@@ -2063,6 +2142,20 @@ def main() -> int:
     if battery in ("resilience_kill", "resilience_retry",
                    "resilience_freeze"):
         os.environ["HOROVOD_FAULT_TOLERANCE"] = "1"
+    if battery == "serving":
+        # ISSUE 9: data-parallel serving over the TCP plane with chaos
+        # SIGKILL of rank 2 mid-serve (global collective index 11 = the
+        # completion exchange of serve step 2, with ~16 requests
+        # in-flight).  Fault tolerance on so survivors convert the dead
+        # peer and shrink; metrics on so admission keys off live gauges.
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+        os.environ["HOROVOD_FAULT_TOLERANCE"] = "1"
+        os.environ["HOROVOD_FAULT_TIMEOUT"] = "5"
+        os.environ["HOROVOD_METRICS"] = "on"
+        os.environ["HOROVOD_CHAOS"] = "kill:rank=2,op=11,sig=9"
+        os.environ["HOROVOD_FLIGHT_FILE"] = \
+            f"/tmp/hvd_flight_{os.environ['HOROVOD_RENDEZVOUS_EPOCH']}.json"
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if battery == "resilience_kill":
         os.environ["HOROVOD_FAULT_TIMEOUT"] = "5"
         # Real SIGKILL mid-allreduce at global collective index 3

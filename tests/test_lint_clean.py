@@ -120,6 +120,30 @@ def test_unbounded_wait_scope_is_transport_modules():
     assert lint_source(src, "horovod_tpu/core.py") == []
 
 
+def test_fixture_unbounded_queue_serving():
+    """HVD1006: Queue() without maxsize, SimpleQueue, and blocking
+    put/get without a timeout in serving/ modules (ISSUE 9 satellite);
+    bounded ctors, deadline-bounded/non-blocking handoffs and dict/knob
+    .get() stay clean."""
+    out = lint_paths([os.path.join(FIXTURES, "serving",
+                                   "unbounded_queue.py")])
+    assert _slugs(out) == ["unbounded-queue-in-serving"] * 4
+    assert {v.line for v in out} == {7, 11, 15, 19}
+
+
+def test_unbounded_queue_scope_is_serving():
+    """The rule bites only in serving/ modules — the runner/transport
+    layers have their own wait discipline (HVD1003)."""
+    src = "def f(q):\n    return q.get()\n"
+    assert _slugs(lint_source(src, "horovod_tpu/serving/x.py")) == \
+        ["unbounded-queue-in-serving"]
+    assert lint_source(src, "horovod_tpu/runner/x.py") == []
+    assert lint_source(src, "horovod_tpu/core.py") == []
+    # Config-knob constants are not queues.
+    knob = "def f():\n    return SERVE_QUEUE_DEPTH.get()\n"
+    assert lint_source(knob, "horovod_tpu/serving/x.py") == []
+
+
 def test_fixture_unbalanced_span():
     """HVD1005: activity_start in backend/ without a finally-guarded
     activity_end (ISSUE 7 satellite); the guarded shapes — start inside

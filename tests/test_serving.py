@@ -1,0 +1,373 @@
+"""serving/ battery (ISSUE 9): continuous batching, admission control,
+per-request deadline propagation, the chaos shrink mid-serve, and the
+loadgen SLO harness.
+
+Process-level acceptance (4-rank mp_worker "serving" battery under the
+hard SIGALRM guard): chaos SIGKILLs rank 2 mid-serve; the world shrinks
+4->3, every survivor completes every request it had admitted (zero
+failed in-flight on survivors), accounting balances with bounded shed,
+and a post-shrink hopeless-SLO burst is shed at admission — never
+prefilled on any rank.
+
+Unit level: bounded ingress queue with deadlines stamped at the door,
+token-budgeted continuous batch assembly, admission verdicts
+(expired / load shed / infeasible / admitted) keyed off live telemetry,
+deadline_scope -> per-op deadline propagation, and the loadgen report
+schema (the tier-1 smoke: --requests 64 --duration 5).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_multiprocess import _run_world  # noqa: E402
+
+from horovod_tpu.serving.admission import AdmissionController  # noqa: E402
+from horovod_tpu.serving.batcher import ContinuousBatcher  # noqa: E402
+from horovod_tpu.serving.queue import RequestQueue, ServeRequest  # noqa: E402
+from horovod_tpu.telemetry.registry import MetricsRegistry  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HARD_GUARD_SECONDS = 420
+
+
+@pytest.fixture(autouse=True)
+def hard_timeout_guard():
+    """Serving tests exercise deadline machinery: a regression that
+    re-introduces an unbounded wait must fail fast, not eat the tier-1
+    budget (the resilience-suite convention)."""
+    def _expired(signum, frame):
+        raise TimeoutError(
+            f"serving test exceeded the {HARD_GUARD_SECONDS}s hard "
+            f"guard — a blocking wait has lost its deadline")
+    old = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(HARD_GUARD_SECONDS)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def _mkreq(rid=0, tokens=(1, 2, 3), max_new=4, slo_ms=1000.0,
+           age_s=0.0) -> ServeRequest:
+    now = time.monotonic()
+    return ServeRequest(rid=rid, tokens=list(tokens),
+                        max_new_tokens=max_new, arrival=now - age_s,
+                        deadline=now - age_s + slo_ms / 1e3,
+                        slo_ms=slo_ms)
+
+
+class _AdmitAll:
+    def __init__(self):
+        self.counts = {}
+
+    def admit(self, req, depth, now=None):
+        self.count("admitted")
+        return True, "admitted"
+
+    def count(self, outcome, n=1):
+        self.counts[outcome] = self.counts.get(outcome, 0) + n
+
+
+# --- ingress queue ----------------------------------------------------------
+def test_queue_bounded_and_deadline_stamped():
+    reg = MetricsRegistry(0)
+    q = RequestQueue(maxsize=2, default_slo_ms=500.0, registry=reg)
+    t0 = time.monotonic()
+    assert q.submit([1, 2], 4) == 0
+    assert q.submit([3], 4, slo_ms=50.0) == 1
+    # Full queue sheds at the door (never blocks, never buffers).
+    assert q.submit([4], 4) is None
+    assert reg.counter("horovod_serve_requests_total",
+                       labels={"outcome": "rejected_full"}).value == 1
+    assert reg.gauge("horovod_serve_queue_depth").value == 2
+    ready, expired = q.pop_ready(10)
+    assert [r.rid for r in ready] == [0, 1] and expired == []
+    # Deadlines were stamped at ingress, per-request SLO honored.
+    assert ready[0].deadline == pytest.approx(t0 + 0.5, abs=0.05)
+    assert ready[1].deadline == pytest.approx(t0 + 0.05, abs=0.05)
+
+
+def test_queue_expires_while_queued():
+    q = RequestQueue(maxsize=8, default_slo_ms=1000.0,
+                     registry=MetricsRegistry(0))
+    q.submit([1], 2, slo_ms=1.0)     # expires in 1 ms
+    q.submit([2], 2)                 # healthy
+    time.sleep(0.02)
+    ready, expired = q.pop_ready(10)
+    assert [r.rid for r in expired] == [0]
+    assert [r.rid for r in ready] == [1]
+
+
+def test_queue_close_sheds_new_but_drains_old():
+    q = RequestQueue(maxsize=8, registry=MetricsRegistry(0))
+    assert q.submit([1], 2) == 0
+    q.close()
+    assert q.submit([2], 2) is None
+    ready, _ = q.pop_ready(10)
+    assert [r.rid for r in ready] == [0]
+
+
+# --- continuous batcher -----------------------------------------------------
+def test_batcher_fills_least_loaded_within_budget():
+    reg = MetricsRegistry(0)
+    q = RequestQueue(maxsize=64, registry=reg)
+    adm = _AdmitAll()
+    b = ContinuousBatcher(2, slots_per_replica=2, token_budget=8)
+    for i in range(4):
+        q.submit([1] * 3, 4)
+    plan, expired = b.assemble(0, q, adm)
+    assert expired == []
+    # 2 replicas x 2 slots, 3 prefill tokens each within budget 8.
+    assert len(plan.assign) == 4
+    assert sorted(a.replica for a in plan.assign) == [0, 0, 1, 1]
+    assert b.inflight_count() == 4
+    # Slots full: nothing more is assembled until completions free them.
+    q.submit([1] * 3, 4)
+    plan2, _ = b.assemble(1, q, adm)
+    assert plan2.assign == []
+    b.note_done(plan.assign[0].rid)
+    plan3, _ = b.assemble(2, q, adm)
+    assert len(plan3.assign) == 1
+    assert plan3.assign[0].replica == plan.assign[0].replica
+
+
+def test_batcher_token_budget_defers_not_sheds():
+    """A prompt that exceeds this step's remaining token budget is
+    back-pressure: requeued at the head, admitted on a later step —
+    never silently dropped."""
+    reg = MetricsRegistry(0)
+    q = RequestQueue(maxsize=64, registry=reg)
+    adm = _AdmitAll()
+    b = ContinuousBatcher(1, slots_per_replica=4, token_budget=10)
+    q.submit([1] * 8, 4)
+    q.submit([2] * 8, 4)             # 16 prefill tokens > budget 10
+    plan, _ = b.assemble(0, q, adm)
+    assert [a.rid for a in plan.assign] == [0]
+    assert q.depth() == 1
+    plan2, _ = b.assemble(1, q, adm)
+    assert [a.rid for a in plan2.assign] == [1]
+
+
+def test_batcher_rebuild_reports_lost():
+    b = ContinuousBatcher(3, slots_per_replica=2, token_budget=64)
+    b.inflight = {0: 0, 1: 1, 2: 2, 3: 2}
+    b._active = [1, 1, 2]
+    lost = b.rebuild([[0], [1]])     # replica 2 died with rids 2, 3
+    assert lost == [2, 3]
+    assert b.inflight == {0: 0, 1: 1}
+    assert b._active == [1, 1]
+
+
+# --- admission control ------------------------------------------------------
+def test_admission_verdicts():
+    reg = MetricsRegistry(0)
+    adm = AdmissionController(registry=reg, queue_depth_limit=10,
+                              shed_fraction=0.5, step_ms_seed=10.0)
+    # Already past its deadline: expired, never executed.
+    ok, outcome = adm.admit(_mkreq(slo_ms=1.0, age_s=1.0), 0)
+    assert (ok, outcome) == (False, "expired")
+    # Queue pressure beyond the gauge threshold: load shed.
+    ok, outcome = adm.admit(_mkreq(slo_ms=10000.0), 9)
+    assert (ok, outcome) == (False, "shed")
+    # Deadline-infeasible: 100 decode steps never fit 50 ms at ~10 ms
+    # per step.
+    ok, outcome = adm.admit(_mkreq(max_new=100, slo_ms=50.0), 0)
+    assert (ok, outcome) == (False, "shed")
+    # Feasible and unloaded: admitted.
+    ok, outcome = adm.admit(_mkreq(max_new=4, slo_ms=10000.0), 0)
+    assert (ok, outcome) == (True, "admitted")
+    counts = {m["labels"]["outcome"]: m["value"]
+              for m in reg.snapshot()["metrics"]
+              if m["name"] == "horovod_serve_requests_total"
+              and m["value"] > 0}
+    assert counts == {"admitted": 1, "expired": 1, "shed": 2}
+
+
+def test_admission_estimate_tracks_live_step_time():
+    adm = AdmissionController(registry=MetricsRegistry(0),
+                              queue_depth_limit=100, step_ms_seed=1.0)
+    assert adm.step_ms() == pytest.approx(1.0)
+    for _ in range(16):
+        adm.observe_step_ms(40.0)
+    # The shared Histogram.quantile path takes over from the EWMA seed.
+    assert 20.0 < adm.step_ms() <= 40.0
+    req = _mkreq(max_new=9)
+    assert adm.estimate_completion_ms(req) >= 10 * 20.0
+
+
+def test_admission_reads_straggler_gauge():
+    reg = MetricsRegistry(0)
+    reg.gauge("horovod_controller_straggler_lag_ms",
+              labels={"stat": "mean"}).set(25.0)
+    adm = AdmissionController(registry=reg, queue_depth_limit=100,
+                              step_ms_seed=5.0)
+    assert adm.straggler_lag_ms() == 25.0
+    assert adm.estimate_completion_ms(_mkreq(max_new=1)) \
+        == pytest.approx(2 * 30.0)
+
+
+# --- deadline propagation into resilience ----------------------------------
+class _FakeMonitor:
+    def failed_ranks(self):
+        return frozenset()
+
+    def confirmed_failed_ranks(self):
+        return frozenset()
+
+    def mark_failed(self, r, reason, confirmed=True):
+        pass
+
+    def stop(self):
+        pass
+
+
+def test_deadline_scope_flows_into_per_op_timeout():
+    from horovod_tpu.resilience.context import (ResilienceState,
+                                                deadline_scope, op_scope,
+                                                pending_deadline)
+    state = ResilienceState(0, 2, _FakeMonitor(), fault_timeout=10.0)
+    assert state.op_timeout() == 10.0
+    # A propagated request deadline tightens the wait bound...
+    with op_scope("serve.plan", deadline=time.monotonic() + 1.0):
+        assert 0.5 < state.op_timeout() <= 1.01
+        # ...and nests (inner scope wins, outer restored).
+        with op_scope("inner", deadline=time.monotonic() + 0.6):
+            assert state.op_timeout() <= 0.61
+        assert 0.5 < state.op_timeout() <= 1.01
+    assert state.op_timeout() == 10.0
+    # A hopeless deadline floors at two poll slices: a late request
+    # alone must never instantly declare a healthy peer wedged.
+    with op_scope("serve.plan", deadline=time.monotonic() - 5.0):
+        assert state.op_timeout() == pytest.approx(
+            2.0 * state.poll_interval)
+    # The caller-side half: deadline_scope parks the deadline for core's
+    # enqueue stamping (TensorTableEntry.deadline).
+    assert pending_deadline() is None
+    with deadline_scope(123.0):
+        assert pending_deadline() == 123.0
+        with deadline_scope(None):
+            assert pending_deadline() is None
+        assert pending_deadline() == 123.0
+    assert pending_deadline() is None
+
+
+def test_entry_deadline_field_defaults_none():
+    from horovod_tpu.common.tensor_queue import TensorTableEntry
+    assert TensorTableEntry(tensor_name="x").deadline is None
+
+
+# --- loadgen ----------------------------------------------------------------
+def test_arrival_profiles_shape_rates():
+    import random
+
+    from horovod_tpu.serving import loadgen
+    rng = random.Random(1)
+    steady = loadgen.arrival_times(rng, 10000, 10.0, 100.0, "steady")
+    assert 0 < len(steady) <= 10000
+    assert steady == sorted(steady) and steady[-1] < 10.0
+    rng = random.Random(1)
+    burst = loadgen.arrival_times(rng, 10 ** 6, 10.0, 100.0, "burst")
+    mid = [t for t in burst if 4.0 <= t < 6.0]
+    rest = [t for t in burst if t < 4.0 or t >= 6.0]
+    # 4x rate through the middle fifth: its per-second density dominates.
+    assert len(mid) / 2.0 > 2.0 * len(rest) / 8.0
+    rng = random.Random(1)
+    ramp = loadgen.arrival_times(rng, 10 ** 6, 10.0, 100.0, "ramp")
+    assert len([t for t in ramp if t >= 5.0]) > \
+        2 * len([t for t in ramp if t < 5.0])
+
+
+def _run_loadgen_inproc(tmp_path, argv):
+    import horovod_tpu as hvd
+
+    from horovod_tpu.serving import loadgen
+    hvd.shutdown()                   # a clean single-rank world
+    for var in ("HOROVOD_RANK", "HOROVOD_SIZE"):
+        os.environ.pop(var, None)
+    args = loadgen.make_parser().parse_args(
+        argv + ["--output", str(tmp_path / "SERVE_r{rank}.json")])
+    if args.slo_ms == 0.0:
+        args.slo_ms = None
+    return loadgen.run(args), tmp_path / "SERVE_r0.json"
+
+
+def test_loadgen_report_schema(tmp_path):
+    from horovod_tpu.serving import loadgen
+    report, path = _run_loadgen_inproc(tmp_path, [
+        "--requests", "6", "--duration", "3", "--rate", "50",
+        "--max-new-tokens", "4", "--prompt-tokens", "6"])
+    assert report["schema"] == loadgen.SCHEMA
+    for key in ("offered", "served", "served_within_slo", "shed",
+                "expired", "lost_on_failure", "latency_ms", "step_ms",
+                "goodput_rps", "offered_rps", "world", "steps",
+                "tokens_generated", "wall_s"):
+        assert key in report, key
+    assert report["offered"] == 6 == report["served"]
+    assert report["shed"] == 0 and report["expired"] == 0
+    assert report["latency_ms"]["p50"] > 0.0
+    assert report["latency_ms"]["p999"] >= report["latency_ms"]["p99"] \
+        >= report["latency_ms"]["p50"]
+    assert report["step_ms"]["count"] > 0      # shared quantile path
+    assert report["tokens_generated"] == 6 * 4
+    on_disk = json.loads(path.read_text())
+    assert on_disk["schema"] == loadgen.SCHEMA
+    assert on_disk["served"] == 6
+
+
+def test_loadgen_overload_sheds_at_admission(tmp_path):
+    """Offered load beyond capacity with tight SLOs: requests that
+    cannot meet their deadline are shed/expired at admission — goodput
+    degrades by refusal, not by executing doomed work."""
+    report, _ = _run_loadgen_inproc(tmp_path, [
+        "--requests", "40", "--duration", "2", "--rate", "400",
+        "--max-new-tokens", "64", "--prompt-tokens", "6",
+        "--slo-ms", "40", "--max-batch", "2", "--token-budget", "16"])
+    assert report["offered"] == 40
+    assert report["shed"] + report["expired"] > 0
+    assert report["served"] + report["shed"] + report["expired"] \
+        + report["lost_on_failure"] == report["offered"]
+
+
+def test_loadgen_smoke_cli(tmp_path):
+    """The tier-1 loadgen smoke (ISSUE 9 CI satellite): the documented
+    CLI drives a single-rank serve world end to end and writes the
+    SERVE_r*.json report next to where the bench payloads land."""
+    out = tmp_path / "SERVE_r{rank}.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.serving.loadgen",
+         "--requests", "64", "--duration", "5", "--rate", "40",
+         "--max-new-tokens", "4", "--prompt-tokens", "8",
+         "--output", str(out)],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads((tmp_path / "SERVE_r0.json").read_text())
+    assert report["served"] > 0
+    assert report["served"] + report["shed"] + report["expired"] \
+        + report["lost_on_failure"] == report["offered"]
+    assert report["latency_ms"]["p99"] >= report["latency_ms"]["p50"] > 0
+    assert report["goodput_rps"] > 0
+    assert "loadgen: report written" in proc.stdout
+
+
+# --- the 4-rank chaos acceptance battery ------------------------------------
+def test_serving_chaos_shrink_4rank():
+    """ISSUE 9 acceptance: chaos SIGKILLs rank 2 mid-serve (global
+    collective index 11, ~16 requests in flight); the 4-rank world
+    shrinks to 3, every survivor completes every admitted in-flight
+    request (asserted in-battery), accounting balances with bounded
+    shed, and a post-shrink hopeless-SLO burst is shed at admission
+    without ever being prefilled."""
+    outputs = _run_world(4, "serving", timeout=360.0,
+                         expected_rcs={2: -signal.SIGKILL})
+    assert "shrink at step" in outputs[0], outputs[0]
+    assert "shed at admission" in outputs[0], outputs[0]

@@ -56,6 +56,35 @@ def test_counter_gauge_histogram_basics():
     assert bounds == [0.5, 2.0, 4.0, 16.0]
 
 
+def test_histogram_quantile_interpolates_and_clamps():
+    """ISSUE 9 satellite: quantile(q) interpolates geometrically inside
+    the log2 bucket (serving SLO p50/p99/p999 and training step times
+    share this one path) and clamps to the observed min/max, unlike the
+    bucket-bound percentile()."""
+    reg = MetricsRegistry(0)
+    h = reg.histogram("q_ms")
+    for v in (0.5, 1.5, 3.0, 12.0):
+        h.observe(v)
+    assert h.quantile(0.5) == pytest.approx(2.0)
+    # p99 interpolates to ~15.6 inside the (8, 16] bucket, then clamps
+    # to the observed max of 12 — percentile() would report 16.
+    assert h.quantile(0.99) == pytest.approx(12.0)
+    assert h.percentile(99) == 16.0
+    assert h.quantile(0.0) == pytest.approx(0.5)   # clamped to min
+    assert h.quantile(1.0) == pytest.approx(12.0)
+    # Single-bucket histogram: every quantile stays inside the bucket.
+    h2 = reg.histogram("one_bucket")
+    for _ in range(100):
+        h2.observe(3.0)
+    assert h2.quantile(0.5) == pytest.approx(3.0)
+    assert h2.quantile(0.999) == pytest.approx(3.0)
+    # Empty histogram: 0.0, and the snapshot carries quantile p50/p99.
+    assert reg.histogram("empty").quantile(0.5) == 0.0
+    snap = {m["name"]: m for m in reg.snapshot()["metrics"]}
+    assert snap["q_ms"]["p50"] == pytest.approx(2.0)
+    assert snap["q_ms"]["p99"] == pytest.approx(12.0)
+
+
 def test_histogram_bucket_edges():
     reg = MetricsRegistry(0)
     h = reg.histogram("edges")

@@ -118,3 +118,86 @@ class TestTraining:
         assert dots[-1] < dots[0]
         full = self._train(gpt_tiny(dtype=jnp.float32, remat=True), mesh)
         np.testing.assert_allclose(dots, full, rtol=2e-4)
+
+
+class TestIncrementalDecode:
+    """KV-cache prefill/decode parity vs the full forward pass (ISSUE 9
+    satellite: continuous batching must pay one token of compute per
+    step without changing the math)."""
+
+    def _models(self):
+        import dataclasses
+
+        from horovod_tpu.models import transformer as tfm
+        cfg = gpt_tiny(dtype=jnp.float32, max_seq_len=64)
+        return (TransformerLM(cfg),
+                TransformerLM(dataclasses.replace(cfg, decode=True)))
+
+    def test_prefill_then_decode_matches_full_forward(self):
+        from horovod_tpu.models import transformer as tfm
+        full_model, dmodel = self._models()
+        toks = jax.random.randint(jax.random.key(3), (2, 12), 0, 256)
+        variables = full_model.init(jax.random.key(0), toks)
+        full = full_model.apply(variables, toks)          # [2,12,V]
+
+        logits, cache = tfm.prefill(dmodel, variables, toks[:, :5])
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, :5]),
+                                   atol=2e-3, rtol=2e-3)
+        for i in range(5, 12):
+            step, cache = tfm.decode_step(dmodel, variables, cache,
+                                          toks[:, i:i + 1])
+            np.testing.assert_allclose(np.asarray(step[:, 0]),
+                                       np.asarray(full[:, i]),
+                                       atol=2e-3, rtol=2e-3)
+
+    def test_padded_prefill_uneven_depths(self):
+        """Right-padded prompts of different lengths share one prefill
+        call; each row then decodes from its own cache depth — the
+        continuous-batching shape — and stays on the full-forward
+        trajectory."""
+        from horovod_tpu.models import transformer as tfm
+        full_model, dmodel = self._models()
+        toks = np.asarray(jax.random.randint(jax.random.key(5), (2, 8),
+                                             0, 256))
+        lens = np.array([3, 5], np.int32)
+        padded = np.zeros((2, 8), np.int32)
+        padded[0, :3] = toks[0, :3]
+        padded[1, :5] = toks[1, :5]
+        variables = full_model.init(jax.random.key(0),
+                                    jnp.asarray(padded))
+        logits, cache = tfm.prefill(dmodel, variables,
+                                    jnp.asarray(padded), lengths=lens)
+        full0 = full_model.apply(variables, jnp.asarray(toks[:1]))
+        full1 = full_model.apply(variables, jnp.asarray(toks[1:]))
+        np.testing.assert_allclose(np.asarray(logits[0, 2]),
+                                   np.asarray(full0[0, 2]),
+                                   atol=2e-3, rtol=2e-3)
+        np.testing.assert_allclose(np.asarray(logits[1, 4]),
+                                   np.asarray(full1[0, 4]),
+                                   atol=2e-3, rtol=2e-3)
+        for j in range(3):
+            step = jnp.asarray(
+                np.stack([toks[0, 3 + j], toks[1, 5 + j]])[:, None])
+            lg, cache = tfm.decode_step(dmodel, variables, cache, step)
+            np.testing.assert_allclose(np.asarray(lg[0, 0]),
+                                       np.asarray(full0[0, 3 + j]),
+                                       atol=2e-3, rtol=2e-3)
+            np.testing.assert_allclose(np.asarray(lg[1, 0]),
+                                       np.asarray(full1[0, 5 + j]),
+                                       atol=2e-3, rtol=2e-3)
+
+    def test_decode_rejects_sequence_parallel(self):
+        import dataclasses
+
+        from horovod_tpu.models import transformer as tfm
+        mesh = build_mesh(MeshSpec(dp=2, sp=4))
+        cfg = dataclasses.replace(
+            gpt_tiny(dtype=jnp.float32, attention="ring", mesh=mesh,
+                     batch_spec="dp"), decode=True)
+        model = TransformerLM(cfg)
+        toks = jnp.zeros((1, 4), jnp.int32)
+        variables = TransformerLM(gpt_tiny(dtype=jnp.float32)).init(
+            jax.random.key(0), toks)
+        with pytest.raises(ValueError, match="decode"):
+            model.apply(variables, toks, mutable=["cache"])
