@@ -200,6 +200,20 @@ SERVING_DIRS = frozenset({"serving"})
 QUEUE_CTOR_NAMES = frozenset({"Queue", "LifoQueue", "PriorityQueue"})
 QUEUE_BLOCKING_NAMES = frozenset({"put", "get"})
 
+# HVD1007: streamed-state reads in statesync/ modules — a function that
+# consumes a streamed state image (unflatten into arrays, apply a frame
+# payload) must have a digest/stamp verification call in the same scope
+# (or be the consumption primitive itself, whose callers own the
+# check).  pull_round counts as verifying: it digest-verifies before
+# returning.
+STATE_CONSUME_NAMES = frozenset({
+    "unflatten_state", "apply_chunk", "consume_payload",
+})
+STATE_VERIFY_NAMES = frozenset({
+    "verify_round", "verify_stamp", "state_digest", "pull_round",
+})
+STATESYNC_DIRS = frozenset({"statesync"})
+
 # HVD1005: Timeline span-open calls in backend/ modules must be paired
 # with a finally-guarded close — an exception on the op path otherwise
 # leaves the span open and every later span on the lane nests wrongly
@@ -299,6 +313,9 @@ class _Analyzer(ast.NodeVisitor):
         self._in_serving_dir = bool(
             SERVING_DIRS
             & set(os.path.normpath(path).split(os.sep)[:-1]))
+        self._in_statesync_dir = bool(
+            STATESYNC_DIRS
+            & set(os.path.normpath(path).split(os.sep)[:-1]))
         # Depth of enclosing try-blocks whose finally contains a span
         # close, plus the linenos of span-open statements IMMEDIATELY
         # followed by such a try — the tree's idiom
@@ -338,11 +355,40 @@ class _Analyzer(ast.NodeVisitor):
 
     # --- functions ---------------------------------------------------------
     def _visit_function(self, node) -> None:
+        self._check_state_frame_reads(node)
         self._func_exits.append([])
         self._func_stack.append(node.name)
         self.generic_visit(node)
         self._func_stack.pop()
         self._func_exits.pop()
+
+    # --- HVD1007: unverified streamed-state reads in statesync/ -------------
+    def _check_state_frame_reads(self, node) -> None:
+        if not self._in_statesync_dir:
+            return
+        if node.name.lstrip("_") in STATE_CONSUME_NAMES:
+            return   # the consumption primitive itself: callers verify
+        consumes: list[ast.Call] = []
+        verified = False
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = _terminal_name(sub)
+            if name in STATE_CONSUME_NAMES:
+                consumes.append(sub)
+            elif name in STATE_VERIFY_NAMES:
+                verified = True
+        if verified:
+            return
+        for call in consumes:
+            self._report(
+                "unverified-state-frame", call,
+                f"'{_terminal_name(call)}' consumes streamed state in "
+                f"'{node.name}' with no digest/stamp verification call "
+                f"in scope: a torn or stale snapshot applied unverified "
+                f"silently diverges the joiner — verify_round/"
+                f"state_digest the image against its stamp first, or "
+                f"justify with a suppression")
 
     visit_FunctionDef = _visit_function
     visit_AsyncFunctionDef = _visit_function

@@ -114,6 +114,16 @@ _RULE_LIST = [
          "like an unbounded transport wait (HVD1003) — bound the queue, "
          "shed at the door, and pass timeouts derived from request "
          "deadlines."),
+    Rule("HVD1007", "unverified-state-frame",
+         "Streamed-state consumption (unflatten_state / a frame-payload "
+         "apply) in a statesync/ module inside a function with no "
+         "digest/stamp verification call in scope: bytes that crossed "
+         "the wire from a peer are only state after the FNV digest and "
+         "(epoch, step) stamp checked out — a torn or stale snapshot "
+         "applied unverified silently diverges the joiner from every "
+         "donor.  Verify first (JoinerPuller.verify_round / "
+         "state_digest against the stamp), or justify the read with a "
+         "suppression."),
     Rule("HVD1004", "per-segment-codec-loop",
          "compress/ codec call (quantize/dequantize/from_bytes/to_bytes) "
          "inside a loop in a backend/ module: the per-segment "

@@ -263,6 +263,76 @@ CHAOS = register(
     "send.  Empty (the default) installs nothing.  See "
     "docs/resilience.md for the grammar.")
 
+# --- Elastic state streaming (statesync/ subsystem; docs/statesync.md) ------
+STATESYNC = register(
+    "HOROVOD_STATESYNC", False, _parse_bool,
+    "Peer-to-peer live state streaming + the grow side of elasticity: "
+    "a per-step membership check (one tiny symmetric collective) lets "
+    "incumbents admit a joining rank at a step boundary, donate a "
+    "copy-on-write state snapshot from live peers (no checkpoint file, "
+    "no training pause), and rebuild the world one rank larger once the "
+    "joiner's streamed state digest-verifies.  Off (the default) adds "
+    "no collectives and no threads.")
+STATESYNC_CHUNK_BYTES = register(
+    "HOROVOD_STATESYNC_CHUNK_BYTES", 1 << 20, int,
+    "Chunk size of one streamed state frame (donor->joiner).  Chunks "
+    "are independently addressed (offset, length, crc), so a transfer "
+    "resumes at chunk granularity when a donor dies mid-stream.")
+STATESYNC_POLL_SECONDS = register(
+    "HOROVOD_STATESYNC_POLL_SECONDS", 0.1, float,
+    "Interval of the statesync watcher thread's rendezvous-KV polls "
+    "for join announcements / joiner-ready marks.")
+STATESYNC_TIMEOUT_SECONDS = register(
+    "HOROVOD_STATESYNC_TIMEOUT_SECONDS", 60.0, float,
+    "Deadline for one streaming round (mesh formation + transfer + "
+    "verify) on both the donor and joiner side; a round that exceeds "
+    "it is abandoned (the joiner re-announces, donors stand down).")
+PREEMPT_GRACE_SECONDS = register(
+    "HOROVOD_PREEMPT_GRACE_S", 0.0, float,
+    "Preemption-notice grace window: > 0 installs a SIGTERM handler "
+    "that lets the rank finish its in-flight step, announce an orderly "
+    "departure through the statesync membership check (survivors "
+    "shrink proactively — no RanksFailedError, no heartbeat deadline), "
+    "write its bye| liveness stamp and exit 0.  If no step boundary "
+    "arrives within the window, a backstop stamps bye|, dumps the "
+    "flight recorder and re-delivers the default SIGTERM disposition.  "
+    "0 (the default) keeps the stock SIGTERM behavior.")
+PREEMPT_DONATE = register(
+    "HOROVOD_PREEMPT_DONATE", True, _parse_bool,
+    "On an orderly preemption departure, fast-donate this rank's "
+    "ring-sharded (ZeRO) optimizer-state shard to the rendezvous KV so "
+    "survivors can re-shard without the departed rank (only when the "
+    "training loop registered a shard provider; see docs/statesync.md).")
+
+# --- Autoscale policy loop (statesync/autoscale.py) -------------------------
+AUTOSCALE = register(
+    "HOROVOD_AUTOSCALE", False, _parse_bool,
+    "Rank-0 autoscale controller thread: watches the straggler-lag / "
+    "queue-depth gauges (telemetry/) and the serving shed rate, and "
+    "drives the elastic driver's target world size up/down with "
+    "hysteresis.  Decisions are metrics + flight-recorder events.")
+AUTOSCALE_INTERVAL_SECONDS = register(
+    "HOROVOD_AUTOSCALE_INTERVAL_S", 5.0, float,
+    "Observation interval of the autoscale controller loop.")
+AUTOSCALE_UP_SHED_RATE = register(
+    "HOROVOD_AUTOSCALE_UP_SHED_RATE", 0.05, float,
+    "Scale up when the serving shed rate over one interval exceeds "
+    "this fraction (capacity, not deadline, is the binding constraint).")
+AUTOSCALE_UP_QUEUE_FRACTION = register(
+    "HOROVOD_AUTOSCALE_UP_QUEUE_FRACTION", 0.5, float,
+    "Scale up when queue depth exceeds this fraction of "
+    "HOROVOD_SERVE_QUEUE_DEPTH (or the configured depth limit).")
+AUTOSCALE_DOWN_LAG_MS = register(
+    "HOROVOD_AUTOSCALE_DOWN_LAG_MS", 50.0, float,
+    "Scale down when the coordinator straggler lag exceeds this many "
+    "ms while the queue is idle and nothing is shed: one dragging rank "
+    "costs more step time than its share of the work is worth.")
+AUTOSCALE_HYSTERESIS_ROUNDS = register(
+    "HOROVOD_AUTOSCALE_HYSTERESIS_ROUNDS", 3, int,
+    "Consecutive intervals a scale condition must hold before a "
+    "decision fires (and the cooldown after each decision), so one "
+    "burst never flaps the world size.")
+
 # --- Inference serving (serving/ subsystem; docs/serving.md) ----------------
 SERVE_MAX_BATCH = register(
     "HOROVOD_SERVE_MAX_BATCH", 8, int,

@@ -275,6 +275,14 @@ def init(*, rank: int | None = None, size: int | None = None,
         from . import telemetry as _telemetry
         _global.telemetry = _telemetry.configure(rank)
         _global.flight = _telemetry.flight.configure(rank)
+        if _global.telemetry.enabled:
+            # Every elastic transition re-inits, so the gauge tracks
+            # grow/shrink without statesync having to be loaded.
+            _global.telemetry.gauge(
+                "horovod_world_size",
+                "Live world size as seen by this rank's statesync "
+                "service (tracks every elastic grow/shrink transition)"
+            ).set(size)
         _global.rank, _global.size = rank, size
         _global.local_rank, _global.local_size = local_rank, local_size
         _global.cross_rank, _global.cross_size = cross_rank, cross_size
@@ -575,6 +583,21 @@ def shutdown() -> None:
     resilience.shutdown()   # stop the heartbeat monitor (if any)
     from .parallel import multihost
     multihost.shutdown_jax_distributed()
+
+
+def reinit_world(*, rank: int, size: int, epoch: str) -> None:
+    """Tear the world down and re-form it under a new rendezvous epoch
+    with a (possibly) different rank/size — the elastic transition
+    primitive shared by the serving shrink path (serving/replica.py)
+    and the statesync grow/preemption transitions (statesync/service.py).
+    Every mesh/shm/heartbeat scope keys on the epoch, so no stale state
+    from the previous membership is ever touched; the env writes make
+    the new identity survive any later env-driven re-init."""
+    shutdown()
+    os.environ["HOROVOD_RENDEZVOUS_EPOCH"] = epoch
+    os.environ["HOROVOD_RANK"] = str(rank)
+    os.environ["HOROVOD_SIZE"] = str(size)
+    init()
 
 
 def is_initialized() -> bool:

@@ -24,11 +24,12 @@ from . import chaos
 from .context import (ResilienceState, active_state, configure, current_op,
                       current_op_deadline, deadline_scope, op_scope,
                       pending_deadline, shutdown)
-from .policy import apply_shrink, rebuild_world, run_with_recovery
+from .policy import (apply_shrink, converge_confirmed_dead, rebuild_world,
+                     run_with_recovery)
 
 __all__ = [
     "RanksFailedError", "ResilienceState", "active_state", "apply_shrink",
-    "chaos", "configure", "current_op", "current_op_deadline",
-    "deadline_scope", "op_scope", "pending_deadline", "rebuild_world",
-    "run_with_recovery", "shutdown",
+    "chaos", "configure", "converge_confirmed_dead", "current_op",
+    "current_op_deadline", "deadline_scope", "op_scope",
+    "pending_deadline", "rebuild_world", "run_with_recovery", "shutdown",
 ]

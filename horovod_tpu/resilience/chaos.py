@@ -17,7 +17,13 @@ Response-level actions (fired by the background loop before dispatch;
 - ``freeze:rank=1,op=3,ms=5000``       — sleep mid-collective;
 - ``fail:op=4[,rank=*][,count=2]``     — convert the response to a
   structured ERROR before any byte moves (rank ``*`` makes the failure
-  symmetric on every rank — the retriable case).
+  symmetric on every rank — the retriable case);
+- ``preempt:rank=2,op=7``              — deliver SIGTERM (NOT SIGKILL)
+  to self at the global collective index and keep running: the
+  preemption-notice grace path (HOROVOD_PREEMPT_GRACE_S) is then
+  testable under the same deterministic harness as a kill.  Like every
+  spec, ``rank=`` names the LAUNCH-TIME rank, so a survivor renumbered
+  by an earlier shrink never inherits another rank's preemption.
 
 Send-level actions (fired by ``PeerMesh`` at enqueue; ``send=`` is the
 per-(mesh-scope, peer) send index, ``mesh=`` a scope prefix like
@@ -44,9 +50,10 @@ from ..common.logging import logger
 __all__ = ["ChaosAction", "ChaosEngine", "ChaosInjectedError", "active",
            "configure", "parse_spec"]
 
-_RESPONSE_KINDS = frozenset({"kill", "freeze", "fail"})
+_RESPONSE_KINDS = frozenset({"kill", "freeze", "fail", "preempt"})
 _SEND_KINDS = frozenset({"delay", "drop", "dup"})
-_DEFAULT_COUNTS = {"fail": 1, "delay": 1, "drop": 1, "dup": 1}
+_DEFAULT_COUNTS = {"fail": 1, "preempt": 1, "delay": 1, "drop": 1,
+                   "dup": 1}
 
 
 class ChaosInjectedError(RuntimeError):
@@ -182,6 +189,15 @@ class ChaosEngine:
                     os.kill(os.getpid(), act.sig)
                     time.sleep(5.0)   # SIGKILL lands before this expires
                 os._exit(act.exit_code)
+            elif act.kind == "preempt":
+                logger.warning("chaos: preempting rank %d at collective "
+                               "%d (SIGTERM)", self.rank, idx)
+                import os
+                import signal
+                os.kill(os.getpid(), signal.SIGTERM)
+                # NOT followed by an exit: the grace path owns the
+                # departure; without a grace handler the default
+                # disposition (or flight's chained handler) fires.
             elif act.kind == "freeze":
                 logger.warning("chaos: freezing rank %d at collective %d "
                                "for %.0f ms", self.rank, idx, act.ms)

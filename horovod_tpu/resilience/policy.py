@@ -31,7 +31,8 @@ from ..common.exceptions import HorovodInternalError, RanksFailedError
 from ..common.logging import logger
 from . import context as _context
 
-__all__ = ["apply_shrink", "rebuild_world", "run_with_recovery"]
+__all__ = ["apply_shrink", "converge_confirmed_dead", "rebuild_world",
+           "run_with_recovery"]
 
 # Attempts taken by the most recent run_with_recovery call (observability
 # for tests and post-mortems; single-threaded write from the caller).
@@ -106,6 +107,41 @@ def run_with_recovery(fn, *, policy: str | None = None,
             time.sleep(delay)
             attempt += 1
             rebuild_world(attempt)
+
+
+def converge_confirmed_dead(exc: RanksFailedError) -> frozenset[int]:
+    """Converge on the heartbeat-CONFIRMED dead set after a collective
+    raised RanksFailedError: every survivor must compute the same
+    membership before any of them renumbers the world, and suspicion
+    alone (a slow-but-alive peer) must never shrink it — an
+    unconfirmable failure re-raises ``exc`` instead.
+
+    Shared by the serving shrink path (serving/replica.py) and the
+    statesync failure-shrink transition (statesync/service.py): both
+    poll the liveness monitor until the confirmed set is stable across
+    two polls, bounded by two fault windows."""
+    from . import context as _ctx
+
+    state = _ctx.active_state()
+    if state is None:
+        raise exc
+    suspects = set(exc.failed_ranks)
+    deadline = time.monotonic() + 2.0 * state.fault_timeout
+    confirmed: frozenset[int] = frozenset()
+    while time.monotonic() < deadline:
+        try:
+            state.monitor.poll_once()
+        except Exception:  # noqa: BLE001 - convergence must not mask
+            pass
+        suspects |= state.failed_ranks()
+        now_confirmed = state.confirmed_dead(suspects)
+        if now_confirmed and now_confirmed == confirmed:
+            return confirmed           # stable across two polls
+        confirmed = now_confirmed
+        time.sleep(state.poll_interval)
+    if confirmed:
+        return confirmed
+    raise exc                          # alive-but-wedged: not shrinkable
 
 
 def apply_shrink(driver, failed_ranks) -> dict[int, str]:

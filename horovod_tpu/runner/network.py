@@ -258,6 +258,15 @@ class RendezvousClient:
                 return None
             raise
 
+    def delete(self, scope: str, key: str = "") -> None:
+        """Delete one key (or a whole scope when ``key`` is empty) —
+        statesync consumes its join/ready/donation marks so a later
+        epoch's watcher never replays a resolved event."""
+        req = urlrequest.Request(f"{self._base}/{scope}/{key}",
+                                 method="DELETE")
+        with urlrequest.urlopen(req, timeout=self.timeout):
+            pass
+
     def wait(self, scope: str, key: str,
              timeout: float | None = None) -> bytes:
         deadline = time.monotonic() + (timeout or self.timeout)

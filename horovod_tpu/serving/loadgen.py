@@ -101,7 +101,9 @@ def build_report(executor: ReplicaExecutor, *, offered: int,
         "world": {"size": executor.size,
                   "replica_groups": executor.num_groups,
                   "group_size": executor.group_size,
-                  "shrinks": stats["shrinks"]},
+                  "shrinks": stats["shrinks"],
+                  "grows": stats["grows"]},
+        "goodput_phases": _goodput_phases(executor, wall_s),
         "config": args_echo,
         "offered": offered,
         "served": served,
@@ -131,6 +133,33 @@ def build_report(executor: ReplicaExecutor, *, offered: int,
     return report
 
 
+def _goodput_phases(executor: ReplicaExecutor,
+                    wall_s: float) -> dict | None:
+    """Goodput (served/s) before, during and after the FIRST elastic
+    grow — the number that shows incumbents kept serving through the
+    catch-up (docs/statesync.md).  None when no grow happened."""
+    grows = executor.stats["grows"]
+    done = executor.stats["completed_at"]
+    if not grows or wall_s <= 0:
+        return None
+    g = grows[0]
+    t1 = g["at"]                       # grow transition completed
+    t0 = t1 - max(g.get("window_s", 0.0), 1e-9)   # donation started
+    start = min(done + [t0])
+    end = max(done + [t1])
+
+    def rate(lo: float, hi: float) -> float:
+        span = hi - lo
+        if span <= 0:
+            return 0.0
+        return sum(1 for t in done if lo <= t < hi) / span
+
+    return {"before_rps": rate(start, t0),
+            "during_rps": rate(t0, t1),
+            "after_rps": rate(t1, end + 1e-9),
+            "window_s": t1 - t0}
+
+
 def _registry_snapshot(executor: ReplicaExecutor) -> dict:
     from .. import telemetry
     return telemetry.metrics().snapshot()
@@ -155,6 +184,17 @@ def run(args: argparse.Namespace) -> dict:
     if args.slo_ms:
         overrides["slo_ms"] = args.slo_ms
     executor = ReplicaExecutor(ServeConfig.from_env(**overrides))
+    statesync_service = None
+    if config.STATESYNC.get():
+        # Elastic grow mid-serve (docs/statesync.md): every serve step
+        # ends with the membership check, so a joining replica
+        # (serving/replica.py join_serving_world) can enter while this
+        # harness drives traffic — the report's world.grows and
+        # goodput_phases record the transition.
+        from .. import statesync
+        statesync_service = statesync.StateSyncService(
+            state_provider=executor.state_tree, static_state=True)
+        executor.attach_statesync(statesync_service)
     done = threading.Event()
     t0 = time.monotonic()
     if executor.rank == executor.front:
@@ -184,6 +224,8 @@ def run(args: argparse.Namespace) -> dict:
                           ("served", "shed", "expired", "goodput_rps",
                            "latency_ms", "world")}, sort_keys=True))
         print(f"loadgen: report written to {path}")
+    if statesync_service is not None:
+        statesync_service.close()
     hvd.shutdown()
     return report
 

@@ -134,7 +134,12 @@ class ReplicaExecutor:
         self._unreported: list[dict] = []
         self.stats = {"offered": 0, "expired": 0, "served": 0,
                       "served_slo": 0, "lost": 0,
-                      "latencies_ms": [], "shrinks": []}
+                      "latencies_ms": [], "completed_at": [],
+                      "shrinks": [], "grows": []}
+        # Elastic grow mid-serve (statesync/): attach_statesync wires a
+        # membership service in; None = the pre-ISSUE-10 behavior with
+        # zero extra collectives.
+        self.statesync = None
 
         self.queue = RequestQueue(maxsize=self.cfg.queue_depth,
                                   default_slo_ms=self.cfg.slo_ms)
@@ -294,6 +299,7 @@ class ReplicaExecutor:
     def _account(self, completions: list[dict]) -> None:
         if self.rank != self.front:
             return
+        now = time.monotonic()
         for rec in completions:
             if rec["rid"] not in self.batcher.inflight:
                 continue   # duplicate re-send after a failed exchange
@@ -303,6 +309,64 @@ class ReplicaExecutor:
             self.stats["served"] += 1
             self.stats["served_slo"] += bool(rec["slo_met"])
             self.stats["latencies_ms"].append(rec["latency_ms"])
+            # Completion wall times let the load harness report goodput
+            # before/during/after an elastic grow (docs/serving.md).
+            self.stats["completed_at"].append(now)
+
+    # -- elastic grow mid-serve (statesync/) -----------------------------
+    def attach_statesync(self, service) -> None:
+        """Wire a statesync membership service in: every serve step ends
+        with its boundary check, so a joining replica is admitted at a
+        step boundary and enters after its streamed params verify."""
+        self.statesync = service
+
+    def state_tree(self) -> dict:
+        """The streamed-state template/provider for serving: params are
+        the only cross-replica state (KV caches are per-request), and
+        they never change between steps — the statesync service runs in
+        static mode, so the bulk image IS the joiner's entry state."""
+        import jax
+
+        return {"params": jax.tree_util.tree_map(np.asarray,
+                                                 self.params)}
+
+    def _statesync_boundary(self) -> None:
+        change = self.statesync.step_boundary()
+        if change is not None and change.kind == "grow":
+            self._grow_resync(change.join_id, change.rank, change.size)
+
+    def _grow_resync(self, join_id: int, new_rank: int,
+                     new_size: int) -> None:
+        """Realign the serving world after a grow: every rank (the
+        joiner included — this is its first collective) exchanges
+        (step, gen, resident rids), adopts the maxima, and rebuilds the
+        batcher with the new replica group present but empty.  Nothing
+        in flight is touched: incumbents' KV caches are process-local."""
+        old_size = self.size
+        self.rank, self.size = new_rank, new_size
+        self.front = 0
+        self._configure_groups()
+        mine = {"step": self._step, "gen": self._gen,
+                "rids": (sorted(s.rid for s in self.slots
+                                if s is not None)
+                         if self.group_leader else [])}
+        per_rank = self.hvd.allgather_object(
+            mine, name=f"serve.growsync.{join_id}")
+        self._step = max(p["step"] for p in per_rank)
+        # Fresh gen: post-grow collective names never collide with any
+        # pre-grow step another rank might still have cached.
+        self._gen = max(p["gen"] for p in per_rank) + 1
+        per_group = [per_rank[g * self.group_size]["rids"]
+                     for g in range(self.num_groups)]
+        self.batcher.rebuild(per_group)
+        windows = getattr(self.statesync, "grow_windows", [])
+        self.stats["grows"].append(
+            {"join": join_id, "from": old_size, "to": new_size,
+             "step": self._step, "at": time.monotonic(),
+             "window_s": windows[-1][1] - windows[-1][0]
+             if windows else 0.0})
+        logger.warning("serving: grow %d->%d (join %d) at step %d",
+                       old_size, new_size, join_id, self._step)
 
     # -- the loop --------------------------------------------------------
     def _serve_step(self) -> bool:
@@ -317,6 +381,8 @@ class ReplicaExecutor:
         self._collect_completions()
         completions = self._exchange_completions()
         self._account(completions)
+        if self.statesync is not None:
+            self._statesync_boundary()
         self.admission.observe_step_ms((time.monotonic() - t0) * 1e3)
         return True
 
@@ -341,36 +407,15 @@ class ReplicaExecutor:
                 self._shrink_and_resume(exc)
 
     # -- elastic shrink --------------------------------------------------
-    def _confirmed_dead(self, exc: RanksFailedError) -> frozenset[int]:
-        """Converge on the heartbeat-CONFIRMED dead set: every survivor
-        must compute the same membership, and suspicion alone (a slow
-        peer) must never shrink the world — an unconfirmable failure
-        re-raises instead."""
-        from ..resilience import active_state
-        state = active_state()
-        if state is None:
-            raise exc
-        suspects = set(exc.failed_ranks)
-        deadline = time.monotonic() + 2.0 * state.fault_timeout
-        confirmed: frozenset[int] = frozenset()
-        while time.monotonic() < deadline:
-            try:
-                state.monitor.poll_once()
-            except Exception:  # noqa: BLE001 - convergence must not mask
-                pass
-            suspects |= state.failed_ranks()
-            now_confirmed = state.confirmed_dead(suspects)
-            if now_confirmed and now_confirmed == confirmed:
-                return confirmed       # stable across two polls
-            confirmed = now_confirmed
-            time.sleep(state.poll_interval)
-        if confirmed:
-            return confirmed
-        raise exc                      # alive-but-wedged: not shrinkable
-
     def _shrink_and_resume(self, exc: RanksFailedError) -> None:
         from .. import core
-        dead = self._confirmed_dead(exc)
+        from ..resilience import converge_confirmed_dead
+
+        # Converge on the heartbeat-CONFIRMED dead set (shared with the
+        # statesync failure-shrink path, resilience/policy.py): every
+        # survivor computes the same membership, and suspicion alone (a
+        # slow peer) re-raises instead of shrinking.
+        dead = converge_confirmed_dead(exc)
         survivors = [r for r in range(self.size) if r not in dead]
         new_rank = survivors.index(self.rank)
         new_size = len(survivors)
@@ -380,16 +425,15 @@ class ReplicaExecutor:
         base = os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", "0")
         self._gen += 1
         tag = "_".join(str(r) for r in sorted(dead))
-        core.shutdown()
-        os.environ["HOROVOD_RENDEZVOUS_EPOCH"] = \
-            f"{base.split('~', 1)[0]}~sv{self._gen}x{tag}"
-        os.environ["HOROVOD_RANK"] = str(new_rank)
-        os.environ["HOROVOD_SIZE"] = str(new_size)
-        core.init()
+        core.reinit_world(
+            rank=new_rank, size=new_size,
+            epoch=f"{base.split('~', 1)[0]}~sv{self._gen}x{tag}")
         old = (self.rank, self.size)
         self.rank, self.size = new_rank, new_size
         self.front = 0
         self._configure_groups()
+        if self.statesync is not None:
+            self.statesync.notify_world_changed()
         self._resync()
         self.stats["shrinks"].append(
             {"dead": sorted(dead), "from": old[1], "to": new_size,
@@ -428,3 +472,45 @@ class ReplicaExecutor:
 
     def request_stop(self) -> None:
         self._stop_requested = True
+
+
+def serving_params_template(cfg: ServeConfig) -> dict:
+    """The state tree a serving joiner offers to ``join_world``: the
+    model's parameter pytree (shapes/dtypes only matter — values are
+    replaced by the streamed image)."""
+    import horovod_tpu  # noqa: F401 - jax config side effects
+
+    model_cfg = cfg.model_cfg
+    if model_cfg is None:
+        model_cfg = tfm.gpt_tiny(dtype=jnp.float32)
+    model_cfg = dataclasses.replace(model_cfg, decode=True,
+                                    max_seq_len=cfg.max_seq)
+    model = tfm.TransformerLM(model_cfg)
+    params = model.init(jax.random.PRNGKey(cfg.seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return {"params": jax.tree_util.tree_map(np.asarray, params)}
+
+
+def join_serving_world(serve_cfg: ServeConfig | None = None
+                       ) -> "ReplicaExecutor":
+    """Join a live serving world as a fresh replica (statesync grow):
+    stream the incumbents' params peer-to-peer, enter as rank N, and
+    return a ReplicaExecutor already realigned (step/gen/batcher) and
+    ready for ``serve_loop``.  The incumbents' only stall is this
+    rank's executor construction (model compile) between world rebuild
+    and the first realign exchange — the bulk params transfer happened
+    before they rebuilt anything."""
+    from .. import statesync
+
+    cfg = serve_cfg or ServeConfig.from_env()
+    template = serving_params_template(cfg)
+    tree, info = statesync.join_world(template)
+    params = jax.tree_util.tree_map(jnp.asarray, tree["params"])
+    ex = ReplicaExecutor(cfg, params=params)
+    service = statesync.StateSyncService(state_provider=ex.state_tree,
+                                         static_state=True)
+    ex.attach_statesync(service)
+    # First collective on the new world: adopt the incumbents'
+    # step/gen and announce this (empty) replica group.
+    ex._grow_resync(info.join_id, info.rank, info.size)
+    return ex

@@ -1,0 +1,49 @@
+"""statesync/ — zero-downtime elastic world grow: peer-to-peer live
+state streaming, preemption grace, and the autoscale policy loop
+(ISSUE 10; ROADMAP item 4; docs/statesync.md).
+
+The missing half of elasticity: PR 5 proved the world can shrink past a
+dead rank; this subsystem grows it back — and turns a preemption notice
+into an orderly, failure-free departure — without a checkpoint file and
+without incumbents failing a single step.
+
+Module surface:
+
+- :class:`~.service.StateSyncService` — one rank's membership agent:
+  ``step_boundary()`` runs the per-step membership check (join
+  admission → copy-on-write snapshot + donor thread, joiner-ready →
+  grow transition, SIGTERM grace → proactive shrink), and
+  ``shrink_on_failure()`` packages PR 5's confirmed-dead shrink.
+- :func:`~.service.join_world` — the joiner side: announce, pull the
+  bulk snapshot from every live donor (disjoint shards, chunked,
+  resumable across a donor death, FNV-digest-verified), pull the final
+  boundary image while the incumbents rebuild channels, enter as
+  rank N.
+- :mod:`.snapshot` — flat state images, stamps/digests, ring-shard
+  (ZeRO) re-layout math shared with checkpoint.py.
+- :mod:`.stream` — the donor/joiner streaming protocol over PR 3
+  persistent duplex channels (``tcp_transport`` state-frame verb).
+- :mod:`.autoscale` — the rank-0 policy loop driving the elastic
+  driver's target world size from telemetry, with hysteresis.
+"""
+from __future__ import annotations
+
+from .autoscale import (AutoscaleController, AutoscaleDecision,
+                        AutoscalePolicy, registry_source)
+from .service import (JoinInfo, StateSyncService, WorldChange,
+                      fetch_donation, join_world, resync_replicated)
+from .snapshot import (Snapshot, SnapshotStamp, concat_ring_shards,
+                       flatten_state, reshard_ring_state, shard_for_rank,
+                       state_digest, unflatten_state)
+from .stream import (DonorLostError, DonorServer, JoinerPuller,
+                     StreamError, TornSnapshotError)
+
+__all__ = [
+    "AutoscaleController", "AutoscaleDecision", "AutoscalePolicy",
+    "DonorLostError", "DonorServer", "JoinInfo", "JoinerPuller",
+    "Snapshot", "SnapshotStamp", "StateSyncService", "StreamError",
+    "TornSnapshotError", "WorldChange", "concat_ring_shards",
+    "fetch_donation", "flatten_state", "join_world", "registry_source",
+    "reshard_ring_state", "resync_replicated", "shard_for_rank",
+    "state_digest", "unflatten_state",
+]

@@ -1,0 +1,227 @@
+"""Copy-on-write state snapshots: one flat byte image of the training
+state, stamped for integrity, plus the ring-shard (ZeRO) re-layout math.
+
+A donor never streams live tensors: at a step boundary the service takes
+a :class:`Snapshot` — a single contiguous copy of the provider's pytree
+(the copy IS the copy-on-write: training mutates the live arrays freely
+while donor threads stream the frozen image).  The snapshot is stamped
+with ``(epoch, step, digest, nbytes)``; the digest is an FNV-1a 64-bit
+fold over per-block CRCs (block size 64 KiB — the FNV fold keeps the
+stamp one word, the C-speed CRC inner loop keeps multi-MB states cheap
+to stamp).  A joiner rejects any assembly whose donors disagree on the
+stamp (torn snapshot: donors cut at different steps) or whose assembled
+bytes do not reproduce the digest (corrupt or stale transfer).
+
+Flattening reuses the ``grad_sync`` discipline: ``jax.tree_util``
+leaf order (deterministic for dicts), leaves laid out back to back in
+their own dtypes.  The template-driven :func:`unflatten_state` is the
+only read surface for streamed bytes — hvdlint HVD1007 flags statesync
+code that consumes a frame payload without a verify call in scope.
+
+Ring-shard math: :func:`reshard_ring_state` re-cuts PR 6's
+optimizer-in-ring (ZeRO) shard layout for a new world size — the
+checkpoint round-trip (checkpoint.py) and the joiner's post-entry state
+layout both use it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Any
+
+import numpy as np
+
+# FNV-1a 64-bit (the fingerprint subsystem's constants,
+# analysis/fingerprint.py — one digest family across the tree).
+_FNV_OFFSET = 0xcbf29ce484222325
+_FNV_PRIME = 0x100000001b3
+_MASK = (1 << 64) - 1
+_DIGEST_BLOCK = 64 * 1024
+
+
+def fnv1a_fold(data: bytes, h: int = _FNV_OFFSET) -> int:
+    """Plain FNV-1a 64 over ``data`` (stamp-sized inputs only)."""
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & _MASK
+    return h
+
+
+def state_digest(view) -> int:
+    """FNV-1a 64-bit fold over per-64KiB-block CRC32s of ``view``.
+
+    The outer fold is byte-for-byte FNV-1a (over the 4-byte big-endian
+    block CRCs), so the stamp stays one 64-bit word and any single-bit
+    flip anywhere in the image changes it; the inner CRC loop runs in C
+    (zlib), so stamping a multi-MB optimizer state costs milliseconds,
+    not the seconds a pure-Python FNV over every byte would."""
+    mv = memoryview(view)
+    if mv.format != "B" or mv.ndim != 1:
+        mv = mv.cast("B")
+    h = _FNV_OFFSET
+    for off in range(0, mv.nbytes, _DIGEST_BLOCK):
+        crc = zlib.crc32(mv[off:off + _DIGEST_BLOCK])
+        h = fnv1a_fold(crc.to_bytes(4, "big"), h)
+    return h
+
+
+@dataclasses.dataclass(frozen=True)
+class SnapshotStamp:
+    """Integrity stamp every donor attaches to its META frame and the
+    joiner verifies before any streamed byte is interpreted."""
+    epoch: str
+    step: int
+    digest: int
+    nbytes: int
+
+    def as_meta(self) -> dict:
+        return {"epoch": self.epoch, "step": self.step,
+                "digest": self.digest, "nbytes": self.nbytes}
+
+    @classmethod
+    def from_meta(cls, meta: dict) -> "SnapshotStamp":
+        return cls(epoch=str(meta["epoch"]), step=int(meta["step"]),
+                   digest=int(meta["digest"]), nbytes=int(meta["nbytes"]))
+
+
+def _leaves(tree: Any) -> list:
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def state_nbytes(tree: Any) -> int:
+    return sum(np.asarray(leaf).nbytes for leaf in _leaves(tree))
+
+
+def flatten_state(tree: Any) -> bytearray:
+    """One contiguous byte image of the pytree's leaves in
+    ``jax.tree_util`` order, each leaf in its own dtype.  The returned
+    buffer is a COPY — the caller's live arrays are never aliased, which
+    is what lets donors stream while training keeps mutating."""
+    out = bytearray(state_nbytes(tree))
+    view = memoryview(out)
+    offset = 0
+    for leaf in _leaves(tree):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        n = arr.nbytes
+        view[offset:offset + n] = arr.view(np.uint8).reshape(-1).data
+        offset += n
+    return out
+
+
+def unflatten_state(buf, template: Any) -> Any:
+    """Rebuild a pytree shaped like ``template`` from a flat byte image
+    (the inverse of :func:`flatten_state`).  Every caller must have
+    digest-verified ``buf`` first — see HVD1007."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    view = memoryview(buf)
+    if view.format != "B" or view.ndim != 1:
+        view = view.cast("B")
+    total = sum(np.asarray(leaf).nbytes for leaf in leaves)
+    if view.nbytes != total:
+        raise ValueError(
+            f"state image is {view.nbytes} bytes but the template "
+            f"flattens to {total}; the streamed state does not match "
+            f"this rank's model")
+    out = []
+    offset = 0
+    for leaf in leaves:
+        ref = np.asarray(leaf)
+        n = ref.nbytes
+        arr = np.frombuffer(view[offset:offset + n],
+                            dtype=ref.dtype).reshape(ref.shape).copy()
+        out.append(arr)
+        offset += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class Snapshot:
+    """A frozen, stamped state image taken at one step boundary."""
+
+    def __init__(self, tree: Any, epoch: str, step: int) -> None:
+        self.data = flatten_state(tree)
+        self.stamp = SnapshotStamp(epoch=epoch, step=int(step),
+                                   digest=state_digest(self.data),
+                                   nbytes=len(self.data))
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+
+# ---------------------------------------------------------------------------
+# Ring-shard (ZeRO) re-layout (PR 6 sync_and_apply shard discipline)
+# ---------------------------------------------------------------------------
+def ring_chunk(n_params: int, world: int, config=None) -> int:
+    """Per-rank flat shard length for a given world size — delegates to
+    grad_sync.ring_chunk_size so checkpoint/statesync and the live
+    optimizer-in-ring path can never disagree on the layout."""
+    from ..parallel.grad_sync import GradSyncConfig, ring_chunk_size
+
+    return ring_chunk_size(n_params, world,
+                           config if config is not None
+                           else GradSyncConfig())
+
+
+def concat_ring_shards(shards: list, n_params: int) -> np.ndarray:
+    """Concatenate per-rank 1-D shard arrays back into the unpadded
+    flat buffer (drops the world x chunk padding tail)."""
+    full = np.concatenate([np.asarray(s).reshape(-1) for s in shards])
+    if full.size < n_params:
+        raise ValueError(
+            f"shards cover {full.size} elements < n_params={n_params}")
+    return full[:n_params]
+
+
+def shard_for_rank(full: np.ndarray, n_params: int, world: int,
+                   rank: int, config=None) -> np.ndarray:
+    """Rank ``rank``'s shard of the flat buffer under the ``world``-way
+    ring layout (zero-padded tail on the last shard, exactly like
+    sync_and_apply's padded reduce-scatter)."""
+    chunk = ring_chunk(n_params, world, config)
+    padded = np.zeros(chunk * world, dtype=full.dtype)
+    padded[:n_params] = np.asarray(full).reshape(-1)[:n_params]
+    return padded[rank * chunk:(rank + 1) * chunk].copy()
+
+
+def reshard_ring_state(shards: list, n_params: int, new_world: int,
+                       new_rank: int, config=None) -> Any:
+    """Re-cut a full set of per-rank optimizer-state shard pytrees
+    (old world = ``len(shards)``) into ``new_rank``'s shard for a
+    ``new_world``-way layout.
+
+    Array leaves whose first dimension equals the OLD chunk length are
+    ring-sharded state (adam's m/v, master params): their per-rank
+    pieces concatenate to the full flat buffer, which is re-padded and
+    re-sliced for the new layout.  Everything else (step counters,
+    scalar hyperparameters) is replicated state: taken from shard 0 and
+    asserted identical across shards."""
+    import jax
+
+    old_world = len(shards)
+    if old_world == 0:
+        raise ValueError("need at least one shard to reshard")
+    chunk_old = ring_chunk(n_params, old_world, config)
+    leaves_by_rank = [jax.tree_util.tree_flatten(s) for s in shards]
+    treedef = leaves_by_rank[0][1]
+    for _, td in leaves_by_rank[1:]:
+        if td != treedef:
+            raise ValueError("shard pytrees disagree on structure")
+    out = []
+    for i, leaf0 in enumerate(leaves_by_rank[0][0]):
+        ref = np.asarray(leaf0)
+        if ref.ndim >= 1 and ref.shape[0] == chunk_old:
+            full = concat_ring_shards(
+                [lv[0][i] for lv in leaves_by_rank], n_params)
+            out.append(shard_for_rank(full, n_params, new_world,
+                                      new_rank, config))
+        else:
+            for lv, _ in leaves_by_rank[1:]:
+                if not np.array_equal(np.asarray(lv[i]), ref):
+                    raise ValueError(
+                        "replicated optimizer-state leaf differs "
+                        "across shards (index %d); the shard files are "
+                        "from different steps" % i)
+            out.append(ref.copy())
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -127,8 +127,13 @@ class FlightRecorder:
                 "events": self.snapshot(),
             }
             try:
-                with open(self.path, "w") as f:  # hvdlint: disable=HVD1002 -- failure-path dump: runs only when a structured failure already fired, never during healthy dispatch
+                # Write-then-rename: a concurrent reader (another
+                # thread's conversion, a test, an operator tailing the
+                # evidence) never sees a half-written dump.
+                tmp = f"{self.path}.tmp{os.getpid()}"
+                with open(tmp, "w") as f:  # hvdlint: disable=HVD1002 -- failure-path dump: runs only when a structured failure already fired, never during healthy dispatch
                     json.dump(payload, f, indent=1)
+                os.replace(tmp, self.path)
             except OSError as exc:
                 logger.warning("flight: dump to %s failed: %s",
                                self.path, exc)

@@ -1,0 +1,38 @@
+"""Seeded HVD1007 violations: streamed-state reads that bypass the
+digest/epoch verification (and the clean forms that pass)."""
+import numpy as np
+
+
+def unflatten_state(buf, template):   # consumption primitive: exempt
+    return np.frombuffer(buf, dtype=np.float32)
+
+
+def apply_streamed_state(image, template):
+    # BAD: the image came off the wire and nothing verified it.
+    return unflatten_state(image, template)          # <- HVD1007
+
+
+def apply_chunk_blind(frame, image):
+    # BAD: payload written into live state without a stamp check.
+    consume_payload(frame, image)                    # <- HVD1007
+
+
+def consume_payload(frame, image):   # primitive: exempt by name
+    image[frame["o"]:frame["o"] + frame["n"]] = frame["payload"]
+
+
+def apply_verified_state(image, stamp, template):
+    # OK: digest checked in the same scope before the read.
+    if state_digest(image) != stamp.digest:
+        raise ValueError("stale or torn snapshot rejected")
+    return unflatten_state(image, template)
+
+
+def pull_and_apply(puller, template):
+    # OK: pull_round digest-verifies before returning.
+    image, _stamp = puller.pull_round(0)
+    return unflatten_state(image, template)
+
+
+def state_digest(image):
+    return 0

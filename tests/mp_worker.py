@@ -1338,7 +1338,20 @@ def battery_resilience_kill(hvd, rank, size):
         rec = _flight.recorder()
         assert rec.enabled and rec.dumps >= 1, \
             (rec.enabled, getattr(rec, "dumps", None))
-        payload = _json.load(open(rec.last_dump_path))
+        # Another failure conversion (controller poison + data plane
+        # both dump) may still be REWRITING the file when this thread
+        # reads it — retry briefly instead of decoding a half-written
+        # dump (a rare but real tier-1 flake).
+        for _ in range(40):
+            try:
+                payload = _json.load(open(rec.last_dump_path))
+                break
+            except ValueError:
+                _time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"flight dump at {rec.last_dump_path} never became "
+                f"valid JSON")
         assert payload["rank"] == rank
         events = payload["events"]
         kinds = [ev["kind"] for ev in events]
@@ -2022,6 +2035,331 @@ def battery_serving(hvd, rank, size):
     hvd.barrier()
 
 
+def _statesync_state(n=1 << 18):
+    """Deterministic replicated training state: params/opt evolve by the
+    (identical-on-every-rank) allreduce output, so donors' snapshots are
+    coherent and digests comparable."""
+    return {"params": np.zeros(n, np.float32),
+            "opt": np.zeros(n, np.float32),
+            "step": np.zeros((), np.int64)}
+
+
+def _statesync_train_step(hvd, state):
+    """One lockstep training step; returns the reduced output after
+    applying the deterministic symmetric update."""
+    n = state["params"].size
+    my = np.full(n, float(hvd.rank() + 1), np.float32)
+    out = hvd.allreduce(my, op=hvd.Sum,
+                        name=f"sst.train.{int(state['step'])}")
+    expected = hvd.size() * (hvd.size() + 1) / 2.0
+    np.testing.assert_allclose(out[:8], np.full(8, expected))
+    state["params"] += 0.01 * out
+    state["opt"] += out * out
+    state["step"] += 1
+    return out
+
+
+def _statesync_digest_check(hvd, state):
+    """Every rank's state must be bit-identical after a grow."""
+    from horovod_tpu import statesync
+
+    digest = statesync.state_digest(statesync.flatten_state(state))
+    views = hvd.allgather_object(digest,
+                                 name=f"sst.digest.{int(state['step'])}")
+    assert len(set(views)) == 1, f"post-grow state divergence: {views}"
+    return digest
+
+
+def battery_statesync_grow(hvd, rank, size):
+    """ISSUE 10 acceptance (4-rank, rides 4->3->4): chaos SIGKILLs rank
+    2 mid-training; survivors shrink with zero failed steps after the
+    conversion, then launch-rank 0 spawns a replacement process that
+    joins via peer state streaming — incumbents never fail a step while
+    it catches up, and after the grow every rank's state is
+    bit-identical (digest-exchanged in-battery)."""
+    import subprocess as _subprocess
+    import sys as _sys
+    import time as _time
+
+    from horovod_tpu import statesync
+
+    state = _statesync_state()
+    svc = statesync.StateSyncService(lambda: state)
+    shrunk = grown = False
+    stop_at = None
+    joiner_proc = None
+    launch_rank = rank
+    deadline = _time.monotonic() + 150.0
+    while _time.monotonic() < deadline:
+        try:
+            _statesync_train_step(hvd, state)
+            change = svc.step_boundary()
+        except hvd.RanksFailedError as exc:
+            assert not shrunk, f"step failed AFTER the shrink: {exc}"
+            change = svc.shrink_on_failure(exc)
+        if change is not None and change.kind == "shrink":
+            shrunk = True
+            assert hvd.size() == size - 1, hvd.size()
+            assert 2 in change.dead, change
+            # Realign replicated state: survivors may have caught the
+            # kill on different steps (one applied the last update, one
+            # did not) — the most-advanced rank is the authority.
+            state = statesync.resync_replicated(state,
+                                                int(state["step"]))
+            if hvd.rank() == 0:
+                env = dict(os.environ)
+                for k in ("HOROVOD_CHAOS", "HOROVOD_RANK",
+                          "HOROVOD_SIZE"):
+                    env.pop(k, None)
+                joiner_proc = _subprocess.Popen(
+                    [_sys.executable, os.path.abspath(__file__),
+                     "0", "0",
+                     os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"],
+                     "statesync_joiner"],
+                    env=env, stdout=_subprocess.PIPE,
+                    stderr=_subprocess.STDOUT)
+        elif change is not None and change.kind == "grow":
+            grown = True
+            assert shrunk, "grew before the shrink?"
+            assert hvd.size() == size, hvd.size()
+            stop_at = int(state["step"]) + 3
+        if stop_at is not None and int(state["step"]) >= stop_at:
+            break
+    assert shrunk and grown, (shrunk, grown)
+    _statesync_digest_check(hvd, state)
+    svc.close()
+    if joiner_proc is not None:
+        out, _ = joiner_proc.communicate(timeout=60.0)
+        text = out.decode(errors="replace")
+        print("--- joiner output ---\n" + text)
+        assert joiner_proc.returncode == 0, \
+            f"joiner failed rc={joiner_proc.returncode}:\n{text}"
+        assert "joiner: catch-up" in text
+    print(f"launch rank {launch_rank}: rode {size}->{size - 1}->{size} "
+          f"to step {int(state['step'])} with zero failed "
+          f"post-shrink steps")
+
+
+def battery_statesync_joiner(port):
+    """The replacement rank of the grow battery: runs BEFORE hvd.init —
+    join_world streams state from the live donors, verifies it, and
+    enters the world; then it trains in lockstep with the incumbents."""
+    import time as _time
+
+    from horovod_tpu import statesync
+
+    t0 = _time.monotonic()
+    template = _statesync_state()
+    tree, info = statesync.join_world(template)
+    import horovod_tpu as hvd
+
+    assert hvd.is_initialized() and hvd.rank() == info.rank
+    # Bit-identical to the donors' snapshot: recompute the digest of
+    # the assembled state against the unanimous stamp (the acceptance
+    # criterion's independent check; pull_round verified it once).
+    image = statesync.flatten_state(tree)
+    assert statesync.state_digest(image) == info.stamp.digest
+    # Bounded catch-up: the bulk transfer from N donors in parallel
+    # must cost no more than ~one donor's own streaming time (x2 +
+    # formation slack) — the sharded-stream win over a single source.
+    max_donor_s = max((w for _, w in info.donor_stats.values()),
+                      default=0.0)
+    bulk_s = info.catch_up_ms / 1e3
+    assert bulk_s < 2.0 * max_donor_s + 10.0, \
+        (bulk_s, max_donor_s, info.donor_stats)
+    state = tree
+    svc = statesync.StateSyncService(lambda: state)
+    stop_at = int(state["step"]) + 3
+    while int(state["step"]) < stop_at:
+        _statesync_train_step(hvd, state)
+        svc.step_boundary()
+    _statesync_digest_check(hvd, state)
+    svc.close()
+    print(f"joiner: catch-up {info.catch_up_ms:.0f} ms for "
+          f"{info.bulk_bytes} bytes from {len(info.donor_stats)} "
+          f"donors; entered as rank {info.rank}/{info.size} at step "
+          f"{stop_at - 3}; total wall "
+          f"{_time.monotonic() - t0:.1f}s")
+    hvd.shutdown()
+    return 0
+
+
+def battery_statesync_preempt(hvd, rank, size):
+    """ISSUE 10 SIGTERM-grace acceptance (3-rank): chaos delivers
+    SIGTERM to rank 1 mid-training.  The preempted rank finishes its
+    in-flight step, announces departure through the boundary check,
+    fast-donates its opt state, writes bye| and exits 0; survivors
+    shrink PROACTIVELY at the same boundary — no RanksFailedError is
+    ever raised, and the heartbeat monitor never declares rank 1
+    failed."""
+    import time as _time
+
+    from horovod_tpu import resilience, statesync
+    from horovod_tpu.runner.network import RendezvousClient
+
+    state = _statesync_state(n=1 << 12)
+    svc = statesync.StateSyncService(
+        lambda: state,
+        donate_provider=lambda: {"shard": state["opt"]})
+    kv = RendezvousClient("127.0.0.1",
+                          int(os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]),
+                          20.0)
+    launch_rank = rank
+    shrunk_at = None
+    pre_epoch = os.environ["HOROVOD_RENDEZVOUS_EPOCH"]
+    deadline = _time.monotonic() + 60.0
+    while _time.monotonic() < deadline:
+        prev_epoch = os.environ["HOROVOD_RENDEZVOUS_EPOCH"]
+        # No try/except: ANY RanksFailedError here fails the battery —
+        # the whole point of grace is that survivors never see one.
+        _statesync_train_step(hvd, state)
+        change = svc.step_boundary()
+        if change is not None and change.kind == "departed":
+            assert launch_rank == 1, launch_rank
+            raw = kv.get("hb", f"{prev_epoch}:1")
+            assert raw is not None and raw.startswith(b"bye|"), raw
+            print("preempted rank: departed with bye| stamp inside "
+                  "the grace window")
+            return
+        if change is not None and change.kind == "shrink":
+            assert change.dead == (1,), change
+            assert hvd.size() == size - 1
+            shrunk_at = int(state["step"])
+            # The departed rank's fast-donated opt shard is fetchable
+            # and digest-verified.
+            donated = statesync.fetch_donation(
+                prev_epoch, 1, {"shard": np.zeros_like(state["opt"])},
+                kv=kv)
+            assert donated is not None
+            state = statesync.resync_replicated(state,
+                                                int(state["step"]))
+        if shrunk_at is not None and int(state["step"]) >= shrunk_at + 3:
+            break
+    assert shrunk_at is not None, "the preemption never happened"
+    st = resilience.active_state()
+    assert st is None or not st.failed_ranks(), \
+        f"proactive shrink must beat the heartbeat: {st.failed_ranks()}"
+    assert os.environ["HOROVOD_RENDEZVOUS_EPOCH"] != pre_epoch
+    svc.close()
+    print(f"survivor {launch_rank}: proactive shrink at step "
+          f"{shrunk_at}, no RanksFailedError anywhere")
+
+
+_SERVE_GROW_CFG = dict(max_batch=4, token_budget=64, max_seq=64,
+                       slo_ms=120000.0)
+
+
+def _serve_grow_submit(ex, seed, count):
+    import random as _random
+
+    rng = _random.Random(seed)
+    for _ in range(count):
+        toks = [rng.randrange(2, ex.model.cfg.vocab_size)
+                for _ in range(rng.randint(2, 10))]
+        ex.stats["offered"] += 1
+        assert ex.queue.submit(toks, 10) is not None
+
+
+def battery_statesync_serve(hvd, rank, size):
+    """Serving grow mid-serve (2->3): a joiner replica enters via param
+    streaming while requests are in flight (the incumbents' params are
+    perturbed away from the seed, so the stream is the only way to
+    match them), then a second request wave is served by the grown
+    world — the front end's report records world.grows and positive
+    goodput before/during/after."""
+    import subprocess as _subprocess
+    import sys as _sys
+
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu import statesync
+    from horovod_tpu.serving import ReplicaExecutor, ServeConfig
+    from horovod_tpu.serving.loadgen import _goodput_phases
+    from horovod_tpu.serving.replica import serving_params_template
+
+    cfg = ServeConfig.from_env(**_SERVE_GROW_CFG)
+    tmpl = serving_params_template(cfg)
+    params = jax.tree_util.tree_map(lambda a: jnp.asarray(a + 0.25),
+                                    tmpl["params"])
+    ex = ReplicaExecutor(cfg, params=params)
+    service = statesync.StateSyncService(state_provider=ex.state_tree,
+                                         static_state=True)
+    ex.attach_statesync(service)
+    joiner_proc = None
+    if rank == 0:
+        _serve_grow_submit(ex, 11, 24)
+        env = dict(os.environ)
+        for k in ("HOROVOD_RANK", "HOROVOD_SIZE"):
+            env.pop(k, None)
+        joiner_proc = _subprocess.Popen(
+            [_sys.executable, os.path.abspath(__file__), "0", "0",
+             os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"],
+             "statesync_serve_joiner"],
+            env=env, stdout=_subprocess.PIPE, stderr=_subprocess.STDOUT)
+    # Phase 1: serve the first wave until the joiner has entered (the
+    # front end keeps assembling plans while it streams — goodput never
+    # goes to zero) and the wave drained.
+    ex.serve_loop(stop_when=lambda: bool(ex.stats["grows"]))
+    assert ex.stats["grows"], "the joiner never entered"
+    assert ex.size == size + 1, ex.size
+    assert not ex.stats["shrinks"]
+    # Phase 2: a post-grow wave, served by the grown world (the joiner
+    # runs the same second serve_loop and exits on its plan.stop).
+    ex._stop_requested = False
+    if ex.rank == ex.front:
+        _serve_grow_submit(ex, 13, 12)
+    ex.serve_loop(stop_when=lambda: True)
+    if rank == 0:
+        st = ex.stats
+        assert st["served"] == st["offered"] == 36, st
+        assert st["lost"] == 0 and st["expired"] == 0, st
+        phases = _goodput_phases(ex, 1.0)
+        assert phases is not None and phases["after_rps"] > 0.0, phases
+        g = st["grows"][0]
+        assert g["from"] == size and g["to"] == size + 1, g
+        out, _ = joiner_proc.communicate(timeout=60.0)
+        text = out.decode(errors="replace")
+        print("--- serve joiner output ---\n" + text)
+        assert joiner_proc.returncode == 0, text
+        assert "streamed params verified" in text
+        print(f"serving grow: {st['served']} served across "
+              f"{size}->{size + 1}; goodput phases {phases}")
+    service.close()
+
+
+def battery_statesync_serve_joiner(port):
+    """The serving joiner: streams the incumbents' perturbed params,
+    enters mid-serve, and serves both phases until the front drains."""
+    import jax
+    import numpy as _np
+
+    from horovod_tpu.serving import ServeConfig
+    from horovod_tpu.serving.replica import (join_serving_world,
+                                             serving_params_template)
+
+    cfg = ServeConfig.from_env(**_SERVE_GROW_CFG)
+    ex = join_serving_world(cfg)
+    # The streamed params must be the incumbents' PERTURBED values —
+    # the seed template plus 0.25 — not anything derivable locally.
+    mine = _np.asarray(jax.tree_util.tree_leaves(ex.params)[0])
+    seed = _np.asarray(jax.tree_util.tree_leaves(
+        serving_params_template(cfg)["params"])[0])
+    _np.testing.assert_allclose(mine, seed + 0.25, rtol=0, atol=1e-6)
+    print("serve joiner: streamed params verified (seed + 0.25)")
+    import horovod_tpu as hvd
+
+    ex.serve_loop()                    # phase 1: exits on plan.stop
+    ex._stop_requested = False
+    ex.serve_loop()                    # phase 2
+    print(f"serve joiner: entered as rank {ex.rank}/{ex.size}, "
+          f"served group {ex.group}, completed "
+          f"{len(ex.completed)} locally")
+    ex.statesync.close()
+    hvd.shutdown()
+    return 0
+
+
 BATTERIES = {
     "collectives": battery_collectives,
     "serving": battery_serving,
@@ -2073,6 +2411,17 @@ BATTERIES = {
     "resilience_retry": battery_resilience_retry,
     "resilience_freeze": battery_resilience_freeze,
     "resilience_off": battery_resilience_off,
+    # statesync/ elastic-grow batteries (ISSUE 10).  The *_joiner
+    # entries are PRE-INIT batteries: main() dispatches them before
+    # hvd.init — join_world performs its own world entry.
+    "statesync_grow": battery_statesync_grow,
+    "statesync_preempt": battery_statesync_preempt,
+    "statesync_serve": battery_statesync_serve,
+}
+
+PREINIT_BATTERIES = {
+    "statesync_joiner": battery_statesync_joiner,
+    "statesync_serve_joiner": battery_statesync_serve_joiner,
 }
 
 
@@ -2132,6 +2481,31 @@ def main() -> int:
             f"freeze:rank={size - 1},name=tr_,ms=120"
         os.environ["HOROVOD_FLIGHT_FILE"] = \
             f"/tmp/hvd_flight_{epoch}.json"
+    if battery.startswith("statesync"):
+        # Elastic-grow batteries: TCP plane pinned (worlds rebuild at
+        # several sizes; shm formation at each would dominate wall
+        # time), flight dumps in /tmp, generous per-round deadline for
+        # CI load.
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+        os.environ["HOROVOD_FLIGHT_FILE"] = \
+            f"/tmp/hvd_flight_{os.environ['HOROVOD_RENDEZVOUS_EPOCH']}.json"
+        os.environ.setdefault("HOROVOD_STATESYNC_TIMEOUT_SECONDS", "45")
+        os.environ.setdefault("HOROVOD_FAULT_TOLERANCE", "1")
+    if battery == "statesync_grow":
+        os.environ.setdefault("HOROVOD_FAULT_TIMEOUT", "5")
+        # Real SIGKILL of rank 2 mid-training (~step 4: each step costs
+        # three responses — the train allreduce + the two halves of the
+        # membership allgather).
+        os.environ.setdefault("HOROVOD_CHAOS", "kill:rank=2,op=13,sig=9")
+    if battery == "statesync_preempt":
+        # Grace must beat the heartbeat: generous fault timeout, SIGTERM
+        # at collective 6, 20 s to reach the next step boundary.
+        os.environ.setdefault("HOROVOD_FAULT_TIMEOUT", "30")
+        os.environ["HOROVOD_PREEMPT_GRACE_S"] = "20"
+        os.environ.setdefault("HOROVOD_CHAOS", "preempt:rank=1,op=6")
+    if battery in ("statesync_serve", "statesync_serve_joiner"):
+        os.environ.setdefault("HOROVOD_FAULT_TIMEOUT", "10")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if battery.startswith("resilience"):
         # Chaos batteries pin the TCP plane so the socket-level deadline
         # guards are the ones exercised (the shm plane has its own).
@@ -2209,6 +2583,16 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    if battery in PREINIT_BATTERIES:
+        # Joiner batteries enter the world themselves (join_world runs
+        # core.init after its streamed state verifies).
+        try:
+            return PREINIT_BATTERIES[battery](port)
+        except BaseException:
+            traceback.print_exc()
+            return 1
+
     import horovod_tpu as hvd
 
     hvd.init()

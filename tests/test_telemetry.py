@@ -122,6 +122,16 @@ def test_registry_thread_safety_under_concurrent_workers():
 
 def test_prometheus_exposition_golden_file():
     reg = MetricsRegistry(0)
+    reg.counter("horovod_autoscale_decisions_total", "Autoscale decisions",
+                labels={"direction": "up"}).inc()
+    h_catch = reg.histogram("horovod_catch_up_ms",
+                            "Joiner bulk catch-up wall time")
+    h_catch.observe(850.0)
+    reg.counter("horovod_statesync_bytes_total", "State bytes streamed",
+                labels={"role": "donor"}).inc(4096)
+    reg.counter("horovod_statesync_bytes_total",
+                labels={"role": "joiner"}).inc(4096)
+    reg.gauge("horovod_world_size", "Live world size").set(4)
     reg.counter("hvd_test_bytes_total", "Bytes moved",
                 labels={"peer": "1"}).inc(2048)
     reg.counter("hvd_test_bytes_total", labels={"peer": "2"}).inc(1024)
