@@ -1,0 +1,35 @@
+"""hvdmc — explicit-state model checking of the elastic membership,
+statesync, and recovery protocols (ISSUE 11; docs/analysis.md).
+
+Four pieces close the loop between the distributed state machines and
+their implementation:
+
+- a declarative **protocol-spec DSL** (:mod:`.spec`) with specs
+  co-located next to the code they bind to (``statesync/specs.py``,
+  ``resilience/specs.py``);
+- a **spec<->code conformance pass** (:mod:`.conformance`, rule
+  HVD506) diffing message vocabularies and handler transitions against
+  the implementation ASTs, riding the same single-parse driver as
+  hvdsan (``lint --san``) and gated in CI via
+  ``python -m horovod_tpu.analysis.mc --check-tree``;
+- an **explicit-state model checker** (:mod:`.model` +
+  :mod:`.machines`): BFS over N-rank global states with fault
+  transitions injected at every step (crash, SIGTERM mid-grace,
+  boundary-flag drop, chunk corruption, donor/joiner death
+  mid-stream), verifying no stuck state, no torn snapshot commit,
+  boundary agreement, and join-completes-or-aborts-cleanly, printing
+  counterexamples as rank-interleaved traces annotated with the code
+  sites the specs bind to;
+- a **trace witness** (:mod:`.witness`): mp batteries and
+  flight-recorder dumps replay their observed membership events
+  against the model — an observed transition absent from the model
+  fails CI (unsound spec), model transitions never observed demote to
+  warnings.
+"""
+from .conformance import all_specs, check_tree  # noqa: F401
+from .machines import (MUTATIONS, GrowModel, PreemptModel,  # noqa: F401
+                       ShrinkModel, ToyTornModel)
+from .model import explore, render_trace  # noqa: F401
+from .spec import ProtocolSpec, Transition, Verb  # noqa: F401
+from .witness import check as witness_check  # noqa: F401
+from .witness import load_dumps  # noqa: F401

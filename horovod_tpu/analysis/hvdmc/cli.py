@@ -1,0 +1,150 @@
+"""``python -m horovod_tpu.analysis.mc`` — the hvdmc CLI.
+
+Default action explores every protocol model at head with fault
+injection to a fixpoint and reports state counts + violations (with
+rank-interleaved counterexample traces).  ``--mutate`` drops a named
+guard to prove the checker bites; ``--check-tree`` runs the HVD506
+spec<->code conformance gate; ``--witness`` replays flight-recorder
+dumps through the trace witness.  ``--format json|sarif`` shares the
+report shapes with the hvdlint/hvdsan emitters.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from .machines import (MUTATIONS, GrowModel, PreemptModel, ShrinkModel,
+                       ToyTornModel)
+from .model import explore, render_trace
+
+__all__ = ["main"]
+
+PROTOCOLS = {
+    "grow": GrowModel,
+    "preempt": PreemptModel,
+    "shrink": ShrinkModel,
+    "toy": ToyTornModel,
+}
+
+
+def _explore_protocols(names, ranks, mutations, faults, max_states):
+    out = []
+    for name in names:
+        model = PROTOCOLS[name](ranks, mutations=mutations,
+                                faults=faults)
+        out.append((model, explore(model, max_states=max_states)))
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis.mc",
+        description="Explicit-state model checking of the elastic "
+                    "membership, statesync, and recovery protocols "
+                    "(see docs/analysis.md).")
+    parser.add_argument("--protocol", default="all",
+                        choices=("all",) + tuple(PROTOCOLS),
+                        help="which protocol model to explore")
+    parser.add_argument("--ranks", type=int, default=3,
+                        help="incumbent world size (default 3)")
+    parser.add_argument("--mutate", action="append", default=[],
+                        choices=list(MUTATIONS),
+                        help="drop a named spec guard (seeded-mutation "
+                             "demonstration; repeatable)")
+    parser.add_argument("--no-faults", action="store_true",
+                        help="explore without fault injection")
+    parser.add_argument("--max-states", type=int, default=400_000)
+    parser.add_argument("--check-tree", nargs="?", const="horovod_tpu",
+                        metavar="PATH",
+                        help="run the HVD506 spec<->code conformance "
+                             "gate over a tree (default horovod_tpu) "
+                             "instead of exploring")
+    parser.add_argument("--witness", nargs="*", default=None,
+                        metavar="DUMP",
+                        help="flight-recorder dumps to replay through "
+                             "the trace witness")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text")
+    args = parser.parse_args(argv)
+
+    t0 = time.monotonic()
+    payload: dict = {}
+    rc = 0
+    findings = []
+    if args.check_tree:
+        from .conformance import check_tree
+        findings = check_tree([args.check_tree])
+        payload["conformance"] = [f.json() for f in findings]
+        rc |= 1 if findings else 0
+    results = []
+    if not args.check_tree or args.protocol != "all" or args.mutate:
+        # "all" = the real protocols; the deliberately broken toy model
+        # (golden-counterexample fixture) only runs when named.
+        names = ("grow", "preempt", "shrink") \
+            if args.protocol == "all" else (args.protocol,)
+        if args.check_tree and args.protocol == "all" \
+                and not args.mutate:
+            names = ()
+        results = _explore_protocols(
+            names, args.ranks, tuple(args.mutate),
+            not args.no_faults, args.max_states)
+        payload["protocols"] = {
+            m.name: {
+                "states": r.states,
+                "transitions": r.transitions,
+                "fixpoint": r.fixpoint,
+                "fired": sorted(r.fired),
+                "violations": [
+                    {"property": v.prop, "kind": v.kind,
+                     "trace": render_trace(m, v).splitlines()}
+                    for v in r.violations],
+            } for m, r in results}
+        rc |= 1 if any(r.violations or not r.fixpoint
+                       for _m, r in results) else 0
+    report = None
+    if args.witness is not None:
+        from .witness import check, load_dumps
+        report = check(load_dumps(args.witness))
+        payload["witness"] = {"problems": report.problems,
+                              "warnings": report.warnings,
+                              "observed": report.observed}
+        rc |= 1 if report.problems else 0
+    payload["wall_ms"] = round((time.monotonic() - t0) * 1e3, 3)
+
+    if args.format == "json":
+        print(json.dumps(payload, indent=1))
+    elif args.format == "sarif":
+        from ..hvdsan.san import sarif_payload
+        print(json.dumps(sarif_payload(findings), indent=1))
+    else:
+        for f in findings:
+            print(f.text())
+        for m, r in results:
+            mut = f" mutations={sorted(m.__dict__.get('mutations', ()))}" \
+                if getattr(m, "mutations", None) else ""
+            print(f"hvdmc: {m.name}: {r.states} state(s), "
+                  f"{r.transitions} transition(s), "
+                  f"{'fixpoint' if r.fixpoint else 'STATE CAP HIT'}, "
+                  f"{len(r.violations)} violation(s){mut}")
+            for v in r.violations:
+                print(render_trace(m, v))
+        if report is not None:
+            for p in report.problems:
+                print(f"hvdmc: witness: UNSOUND: {p}")
+            for w in report.warnings:
+                print(f"hvdmc: witness: warning: {w}")
+            print(f"hvdmc: witness: {sum(report.observed.values())} "
+                  f"protocol event(s) replayed "
+                  f"({len(report.observed)} kind(s))")
+        if args.check_tree:
+            print(f"hvdmc: conformance: {len(findings)} finding(s) "
+                  f"in {args.check_tree}", file=sys.stderr)
+        print(f"hvdmc: wall {payload['wall_ms']:.1f} ms",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

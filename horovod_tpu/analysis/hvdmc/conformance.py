@@ -1,0 +1,227 @@
+"""HVD506 — spec <-> code conformance (the hvdmc half of what HVD505
+does for ``common/wire.py``).
+
+The protocol specs co-located with the implementation
+(``statesync/specs.py``, ``resilience/specs.py``) claim a message
+vocabulary and a set of handler transitions bound to concrete
+functions.  This pass diffs both against the collected program facts
+(the same single AST walk hvdsan rides):
+
+**spec -> code** (the checker must verify a protocol that exists):
+
+- every frame verb's constant is defined in its declaring module;
+- every transition's bound function exists;
+- a ``recv:V`` transition's bound function really compares on ``V``'s
+  constant, a ``send:V`` one really packs it;
+- KV-record and boundary-flag verbs appear as string literals in the
+  bound (or anchor-module) code;
+- every ``requires_calls`` name is called from some bound function.
+
+**code -> spec** (the checker must know every protocol branch):
+
+- every ``STATE_*`` constant defined in a verb-declaring module is
+  claimed by some spec verb;
+- every frame-constant comparison or ``pack_state_frame(CONST, ...)``
+  in an anchor module is claimed by a spec transition bound to that
+  function.
+
+A spec only activates when one of its ``anchor_modules`` is in the
+analyzed set, so single-fixture lint runs never see tree-wide drift.
+"""
+from __future__ import annotations
+
+__all__ = ["all_specs", "check_spec_conformance", "check_tree"]
+
+
+def all_specs():
+    """The registered protocol specs (order is report order)."""
+    from ...resilience.specs import shrink_spec
+    from ...statesync.specs import grow_spec, preempt_spec, stream_spec
+
+    return (grow_spec(), stream_spec(), preempt_spec(), shrink_spec())
+
+
+def _module_of(program, funckey: str):
+    """Longest module label that prefixes a hvdsan function key."""
+    parts = funckey.split(".")
+    for i in range(len(parts) - 1, 0, -1):
+        label = ".".join(parts[:i])
+        if label in program.modules:
+            return label
+    return None
+
+
+def check_spec_conformance(analysis, specs=None) -> None:
+    """Emit HVD506 findings on `analysis` (a lockgraph.Analysis)."""
+    program = analysis.program
+    specs = all_specs() if specs is None else specs
+    active = [sp for sp in specs
+              if any(m in program.modules for m in sp.anchor_modules)]
+    if not active:
+        return
+    # code -> spec: every STATE_* constant in a verb-declaring module is
+    # claimed by SOME active spec's vocabulary.
+    claimed_by_module: dict = {}
+    for sp in active:
+        for v in sp.verbs:
+            if v.kind == "frame" and v.const and v.defined_in:
+                claimed_by_module.setdefault(v.defined_in,
+                                             set()).add(v.const)
+    for suffix, claimed in sorted(claimed_by_module.items()):
+        mod = next((m for m in program.modules.values()
+                    if m.path.endswith(suffix)), None)
+        if mod is None:
+            continue
+        defined = {k for k in mod.int_consts if k.startswith("STATE_")}
+        for extra in sorted(defined - claimed):
+            val, line = mod.int_consts[extra]
+            analysis._emit(
+                "spec-conformance", "error", mod.path, line,
+                f"frame verb constant {extra} is not in any protocol "
+                f"spec's vocabulary: the model checker never explores "
+                f"frames of this kind — add the verb (and its "
+                f"transitions) to the spec, or remove the constant")
+        for missing in sorted(claimed - defined):
+            analysis._emit(
+                "spec-conformance", "error", mod.path, 1,
+                f"spec verb constant {missing} is not defined in "
+                f"{suffix}: the spec describes a frame kind the wire "
+                f"cannot carry")
+    for sp in active:
+        _check_spec(analysis, sp)
+    _check_unspecced_handlers(analysis, active)
+
+
+def _anchor_path(program, spec):
+    for m in spec.anchor_modules:
+        mod = program.modules.get(m)
+        if mod is not None:
+            return mod.path
+    return spec.anchor_modules[0] if spec.anchor_modules else "<spec>"
+
+
+def _check_spec(analysis, spec) -> None:
+    program = analysis.program
+    apath = _anchor_path(program, spec)
+    for problem in spec.validate():
+        analysis._emit("spec-conformance", "error", apath, 1,
+                       f"spec {spec.name} is malformed: {problem}")
+    verbs = {v.name: v for v in spec.verbs}
+    for t in spec.transitions:
+        bound = []
+        for key in t.binds:
+            mod = _module_of(program, key)
+            if mod is None:
+                continue             # binding module not analyzed: skip
+            fn = program.functions.get(key)
+            if fn is None:
+                analysis._emit(
+                    "spec-conformance", "error", apath, 1,
+                    f"spec {spec.name} transition {t.tid} binds "
+                    f"{key}, which no longer exists — rebind the "
+                    f"transition or restore the handler")
+            else:
+                bound.append(fn)
+        if not bound:
+            continue
+        called = set()
+        for fn in bound:
+            called |= {ev.spine[-1] for ev in fn.calls}
+        for req in t.requires_calls:
+            if req not in called:
+                analysis._emit(
+                    "spec-conformance", "error", bound[0].path,
+                    bound[0].line,
+                    f"spec {spec.name} transition {t.tid} requires a "
+                    f"call to '{req}' in {', '.join(f.key for f in bound)} "
+                    f"but none was found — the protocol action the "
+                    f"spec models is gone")
+        head, _, vname = t.event.partition(":")
+        verb = verbs.get(vname)
+        if verb is None:
+            continue
+        if verb.kind == "frame" and head in ("recv", "send"):
+            facts = set()
+            for fn in bound:
+                facts |= fn.state_compares if head == "recv" \
+                    else fn.state_packs
+            if verb.const not in facts:
+                what = "compares on" if head == "recv" else "packs"
+                analysis._emit(
+                    "spec-conformance", "error", bound[0].path,
+                    bound[0].line,
+                    f"spec {spec.name} transition {t.tid} says "
+                    f"{bound[0].key} {what} {verb.const}, but the "
+                    f"code does not — handler drift")
+        elif verb.kind in ("kv", "flag") and head in ("kv", "send",
+                                                      "recv"):
+            strs = set()
+            for fn in bound:
+                strs |= fn.strs
+            for m in spec.anchor_modules:
+                mod = program.modules.get(m)
+                if mod is not None:
+                    strs |= mod.strs
+                    for f2 in program.functions.values():
+                        if f2.module == m:
+                            strs |= f2.strs
+            if not any(verb.const in s or s.startswith(verb.const)
+                       for s in strs):
+                analysis._emit(
+                    "spec-conformance", "error", apath, 1,
+                    f"spec {spec.name} verb {verb.name} "
+                    f"({verb.kind} key {verb.const!r}) appears "
+                    f"nowhere in the bound code — the record the "
+                    f"spec models is never written or read")
+
+
+def _check_unspecced_handlers(analysis, active) -> None:
+    """code -> spec: frame-constant handler branches and pack sites in
+    anchor modules must be claimed by a transition bound there."""
+    program = analysis.program
+    claims: dict = {}            # (funckey, const, dir) -> True
+    anchor_mods = set()
+    for sp in active:
+        anchor_mods |= set(sp.anchor_modules)
+        verbs = {v.name: v for v in sp.verbs}
+        for t in sp.transitions:
+            head, _, vname = t.event.partition(":")
+            verb = verbs.get(vname)
+            if verb is None or verb.kind != "frame":
+                continue
+            for key in t.binds:
+                claims[(key, verb.const,
+                        "recv" if head == "recv" else "send")] = True
+    for fn in program.functions.values():
+        if fn.module not in anchor_mods:
+            continue
+        for const in sorted(fn.state_compares):
+            if not claims.get((fn.key, const, "recv")):
+                analysis._emit(
+                    "spec-conformance", "error", fn.path, fn.line,
+                    f"{fn.key} dispatches on frame verb {const} but no "
+                    f"spec transition binds that handler — the model "
+                    f"checker never explores this branch; add the "
+                    f"transition to the protocol spec")
+        for const in sorted(fn.state_packs):
+            if not claims.get((fn.key, const, "send")):
+                analysis._emit(
+                    "spec-conformance", "error", fn.path, fn.line,
+                    f"{fn.key} sends frame verb {const} but no spec "
+                    f"transition claims that send — the model checker "
+                    f"never explores this message; add the transition "
+                    f"to the protocol spec")
+
+
+def check_tree(paths=None):
+    """Standalone conformance over a tree (the ``mc --check-tree``
+    gate): returns the HVD506 findings without running the rest of the
+    hvdsan analysis."""
+    from ..hvdsan.lockgraph import Analysis, Program
+
+    program = Program()
+    program.collect_paths(list(paths or ["horovod_tpu"]))
+    analysis = Analysis(program)
+    check_spec_conformance(analysis)
+    analysis.findings.sort(key=lambda f: (f.path, f.line, f.rule.id))
+    return analysis.findings

@@ -1,0 +1,668 @@
+"""Executable protocol semantics for the hvdmc model checker.
+
+Each machine is a :class:`~.model.Model` whose transition labels are
+spec transition ids (``statesync/specs.py``, ``resilience/specs.py``),
+so counterexample traces annotate with the code sites the specs bind
+to, and the runtime trace witness can ask "was this observed transition
+ever fired by the model?".
+
+Abstraction choices (documented, deliberate):
+
+- training state never appears — only the **step/boundary counter**
+  (saturating at a small cap so the space closes) and the **snapshot
+  stamps** donors cut at;
+- the byte stream is abstracted to per-donor stamp + pull/verify
+  phases; chunk CRCs appear as the ``chunk-crc`` guard against the
+  injected ``chunk-corrupt`` fault;
+- fault injection is adversarial **against the protocol**, not the
+  transport: the boundary flag exchange may drop one rank's receipt
+  (``flag-drop`` — the torn-snapshot hazard the stamp-equality guard
+  contains), chunks may corrupt, donor threads and the joiner may die
+  mid-stream, SIGTERM may land mid-grace and the in-flight step may
+  wedge past the grace window.
+
+Seeded **mutations** (``--mutate``) drop a named guard so CI can prove
+the checker bites:
+
+- ``drop-torn-reject`` — the joiner commits a round even when donor
+  stamps disagree (kills the ``stamps-unanimous`` guard);
+- ``early-ready-ack`` — the joiner posts ``ready`` before the bulk
+  image digest-verifies (kills the ``ready-after-verify`` guard).
+"""
+from __future__ import annotations
+
+from .model import Model
+
+__all__ = ["GrowModel", "MUTATIONS", "PreemptModel", "ShrinkModel",
+           "ToyTornModel", "toy_spec"]
+
+MUTATIONS = ("drop-torn-reject", "early-ready-ack")
+
+_SEQ_CAP = 4
+
+
+def _repl(t: tuple, i: int, v) -> tuple:
+    return t[:i] + (v,) + t[i + 1:]
+
+
+# ---------------------------------------------------------------------------
+# Grow protocol: N incumbents + one joiner
+# ---------------------------------------------------------------------------
+# Incumbent: (ph, pj, rs, ds)  ph R=run B=bound W=rebuild F=failed;
+#            pj/rs = watcher saw join/ready; ds = donor snapshot stamp
+#            (boundary seq it cut at; -1 = not donating).
+# Joiner: (jph, metas, verified, corrupted)
+#            jph I=idle A=announced M=metas P=pulling D=pulled
+#            V=verified Y=ready G=final Q=final-verified E=entered
+#            X=aborted C=crashed; metas = per-donor stamps collected.
+# kv: (join_posted, ready_posted, go_posted)
+# faults: (flagdrop, corrupt, donordeath, joinercrash) budgets +
+#         dead = frozenset of dead donor threads.
+# world: (seq, final_stamp, done)
+class GrowModel(Model):
+    name = "statesync-grow"
+
+    def __init__(self, ranks: int = 3, mutations=(), *,
+                 faults: bool = True) -> None:
+        from ...resilience.specs import shrink_spec
+        from ...statesync.specs import grow_spec, stream_spec
+
+        self.n = int(ranks)
+        self.mutations = frozenset(mutations)
+        unknown = self.mutations - set(MUTATIONS)
+        if unknown:
+            raise ValueError(f"unknown mutation(s) {sorted(unknown)}; "
+                             f"known: {list(MUTATIONS)}")
+        self.spec = (grow_spec(), stream_spec(), shrink_spec())
+        b = 1 if faults else 0
+        self._fault_budget = (b, b, b, b)
+
+    def initial(self):
+        incs = tuple(("R", False, False, -1) for _ in range(self.n))
+        joiner = ("I", (), False, False)
+        return (incs, joiner, (False, False, False),
+                (self._fault_budget, frozenset()), (0, -1, False))
+
+    # -- helpers ---------------------------------------------------------
+    @staticmethod
+    def _live(incs):
+        return [i for i, (ph, *_r) in enumerate(incs) if ph != "F"]
+
+    def actor_label(self, actor):
+        if actor == "J":
+            return "joiner"
+        return super().actor_label(actor)
+
+    def describe(self, state) -> str:
+        incs, joiner, kv, faults, world = state
+        jph, metas, verified, corrupted = joiner
+        seq, fstamp, done = world
+        inc_s = " ".join(
+            f"r{i}:{ph}{'' if ds < 0 else f'/ds{ds}'}"
+            f"{'+pj' if pj else ''}{'+rs' if rs else ''}"
+            for i, (ph, pj, rs, ds) in enumerate(incs))
+        kv_s = "".join(k for k, v in
+                       zip(("J", "R", "G"), kv) if v) or "-"
+        return (f"seq={seq} incs[{inc_s}] joiner={jph}"
+                f"{f'/metas{list(metas)}' if metas else ''}"
+                f"{'+ver' if verified else ''}"
+                f"{'+corrupt' if corrupted else ''} kv={kv_s}"
+                f"{' DONE' if done else ''}")
+
+    # -- properties ------------------------------------------------------
+    def invariants(self, state):
+        incs, joiner, kv, faults, world = state
+        jph, metas, verified, corrupted = joiner
+        out = []
+        if jph == "E" and len(set(metas)) > 1:
+            out.append("torn-commit")
+        if any(ph == "W" for ph, *_r in incs) and not verified:
+            out.append("premature-boundary-ack")
+        if kv[2] and any(ph in "RB" for ph, *_r in incs) \
+                and not world[2]:
+            out.append("boundary-agreement")
+        return out
+
+    def is_terminal(self, state) -> bool:
+        incs, joiner, kv, faults, world = state
+        if world[2]:
+            return True
+        return all(ph == "F" for ph, *_r in incs) and \
+            joiner[0] in "XC"
+
+    def resolved(self, state) -> bool:
+        incs, joiner, kv, faults, world = state
+        if world[2]:
+            return True
+        if joiner[0] in "XC":
+            return not any(ph == "W" for ph, *_r in incs) or \
+                all(ph == "F" for ph, *_r in incs)
+        return False
+
+    # -- semantics -------------------------------------------------------
+    def successors(self, state):
+        incs, joiner, kv, faults, world = state
+        jph, metas, verified, corrupted = joiner
+        join_p, ready_p, go_p = kv
+        budgets, dead = faults
+        flagdrop, corrupt, donordeath, jcrash = budgets
+        seq, fstamp, done = world
+        if self.is_terminal(state):
+            return []
+        out = []
+        live = self._live(incs)
+
+        def st(incs=incs, joiner=joiner, kv=kv, faults=(budgets, dead),
+               world=world):
+            return (incs, joiner, kv, faults, world)
+
+        # -- incumbent local steps --------------------------------------
+        for i in live:
+            ph, pj, rs, ds = incs[i]
+            if ph == "R":
+                out.append((i, ("inc.step",),
+                            st(incs=_repl(incs, i, ("B", pj, rs, ds)))))
+            if ph in "RB":
+                if join_p and not pj and ds < 0:
+                    out.append((i, ("inc.watch-join",),
+                                st(incs=_repl(incs, i,
+                                              (ph, True, rs, ds)))))
+                if ready_p and not rs:
+                    out.append((i, ("inc.watch-ready",),
+                                st(incs=_repl(incs, i,
+                                              (ph, pj, True, ds)))))
+
+        # -- the step boundary (one symmetric exchange) -----------------
+        if live and all(incs[i][0] == "B" for i in live) and \
+                not any(ph == "W" for ph, *_r in incs):
+            seq2 = min(seq + 1, _SEQ_CAP)
+            rs_any = any(incs[i][2] for i in live)
+            pj_any = any(incs[i][1] for i in live)
+            if rs_any:
+                # grow: final boundary snapshot + GO record + rebuild.
+                grown = tuple(
+                    ("W", False, False, seq) if i in live else incs[i]
+                    for i in range(self.n))
+                out.append(("world",
+                            ("inc.boundary-grow", "inc.post-go"),
+                            st(incs=grown, kv=(join_p, ready_p, True),
+                               world=(seq2, seq, done))))
+            elif pj_any and any(incs[i][3] < 0 for i in live):
+                def admit(skip=None):
+                    return tuple(
+                        (("R", False, incs[i][2],
+                          seq if incs[i][3] < 0 and i != skip
+                          else incs[i][3])
+                         if i in live and i != skip else
+                         (("R",) + incs[i][1:] if i in live
+                          else incs[i]))
+                        for i in range(self.n))
+                out.append(("world", ("inc.boundary-admit",),
+                            st(incs=admit(), world=(seq2, fstamp,
+                                                    done))))
+                if flagdrop > 0:
+                    nb = (flagdrop - 1, corrupt, donordeath, jcrash)
+                    for x in live:
+                        if incs[x][3] >= 0:
+                            continue
+                        out.append((
+                            "net",
+                            ("net.flag-drop", "inc.boundary-admit"),
+                            st(incs=admit(skip=x), faults=(nb, dead),
+                               world=(seq2, fstamp, done))))
+            else:
+                idled = tuple(
+                    ("R",) + incs[i][1:] if i in live else incs[i]
+                    for i in range(self.n))
+                out.append(("world", ("inc.boundary-idle",),
+                            st(incs=idled,
+                               world=(seq2, fstamp, done))))
+
+        # -- joiner ------------------------------------------------------
+        alive_donors = [i for i in live if i not in dead]
+        if jph == "I":
+            out.append(("J", ("join.announce",),
+                        st(joiner=("A", metas, verified, corrupted),
+                           kv=(True, ready_p, go_p))))
+        elif jph == "A":
+            if live and all(incs[i][3] >= 0 for i in live):
+                collected = tuple(incs[i][3] for i in live)
+                out.append(("J", ("join.hello", "join.meta"),
+                            st(joiner=("M", collected, verified,
+                                       corrupted))))
+        elif jph == "M":
+            torn = len(set(metas)) > 1
+            if torn and "drop-torn-reject" not in self.mutations:
+                out.append(("J", ("join.torn-reject",),
+                            st(joiner=("X", metas, verified,
+                                       corrupted))))
+            else:
+                out.append(("J", ("join.stamps-ok",),
+                            st(joiner=("P", metas, verified,
+                                       corrupted))))
+        elif jph == "P":
+            if corrupted:
+                out.append(("J", ("join.crc-reject",),
+                            st(joiner=("X", metas, verified, True))))
+            elif alive_donors:
+                out.append(("J", ("join.req", "join.data", "join.end"),
+                            st(joiner=("D", metas, verified, False))))
+            else:
+                out.append(("J", ("join.bulk-abort",),
+                            st(joiner=("X", metas, verified,
+                                       corrupted))))
+            if corrupt > 0 and not corrupted:
+                nb = (flagdrop, corrupt - 1, donordeath, jcrash)
+                out.append(("net", ("net.chunk-corrupt",),
+                            st(joiner=("P", metas, verified, True),
+                               faults=(nb, dead))))
+            if donordeath > 0:
+                nb = (flagdrop, corrupt, donordeath - 1, jcrash)
+                for d in alive_donors:
+                    out.append(("net",
+                                ("net.donor-death", "join.donor-died"),
+                                st(faults=(nb, dead | {d}))))
+        elif jph == "D":
+            out.append(("J", ("join.verify",),
+                        st(joiner=("V", metas, True, corrupted))))
+        elif jph == "G":
+            if corrupt > 0:
+                nb = (flagdrop, corrupt - 1, donordeath, jcrash)
+                out.append(("net", ("net.chunk-corrupt",
+                                    "join.final-abort"),
+                            st(joiner=("X", metas, verified, True),
+                               faults=(nb, dead))))
+            out.append(("J", ("join.data", "join.end", "join.verify"),
+                        st(joiner=("Q", metas, verified, corrupted))))
+        elif jph == "Q":
+            if live and all(incs[i][0] == "W" for i in live):
+                out.append(("J", ("join.enter",),
+                            st(joiner=("E", metas, verified,
+                                       corrupted))))
+        if jph == "V" and not ready_p:
+            out.append(("J", ("join.post-ready", "join.bye"),
+                        st(joiner=("Y", metas, verified, corrupted),
+                           kv=(join_p, True, go_p))))
+        if "early-ready-ack" in self.mutations and jph in "PD" \
+                and not ready_p:
+            # MUTATED: ready acked before the digest verified.
+            out.append(("J", ("join.post-ready",),
+                        st(kv=(join_p, True, go_p))))
+        if jph in "VY" and ready_p and go_p:
+            out.append(("J", ("join.see-go",),
+                        st(joiner=("G", metas, verified, corrupted))))
+        if jcrash > 0 and jph in "AMPDVYGQ":
+            nb = (flagdrop, corrupt, donordeath, jcrash - 1)
+            out.append(("net", ("net.crash-joiner",),
+                        st(joiner=("C", metas, verified, corrupted),
+                           faults=(nb, dead))))
+
+        # -- abort cleanup ----------------------------------------------
+        if jph in "XC":
+            if any(incs[i][0] == "W" for i in live):
+                failed = tuple(
+                    ("F", False, False, -1)
+                    if incs[i][0] == "W" else incs[i]
+                    for i in range(self.n))
+                out.append(("world", ("inc.formation-timeout",),
+                            st(incs=failed)))
+            elif any(incs[i][3] >= 0 for i in live) or join_p or \
+                    ready_p:
+                cleared = tuple(
+                    (incs[i][0], False, False, -1) if i in live
+                    else incs[i] for i in range(self.n))
+                out.append(("world", ("donor.round-timeout",),
+                            st(incs=cleared,
+                               kv=(False, False, go_p))))
+
+        # -- world formation --------------------------------------------
+        if jph == "E" and live and \
+                all(incs[i][0] == "W" for i in live):
+            formed = tuple(
+                ("R", False, False, -1) if i in live else incs[i]
+                for i in range(self.n))
+            out.append(("world", ("inc.world-formed",),
+                        st(incs=formed,
+                           world=(seq, fstamp, True))))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Preemption grace: N ranks, SIGTERM lands on one of them
+# ---------------------------------------------------------------------------
+# Rank: (ph, pre)  ph R=run B=bound Z=wedged D=departed(0)
+#                  T=exited143 F=failcaught; pre = SIGTERM received.
+# kv: (bye, confirmed); faults: (sigterm, dup, wedge) budgets;
+# world: (seq, gen).
+class PreemptModel(Model):
+    name = "statesync-preempt"
+
+    def __init__(self, ranks: int = 3, mutations=(), *,
+                 faults: bool = True) -> None:
+        from ...resilience.specs import shrink_spec
+        from ...statesync.specs import preempt_spec
+
+        self.n = int(ranks)
+        self.mutations = frozenset(mutations)
+        self.spec = (preempt_spec(), shrink_spec())
+        self._budget = (1, 1, 1) if faults else (0, 0, 0)
+
+    def initial(self):
+        return (tuple(("R", False) for _ in range(self.n)),
+                (False, False), self._budget, (0, 0))
+
+    def describe(self, state) -> str:
+        ranks, kv, faults, world = state
+        rs = " ".join(f"r{i}:{ph}{'!' if pre else ''}"
+                      for i, (ph, pre) in enumerate(ranks))
+        return (f"seq={world[0]} gen={world[1]} [{rs}]"
+                f"{' bye' if kv[0] else ''}"
+                f"{' confirmed' if kv[1] else ''}")
+
+    @staticmethod
+    def _victim(ranks):
+        for i, (ph, pre) in enumerate(ranks):
+            if pre or ph in "ZDT":
+                return i
+        return -1
+
+    def invariants(self, state):
+        ranks, kv, faults, world = state
+        bye, confirmed = kv
+        out = []
+        v = self._victim(ranks)
+        if v >= 0 and ranks[v][0] in "DT" and not bye:
+            out.append("bye-before-exit")
+        if v >= 0 and ranks[v][0] == "D" and \
+                any(ph == "F" for ph, _ in ranks):
+            out.append("no-failure-on-clean-path")
+        if world[1] == 1 and not (bye or confirmed):
+            out.append("shrink-requires-evidence")
+        return out
+
+    def resolved(self, state) -> bool:
+        ranks, kv, faults, world = state
+        v = self._victim(ranks)
+        if v < 0:
+            return True
+        return world[1] == 1 and ranks[v][0] in "DT"
+
+    def successors(self, state):
+        ranks, kv, faults, world = state
+        bye, confirmed = kv
+        sig, dup, wedge = faults
+        seq, gen = world
+        out = []
+        v = self._victim(ranks)
+        live = [i for i, (ph, _p) in enumerate(ranks) if ph not in "DT"]
+
+        def st(ranks=ranks, kv=kv, faults=faults, world=world):
+            return (ranks, kv, faults, world)
+
+        for i in live:
+            ph, pre = ranks[i]
+            if ph == "R":
+                tid = "pre.finish-step" if pre else "sur.step"
+                out.append((i, (tid,),
+                            st(ranks=_repl(ranks, i, ("B", pre)))))
+            if sig > 0 and v < 0 and ph in "RB":
+                out.append((i, ("pre.sigterm",),
+                            st(ranks=_repl(ranks, i, (ph, True)),
+                               faults=(0, dup, wedge))))
+            if pre and dup > 0 and ph in "RBZ":
+                out.append((i, ("pre.sigterm-dup",),
+                            st(faults=(sig, dup - 1, wedge))))
+            if pre and wedge > 0 and ph == "R":
+                out.append((i, ("pre.wedge",),
+                            st(ranks=_repl(ranks, i, ("Z", pre)),
+                               faults=(sig, dup, 0))))
+            if ph == "Z":
+                out.append((i, ("pre.backstop",),
+                            st(ranks=_repl(ranks, i, ("T", pre)),
+                               kv=(True, confirmed))))
+            if ph == "B" and gen == 0 and v >= 0 and \
+                    ranks[v][0] in "ZT":
+                out.append((i, ("sur.deadline-fail",),
+                            st(ranks=_repl(ranks, i, ("F", pre)))))
+            if ph == "F" and gen == 0:
+                if ranks[v][0] == "Z" and not confirmed:
+                    out.append((i, ("sur.reraise-suspect",),
+                                st(ranks=_repl(ranks, i, ("B", pre)))))
+
+        if v >= 0 and ranks[v][0] == "T" and not confirmed:
+            out.append((v, ("hb.confirm",), st(kv=(bye, True))))
+
+        # boundary: every live rank bound; a wedged peer blocks it, and
+        # a backstop-exited peer makes the collective FAIL (deadline
+        # conversion), never complete — no boundary until the shrink.
+        if live and all(ranks[i][0] == "B" for i in live) and \
+                (v < 0 or ranks[v][0] == "B" or gen == 1):
+            seq2 = min(seq + 1, 3)
+            if any(ranks[i][1] for i in live):
+                nr = tuple(
+                    ("D", pre) if pre else
+                    (("R", pre) if i in live else (ph2, pre))
+                    for i, (ph2, pre) in enumerate(ranks))
+                out.append(("world",
+                            ("pre.depart", "pre.fast-donate",
+                             "sur.proactive-shrink"),
+                            st(ranks=nr, kv=(True, confirmed),
+                               world=(seq2, 1))))
+            else:
+                nr = tuple(("R", pre) if i in live else ranks[i]
+                           for i in range(self.n))
+                out.append(("world", ("sur.boundary-idle",),
+                            st(ranks=nr, world=(seq2, gen))))
+
+        # failure-shrink convergence (backstop path)
+        survivors = [i for i in live if i != v]
+        if v >= 0 and gen == 0 and survivors and confirmed and \
+                all(ranks[i][0] == "F" for i in survivors):
+            nr = tuple(("R", pre) if i in survivors else ranks[i]
+                       for i, (_ph, pre) in enumerate(ranks))
+            out.append(("world", ("sur.converge-shrink",),
+                        st(ranks=nr, world=(seq, 1))))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Hard-failure shrink convergence
+# ---------------------------------------------------------------------------
+# Rank: (ph, v)  ph R C=crashed Z=frozen F=failcaught K=converging
+#                S=shrunk X=raised; v = state version at the catch.
+class ShrinkModel(Model):
+    name = "resilience-shrink"
+
+    def __init__(self, ranks: int = 3, mutations=(), *,
+                 faults: bool = True) -> None:
+        from ...resilience.specs import shrink_spec
+
+        self.n = int(ranks)
+        self.mutations = frozenset(mutations)
+        self.spec = (shrink_spec(),)
+        self._faults = faults
+
+    def initial(self):
+        return (tuple(("R", 0) for _ in range(self.n)),
+                False, -1, "", False)
+
+    def describe(self, state) -> str:
+        ranks, confirmed, victim, kind, done = state
+        rs = " ".join(f"r{i}:{ph}v{v}" for i, (ph, v) in
+                      enumerate(ranks))
+        return (f"[{rs}] victim={victim}({kind or '-'})"
+                f"{' confirmed' if confirmed else ''}"
+                f"{' DONE' if done else ''}")
+
+    def invariants(self, state):
+        ranks, confirmed, victim, kind, done = state
+        out = []
+        if kind == "freeze" and any(ph == "S" for ph, _v in ranks):
+            out.append("never-shrink-live")
+        if any(ph == "S" for ph, _v in ranks) and not confirmed:
+            out.append("shrink-requires-confirmation")
+        if done:
+            vs = {v for ph, v in ranks if ph == "R"}
+            if len(vs) > 1:
+                out.append("resync-equal")
+        return out
+
+    def is_terminal(self, state) -> bool:
+        ranks, confirmed, victim, kind, done = state
+        if done:
+            return True
+        survivors = [i for i in range(self.n) if i != victim]
+        return victim >= 0 and \
+            all(ranks[i][0] == "X" for i in survivors)
+
+    def resolved(self, state) -> bool:
+        return self.is_terminal(state)
+
+    def successors(self, state):
+        ranks, confirmed, victim, kind, done = state
+        if self.is_terminal(state):
+            return []
+        out = []
+
+        def st(ranks=ranks, confirmed=confirmed, victim=victim,
+               kind=kind, done=done):
+            return (ranks, confirmed, victim, kind, done)
+
+        if victim < 0:
+            if self._faults:
+                for r in range(self.n):
+                    out.append((r, ("vic.crash",),
+                                st(ranks=_repl(ranks, r, ("C", 0)),
+                                   victim=r, kind="crash")))
+                    out.append((r, ("vic.freeze",),
+                                st(ranks=_repl(ranks, r, ("Z", 0)),
+                                   victim=r, kind="freeze")))
+            # no fault chosen: quiescent world — allowed terminal.
+            if not out:
+                return []
+            return out
+        survivors = [i for i in range(self.n) if i != victim]
+        if kind == "crash" and not confirmed:
+            out.append((victim, ("hb.confirm",), st(confirmed=True)))
+        for i in survivors:
+            ph, v = ranks[i]
+            if ph == "R":
+                for nv in (v, min(v + 1, 1)):
+                    out.append((i, ("sur.fail",),
+                                st(ranks=_repl(ranks, i, ("F", nv)))))
+            elif ph == "F":
+                out.append((i, ("sur.converge-poll",),
+                            st(ranks=_repl(ranks, i, ("K", v)))))
+            elif ph == "K" and kind == "freeze":
+                out.append((i, ("sur.reraise-suspect",),
+                            st(ranks=_repl(ranks, i, ("X", v)))))
+        if confirmed and all(ranks[i][0] == "K" for i in survivors):
+            nr = tuple(("S", v) if i in survivors else (ph, v)
+                       for i, (ph, v) in enumerate(ranks))
+            out.append(("world", ("sur.confirm-shrink",), st(ranks=nr)))
+        if survivors and all(ranks[i][0] == "S" for i in survivors):
+            vmax = max(ranks[i][1] for i in survivors)
+            nr = tuple(("R", vmax) if i in survivors else ranks[i]
+                       for i in range(self.n))
+            out.append(("world", ("sur.resync",),
+                        st(ranks=nr, done=True)))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Toy broken spec: torn commit REACHABLE (golden-counterexample fixture)
+# ---------------------------------------------------------------------------
+def toy_spec():
+    """A deliberately broken two-donor spec: donors snapshot at
+    *independent* boundaries (no membership exchange) and the joiner
+    commits with **no stamp-equality guard** — the torn-commit property
+    is reachable, and the shortest counterexample is the golden trace
+    fixture tier-1 asserts byte-for-byte."""
+    from .spec import ProtocolSpec, Transition
+
+    return ProtocolSpec(
+        name="toy-torn",
+        doc="broken on purpose: no boundary exchange, no torn reject",
+        roles=("donor", "joiner"),
+        states={"donor": ("idle", "stepped", "snapped"),
+                "joiner": ("wait", "metas", "committed")},
+        transitions=(
+            Transition("toy.step", "donor", "idle", "stepped",
+                       "internal:step"),
+            Transition("toy.snap-early", "donor", "idle", "snapped",
+                       "internal:snapshot",
+                       binds=("statesync.snapshot.Snapshot",)),
+            Transition("toy.snap-late", "donor", "stepped", "snapped",
+                       "internal:snapshot",
+                       binds=("statesync.snapshot.Snapshot",)),
+            Transition("toy.collect", "joiner", "wait", "metas",
+                       "internal:collect",
+                       binds=("statesync.stream.JoinerPuller"
+                              "._collect_metas",)),
+            Transition("toy.commit", "joiner", "metas", "committed",
+                       "internal:commit",
+                       doc="BROKEN: commits without comparing stamps"),
+        ),
+        properties={"torn-commit": "never commit mixed-stamp images"})
+
+
+class ToyTornModel(Model):
+    name = "toy-torn"
+
+    def __init__(self, ranks: int = 2, mutations=(), *,
+                 faults: bool = True) -> None:
+        self.n = int(ranks)
+        self.spec = (toy_spec(),)
+
+    def initial(self):
+        # donors: (step, stamp) with stamp -1 until snapped; joiner
+        # phase + collected stamps.
+        return (tuple((0, -1) for _ in range(self.n)), ("wait", ()))
+
+    def describe(self, state) -> str:
+        donors, (jph, metas) = state
+        ds = " ".join(f"d{i}:step{s}"
+                      f"{f'/snap{st}' if st >= 0 else ''}"
+                      for i, (s, st) in enumerate(donors))
+        return (f"[{ds}] joiner={jph}"
+                f"{f'/metas{list(metas)}' if metas else ''}")
+
+    def invariants(self, state):
+        donors, (jph, metas) = state
+        if jph == "committed" and len(set(metas)) > 1:
+            return ["torn-commit"]
+        return []
+
+    def is_terminal(self, state) -> bool:
+        _donors, (jph, _metas) = state
+        return jph == "committed"
+
+    def successors(self, state):
+        donors, (jph, metas) = state
+        if self.is_terminal(state):
+            return []
+        out = []
+        for i, (step, stamp) in enumerate(donors):
+            if stamp >= 0:
+                continue
+            if step == 0:
+                out.append((i, ("toy.snap-early",),
+                            (_repl(donors, i, (0, 0)), (jph, metas))))
+                out.append((i, ("toy.step",),
+                            (_repl(donors, i, (1, -1)), (jph, metas))))
+            else:
+                out.append((i, ("toy.snap-late",),
+                            (_repl(donors, i, (1, 1)), (jph, metas))))
+        if jph == "wait" and all(st >= 0 for _s, st in donors):
+            out.append(("J", ("toy.collect",),
+                        (donors, ("metas",
+                                  tuple(st for _s, st in donors)))))
+        if jph == "metas":
+            out.append(("J", ("toy.commit",),
+                        (donors, ("committed", metas))))
+        return out
+
+    def actor_label(self, actor):
+        if actor == "J":
+            return "joiner"
+        return f"donor {actor}" if isinstance(actor, int) else str(actor)

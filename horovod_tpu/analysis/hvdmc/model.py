@@ -1,0 +1,201 @@
+"""hvdmc explicit-state exploration kernel.
+
+A :class:`Model` is an executable protocol semantics whose transition
+labels are spec transition ids (:mod:`.spec`): :func:`explore` BFS-walks
+the global state space to a fixpoint, checking safety invariants at
+every state, flagging **stuck** states (no successors, not terminal),
+and — for models that define a resolution predicate — flagging states
+from which the protocol can no longer reach *any* resolution (the
+"join neither completes nor aborts" livelock class, AG EF resolved).
+
+Counterexamples are reconstructed from BFS parent pointers, so every
+reported trace is a shortest path and the rendering is deterministic
+(the golden-fixture contract: no wall times, no absolute paths — rank
+interleavings and spec-bound code sites only).
+"""
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass, field
+
+__all__ = ["ExploreResult", "Model", "PropertyViolation", "explore",
+           "render_trace"]
+
+
+class Model:
+    """Interface the machines implement.  States must be hashable and
+    successor enumeration deterministic."""
+
+    name = "model"
+    spec = None                  # ProtocolSpec (or a tuple of them)
+
+    def initial(self):
+        raise NotImplementedError
+
+    def successors(self, state):
+        """[(actor, tids, next_state)] — ``actor`` is a rank index or a
+        symbolic actor ("joiner", "world", "net"); ``tids`` a tuple of
+        spec transition ids fired atomically by this step."""
+        raise NotImplementedError
+
+    def invariants(self, state):
+        """Names of safety properties VIOLATED in `state` (empty=OK)."""
+        return ()
+
+    def is_terminal(self, state) -> bool:
+        """Accepting quiescent state (a successor-less non-terminal
+        state is reported as stuck)."""
+        return False
+
+    def resolved(self, state) -> bool | None:
+        """Protocol-resolution predicate for the AG EF check, or None
+        to skip it (models without a completion obligation)."""
+        return None
+
+    def describe(self, state) -> str:
+        return repr(state)
+
+    def actor_label(self, actor) -> str:
+        if isinstance(actor, int):
+            return f"rank {actor}"
+        return str(actor)
+
+
+@dataclass
+class PropertyViolation:
+    prop: str                    # property name, e.g. "torn-commit"
+    kind: str                    # "safety" | "stuck" | "unresolvable"
+    state: object
+    path: list                   # [(actor, tids, state_after)], from init
+    detail: str = ""
+
+
+@dataclass
+class ExploreResult:
+    model_name: str
+    states: int = 0
+    transitions: int = 0
+    fixpoint: bool = False
+    fired: set = field(default_factory=set)      # spec tids exercised
+    violations: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.fixpoint and not self.violations
+
+
+def _path_to(state, parents):
+    path = []
+    cur = state
+    while True:
+        prev = parents[cur]
+        if prev is None:
+            break
+        prev_state, actor, tids = prev
+        path.append((actor, tids, cur))
+        cur = prev_state
+    path.reverse()
+    return path
+
+
+def explore(model: Model, max_states: int = 400_000,
+            max_violations: int = 4) -> ExploreResult:
+    """BFS the model to a fixpoint (or the state cap), collecting the
+    first counterexample per violated property."""
+    res = ExploreResult(model_name=model.name)
+    init = model.initial()
+    parents: dict = {init: None}
+    order: list = [init]
+    edges: dict = collections.defaultdict(list)   # state -> [succ states]
+    frontier = collections.deque([init])
+    seen_props: set = set()
+    capped = False
+    while frontier:
+        state = frontier.popleft()
+        res.states += 1
+        for prop in model.invariants(state):
+            if prop not in seen_props and \
+                    len(res.violations) < max_violations:
+                seen_props.add(prop)
+                res.violations.append(PropertyViolation(
+                    prop=prop, kind="safety", state=state,
+                    path=_path_to(state, parents),
+                    detail=model.describe(state)))
+        succs = model.successors(state)
+        if not succs:
+            if not model.is_terminal(state) and \
+                    "stuck" not in seen_props and \
+                    len(res.violations) < max_violations:
+                seen_props.add("stuck")
+                res.violations.append(PropertyViolation(
+                    prop="no-stuck-state", kind="stuck", state=state,
+                    path=_path_to(state, parents),
+                    detail=model.describe(state)))
+            continue
+        for actor, tids, nxt in succs:
+            res.transitions += 1
+            res.fired.update(tids)
+            edges[state].append(nxt)
+            if nxt not in parents:
+                if len(parents) >= max_states:
+                    capped = True
+                    continue
+                parents[nxt] = (state, actor, tids)
+                order.append(nxt)
+                frontier.append(nxt)
+    res.fixpoint = not capped
+    # AG EF resolved: every reachable state must retain a path to some
+    # resolved state (models opting in via resolved()).
+    if res.fixpoint and model.resolved(init) is not None and \
+            len(res.violations) < max_violations:
+        resolved = {s for s in parents if model.resolved(s)}
+        rev = collections.defaultdict(list)
+        for s, outs in edges.items():
+            for d in outs:
+                rev[d].append(s)
+        can = set(resolved)
+        stack = list(resolved)
+        while stack:
+            for p in rev.get(stack.pop(), ()):
+                if p not in can:
+                    can.add(p)
+                    stack.append(p)
+        for s in order:                      # BFS order -> shortest first
+            if s not in can:
+                res.violations.append(PropertyViolation(
+                    prop="resolution-reachable", kind="unresolvable",
+                    state=s, path=_path_to(s, parents),
+                    detail=model.describe(s)))
+                break
+    return res
+
+
+def _binds_of(spec, tid: str) -> tuple:
+    specs = spec if isinstance(spec, (list, tuple)) else (spec,)
+    for sp in specs:
+        if sp is None:
+            continue
+        t = sp.transition(tid)
+        if t is not None:
+            return t.binds
+    return ()
+
+
+def render_trace(model: Model, violation: PropertyViolation) -> str:
+    """Deterministic rank-interleaved counterexample rendering: one line
+    per fired step, annotated with the code sites the spec binds the
+    transition to."""
+    lines = [f"hvdmc counterexample [{violation.prop}] "
+             f"({violation.kind}) in {model.name}"]
+    for i, (actor, tids, state) in enumerate(violation.path, start=1):
+        binds: list = []
+        for tid in tids:
+            for b in _binds_of(model.spec, tid):
+                if b not in binds:
+                    binds.append(b)
+        anno = f"  [{'; '.join(binds)}]" if binds else ""
+        lines.append(f"  {i:3d}. {model.actor_label(actor):<10} "
+                     f"{' + '.join(tids)}{anno}")
+        lines.append(f"       => {model.describe(state)}")
+    lines.append(f"  violated: {violation.prop} at: {violation.detail}")
+    return "\n".join(lines)

@@ -1,0 +1,163 @@
+"""hvdmc protocol-spec DSL — declarative state machines for the
+distributed membership/recovery protocols.
+
+A :class:`ProtocolSpec` names, for one protocol:
+
+- the **roles** (``incumbent``/``joiner``/``donor``/``survivor``/...),
+  each with its own finite state set;
+- the **message verbs** the protocol puts on a wire or a KV scope
+  (:class:`Verb`): frame verbs carry the code constant they correspond
+  to (``STATE_HELLO`` in ``common/tcp_transport.py``), KV verbs carry
+  the record-key prefix (``join:``), flag verbs name fields of the
+  step-boundary allgather exchange;
+- the **transitions** (:class:`Transition`): ``(role, src state, event,
+  dst state)`` plus the *guard* that must hold (named so a seeded
+  mutation can drop it), the code the transition **binds** to
+  (``statesync.service::StateSyncService._transition_grow`` — function
+  keys in the hvdsan call-graph naming scheme), the terminal call names
+  the bound code must contain (``requires_calls``), and the
+  flight-recorder event kind the transition emits (``observe``) so the
+  runtime trace witness can replay observed event logs against the
+  model.
+
+Three consumers share one spec:
+
+1. the **conformance pass** (HVD506, :mod:`.conformance`) diffs verbs
+   and handler transitions against the implementation ASTs — drift in
+   either direction is a lint error;
+2. the **model checker** (:mod:`.machines` + :mod:`.model`) explores an
+   executable N-rank model whose transition labels are spec transition
+   ids, so counterexample traces annotate with the bound code sites;
+3. the **trace witness** (:mod:`.witness`) maps observed flight-event
+   kinds back to transitions via ``observe`` and fails CI when an
+   observed protocol event has no transition in the model.
+
+The DSL is declarative on purpose: specs never import the runtime, so
+``python -m horovod_tpu.analysis.mc`` runs on a checkout with no JAX.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["ProtocolSpec", "Transition", "Verb"]
+
+
+@dataclass(frozen=True)
+class Verb:
+    """One message verb of a protocol.
+
+    ``kind`` is where the verb lives: ``frame`` = a STATE_MAGIC wire
+    frame kind (``const`` names the code constant, ``defined_in`` the
+    module path suffix that must define it), ``kv`` = a rendezvous-KV
+    record (``const`` is the key prefix the code writes/waits on),
+    ``flag`` = a field of the step-boundary allgather exchange.
+    """
+    name: str
+    kind: str = "frame"          # frame | kv | flag
+    const: str = ""              # code constant name / kv key prefix
+    defined_in: str = ""         # path suffix defining the constant
+    doc: str = ""
+
+
+@dataclass(frozen=True)
+class Transition:
+    """One edge of a role's protocol state machine."""
+    tid: str                     # unique id, e.g. "inc.boundary-grow"
+    role: str
+    src: str
+    dst: str
+    event: str                   # "send:V" | "recv:V" | "kv:V" |
+    #                              "boundary" | "internal:X" | "fault:X"
+    guard: str = ""              # named guard (mutations drop by name)
+    binds: tuple = ()            # hvdsan function keys the edge maps to
+    requires_calls: tuple = ()   # terminal call names the binding needs
+    observe: str = ""            # flight-event kind this edge emits
+    doc: str = ""
+
+
+@dataclass(frozen=True)
+class ProtocolSpec:
+    name: str
+    doc: str
+    roles: tuple
+    states: dict                 # role -> tuple of state names
+    verbs: tuple = ()
+    transitions: tuple = ()
+    # Module labels (hvdsan naming) whose presence in an analyzed set
+    # activates the conformance pass for this spec — single-fixture lint
+    # runs never see tree-wide drift errors.
+    anchor_modules: tuple = ()
+    properties: dict = field(default_factory=dict)   # name -> prose
+
+    # -- validation ------------------------------------------------------
+    def validate(self) -> list[str]:
+        """Structural self-check; returns problem strings (empty = OK)."""
+        problems = []
+        seen: set = set()
+        verb_names = {v.name for v in self.verbs}
+        for t in self.transitions:
+            if t.tid in seen:
+                problems.append(f"duplicate transition id {t.tid!r}")
+            seen.add(t.tid)
+            if t.role not in self.roles:
+                problems.append(f"{t.tid}: unknown role {t.role!r}")
+                continue
+            states = set(self.states.get(t.role, ()))
+            for s in (t.src, t.dst):
+                if s not in states:
+                    problems.append(
+                        f"{t.tid}: state {s!r} not declared for role "
+                        f"{t.role!r}")
+            head, _, rest = t.event.partition(":")
+            if head in ("send", "recv", "kv") and rest not in verb_names:
+                problems.append(
+                    f"{t.tid}: event verb {rest!r} not in the spec "
+                    f"vocabulary")
+            elif head not in ("send", "recv", "kv", "boundary",
+                              "internal", "fault"):
+                problems.append(f"{t.tid}: malformed event {t.event!r}")
+        return problems
+
+    # -- lookups ---------------------------------------------------------
+    def transition(self, tid: str) -> Transition | None:
+        for t in self.transitions:
+            if t.tid == tid:
+                return t
+        return None
+
+    def transitions_for(self, role: str) -> tuple:
+        return tuple(t for t in self.transitions if t.role == role)
+
+    def guards(self) -> frozenset:
+        return frozenset(t.guard for t in self.transitions if t.guard)
+
+    def observed_map(self) -> dict:
+        """flight-event kind -> tuple of transition ids emitting it."""
+        out: dict = {}
+        for t in self.transitions:
+            if t.observe:
+                out.setdefault(t.observe, []).append(t.tid)
+        return {k: tuple(v) for k, v in out.items()}
+
+    def role_adjacency(self, role: str) -> dict:
+        """state -> set of states one transition away (witness replay
+        uses the reflexive-transitive closure for per-rank ordering)."""
+        adj: dict = {s: set() for s in self.states.get(role, ())}
+        for t in self.transitions_for(role):
+            adj.setdefault(t.src, set()).add(t.dst)
+        return adj
+
+    def role_reachability(self, role: str) -> dict:
+        """state -> every state reachable through >= 0 transitions."""
+        adj = self.role_adjacency(role)
+        reach: dict = {}
+        for s in adj:
+            seen = {s}
+            stack = [s]
+            while stack:
+                for n in adj.get(stack.pop(), ()):
+                    if n not in seen:
+                        seen.add(n)
+                        stack.append(n)
+            reach[s] = seen
+        return reach

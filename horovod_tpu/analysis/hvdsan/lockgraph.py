@@ -134,6 +134,12 @@ class FuncRaw:
     writes: list = field(default_factory=list)       # [WriteEvent]
     local_types: dict = field(default_factory=dict)  # name -> type spine
     param_types: dict = field(default_factory=dict)
+    # Protocol-conformance facts (hvdmc HVD506): frame-verb constants
+    # this function compares on / packs, and its string literals (KV
+    # key prefixes and boundary-flag fields).
+    state_compares: set = field(default_factory=set)
+    state_packs: set = field(default_factory=set)
+    strs: set = field(default_factory=set)
 
 
 @dataclass
@@ -156,6 +162,9 @@ class ModuleRaw:
     functions: dict = field(default_factory=dict)    # name -> funckey
     threading_names: set = field(default_factory=set)  # from threading import X
     global_types: dict = field(default_factory=dict)   # module var -> type spine
+    int_consts: dict = field(default_factory=dict)   # NAME -> (value, line)
+    struct_fmts: dict = field(default_factory=dict)  # name -> (fmt, line)
+    strs: set = field(default_factory=set)           # module-level literals
 
 
 @dataclass
@@ -315,6 +324,7 @@ class Program:
         self.suppressions: dict[str, object] = {}    # path -> Suppressions
         self.wire_codecs: list = []                  # per-class encode/decode seqs
         self.wire_prims: dict[str, set] = {}         # Encoder/Decoder method names
+        self.state_frames: list = []                 # pack/unpack_state_frame facts
 
     def collect_source(self, path: str, source: str,
                        tree: ast.AST | None = None) -> None:
@@ -457,6 +467,10 @@ class _Collector(ast.NodeVisitor):
                 and self._cls:
             from .san import collect_wire_method
             collect_wire_method(self.p, self.mod, self._cls, node)
+        if node.name in ("pack_state_frame", "unpack_state_frame") \
+                and not self._cls_stack:
+            from .san import collect_state_frame
+            collect_state_frame(self.p, self.mod, node)
 
     visit_FunctionDef = _visit_function
     visit_AsyncFunctionDef = _visit_function
@@ -486,6 +500,20 @@ class _Collector(ast.NodeVisitor):
         tsp = _spine(target)
         if not tsp:
             return
+        # Module-level facts for the wire/spec drift rules: frame-kind
+        # constants (STATE_HELLO = 1) and struct.Struct formats.
+        if self._fn is None and self._cls is None and len(tsp) == 1:
+            if isinstance(value, ast.Constant) and \
+                    isinstance(value.value, int) and tsp[0].isupper():
+                self.mod.int_consts[tsp[0]] = (value.value,
+                                               target.lineno)
+            elif isinstance(value, ast.Call):
+                vsp = _spine(value.func)
+                if vsp and vsp[-1] == "Struct" and value.args and \
+                        isinstance(value.args[0], ast.Constant) and \
+                        isinstance(value.args[0].value, str):
+                    self.mod.struct_fmts[tsp[0]] = \
+                        (value.args[0].value, target.lineno)
         ctor = self._lock_ctor(value) if value is not None else None
         if ctor is not None:
             kind, cond_arg = ctor
@@ -616,11 +644,41 @@ class _Collector(ast.NodeVisitor):
                         thread_target = _spine(kw.value)
                     elif kw.arg == "name":
                         thread_name = self._name_literal(kw.value)
+            elif name == "Timer":
+                # threading.Timer(interval, function): a one-shot thread
+                # root (the preempt-grace backstop).  The ownership
+                # manifest's THREAD_ROOTS names it.
+                if len(node.args) >= 2:
+                    thread_target = _spine(node.args[1])
+                for kw in node.keywords:
+                    if kw.arg == "function":
+                        thread_target = _spine(kw.value)
+            if name == "pack_state_frame" and node.args:
+                asp = _spine(node.args[0])
+                if asp and len(asp) == 1 and asp[0].isupper():
+                    fn.state_packs.add(asp[0])
             fn.calls.append(CallEvent(
                 spine=sp, held=held, line=node.lineno,
                 kwnames=tuple(kw.arg for kw in node.keywords if kw.arg),
                 thread_target=thread_target, thread_name=thread_name))
         self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        if self._fn is not None:
+            for sub in (node.left, *node.comparators):
+                sp = _spine(sub)
+                if sp and sp[-1].isupper() and \
+                        sp[-1].startswith("STATE_") and \
+                        not sp[-1].endswith("MAGIC"):
+                    self._fn.state_compares.add(sp[-1])
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if isinstance(node.value, str) and len(node.value) <= 48:
+            if self._fn is not None:
+                self._fn.strs.add(node.value)
+            else:
+                self.mod.strs.add(node.value)
 
     @staticmethod
     def _name_literal(node: ast.AST) -> str | None:
@@ -1060,13 +1118,21 @@ class Analysis:
     def _fix_threads(self) -> None:
         for fn in self.program.functions.values():
             for ev in fn.calls:
-                if ev.spine[-1] != "Thread" or ev.thread_target is None:
+                if ev.spine[-1] not in ("Thread", "Timer") or \
+                        ev.thread_target is None:
                     continue
                 pseudo = CallEvent(spine=ev.thread_target, held=(),
                                    line=ev.line)
                 for tkey, _conf in self._resolve_call_uncached(fn, pseudo):
                     self.thread_roots[tkey] = ev.thread_name or \
                         f"thread@{fn.path}:{ev.line}"
+        # Manifest-declared roots (ownership.THREAD_ROOTS): Thread
+        # subclasses (run() overrides) and Timer callbacks static
+        # target resolution can miss get their stable names here.
+        from .ownership import THREAD_ROOTS
+        for tname, (funckey, _why) in THREAD_ROOTS.items():
+            if funckey in self.program.functions:
+                self.thread_roots[funckey] = tname
         reach: dict[str, set] = {k: set() for k in self.program.functions}
         for root, tname in self.thread_roots.items():
             stack = [root]
@@ -1308,8 +1374,11 @@ class Analysis:
         self._find_orphan_conditions()
         from .ownership import check_ownership
         check_ownership(self)
-        from .san import check_wire_drift
+        from .san import check_state_frame_drift, check_wire_drift
         check_wire_drift(self)
+        check_state_frame_drift(self)
+        from ..hvdmc.conformance import check_spec_conformance
+        check_spec_conformance(self)
         self.findings.sort(key=lambda f: (f.path, f.line, f.rule.id))
         return self
 

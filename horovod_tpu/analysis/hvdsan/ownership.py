@@ -173,6 +173,31 @@ def blocking_allowed_under(lock_key: str) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Named thread roots the static Thread(target=) scan cannot see
+# ---------------------------------------------------------------------------
+# thread name -> (function key of the thread body, why it exists).
+# Two shapes land here: Thread SUBCLASSES (run() overrides — no
+# target= keyword to resolve) and threading.Timer callbacks whose
+# receiver type the index fallback cannot bind.  HVD504's
+# cross-thread-write reachability seeds from these exactly like the
+# detected Thread(target=, name=) roots, so writes reachable from the
+# statesync watcher, the autoscale controller, or the preempt backstop
+# timer are checked against the ownership manifest.
+THREAD_ROOTS: dict[str, tuple[str, str]] = {
+    "hvd-statesync-watch": (
+        "statesync.service.StateSyncService._watch_loop",
+        "KV watcher polling join/ready records between boundaries"),
+    "hvd-autoscale": (
+        "statesync.autoscale.AutoscaleController.run",
+        "rank-0 Thread subclass driving the elastic target size"),
+    "hvd-preempt-backstop": (
+        "statesync.service.StateSyncService._grace_expired",
+        "SIGTERM-grace Timer: stamps bye| and exits 143 when no step "
+        "boundary arrives inside the grace window"),
+}
+
+
+# ---------------------------------------------------------------------------
 # HVD504 check (called from lockgraph.Analysis.analyze)
 # ---------------------------------------------------------------------------
 def check_ownership(analysis) -> None:

@@ -160,6 +160,130 @@ def _wire_tokens(node, side: str) -> list:
     return out
 
 
+def collect_state_frame(program, mod, node) -> None:
+    """Extract one side of the STATE_MAGIC frame codec
+    (``pack_state_frame``/``unpack_state_frame`` module functions) for
+    the statesync half of HVD505: header struct identity, header field
+    order, and the magic constant each side keys on."""
+    from .lockgraph import _spine
+    side = "pack" if node.name.startswith("pack") else "unpack"
+    hdr = None
+    fields: list = []
+    magics: set = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "MAGIC" in sub.id:
+            magics.add(sub.id)
+        if not isinstance(sub, ast.Call):
+            continue
+        sp = _spine(sub.func)
+        if not sp or len(sp) < 2:
+            continue
+        if side == "pack" and sp[-1] == "pack":
+            hdr = sp[-2]
+            for a in sub.args:
+                # len(...) and other computed args are positionally
+                # uncomparable: record None so only named fields diff.
+                if isinstance(a, ast.Name):
+                    fields.append(a.id)
+                elif isinstance(a, ast.Attribute):
+                    fields.append(a.attr)
+                else:
+                    fields.append(None)
+        elif side == "unpack" and sp[-1] in ("unpack", "unpack_from"):
+            hdr = sp[-2]
+    if side == "unpack":
+        # Header field order = the tuple-assign targets of unpack_from.
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign) or \
+                    not isinstance(sub.value, ast.Call):
+                continue
+            vsp = _spine(sub.value.func)
+            if vsp and vsp[-1] in ("unpack", "unpack_from"):
+                tgt = sub.targets[0]
+                if isinstance(tgt, ast.Tuple):
+                    fields = [t.id if isinstance(t, ast.Name) else None
+                              for t in tgt.elts]
+                elif isinstance(tgt, ast.Name):
+                    fields = [tgt.id]
+    program.state_frames.append({
+        "module": mod.label, "path": mod.path, "line": node.lineno,
+        "side": side, "hdr": hdr, "fields": tuple(fields),
+        "magics": frozenset(magics)})
+
+
+def check_state_frame_drift(analysis: Analysis) -> None:
+    """HVD505 over the statesync STATE_MAGIC frame codec: the pack and
+    unpack halves must agree on the header struct format, the header
+    field order, and the magic prefix — and the frame-kind constants
+    (``STATE_*``) must carry unique wire values (two verbs sharing a
+    value dispatch each other's frames)."""
+    program = analysis.program
+    by_mod: dict = {}
+    for rec in program.state_frames:
+        by_mod.setdefault(rec["module"], {})[rec["side"]] = rec
+    for modlabel, sides in sorted(by_mod.items()):
+        mod = program.modules.get(modlabel)
+        pack, unpack = sides.get("pack"), sides.get("unpack")
+        if pack is None or unpack is None:
+            rec = pack or unpack
+            other = "unpack_state_frame" if unpack is None \
+                else "pack_state_frame"
+            analysis._emit(
+                "wire-schema-drift", "error", rec["path"], rec["line"],
+                f"{rec['side']}_state_frame has no matching {other} in "
+                f"the same module: a one-sided frame codec cannot "
+                f"round-trip")
+            continue
+        fmts = mod.struct_fmts if mod else {}
+        pf = fmts.get(pack["hdr"], (None, 0))[0]
+        uf = fmts.get(unpack["hdr"], (None, 0))[0]
+        if pf is not None and uf is not None and pf != uf:
+            analysis._emit(
+                "wire-schema-drift", "error", unpack["path"],
+                unpack["line"],
+                f"state-frame header drift: pack_state_frame packs "
+                f"{pack['hdr']}({pf!r}) but unpack_state_frame reads "
+                f"{unpack['hdr']}({uf!r}) — every frame decodes "
+                f"garbage on the peer")
+        if pack["magics"] and unpack["magics"] and \
+                not (pack["magics"] & unpack["magics"]):
+            analysis._emit(
+                "wire-schema-drift", "error", unpack["path"],
+                unpack["line"],
+                f"state-frame magic drift: pack prefixes with "
+                f"{sorted(pack['magics'])} but unpack checks "
+                f"{sorted(unpack['magics'])}")
+        n = min(len(pack["fields"]), len(unpack["fields"]))
+        for i in range(n):
+            a, b = pack["fields"][i], unpack["fields"][i]
+            if a and b and a != b and \
+                    {a, b} & (set(pack["fields"])
+                              & set(unpack["fields"])):
+                analysis._emit(
+                    "wire-schema-drift", "error", unpack["path"],
+                    unpack["line"],
+                    f"state-frame header field-order drift at "
+                    f"position #{i + 1}: pack writes '{a}' where "
+                    f"unpack assigns '{b}' — same width, swapped "
+                    f"fields decode silently wrong")
+                break
+    # Frame-kind verbs must have unique wire values per module.
+    for modlabel, mod in sorted(program.modules.items()):
+        verbs = {k: v for k, v in mod.int_consts.items()
+                 if k.startswith("STATE_")}
+        byval: dict = {}
+        for k, (val, line) in sorted(verbs.items()):
+            prior = byval.get(val)
+            if prior is not None:
+                analysis._emit(
+                    "wire-schema-drift", "error", mod.path, line,
+                    f"frame kinds {prior} and {k} share wire value "
+                    f"{val}: one verb's frames dispatch as the "
+                    f"other's")
+            else:
+                byval[val] = k
+
+
 def check_wire_drift(analysis: Analysis) -> None:
     """HVD505: encode/decode primitive sequences must agree per class,
     and only use primitives both wire codec classes define."""

@@ -850,38 +850,74 @@ def iter_python_files(paths: list[str]):
                         yield os.path.join(root, f)
 
 
-def changed_py_files(paths: list[str]) -> list[str] | None:
+def changed_py_files(paths: list[str], diff_base: str | None = None
+                     ) -> tuple[list[str] | None, str | None]:
     """Python files git reports modified/staged/untracked under
-    ``paths`` (--changed-only).  None when git is unavailable — callers
-    fall back to the full walk."""
+    ``paths`` (--changed-only), **following renames** (a renamed file
+    is linted at its new path).  Returns ``(files, warning)``:
+    ``(None, reason)`` when git is unavailable, errors out, or the
+    requested ``--diff-base`` ref is missing — callers fall back to
+    the full walk and surface the structured warning instead of
+    crashing (CI must degrade to over-checking, never under-)."""
     import subprocess
+
+    def _git(argv):
+        return subprocess.run(["git"] + argv, capture_output=True,
+                              text=True, timeout=30)
+
+    out: set[str] = set()
     try:
-        proc = subprocess.run(
-            ["git", "status", "--porcelain", "--no-renames", "--"]
-            + list(paths),
-            capture_output=True, text=True, timeout=30)
-    except (OSError, subprocess.TimeoutExpired):
-        return None
+        proc = _git(["status", "--porcelain", "--find-renames", "--"]
+                    + list(paths))
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        return None, f"git unavailable ({exc.__class__.__name__}); " \
+                     f"fell back to a full-tree scan"
     if proc.returncode != 0:
-        return None
-    out = []
+        return None, (f"git status failed "
+                      f"({proc.stderr.strip() or proc.returncode}); "
+                      f"fell back to a full-tree scan")
     for line in proc.stdout.splitlines():
         if len(line) < 4:
             continue
         name = line[3:].strip().strip('"')
+        if " -> " in name:       # rename: lint the NEW path
+            name = name.split(" -> ", 1)[1].strip().strip('"')
         if name.endswith(".py") and os.path.isfile(name):
-            out.append(name)
-    return sorted(set(out))
+            out.add(name)
+    if diff_base:
+        try:
+            proc = _git(["diff", "--name-status", "-M", diff_base,
+                         "--"] + list(paths))
+        except (OSError, subprocess.TimeoutExpired) as exc:
+            return None, (f"git diff vs {diff_base!r} unavailable "
+                          f"({exc.__class__.__name__}); fell back to "
+                          f"a full-tree scan")
+        if proc.returncode != 0:
+            return None, (f"diff base {diff_base!r} missing or "
+                          f"unusable "
+                          f"({proc.stderr.strip() or proc.returncode});"
+                          f" fell back to a full-tree scan")
+        for line in proc.stdout.splitlines():
+            parts = line.split("\t")
+            if len(parts) < 2:
+                continue
+            # Rxx old new / Cxx old new: last column is the new path.
+            name = parts[-1].strip().strip('"')
+            if name.endswith(".py") and os.path.isfile(name):
+                out.add(name)
+    return sorted(out), None
 
 
 def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
-                     san: bool = False, changed_only: bool = False
+                     san: bool = False, changed_only: bool = False,
+                     diff_base: str | None = None
                      ) -> tuple[list[Violation], list, dict]:
     """One parse + one rule walk per file; hvdsan (``san=True``) rides
     the SAME trees.  Returns (violations, san findings, stats)."""
     import time as _time
     cfg = cfg or LintConfig()
     out: list[Violation] = []
+    warnings: list[str] = []
     barrier_sites: dict[str, _BarrierSite] = {}
     program = None
     if san:
@@ -889,10 +925,13 @@ def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
         program = Program()
     files = list(iter_python_files(paths))
     if changed_only:
-        changed = changed_py_files(paths)
+        changed, warning = changed_py_files(paths,
+                                            diff_base=diff_base)
         if changed is not None:
             keep = {os.path.normpath(c) for c in changed}
             files = [f for f in files if os.path.normpath(f) in keep]
+        else:
+            warnings.append(f"--changed-only: {warning}")
     t0 = _time.monotonic()
     nfiles = 0
     for path in files:
@@ -920,7 +959,8 @@ def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
         analysis = Analysis(program).analyze()
         findings = [f for f in analysis.findings if cfg.wants(f.rule)]
     stats = {"files": nfiles,
-             "wall_ms": round((_time.monotonic() - t0) * 1e3, 3)}
+             "wall_ms": round((_time.monotonic() - t0) * 1e3, 3),
+             "warnings": warnings}
     out.sort(key=lambda v: (v.path, v.line, v.col, v.rule.id))
     return out, findings, stats
 
@@ -959,9 +999,15 @@ def main(argv: list[str] | None = None) -> int:
                         help="extra basenames/path suffixes allowed to "
                              "write manifest-owned shared state (HVD401)")
     parser.add_argument("--changed-only", action="store_true",
-                        help="lint only files git reports as changed "
-                             "(fast CI gate; cross-file rules see only "
-                             "the changed set)")
+                        help="lint only files git reports as changed, "
+                             "following renames (fast CI gate; "
+                             "cross-file rules see only the changed "
+                             "set; falls back to the full tree with a "
+                             "structured warning when git or the diff "
+                             "base is unavailable)")
+    parser.add_argument("--diff-base", metavar="REF",
+                        help="with --changed-only, also include files "
+                             "changed since REF (git diff -M REF)")
     parser.add_argument("--san", action="store_true",
                         help="also run the hvdsan whole-program "
                              "concurrency analysis (HVD501-505) over "
@@ -975,14 +1021,18 @@ def main(argv: list[str] | None = None) -> int:
                                 for b in args.owner_files.split(",")
                                 if b.strip()}
     violations, findings, stats = lint_paths_timed(
-        args.paths, cfg, san=args.san, changed_only=args.changed_only)
+        args.paths, cfg, san=args.san, changed_only=args.changed_only,
+        diff_base=args.diff_base)
     errors = [f for f in findings if f.severity == "error"]
+    for w in stats["warnings"]:
+        print(f"hvdlint: warning: {w}", file=sys.stderr)
     if args.format == "json":
         print(json.dumps({
             "violations": [v.json() for v in violations],
             "san": [f.json() for f in findings],
             "files": stats["files"],
             "wall_ms": stats["wall_ms"],
+            "warnings": stats["warnings"],
         }, indent=2))
     elif args.format == "sarif":
         from .hvdsan.san import sarif_payload

@@ -1,0 +1,12 @@
+"""``python -m horovod_tpu.analysis.mc`` — explicit-state model
+checking of the elastic membership, statesync, and recovery protocols.
+
+Thin entry shim over :mod:`horovod_tpu.analysis.hvdmc.cli` (kept as a
+module so the documented spelling works; the package also exposes
+``python -m horovod_tpu.analysis.hvdmc``)."""
+import sys
+
+from .hvdmc.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

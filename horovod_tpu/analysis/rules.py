@@ -74,6 +74,15 @@ _RULE_LIST = [
          "on both sides: every frame after the drifting field decodes "
          "garbage on the peer (the fp_*/tm_*/trace_* growth pattern "
          "with no cross-check)."),
+    Rule("HVD506", "spec-conformance",
+         "The implementation drifted from a co-located hvdmc protocol "
+         "spec (statesync/specs.py, resilience/specs.py), in either "
+         "direction: a frame verb or handler branch the spec does not "
+         "know (the model checker never explores it), or a spec "
+         "transition whose bound function, required call, or message "
+         "vocabulary no longer exists in the code (the checker "
+         "verifies a protocol nobody runs).  Update the spec and the "
+         "code in the same change."),
     Rule("HVD901", "bare-suppression",
          "hvdlint suppression without a '-- <justification>' comment."),
     Rule("HVD902", "syntax-error",

@@ -1,0 +1,83 @@
+"""Protocol spec for the hard-failure shrink-convergence path (hvdmc).
+
+Co-located with ``policy.py``: a collective raised ``RanksFailedError``
+on some (possibly different) step of every survivor; before any of them
+renumbers the world they must converge on the heartbeat-CONFIRMED dead
+set — suspicion alone (a slow-but-alive peer) must never shrink the
+world — and realign replicated state afterwards
+(``resync_replicated``).  Shared by the serving shrink handler
+(``serving/replica.py``) and the statesync failure-shrink transition.
+"""
+from __future__ import annotations
+
+from ..analysis.hvdmc.spec import ProtocolSpec, Transition, Verb
+
+__all__ = ["shrink_spec"]
+
+_POLICY = "resilience.policy"
+_SVC = "statesync.service.StateSyncService"
+_REPLICA = "serving.replica.ReplicaExecutor"
+
+
+def shrink_spec() -> ProtocolSpec:
+    transitions = (
+        Transition("vic.crash", "victim", "run", "crashed",
+                   "fault:crash"),
+        Transition("vic.freeze", "victim", "run", "frozen",
+                   "fault:freeze",
+                   doc="alive but wedged: suspect, never confirmable"),
+        Transition("hb.confirm", "victim", "crashed", "crashed",
+                   "internal:heartbeat-confirms",
+                   doc="stale stamps + transport evidence upgrade the "
+                       "suspect to CONFIRMED"),
+        Transition("sur.fail", "survivor", "run", "failcaught",
+                   "internal:ranks-failed",
+                   binds=(f"{_SVC}.shrink_on_failure",
+                          f"{_REPLICA}._shrink_and_resume"),
+                   doc="survivors can catch the failure on DIFFERENT "
+                       "steps (one applied the last update, a neighbor "
+                       "did not)"),
+        Transition("sur.converge-poll", "survivor", "failcaught",
+                   "converging", "internal:poll",
+                   requires_calls=("poll_once",),
+                   binds=(f"{_POLICY}.converge_confirmed_dead",)),
+        Transition("sur.confirm-shrink", "survivor", "converging",
+                   "shrunk", "internal:confirmed-stable",
+                   guard="confirmed-only",
+                   requires_calls=("reinit_world",), observe="shrink",
+                   binds=(f"{_SVC}.shrink_on_failure",)),
+        Transition("sur.reraise-suspect", "survivor", "converging",
+                   "raised", "internal:unconfirmable",
+                   guard="confirmed-only",
+                   binds=(f"{_POLICY}.converge_confirmed_dead",),
+                   doc="no confirmation inside two fault windows: "
+                       "re-raise rather than shrink over a live peer"),
+        Transition("sur.resync", "survivor", "shrunk", "run",
+                   "internal:resync",
+                   requires_calls=("broadcast_object",),
+                   binds=("statesync.service.resync_replicated",),
+                   doc="the most-advanced survivor broadcasts; every "
+                       "rank adopts its state version"),
+    )
+    return ProtocolSpec(
+        name="resilience-shrink",
+        doc="hard-failure shrink convergence (docs/resilience.md)",
+        roles=("victim", "survivor"),
+        states={"victim": ("run", "crashed", "frozen"),
+                "survivor": ("run", "failcaught", "converging",
+                             "shrunk", "raised")},
+        verbs=(Verb("BYE", "kv", "bye|",
+                    doc="orderly-shutdown liveness stamp: an epoch-"
+                        "rebuilding rank is never mistaken for dead"),),
+        transitions=transitions,
+        anchor_modules=(_POLICY,),
+        properties={
+            "never-shrink-live":
+                "a frozen (alive) peer is never in any committed dead "
+                "set — convergence re-raises instead",
+            "dead-set-agreement":
+                "every survivor commits the identical dead set",
+            "resync-equal":
+                "after resync every survivor holds the same state "
+                "version",
+        })

@@ -419,6 +419,13 @@ class ReplicaExecutor:
         survivors = [r for r in range(self.size) if r not in dead]
         new_rank = survivors.index(self.rank)
         new_size = len(survivors)
+        from ..telemetry import flight
+
+        rec = flight.recorder()
+        if rec.enabled:
+            rec.record("shrink", f"dead {sorted(dead)}",
+                       detail=f"serving {self.size}->{new_size} at "
+                              f"step {self._step}")
         logger.warning(
             "serving: shrink %d->%d (dead=%s); this rank %d -> %d",
             self.size, new_size, sorted(dead), self.rank, new_rank)

@@ -307,6 +307,13 @@ class StateSyncService:
         donor.offer_snapshot(0, snap)
         donor.start()
         self._donors[join_id] = donor
+        from ..telemetry import flight
+
+        rec = flight.recorder()
+        if rec.enabled:
+            rec.record("donate", f"join {join_id}",
+                       detail=f"{len(snap)} bytes from the step-"
+                              f"{self._seq} boundary snapshot")
         logger.info("statesync: join %d admitted; donating %d bytes "
                     "from the step-%d boundary snapshot", join_id,
                     len(snap), self._seq)
@@ -384,6 +391,14 @@ class StateSyncService:
         new_rank = survivors.index(old_rank)
         tag = "_".join(str(r) for r in departing)
         new_epoch = f"{epoch}~p{tag}"
+        from ..telemetry import flight
+
+        rec = flight.recorder()
+        if rec.enabled:
+            rec.record("shrink-proactive", f"departed {departing}",
+                       detail=f"{old_size}->{len(survivors)} at "
+                              f"boundary {self._seq}; no "
+                              f"RanksFailedError anywhere")
         logger.warning("statesync: proactive shrink %d->%d (preempted "
                        "rank(s) %s); this rank %d -> %d", old_size,
                        len(survivors), departing, old_rank, new_rank)
@@ -410,6 +425,13 @@ class StateSyncService:
         survivors = [r for r in range(old_size) if r not in dead]
         new_rank = survivors.index(old_rank)
         tag = "_".join(str(r) for r in sorted(dead))
+        from ..telemetry import flight
+
+        rec = flight.recorder()
+        if rec.enabled:
+            rec.record("shrink", f"dead {sorted(dead)}",
+                       detail=f"{old_size}->{len(survivors)}; "
+                              f"heartbeat-confirmed set")
         logger.warning("statesync: failure shrink %d->%d (dead=%s); "
                        "this rank %d -> %d", old_size, len(survivors),
                        sorted(dead), old_rank, new_rank)
@@ -520,6 +542,13 @@ def join_world(template_state: Any, *, timeout: float | None = None,
                                     f"{os.getpid()}:{attempt}")
         kv.put(scope, f"join:{join_id}",
                json.dumps({"id": join_id, "epoch": epoch}).encode())
+        from ..telemetry import flight
+
+        rec = flight.recorder()
+        if rec.enabled:
+            rec.record("join-announce", f"join {join_id}",
+                       detail=f"epoch {epoch}, {size} donors, "
+                              f"attempt {attempt}")
         puller = JoinerPuller(kv, sync_scope(epoch, join_id), size,
                               timeout=timeout)
         try:
@@ -530,6 +559,12 @@ def join_world(template_state: Any, *, timeout: float | None = None,
             bulk_stats = dict(puller.donor_stats)
             kv.put(scope, f"ready:{join_id}",
                    json.dumps(stamp.as_meta()).encode())
+            if rec.enabled:
+                # Ready is posted ONLY after pull_round digest-verified
+                # the bulk image (the spec guard "ready-after-verify").
+                rec.record("join-ready", f"join {join_id}",
+                           detail=f"bulk {stamp.nbytes} bytes verified "
+                                  f"in {catch_up_ms:.0f} ms")
             go = json.loads(kv.wait(scope, f"go:{join_id}", timeout))
             if go["final"]:
                 image, stamp = puller.pull_round(1)
@@ -554,6 +589,11 @@ def join_world(template_state: Any, *, timeout: float | None = None,
         tree = unflatten_state(image, template_state)
         core.reinit_world(rank=int(go["rank"]), size=int(go["size"]),
                           epoch=go["epoch"])
+        rec = flight.recorder()
+        if rec.enabled:
+            rec.record("join-entered",
+                       f"rank {go['rank']}/{go['size']}",
+                       detail=f"epoch {go['epoch']} seq {go['seq']}")
         from ..telemetry import metrics
 
         metrics().histogram(

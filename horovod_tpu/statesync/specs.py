@@ -1,0 +1,337 @@
+"""Protocol specs for the statesync membership machinery (hvdmc DSL).
+
+Three specs, co-located with the implementation they bind to so a
+protocol change and its spec change land in one diff — the HVD506
+conformance pass (``analysis/hvdmc/conformance.py``) fails the tree
+when they drift in either direction:
+
+- :func:`stream_spec` — the STATE_MAGIC peer-streaming wire protocol
+  (``stream.py`` over the frame verbs in ``common/tcp_transport.py``);
+- :func:`grow_spec` — the step-synchronous membership boundary and the
+  zero-downtime grow transition (``service.py`` + the joiner half of
+  ``join_world``);
+- :func:`preempt_spec` — SIGTERM preemption grace: boundary departure,
+  proactive survivor shrink, and the backstop timer.
+
+The specs are pure data (no runtime imports): the model checker
+(``python -m horovod_tpu.analysis.mc``) explores executable semantics
+labeled with these transition ids, and the trace witness replays mp
+battery flight logs against them via the ``observe`` event kinds.
+"""
+from __future__ import annotations
+
+from ..analysis.hvdmc.spec import ProtocolSpec, Transition, Verb
+
+__all__ = ["grow_spec", "preempt_spec", "stream_spec"]
+
+_TCPT = "common/tcp_transport.py"
+_SERVICE = "statesync.service"
+_STREAM = "statesync.stream"
+_SVC = f"{_SERVICE}.StateSyncService"
+
+
+def stream_spec() -> ProtocolSpec:
+    """STATE_MAGIC streaming: joiner pulls disjoint, CRC-checked shards
+    of a stamped snapshot from every donor; nothing is *state* until the
+    assembled image reproduces the donors' unanimous stamp."""
+    verbs = (
+        Verb("HELLO", "frame", "STATE_HELLO", _TCPT,
+             "joiner -> donor: open a snapshot round"),
+        Verb("META", "frame", "STATE_META", _TCPT,
+             "donor -> joiner: the snapshot stamp + byte total"),
+        Verb("REQ", "frame", "STATE_REQ", _TCPT,
+             "joiner -> donor: request a byte range"),
+        Verb("DATA", "frame", "STATE_DATA", _TCPT,
+             "donor -> joiner: one CRC-stamped chunk"),
+        Verb("END", "frame", "STATE_END", _TCPT,
+             "donor -> joiner: requested range fully streamed"),
+        Verb("BYE", "frame", "STATE_BYE", _TCPT,
+             "joiner -> donor: transfer complete, stand down"),
+    )
+    transitions = (
+        Transition("donor.hello", "donor", "serving", "serving",
+                   "recv:HELLO",
+                   binds=(f"{_STREAM}.DonorServer._serve",),
+                   doc="block until the wanted snapshot round arrives"),
+        Transition("donor.send-meta", "donor", "serving", "serving",
+                   "send:META",
+                   binds=(f"{_STREAM}.DonorServer._serve",)),
+        Transition("donor.serve-range", "donor", "serving", "serving",
+                   "recv:REQ",
+                   binds=(f"{_STREAM}.DonorServer._serve",)),
+        Transition("donor.send-data", "donor", "serving", "serving",
+                   "send:DATA",
+                   binds=(f"{_STREAM}.DonorServer._serve_range",)),
+        Transition("donor.send-end", "donor", "serving", "serving",
+                   "send:END",
+                   binds=(f"{_STREAM}.DonorServer._serve_range",)),
+        Transition("donor.bye", "donor", "serving", "done", "recv:BYE",
+                   binds=(f"{_STREAM}.DonorServer._serve",)),
+        Transition("donor.round-timeout", "donor", "serving", "done",
+                   "fault:joiner-lost",
+                   binds=(f"{_STREAM}.DonorServer.run",),
+                   doc="joiner death/deadline: stand down quietly; the "
+                       "main thread's world was never blocked on this"),
+        Transition("join.hello", "joiner", "connect", "hello",
+                   "send:HELLO",
+                   binds=(f"{_STREAM}.JoinerPuller._collect_metas",)),
+        Transition("join.meta", "joiner", "hello", "metas", "recv:META",
+                   binds=(f"{_STREAM}.JoinerPuller._collect_metas",)),
+        Transition("join.stamps-ok", "joiner", "metas", "pull",
+                   "internal:stamps-unanimous",
+                   guard="stamps-unanimous",
+                   binds=(f"{_STREAM}.JoinerPuller._collect_metas",)),
+        Transition("join.torn-reject", "joiner", "metas", "aborted",
+                   "internal:torn-stamp",
+                   guard="stamps-unanimous", observe="torn-reject",
+                   binds=(f"{_STREAM}.JoinerPuller._collect_metas",),
+                   doc="donors cut at different steps: reject the whole "
+                       "round before a single byte is interpreted"),
+        Transition("join.req", "joiner", "pull", "pull", "send:REQ",
+                   binds=(f"{_STREAM}.JoinerPuller._pull_range",)),
+        Transition("join.data", "joiner", "pull", "pull", "recv:DATA",
+                   guard="chunk-crc",
+                   binds=(f"{_STREAM}.JoinerPuller._pull_range",)),
+        Transition("join.end", "joiner", "pull", "pull", "recv:END",
+                   binds=(f"{_STREAM}.JoinerPuller._pull_range",)),
+        Transition("join.crc-reject", "joiner", "pull", "aborted",
+                   "internal:crc-mismatch", guard="chunk-crc",
+                   observe="torn-reject",
+                   binds=(f"{_STREAM}.JoinerPuller._pull_range",)),
+        Transition("join.donor-died", "joiner", "pull", "pull",
+                   "fault:donor-death",
+                   binds=(f"{_STREAM}.JoinerPuller.pull_round",),
+                   doc="reassign the dead donor's unfinished tail to a "
+                       "survivor (chunk-granular resume)"),
+        Transition("join.verify", "joiner", "pull", "verified",
+                   "internal:digest-verifies", guard="digest-verifies",
+                   binds=(f"{_STREAM}.JoinerPuller.pull_round",
+                          f"{_STREAM}.JoinerPuller.verify_round")),
+        Transition("join.digest-reject", "joiner", "pull", "aborted",
+                   "internal:digest-mismatch", guard="digest-verifies",
+                   observe="torn-reject",
+                   binds=(f"{_STREAM}.JoinerPuller.verify_round",)),
+        Transition("join.bye", "joiner", "verified", "done", "send:BYE",
+                   binds=(f"{_STREAM}.JoinerPuller.close",)),
+    )
+    return ProtocolSpec(
+        name="statesync-stream",
+        doc="STATE_MAGIC peer state streaming (docs/statesync.md)",
+        roles=("donor", "joiner"),
+        states={"donor": ("idle", "serving", "done"),
+                "joiner": ("connect", "hello", "metas", "pull",
+                           "verified", "done", "aborted")},
+        verbs=verbs,
+        transitions=(
+            Transition("donor.mesh-join", "donor", "idle", "serving",
+                       "internal:mesh-formed",
+                       binds=(f"{_STREAM}.DonorServer._serve",)),
+        ) + transitions,
+        anchor_modules=(_STREAM, "common.tcp_transport"),
+        properties={
+            "no-torn-commit":
+                "an image is consumed only after it reproduces the "
+                "donors' unanimous (epoch, step, digest) stamp",
+            "resumable":
+                "a donor death mid-stream never loses committed chunks",
+        })
+
+
+def grow_spec() -> ProtocolSpec:
+    """Step-synchronous membership boundary + the grow transition."""
+    verbs = (
+        Verb("JOIN", "kv", "join:", doc="joiner's announcement record"),
+        Verb("READY", "kv", "ready:",
+             doc="joiner's bulk image digest-verified"),
+        Verb("GO", "kv", "go:",
+             doc="rank 0's grow commit: new epoch/size/rank/seq"),
+        Verb("WORLD", "kv", "world",
+             doc="rank 0's world identity record"),
+        Verb("JOINFLAG", "flag", "join",
+             doc="boundary-allgather field: locally watched join id"),
+        Verb("READYFLAG", "flag", "ready",
+             doc="boundary-allgather field: locally watched ready id"),
+        Verb("DEPARTFLAG", "flag", "depart",
+             doc="boundary-allgather field: SIGTERM departure intent"),
+    )
+    transitions = (
+        # -- incumbent ---------------------------------------------------
+        Transition("inc.step", "incumbent", "run", "bound",
+                   "internal:step", binds=(f"{_SVC}.step_boundary",)),
+        Transition("inc.watch-join", "incumbent", "run", "run",
+                   "kv:JOIN", binds=(f"{_SVC}._watch_once",)),
+        Transition("inc.watch-ready", "incumbent", "run", "run",
+                   "kv:READY", binds=(f"{_SVC}._watch_once",)),
+        Transition("inc.boundary-idle", "incumbent", "bound", "run",
+                   "boundary", binds=(f"{_SVC}.step_boundary",)),
+        Transition("inc.boundary-admit", "incumbent", "bound", "run",
+                   "boundary", guard="single-active-join",
+                   observe="donate",
+                   binds=(f"{_SVC}.step_boundary",
+                          f"{_SVC}._start_donation"),
+                   doc="every rank snapshots at the SAME boundary the "
+                       "merged exchange admitted the join at"),
+        Transition("inc.boundary-grow", "incumbent", "bound", "rebuild",
+                   "boundary", guard="joiner-ready-verified",
+                   requires_calls=("reinit_world",), observe="grow",
+                   binds=(f"{_SVC}._transition_grow",)),
+        Transition("inc.post-go", "incumbent", "rebuild", "rebuild",
+                   "kv:GO", binds=(f"{_SVC}._transition_grow",)),
+        Transition("inc.world-formed", "incumbent", "rebuild", "run",
+                   "internal:mesh-formed",
+                   binds=(f"{_SVC}._refresh_world",)),
+        Transition("inc.publish-world", "incumbent", "run", "run",
+                   "kv:WORLD", binds=(f"{_SVC}._refresh_world",)),
+        Transition("inc.formation-timeout", "incumbent", "rebuild",
+                   "failed", "fault:joiner-lost",
+                   binds=(f"{_SVC}._transition_grow",),
+                   doc="joiner died after GO: the N+1 mesh formation "
+                       "times out into a structured, detected failure "
+                       "(never a silent wedge)"),
+        # -- joiner ------------------------------------------------------
+        Transition("join.announce", "joiner", "idle", "announced",
+                   "kv:JOIN", observe="join-announce",
+                   binds=(f"{_SERVICE}.join_world",)),
+        Transition("join.bulk", "joiner", "announced", "bulk",
+                   "internal:bulk-stream",
+                   binds=(f"{_SERVICE}.join_world",),
+                   doc="the statesync-stream machine runs here"),
+        Transition("join.bulk-abort", "joiner", "bulk", "aborted",
+                   "internal:stream-failed",
+                   binds=(f"{_SERVICE}.join_world",)),
+        Transition("join.post-ready", "joiner", "bulk", "ready",
+                   "kv:READY", guard="ready-after-verify",
+                   observe="join-ready",
+                   binds=(f"{_SERVICE}.join_world",),
+                   doc="ready is posted ONLY after the bulk image "
+                       "digest-verified — the boundary ack mutation "
+                       "the checker must catch drops this guard"),
+        Transition("join.see-go", "joiner", "ready", "final", "kv:GO",
+                   binds=(f"{_SERVICE}.join_world",)),
+        Transition("join.final-abort", "joiner", "final", "aborted",
+                   "internal:stream-failed",
+                   binds=(f"{_SERVICE}.join_world",)),
+        Transition("join.enter", "joiner", "final", "entered",
+                   "internal:enter-world",
+                   requires_calls=("reinit_world",),
+                   observe="join-entered",
+                   binds=(f"{_SERVICE}.join_world",)),
+        # -- injected faults ---------------------------------------------
+        Transition("net.flag-drop", "net", "env", "env",
+                   "fault:flag-drop",
+                   doc="one rank's boundary-exchange receipt is lost: "
+                       "it admits the join one boundary late and "
+                       "donates a later-step snapshot (the torn hazard "
+                       "the stamp-equality guard contains)"),
+        Transition("net.chunk-corrupt", "net", "env", "env",
+                   "fault:chunk-corrupt"),
+        Transition("net.donor-death", "net", "env", "env",
+                   "fault:donor-death"),
+        Transition("net.crash-joiner", "net", "env", "env",
+                   "fault:crash"),
+    )
+    return ProtocolSpec(
+        name="statesync-grow",
+        doc="membership boundary + zero-downtime grow "
+            "(docs/statesync.md)",
+        roles=("incumbent", "joiner", "net"),
+        states={"incumbent": ("run", "bound", "rebuild", "failed"),
+                "joiner": ("idle", "announced", "bulk", "ready",
+                           "final", "entered", "aborted", "crashed"),
+                "net": ("env",)},
+        verbs=verbs,
+        transitions=transitions,
+        anchor_modules=(_SERVICE,),
+        properties={
+            "torn-commit":
+                "the joiner never enters the world with an image whose "
+                "donor stamps disagree",
+            "premature-boundary-ack":
+                "incumbents commit the grow boundary only after the "
+                "joiner's bulk image digest-verified",
+            "boundary-agreement":
+                "all live ranks converge on the same membership at the "
+                "same boundary seq",
+            "resolution-reachable":
+                "from every reachable state the join can still "
+                "complete, abort cleanly, or fail detected",
+        })
+
+
+def preempt_spec() -> ProtocolSpec:
+    """SIGTERM preemption grace: announce at the boundary, donate,
+    depart with a ``bye|`` stamp; survivors shrink proactively; the
+    backstop timer bounds a wedged step."""
+    verbs = (
+        Verb("DEPARTFLAG", "flag", "depart",
+             doc="boundary-allgather field: departure intent"),
+        Verb("DONATE", "kv", "ssdonate.",
+             doc="fast-donated opt-shard records (digest-stamped)"),
+    )
+    transitions = (
+        Transition("pre.sigterm", "preemptee", "run", "grace",
+                   "internal:sigterm", observe="sigterm-grace",
+                   binds=(f"{_SVC}._on_sigterm",)),
+        Transition("pre.sigterm-dup", "preemptee", "grace", "grace",
+                   "internal:sigterm",
+                   binds=(f"{_SVC}._on_sigterm",),
+                   doc="a second SIGTERM mid-grace is idempotent"),
+        Transition("pre.finish-step", "preemptee", "grace", "bound",
+                   "internal:step", binds=(f"{_SVC}.step_boundary",)),
+        Transition("pre.fast-donate", "preemptee", "bound", "bound",
+                   "kv:DONATE", binds=(f"{_SVC}._fast_donate",)),
+        Transition("pre.depart", "preemptee", "bound", "departed",
+                   "boundary", guard="depart-at-boundary",
+                   requires_calls=("shutdown",), observe="departed",
+                   binds=(f"{_SVC}._transition_depart",),
+                   doc="orderly: the monitor stop writes the bye| "
+                       "stamp; peers read a goodbye, never silence"),
+        Transition("pre.wedge", "preemptee", "grace", "wedged",
+                   "fault:wedge",
+                   binds=(f"{_SVC}._grace_expired",),
+                   doc="the in-flight step never reaches a boundary"),
+        Transition("pre.backstop", "preemptee", "wedged", "exited143",
+                   "internal:grace-expired",
+                   requires_calls=("_exit",),
+                   observe="sigterm-grace-expired",
+                   binds=(f"{_SVC}._grace_expired",)),
+        Transition("sur.step", "survivor", "run", "bound",
+                   "internal:step", binds=(f"{_SVC}.step_boundary",)),
+        Transition("sur.boundary-idle", "survivor", "bound", "run",
+                   "boundary", binds=(f"{_SVC}.step_boundary",)),
+        Transition("sur.proactive-shrink", "survivor", "bound", "run",
+                   "boundary", guard="depart-announced",
+                   requires_calls=("reinit_world",),
+                   observe="shrink-proactive",
+                   binds=(f"{_SVC}._transition_depart",)),
+        Transition("sur.deadline-fail", "survivor", "bound",
+                   "failcaught", "fault:peer-dead",
+                   binds=(f"{_SVC}.shrink_on_failure",)),
+        Transition("sur.converge-shrink", "survivor", "failcaught",
+                   "run", "internal:confirmed-dead",
+                   guard="confirmed-only",
+                   requires_calls=("converge_confirmed_dead",
+                                   "reinit_world"),
+                   observe="shrink",
+                   binds=(f"{_SVC}.shrink_on_failure",)),
+    )
+    return ProtocolSpec(
+        name="statesync-preempt",
+        doc="SIGTERM preemption grace (docs/statesync.md)",
+        roles=("preemptee", "survivor"),
+        states={"preemptee": ("run", "grace", "bound", "wedged",
+                              "departed", "exited143"),
+                "survivor": ("run", "bound", "failcaught")},
+        verbs=verbs,
+        transitions=transitions,
+        anchor_modules=(_SERVICE,),
+        properties={
+            "bye-before-exit":
+                "the preempted rank never exits without its bye| stamp "
+                "(orderly boundary departure or the backstop)",
+            "no-failure-on-clean-path":
+                "when the departure is announced at a boundary, no "
+                "survivor ever raises RanksFailedError",
+            "survivors-converge":
+                "survivors always reach the N-1 world",
+        })

@@ -93,6 +93,17 @@ class StreamGuard:
         return DonorLostError(peer, f"{detail} ({phase})")
 
 
+def _record_reject(name: str, detail: str) -> None:
+    """A rejected round is a protocol transition (spec tid
+    ``join.torn-reject``/``join.crc-reject``/``join.digest-reject``):
+    it rides the flight ring so the hvdmc trace witness can replay it."""
+    from ..telemetry import flight
+
+    rec = flight.recorder()
+    if rec.enabled:
+        rec.record("torn-reject", name, detail=detail[:160])
+
+
 def _statesync_bytes_counter(role: str):
     from ..telemetry import metrics
 
@@ -309,6 +320,8 @@ class JoinerPuller:
         stamp = next(iter(stamps.values()))
         for d, s in stamps.items():
             if s != stamp:
+                _record_reject("torn-stamp",
+                               f"donor {d}: {s} != {stamp}")
                 raise TornSnapshotError(
                     f"torn snapshot: donor {d} stamped {s}, another "
                     f"donor stamped {stamp} — the donors cut at "
@@ -343,6 +356,8 @@ class JoinerPuller:
                         f"inside a range")
                 o, n = int(meta["o"]), int(meta["n"])
                 if zlib.crc32(payload) != int(meta["crc"]):
+                    _record_reject("chunk-crc",
+                                   f"donor {donor} offset {o}")
                     raise TornSnapshotError(
                         f"donor {donor}: chunk at offset {o} failed "
                         f"its CRC — rejecting the round")
@@ -372,6 +387,9 @@ class JoinerPuller:
         assembled image must reproduce the donors' unanimous stamp."""
         got = state_digest(image)
         if got != stamp.digest:
+            _record_reject("digest",
+                           f"{got:#x} != {stamp.digest:#x} (epoch "
+                           f"{stamp.epoch}, step {stamp.step})")
             raise TornSnapshotError(
                 f"assembled state digest {got:#x} != stamped "
                 f"{stamp.digest:#x} (epoch {stamp.epoch}, step "

@@ -181,16 +181,24 @@ def _chain_sigterm() -> None:
 
 def configure(rank: int = 0):
     """(Re)build the process recorder from the environment (core.init);
-    safe to call again across elastic/retry re-inits — the ring is
-    fresh, the SIGTERM chain installs once."""
+    safe to call again across elastic/retry re-inits — the SIGTERM
+    chain installs once, and the previous enabled ring's events CARRY
+    OVER into the new recorder (bounded by the new capacity): the
+    membership transitions recorded just before a world rebuild
+    (``grow``/``shrink``/``departed``/...) are exactly what the hvdmc
+    trace witness replays from an end-of-run dump, and what a
+    post-rebuild failure dump needs for cross-epoch context."""
     global _recorder
     with _lock:
+        prev = _recorder
         if not config.FLIGHT.get():
             _recorder = NULL_FLIGHT
             return _recorder
         _recorder = FlightRecorder(
             rank, config.FLIGHT_EVENTS.get(),
             resolve_dump_path(config.FLIGHT_FILE.get(), rank))
+        if isinstance(prev, FlightRecorder):
+            _recorder._ring.extend(prev._ring)
         _chain_sigterm()
         return _recorder
 

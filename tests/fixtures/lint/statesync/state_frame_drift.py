@@ -1,0 +1,33 @@
+"""Seeded HVD505 fixture: a statesync-style STATE_MAGIC frame codec
+whose pack and unpack halves drifted apart — every check the rule makes
+fires once (header struct format, header field order, magic prefix,
+duplicate frame-kind wire values).  Never imported; parsed by
+tests/test_hvdsan.py."""
+import struct
+
+MAGIC_A = b"\xffFIXSTATE\xff"
+MAGIC_B = b"\xffFIXDRIFT\xff"
+_HDR_A = struct.Struct(">BI")
+_HDR_B = struct.Struct(">IB")
+
+STATE_PING = 1
+STATE_PONG = 1          # duplicate wire value: PONG frames dispatch as PING
+STATE_DONE = 3
+
+
+def pack_state_frame(kind, meta, payload=b""):
+    meta_raw = bytes(meta)
+    head = MAGIC_A + _HDR_A.pack(kind, len(meta_raw)) + meta_raw
+    return head + bytes(payload)
+
+
+def unpack_state_frame(raw):
+    view = memoryview(raw)
+    n_magic = len(MAGIC_B)
+    if bytes(view[:n_magic]) != MAGIC_B:       # wrong magic
+        raise ValueError("not a state frame")
+    # swapped header fields vs the pack side, via a different struct
+    meta_len, kind = _HDR_B.unpack_from(view, n_magic)
+    meta_start = n_magic + _HDR_B.size
+    meta = bytes(view[meta_start:meta_start + meta_len])
+    return kind, meta, view[meta_start + meta_len:]

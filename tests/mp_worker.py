@@ -2059,6 +2059,25 @@ def _statesync_train_step(hvd, state):
     return out
 
 
+def _statesync_witness_dump(tag, launch_rank):
+    """End-of-battery flight dump for the hvdmc trace witness: the
+    driver test replays every WITNESS_DUMP file through
+    horovod_tpu.analysis.hvdmc.witness and fails on any observed
+    membership transition the model does not know.  Keyed by LAUNCH
+    rank, not world rank — elastic renumbering would otherwise collide
+    a departed rank's dump with a renumbered survivor's."""
+    from horovod_tpu.telemetry import flight
+
+    rec = flight.recorder()
+    if not rec.enabled:
+        return
+    epoch0 = os.environ["HOROVOD_RENDEZVOUS_EPOCH"].split("~", 1)[0]
+    rec.path = f"/tmp/hvd_witness_{epoch0}.launch{launch_rank}.json"
+    path = rec.dump(reason=f"hvdmc witness ({tag})")
+    if path:
+        print(f"WITNESS_DUMP {path}")
+
+
 def _statesync_digest_check(hvd, state):
     """Every rank's state must be bit-identical after a grow."""
     from horovod_tpu import statesync
@@ -2127,6 +2146,7 @@ def battery_statesync_grow(hvd, rank, size):
             break
     assert shrunk and grown, (shrunk, grown)
     _statesync_digest_check(hvd, state)
+    _statesync_witness_dump("grow battery", launch_rank)
     svc.close()
     if joiner_proc is not None:
         out, _ = joiner_proc.communicate(timeout=60.0)
@@ -2174,6 +2194,7 @@ def battery_statesync_joiner(port):
         _statesync_train_step(hvd, state)
         svc.step_boundary()
     _statesync_digest_check(hvd, state)
+    _statesync_witness_dump("grow battery joiner", "J")
     svc.close()
     print(f"joiner: catch-up {info.catch_up_ms:.0f} ms for "
           f"{info.bulk_bytes} bytes from {len(info.donor_stats)} "
@@ -2218,6 +2239,8 @@ def battery_statesync_preempt(hvd, rank, size):
             assert launch_rank == 1, launch_rank
             raw = kv.get("hb", f"{prev_epoch}:1")
             assert raw is not None and raw.startswith(b"bye|"), raw
+            _statesync_witness_dump("preempt battery departed",
+                                    launch_rank)
             print("preempted rank: departed with bye| stamp inside "
                   "the grace window")
             return
@@ -2240,6 +2263,7 @@ def battery_statesync_preempt(hvd, rank, size):
     assert st is None or not st.failed_ranks(), \
         f"proactive shrink must beat the heartbeat: {st.failed_ranks()}"
     assert os.environ["HOROVOD_RENDEZVOUS_EPOCH"] != pre_epoch
+    _statesync_witness_dump("preempt battery survivor", launch_rank)
     svc.close()
     print(f"survivor {launch_rank}: proactive shrink at step "
           f"{shrunk_at}, no RanksFailedError anywhere")

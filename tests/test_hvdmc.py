@@ -1,0 +1,387 @@
+"""hvdmc — explicit-state model checking of the membership protocols
+(ISSUE 11).
+
+Spec DSL validation, the explicit-state kernel (BFS to fixpoint,
+counterexample reconstruction, the AG-EF resolution check), the four
+machines at head (zero violations with fault injection), the two
+seeded spec mutations the acceptance demands (drop the torn-stamp
+reject; ack a boundary before the digest verifies) with
+rank-interleaved traces, the byte-for-byte golden counterexample of
+the deliberately broken toy spec, the HVD506 spec<->code conformance
+pass in both drift directions, the trace witness, and the CLI.
+
+The mp-battery witness replay acceptance lives in
+tests/test_statesync.py (_replay_witness).
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.analysis.hvdmc import (MUTATIONS, GrowModel,
+                                        PreemptModel, ShrinkModel,
+                                        ToyTornModel, all_specs,
+                                        check_tree, explore,
+                                        render_trace, witness_check)
+from horovod_tpu.analysis.hvdmc.machines import toy_spec
+from horovod_tpu.resilience.specs import shrink_spec
+from horovod_tpu.statesync.specs import (grow_spec, preempt_spec,
+                                         stream_spec)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TREE = os.path.join(REPO, "horovod_tpu")
+GOLDEN = os.path.join(REPO, "tests", "fixtures", "mc",
+                      "toy_torn_trace.txt")
+
+
+# --- spec DSL ---------------------------------------------------------------
+def test_all_specs_validate():
+    specs = all_specs()
+    assert {sp.name for sp in specs} == {
+        "statesync-grow", "statesync-stream", "statesync-preempt",
+        "resilience-shrink"}
+    for sp in specs + (toy_spec(),):
+        assert sp.validate() == [], sp.name
+        # Every transition id is unique across the registry too.
+    tids = [t.tid for sp in specs for t in sp.transitions]
+    assert len(tids) == len(set(tids))
+
+
+def test_spec_observe_map_and_reachability():
+    sp = preempt_spec()
+    obs = sp.observed_map()
+    assert obs["departed"] == ("pre.depart",)
+    assert obs["sigterm-grace"] == ("pre.sigterm",)
+    reach = sp.role_reachability("preemptee")
+    assert "departed" in reach["run"]          # run ->* departed
+    assert "run" not in reach["departed"]      # departure is final
+
+
+def test_spec_validation_catches_malformed():
+    from horovod_tpu.analysis.hvdmc.spec import (ProtocolSpec,
+                                                 Transition)
+    bad = ProtocolSpec(
+        name="bad", doc="", roles=("a",), states={"a": ("s1",)},
+        transitions=(
+            Transition("t1", "a", "s1", "missing", "internal:x"),
+            Transition("t1", "a", "s1", "s1", "recv:NOPE"),
+        ))
+    problems = bad.validate()
+    assert any("missing" in p for p in problems)
+    assert any("duplicate" in p for p in problems)
+    assert any("NOPE" in p for p in problems)
+
+
+# --- the checker at head ----------------------------------------------------
+def test_grow_model_explores_to_fixpoint_with_zero_violations():
+    """ISSUE 11 acceptance: the 3-rank grow protocol with fault
+    injection (boundary-flag drop, chunk corruption, donor death
+    mid-stream, joiner crash) explores to a fixpoint with a reported
+    state count and zero safety/progress violations at head."""
+    r = explore(GrowModel(3))
+    assert r.fixpoint and r.violations == []
+    assert r.states > 5000, r.states          # faults genuinely explored
+    assert {"inc.boundary-admit", "inc.boundary-grow", "join.enter",
+            "join.torn-reject", "net.flag-drop", "net.chunk-corrupt",
+            "net.donor-death", "inc.formation-timeout"} <= r.fired
+
+
+def test_preempt_and_shrink_models_clean_at_head():
+    for model in (PreemptModel(3), ShrinkModel(3)):
+        r = explore(model)
+        assert r.fixpoint and r.violations == [], model.name
+        assert r.states > 100, (model.name, r.states)
+    r = explore(PreemptModel(3))
+    assert {"pre.sigterm", "pre.depart", "sur.proactive-shrink",
+            "pre.wedge", "pre.backstop", "sur.converge-shrink"} \
+        <= r.fired
+    r = explore(ShrinkModel(3))
+    assert {"vic.crash", "vic.freeze", "sur.reraise-suspect",
+            "sur.confirm-shrink", "sur.resync"} <= r.fired
+
+
+def test_no_faults_mode_shrinks_the_space():
+    full = explore(GrowModel(3)).states
+    clean = explore(GrowModel(3, faults=False)).states
+    assert clean < full
+
+
+# --- seeded mutations (the checker must bite) -------------------------------
+def test_mutation_drop_torn_reject_caught_with_trace():
+    """Dropping the torn-stamp reject lets a boundary-flag drop commit
+    a mixed-stamp image: the checker reports torn-commit with a
+    rank-interleaved trace bound to the code sites."""
+    m = GrowModel(3, mutations=("drop-torn-reject",))
+    r = explore(m)
+    assert r.fixpoint
+    props = {v.prop for v in r.violations}
+    assert "torn-commit" in props, props
+    v = next(v for v in r.violations if v.prop == "torn-commit")
+    trace = render_trace(m, v)
+    assert "net.flag-drop" in trace
+    assert "join.enter" in trace
+    assert "statesync.service.StateSyncService._start_donation" in trace
+    assert "statesync.stream.JoinerPuller._collect_metas" in trace
+    # Rank-interleaved: several distinct actors appear.
+    assert "rank 0" in trace and "joiner" in trace and "net" in trace
+
+
+def test_mutation_early_ready_ack_caught_with_trace():
+    """Acking the boundary before the digest verifies lets incumbents
+    commit the grow boundary over an unverified image."""
+    m = GrowModel(3, mutations=("early-ready-ack",))
+    r = explore(m)
+    assert r.fixpoint
+    props = {v.prop for v in r.violations}
+    assert "premature-boundary-ack" in props, props
+    v = next(v for v in r.violations
+             if v.prop == "premature-boundary-ack")
+    trace = render_trace(m, v)
+    assert "join.post-ready" in trace
+    assert "inc.boundary-grow" in trace
+    assert "statesync.service.StateSyncService._transition_grow" in trace
+
+
+def test_unknown_mutation_rejected():
+    with pytest.raises(ValueError):
+        GrowModel(3, mutations=("no-such-guard",))
+    assert set(MUTATIONS) == {"drop-torn-reject", "early-ready-ack"}
+
+
+# --- golden counterexample --------------------------------------------------
+def test_toy_torn_golden_trace_byte_for_byte():
+    """The deliberately broken toy spec (torn commit reachable) yields
+    a stable shortest counterexample; the rendering is asserted
+    byte-for-byte against the checked-in fixture."""
+    m = ToyTornModel()
+    r = explore(m)
+    assert r.fixpoint
+    assert [v.prop for v in r.violations] == ["torn-commit"]
+    rendered = render_trace(m, r.violations[0]) + "\n"
+    with open(GOLDEN, "rb") as f:
+        assert rendered.encode() == f.read()
+
+
+# --- HVD506 conformance -----------------------------------------------------
+def test_tree_is_spec_conformant():
+    assert check_tree([TREE]) == []
+
+
+def _mutated_tree(tmp_path, edit):
+    """Copy the spec-bound statesync files under a fake horovod_tpu/
+    root, apply `edit` (src -> src), and return the root path."""
+    root = tmp_path / "horovod_tpu"
+    for rel in ("statesync/service.py", "statesync/stream.py",
+                "common/tcp_transport.py", "resilience/policy.py",
+                "serving/replica.py"):
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(TREE, *rel.split("/")), dst)
+    edit(root)
+    return str(root)
+
+
+def test_conformance_catches_removed_handler(tmp_path):
+    """spec -> code: deleting the BYE send from JoinerPuller.close
+    drifts from the stream spec's join.bye transition."""
+    def edit(root):
+        p = root / "statesync" / "stream.py"
+        src = p.read_text().replace(
+            "mesh.send(d, pack_state_frame(STATE_BYE, {}))", "pass")
+        p.write_text(src)
+    findings = check_tree([_mutated_tree(tmp_path, edit)])
+    msgs = [f.message for f in findings]
+    assert any("join.bye" in m and "STATE_BYE" in m for m in msgs), msgs
+    assert all(f.rule.id == "HVD506" for f in findings)
+
+
+def test_conformance_catches_unspecced_verb_and_handler(tmp_path):
+    """code -> spec: a new frame verb + handler branch the specs do
+    not know is drift in the other direction."""
+    def edit(root):
+        p = root / "common" / "tcp_transport.py"
+        p.write_text(p.read_text() + "\nSTATE_GOSSIP = 9\n")
+        q = root / "statesync" / "stream.py"
+        src = q.read_text().replace(
+            "elif kind == STATE_BYE:",
+            "elif kind == STATE_GOSSIP:\n"
+            "                    pass\n"
+            "                elif kind == STATE_BYE:")
+        q.write_text(src)
+    findings = check_tree([_mutated_tree(tmp_path, edit)])
+    msgs = [f.message for f in findings]
+    assert any("STATE_GOSSIP" in m and "vocabulary" in m
+               for m in msgs), msgs
+    assert any("STATE_GOSSIP" in m and "dispatches" in m
+               for m in msgs), msgs
+
+
+def test_conformance_catches_missing_required_call(tmp_path):
+    """spec -> code: the grow transition must reinit the world."""
+    def edit(root):
+        p = root / "statesync" / "service.py"
+        src = p.read_text().replace(
+            "core.reinit_world(rank=old_rank, size=new_size,"
+            " epoch=new_epoch)",
+            "pass")
+        p.write_text(src)
+    findings = check_tree([_mutated_tree(tmp_path, edit)])
+    msgs = [f.message for f in findings]
+    assert any("inc.boundary-grow" in m and "reinit_world" in m
+               for m in msgs), msgs
+
+
+def test_conformance_inactive_without_anchor_modules(tmp_path):
+    """Single-fixture runs never see tree-wide drift errors."""
+    p = tmp_path / "loose.py"
+    p.write_text("STATE_WHATEVER = 42\n")
+    assert check_tree([str(p)]) == []
+
+
+# --- trace witness ----------------------------------------------------------
+def _payload(rank, kinds):
+    return {"rank": rank,
+            "events": [{"kind": k, "name": ""} for k in kinds]}
+
+
+def test_witness_accepts_battery_shaped_logs():
+    report = witness_check([
+        _payload(0, ["enqueue", "shrink", "donate", "dispatch",
+                     "grow", "done"]),
+        _payload(3, ["join-announce", "join-ready", "join-entered"]),
+        _payload(1, ["sigterm-grace", "departed"]),
+    ])
+    assert report.problems == []
+    assert report.observed["grow"] == 1
+    # Kinds never replayed demote to coverage warnings.
+    assert any("sigterm-grace-expired" in w for w in report.warnings)
+
+
+def test_witness_fails_on_unknown_protocol_kind():
+    report = witness_check([_payload(0, ["membership-mystery"])])
+    assert report.problems and "unsound" in report.problems[0]
+    assert not report.ok
+
+
+def test_witness_fails_on_impossible_order():
+    report = witness_check([_payload(0, ["departed", "sigterm-grace"])])
+    assert any("contradicts the spec" in p for p in report.problems)
+
+
+def test_witness_ignores_generic_kinds():
+    report = witness_check([_payload(0, ["enqueue", "dispatch", "done",
+                                         "error", "lock-order",
+                                         "autoscale", "sigterm"])])
+    assert report.problems == [] and report.observed == {}
+
+
+def test_witness_fired_gate():
+    """A spec transition the model semantics never reach is unsound."""
+    report = witness_check([_payload(0, ["grow"])], fired=set())
+    assert any("never fires" in p for p in report.problems)
+
+
+# --- CLI --------------------------------------------------------------------
+def _mc(*argv, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.mc", *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=timeout)
+
+
+def test_cli_default_explores_all_protocols_clean():
+    proc = _mc("--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    protos = payload["protocols"]
+    assert set(protos) == {"statesync-grow", "statesync-preempt",
+                           "resilience-shrink"}
+    for name, rec in protos.items():
+        assert rec["fixpoint"] and rec["violations"] == [], name
+        assert rec["states"] > 0
+    assert protos["statesync-grow"]["states"] > 5000
+
+
+def test_cli_mutation_exits_nonzero_with_trace():
+    proc = _mc("--protocol", "grow", "--mutate", "drop-torn-reject")
+    assert proc.returncode == 1
+    assert "torn-commit" in proc.stdout
+    assert "hvdmc counterexample" in proc.stdout
+    assert "net.flag-drop" in proc.stdout
+
+
+def test_cli_check_tree_gate():
+    proc = _mc("--check-tree", "--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["conformance"] == []
+
+
+def test_cli_sarif_shape():
+    proc = _mc("--check-tree", "--format", "sarif")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    sarif = json.loads(proc.stdout)
+    assert sarif["version"] == "2.1.0"
+    assert sarif["runs"][0]["results"] == []
+
+
+def test_cli_witness_replay(tmp_path):
+    good = tmp_path / "w0.json"
+    good.write_text(json.dumps(_payload(0, ["sigterm-grace",
+                                            "departed"])))
+    proc = _mc("--check-tree", "--witness", str(good))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    bad = tmp_path / "w1.json"
+    bad.write_text(json.dumps(_payload(1, ["membership-mystery"])))
+    proc = _mc("--check-tree", "--witness", str(bad))
+    assert proc.returncode == 1
+    assert "UNSOUND" in proc.stdout
+
+
+def test_cli_toy_protocol_reproduces_golden():
+    proc = _mc("--protocol", "toy", "--ranks", "2")
+    assert proc.returncode == 1
+    with open(GOLDEN) as f:
+        assert f.read().strip() in proc.stdout
+
+
+# --- taxonomy sync gate -----------------------------------------------------
+def test_every_observable_kind_is_emitted_or_generic():
+    """The flight-event kinds the specs claim and the generic taxonomy
+    must stay disjoint (a generic kind would silently shadow a
+    protocol transition in the witness)."""
+    from horovod_tpu.analysis.hvdmc.witness import GENERIC_KINDS
+    claimed = {t.observe for sp in all_specs()
+               for t in sp.transitions if t.observe}
+    assert claimed
+    assert not (claimed & GENERIC_KINDS)
+
+
+def test_grow_spec_covers_state_verbs():
+    """Spec vocabulary == wire vocabulary (the conformance pass proves
+    it against the AST; this pins the python-side constants too)."""
+    from horovod_tpu.common import tcp_transport as t
+    consts = {n for n in dir(t)
+              if n.startswith("STATE_") and
+              isinstance(getattr(t, n), int)}
+    claimed = {v.const for v in stream_spec().verbs}
+    assert claimed == consts
+
+
+def test_shrink_and_grow_specs_bind_real_functions():
+    """Every bind in every spec resolves against the real tree (the
+    conformance gate proves this too; kept as a direct unit so a
+    rename fails fast with a readable diff)."""
+    from horovod_tpu.analysis.hvdsan.lockgraph import Program
+    program = Program()
+    program.collect_paths([TREE])
+    missing = []
+    for sp in (grow_spec(), stream_spec(), preempt_spec(),
+               shrink_spec()):
+        for tr in sp.transitions:
+            for key in tr.binds:
+                if key not in program.functions:
+                    missing.append((sp.name, tr.tid, key))
+    assert missing == []

@@ -89,6 +89,32 @@ def test_fixture_wire_drift_hvd505():
     assert any("swapped" in m for m in msgs)
 
 
+def test_fixture_state_frame_drift_hvd505():
+    """ISSUE 11 satellite: HVD505 extended over the statesync
+    STATE_MAGIC frame codec — the seeded fixture drifts every check
+    once (duplicate verb wire value, header struct format, magic
+    prefix, header field order)."""
+    a = analyze_paths([os.path.join(REPO, "tests", "fixtures", "lint",
+                                    "statesync",
+                                    "state_frame_drift.py")])
+    assert _slugs(a) == ["wire-schema-drift"] * 4
+    msgs = " | ".join(f.message for f in a.findings)
+    assert "share wire value" in msgs
+    assert "header drift" in msgs and "'>BI'" in msgs
+    assert "magic drift" in msgs
+    assert "field-order drift" in msgs and "'kind'" in msgs
+
+
+def test_tree_state_frame_codec_in_sync(tree_analysis):
+    """common/tcp_transport.py's pack/unpack_state_frame agree (the
+    statesync half of test_tree_wire_schemas_in_sync)."""
+    assert len(tree_analysis.program.state_frames) == 2
+    assert {r["side"] for r in tree_analysis.program.state_frames} \
+        == {"pack", "unpack"}
+    assert not [f for f in tree_analysis.findings
+                if f.rule.id == "HVD505"]
+
+
 def test_all_san_fixtures_detected_together():
     a = analyze_paths([SAN_FIXTURES])
     assert {"lock-order-inversion", "lock-held-across-blocking",
@@ -141,6 +167,22 @@ def test_tree_thread_roots(tree_analysis):
     names = set(tree_analysis.thread_roots.values())
     assert {"hvd-background", "hvd-timeline", "hvd-send-*",
             "hvd-heartbeat"} <= names
+    # ISSUE 11 satellite: PR 10's threads are named roots (watcher via
+    # Thread(target=), autoscale via the manifest — Thread subclass —
+    # and the preempt backstop via Timer detection + manifest).
+    assert {"hvd-statesync-watch", "hvd-autoscale",
+            "hvd-preempt-backstop"} <= names
+
+
+def test_thread_roots_manifest_resolves(tree_analysis):
+    """Every manifest-declared root names a real function, carries a
+    justification, and reaches the HVD504 reachability set."""
+    from horovod_tpu.analysis.hvdsan.ownership import THREAD_ROOTS
+    for name, (funckey, why) in THREAD_ROOTS.items():
+        assert funckey in tree_analysis.program.functions, funckey
+        assert len(why) > 20, name
+        assert tree_analysis.thread_roots[funckey] == name
+        assert name in tree_analysis.thread_reach[funckey]
 
 
 def test_tree_init_lock_edges(tree_analysis):

@@ -280,6 +280,90 @@ def test_cli_changed_only_smoke(capsys):
         [f for root, _, fs in os.walk(FIXTURES) for f in fs])
 
 
+def test_changed_only_missing_diff_base_falls_back_with_warning(
+        capsys):
+    """ISSUE 11 satellite: an unusable diff base must degrade to the
+    full-tree scan with a STRUCTURED warning — never a crash, never an
+    under-checked gate."""
+    rc = main([os.path.join(FIXTURES, "clean.py"), "--changed-only",
+               "--diff-base", "no-such-ref-xyzzy",
+               "--format", "json"])
+    captured = capsys.readouterr()
+    payload = json.loads(captured.out)
+    assert rc == 0
+    assert payload["files"] == 1               # full fallback walk ran
+    assert payload["warnings"], payload
+    assert "no-such-ref-xyzzy" in payload["warnings"][0]
+    assert "hvdlint: warning:" in captured.err
+
+
+def test_changed_only_without_git_falls_back_with_warning(
+        capsys, monkeypatch, tmp_path):
+    """git unavailable (empty PATH) -> (None, reason) from
+    changed_py_files, full walk, structured warning in the JSON."""
+    from horovod_tpu.analysis.lint import changed_py_files
+    monkeypatch.setenv("PATH", str(tmp_path))   # no git anywhere
+    files, warning = changed_py_files([FIXTURES])
+    assert files is None
+    assert "git" in warning and "full-tree" in warning
+    rc = main([os.path.join(FIXTURES, "clean.py"), "--changed-only",
+               "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["files"] == 1
+    assert any("full-tree" in w for w in payload["warnings"])
+
+
+def test_changed_only_follows_renames(tmp_path, monkeypatch):
+    """A staged rename is linted at its NEW path (git status 'R old ->
+    new'; previously --no-renames hid the file entirely)."""
+    from horovod_tpu.analysis.lint import changed_py_files
+    repo = tmp_path / "r"
+    repo.mkdir()
+
+    def git(*argv):
+        subprocess.run(["git", "-c", "user.email=t@t",
+                        "-c", "user.name=t", *argv],
+                       cwd=repo, check=True, capture_output=True)
+
+    git("init", "-q")
+    (repo / "old_name.py").write_text("x = 1\n")
+    git("add", "old_name.py")
+    git("commit", "-qm", "seed")
+    git("mv", "old_name.py", "new_name.py")
+    monkeypatch.chdir(repo)
+    files, warning = changed_py_files(["."])
+    assert warning is None
+    assert files == ["new_name.py"]
+    # --diff-base vs the seed commit reports the rename's new path too.
+    files, warning = changed_py_files(["."], diff_base="HEAD")
+    assert warning is None and "new_name.py" in files
+
+
+# --- ISSUE 11: the hvdmc spec-conformance gate -------------------------------
+def test_tree_spec_conformance_check_tree_gate():
+    """`python -m horovod_tpu.analysis.mc --check-tree` is the CI gate:
+    the tree at head is spec-clean, and the JSON shape matches the
+    lint/san emitters (list of rule-stamped findings)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.mc",
+         "--check-tree", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["conformance"] == []
+    assert payload["wall_ms"] > 0
+
+
+def test_san_driver_includes_spec_conformance():
+    """HVD506 rides `lint --san` exactly like HVD505: a tree-context
+    drift (an unclaimed frame verb) surfaces through lint_paths_timed
+    with san=True."""
+    from horovod_tpu.analysis.hvdmc.conformance import check_tree
+    assert check_tree([TREE]) == []
+    assert "HVD506" in RULES and \
+        RULES["HVD506"].slug == "spec-conformance"
+
+
 def test_cli_san_flag_runs_hvdsan(capsys):
     """--san rides the same parse: the seeded inversion fixture yields
     an HVD501 finding through the lint CLI."""

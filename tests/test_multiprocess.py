@@ -14,7 +14,8 @@ _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _run_world(size: int, battery: str, timeout: float = 90.0,
-               expected_rcs: dict | None = None) -> list[str]:
+               expected_rcs: dict | None = None,
+               extra_env: dict | None = None) -> list[str]:
     """Spawn `size` workers against one rendezvous server; assert each
     rank's exit code (0 by default; override per rank via expected_rcs,
     e.g. {1: 37} for a fault-injection battery). Returns per-rank
@@ -25,6 +26,7 @@ def _run_world(size: int, battery: str, timeout: float = 90.0,
     env.pop("HOROVOD_RANK", None)
     env.pop("HOROVOD_SIZE", None)
     env["HOROVOD_RENDEZVOUS_EPOCH"] = f"{battery}{size}"
+    env.update(extra_env or {})
     procs = [
         subprocess.Popen([sys.executable, _WORKER, str(r), str(size),
                           str(port), battery],

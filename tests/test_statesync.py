@@ -71,17 +71,52 @@ def hard_timeout_guard():
 # ---------------------------------------------------------------------------
 # Process-level acceptance batteries
 # ---------------------------------------------------------------------------
+def _witness_env(battery: str, size: int) -> dict:
+    """A deep flight ring so the membership transitions survive the
+    per-step enqueue/dispatch churn until the end-of-battery witness
+    dump (mp_worker routes the dump files themselves to launch-rank-
+    keyed /tmp paths); stale dumps from earlier runs are removed."""
+    import glob
+    for stale in glob.glob(f"/tmp/hvd_witness_{battery}{size}"
+                           f".launch*.json"):
+        os.unlink(stale)
+    return {"HOROVOD_FLIGHT_EVENTS": "4096"}
+
+
+def _replay_witness(outputs, expect_kinds):
+    """ISSUE 11 acceptance: the battery's flight/event logs replay
+    through the hvdmc trace witness and every observed membership
+    transition exists in the model (problems == unsound spec)."""
+    from horovod_tpu.analysis import hvdmc
+
+    dumps = sorted({line.split(" ", 1)[1].strip()
+                    for out in outputs for line in out.splitlines()
+                    if line.startswith("WITNESS_DUMP ")})
+    assert dumps, "no battery wrote a witness dump"
+    report = hvdmc.witness_check(hvdmc.load_dumps(dumps))
+    assert report.problems == [], "\n".join(report.problems)
+    assert expect_kinds <= set(report.observed), \
+        (sorted(report.observed), expect_kinds)
+    return report
+
+
 def test_statesync_grow_rides_4_3_4():
     """ISSUE 10 acceptance: SIGKILL a rank mid-training, survivors
     shrink with zero failed steps, a replacement joins via peer
     streaming with zero failed incumbent steps, catch-up wall bounded,
     streamed state digest-verified bit-identical (all asserted
-    in-battery; the joiner's lifecycle is owned by launch rank 0)."""
+    in-battery; the joiner's lifecycle is owned by launch rank 0).
+    ISSUE 11: the observed flight events replay through the hvdmc
+    trace witness against the grow model."""
     outputs = _run_world(4, "statesync_grow", timeout=240.0,
-                         expected_rcs={2: -signal.SIGKILL})
+                         expected_rcs={2: -signal.SIGKILL},
+                         extra_env=_witness_env("statesync_grow", 4))
     for r in (0, 1, 3):
         assert "rode 4->3->4" in outputs[r], outputs[r]
     assert "joiner: catch-up" in outputs[0], outputs[0]
+    _replay_witness(outputs, {"shrink", "donate", "grow",
+                              "join-announce", "join-ready",
+                              "join-entered"})
 
 
 def test_statesync_preempt_grace_3rank():
@@ -89,11 +124,15 @@ def test_statesync_preempt_grace_3rank():
     with bye| inside the grace window (exit 0 — never a signal death)
     and survivors shrink proactively with no RanksFailedError raised
     anywhere (the battery runs its collectives bare: any structured
-    failure is a worker failure here)."""
-    outputs = _run_world(3, "statesync_preempt", timeout=150.0)
+    failure is a worker failure here).  ISSUE 11: the observed flight
+    events replay through the hvdmc trace witness."""
+    outputs = _run_world(3, "statesync_preempt", timeout=150.0,
+                         extra_env=_witness_env("statesync_preempt", 3))
     assert "departed with bye| stamp" in outputs[1], outputs[1]
     for r in (0, 2):
         assert "no RanksFailedError anywhere" in outputs[r], outputs[r]
+    _replay_witness(outputs, {"sigterm-grace", "departed",
+                              "shrink-proactive"})
 
 
 @pytest.mark.slow
