@@ -27,6 +27,16 @@ gap from both ends:
   manifest (HVD504, also feeding hvdlint's HVD401); a wire-schema
   drift check (HVD505); and a ``HOROVOD_SAN=1`` runtime witness whose
   observed lock-order graph CI diffs against the static one.
+- :mod:`horovod_tpu.analysis.hvdflow` — **hvdflow**, interprocedural
+  rank-divergence dataflow (CLI:
+  ``python -m horovod_tpu.analysis.hvdflow`` or ``lint --flow``):
+  per-function collective-effect summaries composed through the hvdsan
+  call graph plus a rank-taint fixpoint, flagging divergent collective
+  streams under rank-tainted branches (HVD601) and loops (HVD602),
+  serve-path waits with no deadline on any interprocedural path
+  (HVD603), and raw ``HOROVOD_*`` environment reads missing from the
+  typed knob registry (HVD604, ``lint --knobs`` /
+  docs/configuration.md) — the compile-time half of fingerprinting.
 
 See docs/analysis.md for the rule catalogue and fingerprint modes.
 """

@@ -101,7 +101,8 @@ class Divergence:
         return (f"Collective fingerprint divergence {where}: {by_rank}. "
                 f"Every rank must submit the same collectives in the same "
                 f"order; check for rank-gated collective calls "
-                f"(hvdlint: python -m horovod_tpu.analysis.lint).")
+                f"(hvdlint/hvdflow: python -m horovod_tpu.analysis.lint "
+                f"--flow reports the same per-arm op streams as HVD601).")
 
 
 def _pretty(descriptor: str) -> str:

@@ -1,0 +1,49 @@
+"""hvdflow — interprocedural rank-divergence dataflow analysis.
+
+The compile-time half of collective fingerprinting: hvdlint's HVD101
+flags a collective *syntactically* under a rank-gated branch, and the
+runtime fingerprint (``HOROVOD_FINGERPRINT``) catches divergence after
+one negotiation cycle — but a collective buried three calls below an
+``if hvd.rank() == 0:`` branch is invisible to both until it hangs a
+real world.  hvdflow closes that gap the way hvdsan (locks) and hvdmc
+(protocols) did: a whole-program static pass whose findings the runtime
+witness corroborates.
+
+The pass (``flow.py``) builds, over the hvdsan call graph with its
+typed receiver resolution:
+
+1. **Collective-effect summaries** — for every function, the ordered
+   stream of collective call sites it may execute (allreduce /
+   allgather / broadcast / alltoall / barrier / kv_barrier /
+   broadcast_object / allgather_object, plus the statesync boundary
+   exchange), composed through confidently-resolved calls.
+2. **Rank-taint analysis** — sources are ``hvd.rank()`` /
+   ``local_rank()`` and friends, ``rank ==``/``!=`` comparisons,
+   coordinator predicates and the ``.rank``-family attributes named in
+   :data:`~.flow.TAINT_ATTR_SOURCES`; taint propagates through
+   assignments, returns, parameters (call-site arguments) and boolean
+   contexts to a fixpoint.
+
+Rules:
+
+- **HVD601 divergent-collective** — a collective effect reachable
+  under one arm of a rank-tainted branch with no sequence-equal effect
+  on the sibling arm.  Each finding carries the would-be fingerprint
+  stream of both arms and the first divergent op — the static twin of
+  the runtime divergence ERROR.  Rank-0-only *non*-collective work
+  stays legal.
+- **HVD602 divergent-loop-trip** — collectives inside a loop whose
+  trip count is rank-tainted (``range(rank)``).
+- **HVD603 unbounded-serve-wait** — a blocking wait reachable from the
+  serving dispatch path with no ``deadline_scope``/``op_scope``/
+  ``op_timeout`` bound on any interprocedural path (the flow-aware
+  upgrade of HVD1003).
+- **HVD604 unregistered-knob-read** — an ``os.environ``/``getenv``
+  read of a ``HOROVOD_*`` name missing from the typed knob registry
+  (``common/config.py``).
+
+CLI: ``python -m horovod_tpu.analysis.hvdflow`` (or ``lint --flow`` to
+ride the shared single-parse driver).  See docs/analysis.md.
+"""
+from .flow import (FLOW_RULE_IDS, FlowProgram,  # noqa: F401
+                   analyze_flow, main)

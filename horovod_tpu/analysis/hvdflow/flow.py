@@ -1,0 +1,836 @@
+"""hvdflow core: effect summaries, rank taint, and the HVD601-604 checks.
+
+Model
+-----
+
+Per function (one AST walk, riding the shared single-parse driver):
+
+- an **effect tree**: the ordered sequence of collective call sites
+  (``("coll", op, label, site)``), unresolved calls
+  (``("call", spine, site)``), branches (``("branch", test, site,
+  then_effects, else_effects)``) and loops (``("loop", trip_expr,
+  site, body_effects)``) the function body may execute;
+- **taint facts**: assignments, returns and call-site arguments, so the
+  global fixpoint can propagate rank taint through locals, returns and
+  parameters;
+- **HVD603 facts**: blocking waits (with their boundedness) and whether
+  the function establishes a deadline guard
+  (``deadline_scope``/``op_scope``/``op_timeout``);
+- **HVD604 facts**: raw environment reads of ``HOROVOD_*`` literals.
+
+Streams
+-------
+
+A function's **fingerprint stream** is its effect tree flattened
+through the hvdsan call graph (typed receiver resolution; only
+*confident* targets are followed, so imprecision yields missed tokens,
+never phantom ones): collectives become tokens, an untainted branch
+whose arms agree contributes the shared stream, an untainted branch
+whose arms differ contributes one ``{a|b}`` token (data-dependent but
+rank-symmetric — both ranks take the same arm), a loop contributes one
+``loop[...]`` token (unknown but rank-invariant trip count).  Two arms
+of a **rank-tainted** branch must produce sequence-equal streams
+(HVD601); a **rank-tainted** loop trip must gate an empty stream
+(HVD602).  The stream rendering in each finding is exactly the op
+sequence the runtime fingerprint would fold, so a static finding and
+its runtime divergence ERROR describe the same evidence.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+
+from ..hvdsan.lockgraph import (Analysis, CallEvent, Finding, Program,
+                                module_label, norm_path, _spine)
+from ..lint import COLLECTIVE_NAMES, iter_python_files
+from ..rules import RULES
+
+# --- manifests ---------------------------------------------------------------
+# The collective-effect alphabet: every eager/SPMD collective hvdlint
+# knows, plus the object-pickle collectives and the statesync boundary
+# exchange (a call to either IS one symmetric exchange on the wire).
+FLOW_COLLECTIVES = frozenset(COLLECTIVE_NAMES) | frozenset({
+    "broadcast_object", "allgather_object", "step_boundary",
+})
+
+# Rank-taint sources.  Names and attributes are a small reviewed
+# manifest (docs/analysis.md): a bare name or ``.attr`` that *is* a
+# per-rank value, and callables whose return differs per rank.
+TAINT_NAME_SOURCES = frozenset({
+    "rank", "local_rank", "cross_rank", "node_rank", "request_rank",
+    "process_index", "is_coordinator", "local_joined", "joined_ranks",
+    "launch_rank",
+})
+TAINT_ATTR_SOURCES = frozenset({
+    "rank", "_rank", "local_rank", "cross_rank", "node_rank",
+    "process_index", "request_rank", "launch_rank", "is_coordinator",
+})
+TAINT_CALL_SOURCES = frozenset({
+    "rank", "local_rank", "cross_rank", "node_rank", "process_index",
+    "is_coordinator",
+})
+
+# World-symmetric names: identical on every rank by construction, so
+# they never carry taint even when assigned from a rank-derived
+# expression (``rank, size = resolve_world()`` must not taint ``size``).
+SYMMETRIC_NAMES = frozenset({
+    "size", "world_size", "local_size", "cross_size", "node_size",
+    "nranks", "num_ranks", "np",
+})
+
+# HVD603: the serving dispatch roots (functions whose interprocedural
+# frontier must never reach an unbounded blocking wait without a
+# deadline on the path), the deadline-guard vocabulary, and the
+# blocking-wait vocabulary (HVD1003's set plus queue handoffs).
+SERVE_DISPATCH_ROOTS = frozenset({"serve_loop"})
+GUARD_NAMES = frozenset({"deadline_scope", "op_scope", "op_timeout"})
+# World-formation boundary: the serve-path walk stops at (re)init —
+# world formation/teardown is governed by HOROVOD_GLOO_TIMEOUT_SECONDS
+# and the fault-tolerance deadlines (docs/resilience.md), not by any
+# single request's SLO, and it only runs on the exceptional
+# shrink/grow path where the in-flight map is being resynced anyway.
+SERVE_WAIT_BOUNDARIES = frozenset({
+    "core.init", "core.reinit_world", "core.shutdown",
+})
+WAIT_NAMES = frozenset({"recv", "recv_into", "join", "wait", "urlopen",
+                        "get", "put"})
+_BOUND_HINTS = ("timeout", "deadline", "poll")
+_MAX_SERVE_DEPTH = 14
+
+# Stream caps: a divergence is located within the first tokens; capping
+# keeps pathological recursion bounded.
+_MAX_STREAM = 48
+
+FLOW_RULE_IDS = frozenset({"HVD601", "HVD602", "HVD603", "HVD604"})
+
+
+# --- per-function facts ------------------------------------------------------
+@dataclass
+class FlowFunc:
+    key: str
+    module: str
+    name: str
+    path: str
+    line: int
+    params: list = field(default_factory=list)
+    effects: list = field(default_factory=list)
+    assigns: list = field(default_factory=list)   # [(names, expr)]
+    returns: list = field(default_factory=list)   # [expr]
+    calls: list = field(default_factory=list)     # [(spine, Call node)]
+    waits: list = field(default_factory=list)     # [(name, node, bounded)]
+    guard: bool = False
+    tainted_locals: set = field(default_factory=set)
+
+
+@dataclass
+class FlowProgram:
+    funcs: dict = field(default_factory=dict)     # key -> FlowFunc
+    env_reads: list = field(default_factory=list)  # [(path, name, line)]
+
+    def collect_source(self, path: str, source: str,
+                       tree: ast.AST | None = None) -> None:
+        if tree is None:
+            tree = ast.parse(source, filename=path)
+        _FlowCollector(self, norm_path(path),
+                       module_label(path)).visit(tree)
+
+
+def _terminal(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _call_label(node: ast.Call) -> str:
+    """Tensor/tag label of a collective call, for the fingerprint-style
+    stream rendering: the ``name=``/``tag=`` string literal, else the
+    first string-literal positional, else ''."""
+    for kw in node.keywords:
+        if kw.arg in ("name", "tag") and \
+                isinstance(kw.value, ast.Constant) and \
+                isinstance(kw.value.value, str):
+            return kw.value.value
+    for arg in node.args:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+    return ""
+
+
+def _wait_is_exempt(node: ast.Call, name: str) -> bool:
+    """str.join / os.path.join and dict/config .get() lookalikes."""
+    if name == "join":
+        if not isinstance(node.func, ast.Attribute):
+            return True
+        base = node.func.value
+        if isinstance(base, ast.Constant) and isinstance(base.value, str):
+            return True
+        sp = _spine(node.func)
+        if sp and set(sp[:-1]) & {"path", "sep", "pathsep", "linesep",
+                                  "os", "posixpath", "ntpath"}:
+            return True
+        return False
+    if name in ("get", "put"):
+        # only queue-looking receivers block (mirrors HVD1006's filter)
+        if not isinstance(node.func, ast.Attribute):
+            return True
+        base = node.func.value
+        ident = base.attr if isinstance(base, ast.Attribute) else (
+            base.id if isinstance(base, ast.Name) else None)
+        if ident is None or ident.isupper():
+            return True
+        low = ident.lower()
+        return not (low == "q" or "queue" in low or low.endswith("_q"))
+    return False
+
+
+def _call_is_bounded(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+        if kw.arg and any(h in kw.arg.lower() for h in _BOUND_HINTS):
+            return True
+    for arg in node.args:
+        for sub in ast.walk(arg):
+            ident = sub.id if isinstance(sub, ast.Name) else (
+                sub.attr if isinstance(sub, ast.Attribute) else None)
+            if ident and any(h in ident.lower() for h in _BOUND_HINTS):
+                return True
+    return False
+
+
+_ENV_SPINES = ("environ",)
+
+
+def _env_read_name(node: ast.AST) -> str | None:
+    """HOROVOD_* literal READ via os.environ.get / os.getenv /
+    os.environ[...] (Load context only — launchers *setting* child env
+    are not reads)."""
+    lit = None
+    if isinstance(node, ast.Call):
+        name = _terminal(node)
+        if name == "getenv" and node.args:
+            lit = node.args[0]
+        elif name == "get" and isinstance(node.func, ast.Attribute) \
+                and _terminal(node.func.value) in _ENV_SPINES \
+                and node.args:
+            lit = node.args[0]
+    elif isinstance(node, ast.Subscript) and \
+            isinstance(node.ctx, ast.Load) and \
+            _terminal(node.value) in _ENV_SPINES:
+        lit = node.slice
+    if isinstance(lit, ast.Constant) and isinstance(lit.value, str) \
+            and lit.value.startswith("HOROVOD_"):
+        return lit.value
+    return None
+
+
+_SCOPE_STMTS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+class _FlowCollector(ast.NodeVisitor):
+    """Single-pass per-file fact extractor (mirrors the hvdsan
+    collector's qualname scheme so FlowFunc keys line up with the
+    Program's FuncRaw keys for call resolution)."""
+
+    def __init__(self, prog: FlowProgram, path: str, label: str) -> None:
+        self.p = prog
+        self.path = path
+        self.label = label
+        self._cls_stack: list[str] = []
+        self._fn_stack: list[str] = []
+
+    def _qual(self, name: str) -> str:
+        parts = [self.label] if self.label else []
+        if self._cls_stack:
+            parts.append(self._cls_stack[-1])
+        parts.extend(self._fn_stack)
+        parts.append(name)
+        return ".".join(parts)
+
+    def visit_Module(self, node: ast.Module) -> None:
+        # Module-level env reads count too (import-time knob reads).
+        for sub in ast.walk(node):
+            name = _env_read_name(sub)
+            if name is not None:
+                self.p.env_reads.append(
+                    (self.path, name, getattr(sub, "lineno", 1)))
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._cls_stack.append(node.name)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._cls_stack.pop()
+
+    def _visit_function(self, node) -> None:
+        fn = FlowFunc(key=self._qual(node.name), module=self.label,
+                      name=node.name, path=self.path, line=node.lineno)
+        args = node.args
+        fn.params = [a.arg for a in (args.posonlyargs + args.args
+                                     + args.kwonlyargs)]
+        _FuncScan(fn).scan(node)
+        self.p.funcs[fn.key] = fn
+        self._fn_stack.append(node.name)
+        for stmt in node.body:
+            self.visit(stmt)      # nested defs get their own FlowFunc
+        self._fn_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+
+class _FuncScan:
+    """Effect-tree + fact extraction for ONE function body (nested
+    function/class scopes are skipped — they are their own units)."""
+
+    def __init__(self, fn: FlowFunc) -> None:
+        self.fn = fn
+
+    def scan(self, node) -> None:
+        self.fn.effects = self._stmts(node.body)
+
+    # -- expressions --------------------------------------------------------
+    def _scan_expr(self, expr: ast.AST | None) -> list:
+        """Effects contributed by one expression, in syntactic order;
+        also records taint/wait/guard/call facts along the way."""
+        out: list = []
+        if expr is None:
+            return out
+        stack = [expr]
+        while stack:
+            node = stack.pop(0)
+            if isinstance(node, (ast.Lambda,) + _SCOPE_STMTS):
+                continue
+            if isinstance(node, (ast.ListComp, ast.SetComp,
+                                 ast.DictComp, ast.GeneratorExp)):
+                # A comprehension is a loop: its first generator's
+                # iterable is the trip count, everything inside the
+                # element/conditions is the body.
+                gen0 = node.generators[0]
+                # the first iterable is evaluated once, before the loop
+                out.extend(self._scan_expr(gen0.iter))
+                inner: list = []
+                for sub in ([node.elt] if hasattr(node, "elt")
+                            else [node.key, node.value]):
+                    inner.extend(self._scan_expr(sub))
+                for g in node.generators:
+                    for cond in g.ifs:
+                        inner.extend(self._scan_expr(cond))
+                    if g is not gen0:
+                        inner.extend(self._scan_expr(g.iter))
+                out.append(("loop", gen0.iter, node.lineno, inner))
+                continue
+            if isinstance(node, ast.NamedExpr):
+                tgt = node.target
+                if isinstance(tgt, ast.Name):
+                    self.fn.assigns.append(((tgt.id,), node.value))
+            if isinstance(node, ast.Call):
+                self._note_call(node)
+                name = _terminal(node)
+                if name in FLOW_COLLECTIVES:
+                    out.append(("coll", name, _call_label(node),
+                                node.lineno))
+                else:
+                    sp = _spine(node.func)
+                    if sp:
+                        out.append(("call", sp, node.lineno))
+            stack = list(ast.iter_child_nodes(node)) + stack
+        return out
+
+    def _note_call(self, node: ast.Call) -> None:
+        name = _terminal(node)
+        sp = _spine(node.func)
+        if sp:
+            self.fn.calls.append((sp, node))
+        if name in GUARD_NAMES:
+            self.fn.guard = True
+        if name in WAIT_NAMES and not _wait_is_exempt(node, name):
+            self.fn.waits.append((name, node, _call_is_bounded(node)))
+
+    # -- statements ---------------------------------------------------------
+    def _stmts(self, stmts: list) -> list:
+        out: list = []
+        for st in stmts:
+            if isinstance(st, _SCOPE_STMTS):
+                continue
+            if isinstance(st, ast.If):
+                out.extend(self._scan_expr(st.test))
+                out.append(("branch", st.test, st.lineno,
+                            self._stmts(st.body), self._stmts(st.orelse)))
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                out.extend(self._scan_expr(st.iter))
+                if isinstance(st.target, ast.Name):
+                    self.fn.assigns.append(((st.target.id,), st.iter))
+                elif isinstance(st.target, ast.Tuple):
+                    names = tuple(e.id for e in st.target.elts
+                                  if isinstance(e, ast.Name))
+                    if names:
+                        self.fn.assigns.append((names, st.iter))
+                out.append(("loop", st.iter, st.lineno,
+                            self._stmts(st.body + st.orelse)))
+            elif isinstance(st, ast.While):
+                out.extend(self._scan_expr(st.test))
+                out.append(("loop", st.test, st.lineno,
+                            self._stmts(st.body + st.orelse)))
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    out.extend(self._scan_expr(item.context_expr))
+                    if _terminal(item.context_expr) in GUARD_NAMES:
+                        self.fn.guard = True
+                    if isinstance(item.optional_vars, ast.Name):
+                        self.fn.assigns.append(
+                            ((item.optional_vars.id,), item.context_expr))
+                out.extend(self._stmts(st.body))
+            elif isinstance(st, ast.Try) or \
+                    st.__class__.__name__ == "TryStar":
+                out.extend(self._stmts(st.body))
+                for h in st.handlers:
+                    out.extend(self._stmts(h.body))
+                out.extend(self._stmts(st.orelse))
+                out.extend(self._stmts(st.finalbody))
+            else:
+                if isinstance(st, ast.Assign):
+                    if len(st.targets) == 1 and \
+                            isinstance(st.targets[0], ast.Tuple) and \
+                            isinstance(st.value, ast.Tuple) and \
+                            len(st.targets[0].elts) == \
+                            len(st.value.elts):
+                        # a, b = x, y — match taint elementwise
+                        for t, v in zip(st.targets[0].elts,
+                                        st.value.elts):
+                            if isinstance(t, ast.Name):
+                                self.fn.assigns.append(((t.id,), v))
+                    else:
+                        names = []
+                        for t in st.targets:
+                            if isinstance(t, ast.Name):
+                                names.append(t.id)
+                            elif isinstance(t, ast.Tuple):
+                                names.extend(e.id for e in t.elts
+                                             if isinstance(e, ast.Name))
+                        if names:
+                            self.fn.assigns.append((tuple(names),
+                                                    st.value))
+                elif isinstance(st, ast.AnnAssign) and \
+                        isinstance(st.target, ast.Name) and \
+                        st.value is not None:
+                    self.fn.assigns.append(((st.target.id,), st.value))
+                elif isinstance(st, ast.AugAssign) and \
+                        isinstance(st.target, ast.Name):
+                    self.fn.assigns.append(((st.target.id,), st.value))
+                elif isinstance(st, ast.Return) and st.value is not None:
+                    self.fn.returns.append(st.value)
+                out.extend(self._scan_expr(st))
+        return out
+
+
+# --- the analysis ------------------------------------------------------------
+class FlowAnalysis:
+    """Global taint fixpoint + stream composition + the four checks."""
+
+    def __init__(self, program: Program, flow: FlowProgram) -> None:
+        self.program = program
+        self.flow = flow
+        self.an = Analysis(program)
+        self.an._build_indexes()
+        self.findings: list[Finding] = []
+        self.tainted_returns: set[str] = set()
+        self.tainted_params: dict[str, set] = {}
+        self._resolve_cache: dict = {}
+        self._stream_cache: dict = {}
+
+    # -- call resolution (typed, via the hvdsan graph) ----------------------
+    def _resolve(self, fn: FlowFunc, spine: tuple, line: int) -> list:
+        key = (fn.key, spine)
+        hit = self._resolve_cache.get(key)
+        if hit is not None:
+            return hit
+        fraw = self.program.functions.get(fn.key)
+        if fraw is None:
+            self._resolve_cache[key] = []
+            return []
+        ev = CallEvent(spine=spine, held=(), line=line)
+        out = self.an._resolve_call_uncached(fraw, ev)
+        self._resolve_cache[key] = out
+        return out
+
+    # -- taint ---------------------------------------------------------------
+    def _expr_tainted(self, fn: FlowFunc, expr: ast.AST) -> bool:
+        """Collective calls are taint SANITIZERS: an allgather'd /
+        broadcast / allreduced value is identical on every rank by
+        construction, so their whole subtree is skipped — branching on
+        an exchanged membership view is the sanctioned symmetric idiom
+        (statesync.step_boundary), not a divergence."""
+        stack = [expr]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.Lambda,) + _SCOPE_STMTS):
+                continue
+            if isinstance(sub, ast.Name) and \
+                    (sub.id in TAINT_NAME_SOURCES
+                     or sub.id in fn.tainted_locals):
+                return True
+            if isinstance(sub, ast.Attribute) and \
+                    sub.attr in TAINT_ATTR_SOURCES:
+                return True
+            if isinstance(sub, ast.Call):
+                name = _terminal(sub)
+                if name in FLOW_COLLECTIVES:
+                    continue     # symmetric result: sanitize subtree
+                if name in TAINT_CALL_SOURCES:
+                    return True
+                sp = _spine(sub.func)
+                if sp and self.tainted_returns:
+                    for tkey, _conf in self._resolve(fn, sp, sub.lineno):
+                        if tkey in self.tainted_returns:
+                            return True
+            stack.extend(ast.iter_child_nodes(sub))
+        return False
+
+    def _fix_taint(self) -> None:
+        funcs = self.flow.funcs
+        for fn in funcs.values():
+            self.tainted_params.setdefault(fn.key, set())
+        for _round in range(16):
+            changed = False
+            for fn in funcs.values():
+                tl = set(self.tainted_params[fn.key])
+                # local assignment fixpoint (order-insensitive)
+                for _ in range(4):
+                    before = len(tl)
+                    fn.tainted_locals = tl
+                    for names, expr in fn.assigns:
+                        carriers = set(names) - SYMMETRIC_NAMES
+                        if expr is not None and carriers and \
+                                not (carriers <= tl) and \
+                                self._expr_tainted(fn, expr):
+                            tl |= carriers
+                    if len(tl) == before:
+                        break
+                fn.tainted_locals = tl
+                if fn.key not in self.tainted_returns and any(
+                        self._expr_tainted(fn, r) for r in fn.returns):
+                    self.tainted_returns.add(fn.key)
+                    changed = True
+                # argument -> parameter propagation
+                for sp, node in fn.calls:
+                    if sp[-1] in FLOW_COLLECTIVES:
+                        continue    # the alphabet's terminals: opaque
+                    targets = self._resolve(fn, sp, node.lineno)
+                    if not targets:
+                        continue
+                    t_args = [a for a in node.args
+                              if self._expr_tainted(fn, a)]
+                    t_kws = [kw.arg for kw in node.keywords
+                             if kw.arg and self._expr_tainted(fn, kw.value)]
+                    if not t_args and not t_kws:
+                        continue
+                    for tkey, conf in targets:
+                        callee = funcs.get(tkey)
+                        if callee is None or not conf:
+                            continue
+                        params = callee.params
+                        off = 1 if params and params[0] in ("self", "cls") \
+                            and (len(sp) > 1 or tkey.endswith("__init__")) \
+                            else 0
+                        tp = self.tainted_params[tkey]
+                        for i, a in enumerate(node.args):
+                            j = i + off
+                            if a in t_args and j < len(params) and \
+                                    params[j] not in tp and \
+                                    params[j] not in SYMMETRIC_NAMES:
+                                tp.add(params[j])
+                                changed = True
+                        for kw in t_kws:
+                            if kw in params and kw not in tp and \
+                                    kw not in SYMMETRIC_NAMES:
+                                tp.add(kw)
+                                changed = True
+            if not changed:
+                break
+
+    # -- streams -------------------------------------------------------------
+    def _func_stream(self, key: str, stack: frozenset) -> list:
+        if key in self._stream_cache:
+            return self._stream_cache[key]
+        fn = self.flow.funcs.get(key)
+        if fn is None or key in stack:
+            return []
+        out = self._stream_of(fn.effects, fn, stack | {key})
+        self._stream_cache[key] = out
+        return out
+
+    def _stream_of(self, effs: list, fn: FlowFunc,
+                   stack: frozenset) -> list:
+        """[(token, (path, line))] — see the module docstring."""
+        out: list = []
+        for e in effs:
+            kind = e[0]
+            if kind == "coll":
+                _, op, label, line = e
+                tok = f"{op}({label})" if label else op
+                out.append((tok, (fn.path, line)))
+            elif kind == "call":
+                _, sp, line = e
+                for tkey, conf in self._resolve(fn, sp, line):
+                    if conf:
+                        out.extend(self._func_stream(tkey, stack))
+                        break
+            elif kind == "branch":
+                _, test, line, then_e, else_e = e
+                t = self._stream_of(then_e, fn, stack)
+                o = self._stream_of(else_e, fn, stack)
+                if [x for x, _ in t] == [x for x, _ in o]:
+                    out.extend(t)
+                elif t or o:
+                    out.append((
+                        "{%s|%s}" % (_render(t) or "-", _render(o) or "-"),
+                        (fn.path, line)))
+            elif kind == "loop":
+                _, _trip, line, body_e = e
+                body = self._stream_of(body_e, fn, stack)
+                if body:
+                    out.append((f"loop[{_render(body)}]",
+                                (fn.path, line)))
+            if len(out) > _MAX_STREAM:
+                return out[:_MAX_STREAM]
+        return out
+
+    # -- findings ------------------------------------------------------------
+    def _suppressed_span(self, path: str, start: int, end: int,
+                         rule) -> bool:
+        sup = self.program.suppressions.get(path)
+        return bool(sup and sup.active_span(start, max(start, end), rule))
+
+    def _emit(self, rule_key: str, severity: str, path: str, line: int,
+              message: str, sites: tuple = (),
+              span_end: int | None = None) -> None:
+        rule = RULES[rule_key]
+        if self._suppressed_span(path, line, span_end or line, rule):
+            return
+        self.findings.append(Finding(rule=rule, severity=severity,
+                                     path=path, line=line,
+                                     message=message, sites=sites))
+
+    def _walk_effects(self, effs: list):
+        for e in effs:
+            yield e
+            if e[0] == "branch":
+                yield from self._walk_effects(e[3])
+                yield from self._walk_effects(e[4])
+            elif e[0] == "loop":
+                yield from self._walk_effects(e[3])
+
+    def _check_divergence(self) -> None:
+        """HVD601 + HVD602."""
+        for fn in self.flow.funcs.values():
+            for e in self._walk_effects(fn.effects):
+                if e[0] == "branch":
+                    _, test, line, then_e, else_e = e
+                    if not self._expr_tainted(fn, test):
+                        continue
+                    t = self._stream_of(then_e, fn, frozenset({fn.key}))
+                    o = self._stream_of(else_e, fn, frozenset({fn.key}))
+                    tt = [x for x, _ in t]
+                    oo = [x for x, _ in o]
+                    if tt == oo:
+                        continue
+                    k = next((i for i, (a, b) in enumerate(
+                        zip(tt, oo)) if a != b), min(len(tt), len(oo)))
+                    a_tok = tt[k] if k < len(tt) else "(end of stream)"
+                    b_tok = oo[k] if k < len(oo) else "(end of stream)"
+                    sites = tuple(s for _, s in (t + o)[:6])
+                    self._emit(
+                        "divergent-collective", "error", fn.path, line,
+                        f"rank-tainted branch in '{fn.key}' gates a "
+                        f"divergent collective stream: if-arm fingerprint"
+                        f" [{_render(t) or '(empty)'}] vs else-arm "
+                        f"[{_render(o) or '(empty)'}]; first divergent "
+                        f"op #{k + 1}: {a_tok} vs {b_tok}.  Ranks taking"
+                        f" different arms submit different collective "
+                        f"sequences and the negotiation wedges (runtime:"
+                        f" the HOROVOD_FINGERPRINT divergence ERROR) — "
+                        f"hoist the collectives out of the rank branch "
+                        f"(rank-gated non-collective work is legal), or "
+                        f"justify with a suppression",
+                        sites=sites,
+                        span_end=getattr(test, "end_lineno", line))
+                elif e[0] == "loop":
+                    _, trip, line, body_e = e
+                    if trip is None or not self._expr_tainted(fn, trip):
+                        continue
+                    body = self._stream_of(body_e, fn,
+                                           frozenset({fn.key}))
+                    if not body:
+                        continue
+                    sites = tuple(s for _, s in body[:6])
+                    self._emit(
+                        "divergent-loop-trip", "error", fn.path, line,
+                        f"collective stream [{_render(body)}] inside a "
+                        f"loop in '{fn.key}' whose trip count is "
+                        f"rank-tainted: ranks execute the body a "
+                        f"different number of times, shifting every "
+                        f"later op in their fingerprint streams — make "
+                        f"the trip count rank-invariant, or justify "
+                        f"with a suppression",
+                        sites=sites,
+                        span_end=getattr(trip, "end_lineno", line))
+
+    def _check_serve_waits(self) -> None:
+        """HVD603: DFS over the call graph from every serving dispatch
+        root; a function's waits are bounded once ANY frame on the path
+        (itself included) established a deadline guard."""
+        roots = [fn for fn in self.flow.funcs.values()
+                 if (fn.module.split(".")[0] == "serving"
+                     or "/serving/" in fn.path)
+                 and fn.name in SERVE_DISPATCH_ROOTS]
+        reported: set = set()
+        for root in roots:
+            seen: set = set()
+            stack = [(root.key, (root.name,), False)]
+            while stack:
+                key, pathnames, guarded = stack.pop()
+                fn = self.flow.funcs.get(key)
+                if fn is None:
+                    continue
+                g = guarded or fn.guard
+                state = (key, g)
+                if state in seen or len(pathnames) > _MAX_SERVE_DEPTH:
+                    continue
+                seen.add(state)
+                if not g:
+                    for name, node, bounded in fn.waits:
+                        if bounded:
+                            continue
+                        site = (fn.path, node.lineno)
+                        if site in reported:
+                            continue
+                        reported.add(site)
+                        self._emit(
+                            "unbounded-serve-wait", "error", fn.path,
+                            node.lineno,
+                            f"blocking '{name}' in '{fn.key}' is "
+                            f"reachable from the serving dispatch root "
+                            f"'{root.key}' via "
+                            f"{' -> '.join(pathnames)} with no "
+                            f"deadline_scope/op_scope/op_timeout bound "
+                            f"anywhere on the path: one dead peer or "
+                            f"wedged handoff stalls the serve loop past"
+                            f" every request's SLO — bound the wait "
+                            f"from the request deadline, or justify "
+                            f"the external bound with a suppression")
+                for sp, node in fn.calls:
+                    if sp[-1] in FLOW_COLLECTIVES:
+                        continue
+                    for tkey, conf in self._resolve(fn, sp,
+                                                    node.lineno):
+                        if conf and tkey not in SERVE_WAIT_BOUNDARIES:
+                            # cycles break on the seen set
+                            callee = self.flow.funcs.get(tkey)
+                            label = callee.name if callee else tkey
+                            stack.append(
+                                (tkey, pathnames + (label,), g))
+
+    def _check_knob_reads(self) -> None:
+        """HVD604: raw HOROVOD_* environment reads must name a knob the
+        typed registry declares."""
+        try:
+            from ...common import config
+            registered = set(config.all_knobs())
+        except Exception:            # pragma: no cover - broken install
+            return
+        for path, name, line in self.flow.env_reads:
+            if name in registered:
+                continue
+            if path.endswith("common/config.py"):
+                continue             # the registry itself
+            self._emit(
+                "unregistered-knob-read", "error", path, line,
+                f"raw environment read of {name!r}, which is not "
+                f"declared in the typed knob registry "
+                f"(common/config.py): undeclared knobs have no type, "
+                f"default, doc line or docs/configuration.md row — "
+                f"register(name, type, default, doc) it, or justify "
+                f"the raw read with a suppression")
+
+    def analyze(self) -> "FlowAnalysis":
+        self._fix_taint()
+        self._check_divergence()
+        self._check_serve_waits()
+        self._check_knob_reads()
+        self.findings.sort(key=lambda f: (f.path, f.line, f.rule.id))
+        return self
+
+
+def _render(stream: list) -> str:
+    return " -> ".join(tok for tok, _ in stream)
+
+
+def analyze_flow(program: Program, flow: FlowProgram,
+                 cfg=None) -> list[Finding]:
+    findings = FlowAnalysis(program, flow).analyze().findings
+    if cfg is not None:
+        findings = [f for f in findings if cfg.wants(f.rule)]
+    return findings
+
+
+def analyze_paths(paths) -> list[Finding]:
+    program = Program()
+    flow = FlowProgram()
+    for p in iter_python_files(list(paths)):
+        try:
+            with open(p, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=p)
+        except (OSError, SyntaxError):
+            continue
+        program.collect_source(p, src, tree)
+        flow.collect_source(p, src, tree)
+    return analyze_flow(program, flow)
+
+
+# --- CLI ---------------------------------------------------------------------
+def main(argv: list[str] | None = None) -> int:
+    import time as _time
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis.hvdflow",
+        description="Interprocedural rank-divergence dataflow analysis "
+                    "(HVD601-604; see docs/analysis.md).")
+    parser.add_argument("paths", nargs="*", default=["horovod_tpu"])
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text")
+    parser.add_argument("--knobs", action="store_true",
+                        help="print the generated typed-knob registry "
+                             "table (docs/configuration.md) and exit")
+    args = parser.parse_args(argv)
+    if args.knobs:
+        from ...common.config import configuration_markdown
+        print(configuration_markdown(), end="")
+        return 0
+    t0 = _time.monotonic()
+    findings = analyze_paths(args.paths)
+    wall_ms = round((_time.monotonic() - t0) * 1e3, 3)
+    errors = [f for f in findings if f.severity == "error"]
+    if args.format == "json":
+        print(json.dumps({"flow": [f.json() for f in findings],
+                          "wall_ms": wall_ms}, indent=2))
+    elif args.format == "sarif":
+        from ..hvdsan.san import sarif_payload
+        print(json.dumps(sarif_payload(findings), indent=2))
+    else:
+        for f in findings:
+            print(f.text())
+        print(f"hvdflow: {len(errors)} error(s), "
+              f"{len(findings) - len(errors)} warning(s) in "
+              f"{', '.join(args.paths)} ({wall_ms:.1f} ms)",
+              file=sys.stderr)
+    return 1 if errors else 0

@@ -6,13 +6,17 @@ CLI::
                                         [--format text|json|sarif]
                                         [--select RULES] [--ignore RULES]
                                         [--changed-only] [--san]
+                                        [--flow] [--knobs]
 
 ``--changed-only`` lints only files git reports as modified/untracked
 (sub-second gate as the rule count grows; cross-file rules see only the
 changed set).  ``--san`` additionally runs the hvdsan whole-program
 concurrency analysis (HVD501-505, analysis/hvdsan/) over the SAME parse
-of each file — one AST per file serves both rule families.  ``--sarif``
-emits SARIF 2.1.0 so findings annotate PRs.
+of each file — one AST per file serves both rule families.  ``--flow``
+does the same for the hvdflow interprocedural rank-divergence dataflow
+analysis (HVD601-604, analysis/hvdflow/).  ``--knobs`` prints the
+generated typed-knob registry table (docs/configuration.md) and exits.
+``--sarif`` emits SARIF 2.1.0 so findings annotate PRs.
 
 Walks a Python tree and flags call patterns that break the invariant the
 whole coordination protocol rests on — every rank submits the same
@@ -286,13 +290,53 @@ class _BarrierSite:
     tag: str
 
 
+def statement_spans(tree: ast.AST) -> list[tuple[int, int]]:
+    """Physical-line span of every statement: suppressions anchor to
+    the whole statement, so a comment on any line of a multi-line call
+    covers the violation reported at the call's first line, and a
+    suppression on a decorated ``def`` line covers its decorators.
+    Function/class spans stop at the header (body statements have their
+    own spans) so a suppression inside a body never silences the
+    def-line or decorator-line findings of the enclosing scope."""
+    spans: list[tuple[int, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        start = node.lineno
+        end = node.end_lineno or start
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if node.decorator_list:
+                start = min(start,
+                            min(d.lineno for d in node.decorator_list))
+            if node.body:
+                end = max(start, node.body[0].lineno - 1)
+        spans.append((start, end))
+    return spans
+
+
+def span_suppressed(spans: list[tuple[int, int]], sup, line: int,
+                    rule) -> bool:
+    """Suppression check against the smallest statement span containing
+    ``line`` (innermost statement wins, so a suppression on an outer
+    compound statement never blankets its body)."""
+    best: tuple[int, int] | None = None
+    for s, e in spans:
+        if s <= line <= e and (best is None or
+                               (e - s) < (best[1] - best[0])):
+            best = (s, e)
+    return best is not None and sup.active_span(best[0], best[1], rule)
+
+
 class _Analyzer(ast.NodeVisitor):
     def __init__(self, path: str, cfg: LintConfig, sup: Suppressions,
                  out: list[Violation],
-                 barrier_sites: dict[str, _BarrierSite]) -> None:
+                 barrier_sites: dict[str, _BarrierSite],
+                 spans: list[tuple[int, int]] | None = None) -> None:
         self.path = path
         self.cfg = cfg
         self.sup = sup
+        self.spans = spans or []
         self.out = out
         self.barrier_sites = barrier_sites
         self._in_hot_dir = bool(
@@ -338,7 +382,8 @@ class _Analyzer(ast.NodeVisitor):
         if not self.cfg.wants(rule):
             return
         line = getattr(node, "lineno", 1)
-        if self.sup.active(line, rule):
+        if self.sup.active(line, rule) or \
+                span_suppressed(self.spans, self.sup, line, rule):
             return
         self.out.append(Violation(self.path, line,
                                   getattr(node, "col_offset", 0) + 1,
@@ -824,7 +869,7 @@ def lint_source(source: str, path: str, cfg: LintConfig | None = None,
             return out
     analyzer = _Analyzer(path, cfg, sup,
                          out, barrier_sites if barrier_sites is not None
-                         else {})
+                         else {}, spans=statement_spans(tree))
     analyzer.visit(tree)
     bare_rule = RULES["bare-suppression"]
     if cfg.wants(bare_rule):
@@ -910,19 +955,24 @@ def changed_py_files(paths: list[str], diff_base: str | None = None
 
 def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
                      san: bool = False, changed_only: bool = False,
-                     diff_base: str | None = None
+                     diff_base: str | None = None, flow: bool = False
                      ) -> tuple[list[Violation], list, dict]:
-    """One parse + one rule walk per file; hvdsan (``san=True``) rides
-    the SAME trees.  Returns (violations, san findings, stats)."""
+    """One parse + one rule walk per file; hvdsan (``san=True``) and
+    hvdflow (``flow=True``) ride the SAME trees.  Returns
+    (violations, san+flow findings, stats)."""
     import time as _time
     cfg = cfg or LintConfig()
     out: list[Violation] = []
     warnings: list[str] = []
     barrier_sites: dict[str, _BarrierSite] = {}
     program = None
-    if san:
+    flowprog = None
+    if san or flow:
         from .hvdsan.lockgraph import Program
         program = Program()
+    if flow:
+        from .hvdflow.flow import FlowProgram
+        flowprog = FlowProgram()
     files = list(iter_python_files(paths))
     if changed_only:
         changed, warning = changed_py_files(paths,
@@ -953,11 +1003,16 @@ def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
                                tree=tree))
         if program is not None:
             program.collect_source(path, source, tree)
+        if flowprog is not None:
+            flowprog.collect_source(path, source, tree)
     findings: list = []
-    if program is not None:
+    if san and program is not None:
         from .hvdsan.lockgraph import Analysis
         analysis = Analysis(program).analyze()
         findings = [f for f in analysis.findings if cfg.wants(f.rule)]
+    if flowprog is not None:
+        from .hvdflow.flow import analyze_flow
+        findings.extend(analyze_flow(program, flowprog, cfg))
     stats = {"files": nfiles,
              "wall_ms": round((_time.monotonic() - t0) * 1e3, 3),
              "warnings": warnings}
@@ -1012,7 +1067,21 @@ def main(argv: list[str] | None = None) -> int:
                         help="also run the hvdsan whole-program "
                              "concurrency analysis (HVD501-505) over "
                              "the same parse of each file")
+    parser.add_argument("--flow", action="store_true",
+                        help="also run the hvdflow interprocedural "
+                             "rank-divergence dataflow analysis "
+                             "(HVD601-604) over the same parse of "
+                             "each file")
+    parser.add_argument("--knobs", action="store_true",
+                        help="print the generated typed-knob registry "
+                             "table (the docs/configuration.md "
+                             "content) and exit")
     args = parser.parse_args(argv)
+
+    if args.knobs:
+        from ..common.config import configuration_markdown
+        print(configuration_markdown(), end="")
+        return 0
 
     cfg = LintConfig(select=_parse_rule_set(args.select),
                      ignore=_parse_rule_set(args.ignore))
@@ -1022,14 +1091,19 @@ def main(argv: list[str] | None = None) -> int:
                                 if b.strip()}
     violations, findings, stats = lint_paths_timed(
         args.paths, cfg, san=args.san, changed_only=args.changed_only,
-        diff_base=args.diff_base)
+        diff_base=args.diff_base, flow=args.flow)
+    from .hvdflow.flow import FLOW_RULE_IDS
+    san_findings = [f for f in findings
+                    if f.rule.id not in FLOW_RULE_IDS]
+    flow_findings = [f for f in findings if f.rule.id in FLOW_RULE_IDS]
     errors = [f for f in findings if f.severity == "error"]
     for w in stats["warnings"]:
         print(f"hvdlint: warning: {w}", file=sys.stderr)
     if args.format == "json":
         print(json.dumps({
             "violations": [v.json() for v in violations],
-            "san": [f.json() for f in findings],
+            "san": [f.json() for f in san_findings],
+            "flow": [f.json() for f in flow_findings],
             "files": stats["files"],
             "wall_ms": stats["wall_ms"],
             "warnings": stats["warnings"],
@@ -1044,9 +1118,9 @@ def main(argv: list[str] | None = None) -> int:
         for f in findings:
             print(f.text())
         print(f"hvdlint: {len(violations)} violation(s)"
-              + (f", {len(errors)} san error(s), "
-                 f"{len(findings) - len(errors)} san warning(s)"
-                 if args.san else "")
+              + (f", {len(errors)} san/flow error(s), "
+                 f"{len(findings) - len(errors)} warning(s)"
+                 if (args.san or args.flow) else "")
               + f" in {', '.join(args.paths)} "
               f"({stats['files']} file(s), {stats['wall_ms']:.1f} ms)",
               file=sys.stderr)

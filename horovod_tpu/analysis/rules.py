@@ -83,6 +83,39 @@ _RULE_LIST = [
          "vocabulary no longer exists in the code (the checker "
          "verifies a protocol nobody runs).  Update the spec and the "
          "code in the same change."),
+    Rule("HVD601", "divergent-collective",
+         "A collective effect is reachable under one arm of a "
+         "rank-tainted branch with no sequence-equal effect on the "
+         "sibling arm (hvdflow, interprocedural): the gated ranks "
+         "submit a different collective stream than their peers and "
+         "the negotiation wedges — exactly the divergence runtime "
+         "fingerprinting (HOROVOD_FINGERPRINT) reports as a "
+         "structured ERROR.  Rank-0-only non-collective work (logging, "
+         "checkpoint writes) stays legal: both arms' streams are "
+         "empty and therefore equal."),
+    Rule("HVD602", "divergent-loop-trip",
+         "Collective effect inside a loop whose trip count is "
+         "rank-tainted (e.g. `for _ in range(rank)` or a while on a "
+         "rank-derived bound, hvdflow): ranks execute the collective a "
+         "different number of times, shifting every later op in the "
+         "stream — the off-by-one twin of HVD601 that per-line rules "
+         "cannot see."),
+    Rule("HVD603", "unbounded-serve-wait",
+         "A blocking wait reachable from the serving dispatch path "
+         "with no deadline_scope/op_scope/op_timeout bound on any "
+         "interprocedural path (hvdflow's flow-aware upgrade of "
+         "HVD1003): one dead peer or wedged handoff then stalls the "
+         "serve loop past every request's SLO — bound the wait from "
+         "the request deadline (resilience.deadline_scope) or justify "
+         "the external bound with a suppression."),
+    Rule("HVD604", "unregistered-knob-read",
+         "os.environ/getenv read of a HOROVOD_* name that is not "
+         "declared in the typed knob registry (common/config.py): "
+         "undeclared knobs have no type, no default, no doc line and "
+         "never appear in docs/configuration.md or the operator "
+         "console — register the knob (name, type, default, doc) and "
+         "read it through the registry, or justify the raw read with "
+         "a suppression."),
     Rule("HVD901", "bare-suppression",
          "hvdlint suppression without a '-- <justification>' comment."),
     Rule("HVD902", "syntax-error",
@@ -145,8 +178,29 @@ _RULE_LIST = [
 
 RULES: dict[str, Rule] = {}
 for _r in _RULE_LIST:
+    # Rule-id/slug uniqueness across every family (hvdlint, hvdsan,
+    # hvdmc, hvdflow) is asserted at registry build time: a duplicate
+    # would silently shadow an existing rule's summary and suppression
+    # key, so it fails the import instead.
+    if _r.id in RULES:
+        raise AssertionError(
+            f"duplicate rule id {_r.id!r}: already registered as "
+            f"[{RULES[_r.id].slug}]")
+    if _r.slug in RULES:
+        raise AssertionError(
+            f"duplicate rule slug {_r.slug!r}: already registered as "
+            f"{RULES[_r.slug].id}")
     RULES[_r.id] = _r
     RULES[_r.slug] = _r
+
+
+def undocumented_rules(doc_text: str) -> list[str]:
+    """Rule ids with no ``| HVDxxx |`` row in the given documentation
+    text (docs/analysis.md's rule tables) — the generated-or-verified
+    contract: a new rule cannot land undocumented (CI asserts this
+    returns [])."""
+    return sorted(r.id for r in set(RULES.values())
+                  if f"| {r.id} |" not in doc_text)
 
 
 @dataclass
@@ -185,6 +239,18 @@ class Suppressions:
         if keys & self.file_wide:
             return True
         return bool(keys & self.by_line.get(line, set()))
+
+    def active_span(self, start: int, end: int, rule: Rule) -> bool:
+        """True when the rule is suppressed anywhere in the physical
+        line range ``start..end`` (inclusive) — a suppression anchors
+        to the whole *statement*, not one physical line, so a comment
+        on the closing line of a multi-line call (or on the ``def``
+        line of a decorated function) still covers it."""
+        keys = {rule.id, rule.slug, "all"}
+        if keys & self.file_wide:
+            return True
+        return any(keys & self.by_line.get(ln, set())
+                   for ln in range(start, end + 1))
 
 
 def parse_suppressions(source: str) -> Suppressions:

@@ -52,6 +52,57 @@ def all_knobs() -> dict[str, Knob]:
     return dict(_REGISTRY)
 
 
+def _knob_type_name(parser: Callable[[str], Any]) -> str:
+    return {"_parse_bool": "bool", "parse_tristate": "tristate"}.get(
+        getattr(parser, "__name__", ""),
+        getattr(parser, "__name__", "str"))
+
+
+def _knob_default_repr(default: Any) -> str:
+    if isinstance(default, bool):
+        return "1" if default else "0"
+    if default == "" or default is None:
+        return "*(unset)*"
+    return f"`{default}`"
+
+
+def configuration_markdown() -> str:
+    """The generated knob table: one row per registered ``HOROVOD_*``
+    knob (name, type, default, doc).  ``python -m
+    horovod_tpu.analysis.lint --knobs`` prints it, docs/configuration.md
+    embeds it, and CI asserts the two are byte-identical — an
+    undocumented knob cannot exist, and hvdflow's HVD604 flags any raw
+    environment read of a name missing from this registry."""
+    lines = [
+        "# Configuration — the typed `HOROVOD_*` knob registry",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand.  Regenerate with",
+        "     `python -m horovod_tpu.analysis.lint --knobs >"
+        " docs/configuration.md`;",
+        "     tests/test_lint_clean.py asserts this file matches the",
+        "     registry in horovod_tpu/common/config.py. -->",
+        "",
+        f"Every knob is declared once in `horovod_tpu/common/config.py`"
+        f" with its type,",
+        "default and doc line; raw `os.environ` reads of `HOROVOD_*`"
+        " names outside the",
+        "registry are flagged by hvdflow rule HVD604"
+        " (docs/analysis.md).",
+        "",
+        f"{len(_REGISTRY)} knobs:",
+        "",
+        "| knob | type | default | description |",
+        "|---|---|---|---|",
+    ]
+    for name in sorted(_REGISTRY):
+        k = _REGISTRY[name]
+        doc = " ".join(k.doc.split())
+        lines.append(f"| `{name}` | {_knob_type_name(k.parser)} | "
+                     f"{_knob_default_repr(k.default)} | {doc} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
 # --- Core cycle / fusion knobs (reference: common/common.h:66-96) -----------
 FUSION_THRESHOLD = register(
     "HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024, int,
@@ -472,6 +523,76 @@ JAX_DISTRIBUTED = register(
     "HOROVOD_JAX_DISTRIBUTED", "auto", str,
     "Form the multi-process JAX world at init (jax.distributed.initialize "
     "via the rendezvous KV): 1 | 0 | auto (yes on accelerator backends).")
+JAX_HEARTBEAT_TIMEOUT_SECONDS = register(
+    "HOROVOD_JAX_HEARTBEAT_TIMEOUT_SECONDS", 100.0, float,
+    "jax.distributed coordinator heartbeat timeout passed through to "
+    "jax.distributed.initialize when the installed jaxlib accepts it "
+    "(parallel/multihost.py filters kwargs by signature).")
+JAX_TEARDOWN_GRACE_SECONDS = register(
+    "HOROVOD_JAX_TEARDOWN_GRACE_SECONDS", 30.0, float,
+    "Grace window for jax.distributed.shutdown at world teardown "
+    "before the process gives up waiting on the coordination service.")
+JAX_TEARDOWN_SETTLE_SECONDS = register(
+    "HOROVOD_JAX_TEARDOWN_SETTLE_SECONDS", 10.0, float,
+    "Settle pause after a jax.distributed teardown so late peer RPCs "
+    "drain before the next epoch's world forms (elastic rebuilds).")
+SHM_BARRIER_TIMEOUT_SECONDS = register(
+    "HOROVOD_SHM_BARRIER_TIMEOUT_SECONDS", 600.0, float,
+    "Timeout of the shared-memory plane's 3-phase lockstep barrier; a "
+    "rank missing past it aborts the op with a structured error naming "
+    "the lagging rank instead of spinning forever.")
+STREAMING_CE_MIN_ELEMENTS = register(
+    "HOROVOD_STREAMING_CE_MIN_ELEMENTS", 0, int,
+    "Logit-tensor element count above which the trainer switches to "
+    "the streaming (chunked) cross-entropy loss; unset derives the "
+    "threshold from discoverable device memory (HBM/16), 0 forces "
+    "streaming everywhere (training.py).")
+TPU_DISABLE_NATIVE = register(
+    "HOROVOD_TPU_DISABLE_NATIVE", False, _parse_bool,
+    "Force the pure-numpy fallbacks for the native C codec/fused "
+    "kernels (native/): a perf switch, never a correctness one — both "
+    "implementations are bitwise identical.")
+
+# --- Launcher / cluster integration (read at their launch-time sites) -------
+# These are set by launchers for the worker processes they spawn and
+# read before (or outside) any registry import; they are declared here
+# so the typed registry — and docs/configuration.md, generated from it —
+# is the one complete knob inventory (hvdflow HVD604 flags any raw
+# HOROVOD_* read whose name is missing from this file).
+DRIVER_ADDR = register(
+    "HOROVOD_DRIVER_ADDR", "", str,
+    "Elastic driver RPC address the worker dials back to "
+    "(elastic/worker.py; set by the elastic launcher).")
+DRIVER_PORT = register(
+    "HOROVOD_DRIVER_PORT", -1, int,
+    "Elastic driver RPC port (elastic/worker.py; set by the launcher).")
+GLOO_IFACE = register(
+    "HOROVOD_GLOO_IFACE", "", str,
+    "Network interface name that pins the address peers dial for the "
+    "TCP data/control planes (runner/network.py); empty = the default "
+    "route's interface.")
+RENDEZVOUS_EPOCH = register(
+    "HOROVOD_RENDEZVOUS_EPOCH", "0", str,
+    "Rendezvous-KV key namespace of the current world incarnation; "
+    "elastic rebuilds, retry recovery and statesync grow bump it "
+    "(e.g. '3~r1', '3+j2') so a rebuilt world never collides with "
+    "stale keys from the previous epoch.  Set by launchers and "
+    "recovery paths, not by hand.")
+SECRET_KEY = register(
+    "HOROVOD_SECRET_KEY", "", str,
+    "Shared HMAC secret authenticating elastic driver<->worker RPCs "
+    "(elastic/rpc.py); generated by the launcher per run.")
+JSRUN_CPU_PER_SLOT = register(
+    "HOROVOD_JSRUN_CPU_PER_SLOT", -1, int,
+    "CPUs per resource-set slot for the LSF/jsrun launcher "
+    "(runner/js_run.py); unset derives it from the allocation.")
+JSRUN_HOSTS = register(
+    "HOROVOD_JSRUN_HOSTS", "", str,
+    "Explicit host list override for the LSF/jsrun launcher.")
+LSF_COMPUTE_HOSTS = register(
+    "HOROVOD_LSF_COMPUTE_HOSTS", "", str,
+    "LSF compute-host list override consulted before LSB_MCPU_HOSTS "
+    "(runner/js_run.py).")
 XLA_OPERATIONS = register(
     "HOROVOD_XLA_OPERATIONS", "auto", str,
     "Eager-core device data plane: 1 (require XLA backend) | 0 (TCP only) "

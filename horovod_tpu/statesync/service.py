@@ -283,9 +283,9 @@ class StateSyncService:
                             if v["depart"] >= 0})
         ready_id = max(v["ready"] for v in views)
         join_id = max(v["join"] for v in views)
-        if departing:
+        if departing:  # hvdlint: disable=HVD601 -- boundary decision derives from the allgather'd membership views, identical on every rank (hvdmc boundary-agreement property); the taint is the size==1 fallback arm, which has no peer to diverge from
             return self._transition_depart(departing)
-        if ready_id >= 0:
+        if ready_id >= 0:  # hvdlint: disable=HVD601 -- same allgather'd-views agreement as the depart arm above: every rank computes the same ready_id at the same boundary seq
             return self._transition_grow(ready_id)
         if join_id >= 0:
             self._start_donation(join_id)

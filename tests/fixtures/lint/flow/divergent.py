@@ -1,0 +1,34 @@
+"""Seeded HVD601 fixtures: rank-gated collective streams, direct and
+buried three calls deep (the case per-line hvdlint cannot see)."""
+import horovod_tpu as hvd
+
+
+def direct(t, rank):
+    if rank == 0:
+        hvd.allreduce(t, name="extra")
+    return hvd.allreduce(t, name="step")
+
+
+def _deep3(t):
+    return hvd.allreduce(t, name="buried")
+
+
+def _deep2(t):
+    return _deep3(t)
+
+
+def _deep1(t):
+    return _deep2(t)
+
+
+def interprocedural(t):
+    if hvd.rank() == 0:
+        _deep1(t)
+    return hvd.allreduce(t, name="after")
+
+
+def asymmetric_arms(t, rank):
+    if rank % 2 == 0:
+        hvd.allreduce(t, name="even")
+    else:
+        hvd.allgather(t, name="odd")

@@ -1,0 +1,22 @@
+"""The shared seed of the 4-rank flow battery (test_multiprocess
+test_flow_divergence_caught_static_and_runtime): the SAME rank-gated
+collective below is caught
+
+- statically by hvdflow — HVD601 names the tainted branch in
+  ``rank_gated_step`` and carries the would-be fingerprint stream of
+  both arms ([allreduce(flow_extra)] vs []), and
+- at runtime by collective fingerprinting — the seeded rank submits
+  ``flow_extra`` while its peers submit ``flow_step``, and every rank
+  receives the structured divergence ERROR within one strict-mode
+  negotiation cycle.
+"""
+
+
+def _extra_sync(hvd, t):
+    hvd.allreduce(t, name="flow_extra")
+
+
+def rank_gated_step(hvd, t, rank, seed_rank):
+    if rank == seed_rank:
+        _extra_sync(hvd, t)
+    return hvd.allreduce(t, name="flow_step")

@@ -1,0 +1,37 @@
+"""Legal idioms hvdflow must NOT flag: rank-0-only non-collective work,
+sequence-equal arms, and branches on exchanged (rank-symmetric) values."""
+import horovod_tpu as hvd
+
+
+def rank0_logging(t, rank):
+    if rank == 0:
+        print("step done", t.shape)
+    return hvd.allreduce(t, name="ok")
+
+
+def equal_arms(t, rank):
+    if rank == 0:
+        out = hvd.allreduce(t, name="same")
+    else:
+        out = hvd.allreduce(t, name="same")
+    return out
+
+
+def symmetric_views(t):
+    # allgather results are identical on every rank: branching on them
+    # is the sanctioned membership-agreement idiom, not a divergence.
+    views = hvd.allgather_object({"x": hvd.rank()}, name="views")
+    if max(v["x"] for v in views) > 2:
+        hvd.allreduce(t, name="agreed")
+
+
+def world_sized(t, rank, size):
+    # `size` is world-symmetric even when it arrives through the same
+    # tuple as a rank: branching on it cannot diverge the stream.
+    rank, size = _resolve_world()
+    if size > 1:
+        hvd.allreduce(t, name="multi")
+
+
+def _resolve_world():
+    return hvd.rank(), 4

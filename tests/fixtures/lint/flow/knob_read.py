@@ -1,0 +1,15 @@
+"""Seeded HVD604 fixtures: raw environment reads of HOROVOD_* names the
+typed registry (common/config.py) does not declare."""
+import os
+
+
+def bad_get():
+    return os.environ.get("HOROVOD_TOTALLY_UNDECLARED")
+
+
+def bad_subscript():
+    return os.environ["HOROVOD_ALSO_UNDECLARED"]
+
+
+def bad_getenv():
+    return os.getenv("HOROVOD_UNDECLARED_THREE", "0")

@@ -1,0 +1,16 @@
+"""HVD604 clean twin: registered reads, child-env writes (launchers
+assembling a worker environment are not reads), and non-HOROVOD vars."""
+import os
+
+
+def registered_read():
+    return os.environ.get("HOROVOD_FUSION_THRESHOLD")
+
+
+def launcher_write(env):
+    env["HOROVOD_RANK"] = "0"
+    os.environ["HOROVOD_NOT_A_KNOB_BUT_A_WRITE"] = "1"
+
+
+def non_horovod():
+    return os.environ.get("PATH", "")

@@ -1,0 +1,14 @@
+"""Seeded HVD602 fixtures: collectives inside rank-tainted loop trips."""
+import horovod_tpu as hvd
+
+
+def per_rank_rounds(t, rank):
+    for _ in range(rank):
+        hvd.allreduce(t, name="per")
+
+
+def while_rank(t):
+    r = hvd.rank()
+    while r > 0:
+        hvd.broadcast(t, root_rank=0)
+        r -= 1

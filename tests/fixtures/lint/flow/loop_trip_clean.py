@@ -1,0 +1,12 @@
+"""Loops hvdflow must NOT flag: fixed and world-symmetric trip counts."""
+import horovod_tpu as hvd
+
+
+def fixed_rounds(t):
+    for _ in range(4):
+        hvd.allreduce(t, name="fixed")
+
+
+def world_rounds(t, size):
+    for _ in range(size):
+        hvd.allreduce(t, name="world")

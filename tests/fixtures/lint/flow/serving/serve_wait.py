@@ -1,0 +1,18 @@
+"""Seeded HVD603 fixture: the serve dispatch loop reaches unbounded
+blocking waits (a queue handoff and a transport recv, one call deep)
+with no deadline_scope/op_scope/op_timeout anywhere on the path."""
+
+
+def serve_loop(q, ch):
+    while True:
+        plan = _next_plan(q)
+        _dispatch(ch, plan)
+
+
+def _next_plan(q):
+    return q.get()
+
+
+def _dispatch(ch, plan):
+    ch.send(plan)
+    return ch.recv()

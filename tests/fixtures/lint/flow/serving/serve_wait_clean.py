@@ -1,0 +1,16 @@
+"""HVD603 clean twin: the same shape with the waits bounded — the queue
+pop carries a timeout and the dispatch leg runs under deadline_scope,
+so every wait on the path inherits a request-derived bound."""
+from horovod_tpu.resilience import deadline_scope
+
+
+def serve_loop(q, ch, slo_s):
+    while True:
+        plan = q.get(timeout=0.1)
+        with deadline_scope(slo_s):
+            _dispatch(ch, plan)
+
+
+def _dispatch(ch, plan):
+    ch.send(plan)
+    return ch.recv()

@@ -1,0 +1,24 @@
+"""Suppression statement-range regression fixture: a suppression
+anchors to the whole statement (lineno..end_lineno), so a comment on
+the CLOSING line of a multi-line call — or on the ``def`` line of a
+decorated function — still covers the violation reported at the
+statement's first line.  This file must lint clean."""
+import horovod_tpu as hvd
+
+
+def multi_line_call(t, rank):
+    if rank == 0:
+        hvd.allreduce(
+            t,
+            name="spanned")  # hvdlint: disable=HVD101 -- single-rank tool path, never negotiates; regression: suppression on the closing line of a multi-line statement
+
+
+def _gate(cond):
+    def deco(fn):
+        return fn
+    return deco
+
+
+@_gate(0 == hvd.rank() and hvd.barrier())
+def decorated(t, rank):  # hvdlint: disable=HVD101 -- regression: a suppression on the def line covers its decorators
+    return t

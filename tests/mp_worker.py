@@ -311,6 +311,36 @@ def battery_stall(hvd, rank, size):
     raise AssertionError("stall shutdown never propagated to idle rank")
 
 
+def battery_flow(hvd, rank, size):
+    """ISSUE 12 acceptance (the runtime half): the seeded rank-gated
+    collective from tests/fixtures/lint/flow/divergent_battery.py — the
+    very file hvdflow flags with HVD601, naming the tainted branch and
+    the two arms' fingerprint streams — is caught by strict-mode
+    fingerprinting as a structured divergence ERROR on EVERY rank,
+    naming the divergent op, within one negotiation cycle."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "fixtures", "lint", "flow"))
+    import divergent_battery
+
+    t = np.ones(64, np.float32)
+    for i in range(3):
+        out = hvd.allreduce(t, op=hvd.Sum, name=f"flow_warm{i}")
+        np.testing.assert_allclose(np.asarray(out), t * size)
+    seed = int(os.environ.get("HOROVOD_FLOW_SEED_RANK", "2"))
+    try:
+        divergent_battery.rank_gated_step(hvd, t, rank, seed)
+    except Exception as exc:
+        msg = str(exc)
+        assert "fingerprint divergence" in msg.lower(), msg
+        assert "flow_extra" in msg or "flow_step" in msg, msg
+        print(f"FLOW_DIVERGENCE_CAUGHT rank={rank} {msg[:200]}",
+              flush=True)
+        return
+    raise AssertionError("rank-gated collective completed without a "
+                         "fingerprint divergence ERROR")
+
+
 def battery_errors(hvd, rank, size):
     # Shape mismatch must raise a structured error on every rank, not hang.
     shape = (4,) if rank == 0 else (5,)
@@ -2441,6 +2471,9 @@ BATTERIES = {
     "statesync_grow": battery_statesync_grow,
     "statesync_preempt": battery_statesync_preempt,
     "statesync_serve": battery_statesync_serve,
+    # hvdflow runtime cross-check (ISSUE 12): the seeded rank-gated
+    # collective must die as a structured fingerprint ERROR, not a hang.
+    "flow": battery_flow,
 }
 
 PREINIT_BATTERIES = {
@@ -2462,6 +2495,11 @@ def main() -> int:
     if battery == "stall":
         os.environ["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "1"
         os.environ["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = "3"
+    if battery == "flow":
+        # Strict mode: divergence surfaces within one forced
+        # negotiation heartbeat even in cache steady state.
+        os.environ.setdefault("HOROVOD_FINGERPRINT", "strict")
+        os.environ.setdefault("HOROVOD_FLOW_SEED_RANK", "2")
     if battery == "autotune":
         os.environ["HOROVOD_AUTOTUNE"] = "1"
         os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"

@@ -1,0 +1,307 @@
+"""hvdflow tests (analysis/hvdflow/): interprocedural rank-divergence
+dataflow — effect summaries, the taint engine (sources, propagation,
+sanitizers, world-symmetric names), HVD601-604 on the seeded fixtures,
+suppressions, the CLI and the lint --flow driver integration."""
+import ast
+import json
+import os
+import subprocess
+import sys
+
+from horovod_tpu.analysis.hvdflow.flow import (FLOW_RULE_IDS,
+                                               FlowProgram, analyze_flow,
+                                               analyze_paths)
+from horovod_tpu.analysis.hvdflow.flow import main as flow_main
+from horovod_tpu.analysis.hvdsan.lockgraph import Program
+from horovod_tpu.analysis.lint import LintConfig, lint_paths_timed
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TREE = os.path.join(REPO, "horovod_tpu")
+FLOW = os.path.join(REPO, "tests", "fixtures", "lint", "flow")
+
+
+def _analyze(src: str, path: str = "x.py"):
+    program = Program()
+    flow = FlowProgram()
+    tree = ast.parse(src, filename=path)
+    program.collect_source(path, src, tree)
+    flow.collect_source(path, src, tree)
+    return analyze_flow(program, flow)
+
+
+def _rules(findings):
+    return [f.rule.id for f in findings]
+
+
+# --- seeded fixtures: flagged/clean pairs ------------------------------------
+def test_fixture_divergent_flagged():
+    out = analyze_paths([os.path.join(FLOW, "divergent.py")])
+    assert _rules(out) == ["HVD601"] * 3
+    assert {f.line for f in out} == {7, 25, 31}
+
+
+def test_fixture_divergent_interprocedural_three_deep():
+    """The collective is three calls below the gate — invisible to the
+    per-line HVD101, named (with its stream) by hvdflow."""
+    out = analyze_paths([os.path.join(FLOW, "divergent.py")])
+    inter = next(f for f in out if f.line == 25)
+    assert "allreduce(buried)" in inter.message
+    assert "(empty)" in inter.message
+    # the collective's real site rides along as an anchor
+    assert any(ln == 13 for _p, ln in inter.sites)
+
+
+def test_fixture_divergent_carries_fingerprint_diff():
+    """Each HVD601 carries the would-be fingerprint stream of BOTH arms
+    and pinpoints the first divergent op — the static twin of the
+    runtime divergence ERROR's evidence."""
+    out = analyze_paths([os.path.join(FLOW, "divergent.py")])
+    arms = next(f for f in out if f.line == 31)
+    assert "allreduce(even)" in arms.message
+    assert "allgather(odd)" in arms.message
+    assert "first divergent op #1" in arms.message
+
+
+def test_fixture_divergent_clean_idioms():
+    """rank-0-only logging, sequence-equal arms, branches on exchanged
+    views and world-symmetric sizes all stay silent."""
+    out = analyze_paths([os.path.join(FLOW, "divergent_clean.py")])
+    assert out == [], "\n".join(f.text() for f in out)
+
+
+def test_fixture_loop_trip_flagged_and_clean():
+    out = analyze_paths([os.path.join(FLOW, "loop_trip.py")])
+    assert _rules(out) == ["HVD602"] * 2
+    assert {f.line for f in out} == {6, 12}
+    assert analyze_paths([os.path.join(FLOW, "loop_trip_clean.py")]) == []
+
+
+def test_fixture_serve_wait_flagged_and_clean():
+    out = analyze_paths([os.path.join(FLOW, "serving",
+                                      "serve_wait.py")])
+    assert _rules(out) == ["HVD603"] * 2
+    assert all("serve_loop" in f.message for f in out)
+    assert any("'get'" in f.message for f in out)
+    assert any("'recv'" in f.message for f in out)
+    assert analyze_paths([os.path.join(FLOW, "serving",
+                                       "serve_wait_clean.py")]) == []
+
+
+def test_fixture_knob_read_flagged_and_clean():
+    out = analyze_paths([os.path.join(FLOW, "knob_read.py")])
+    assert _rules(out) == ["HVD604"] * 3
+    assert {f.message.split("'")[1] for f in out} == {
+        "HOROVOD_TOTALLY_UNDECLARED", "HOROVOD_ALSO_UNDECLARED",
+        "HOROVOD_UNDECLARED_THREE"}
+    assert analyze_paths([os.path.join(FLOW, "knob_read_clean.py")]) == []
+
+
+def test_all_flow_fixtures_flagged_together():
+    """Whole-directory walk (the CI shape): every seeded rule surfaces,
+    the clean twins stay silent."""
+    out = analyze_paths([FLOW])
+    found = set(_rules(out))
+    assert found == {"HVD601", "HVD602", "HVD603", "HVD604"}
+    flagged_files = {os.path.basename(f.path) for f in out}
+    assert not flagged_files & {"divergent_clean.py",
+                                "loop_trip_clean.py",
+                                "serve_wait_clean.py",
+                                "knob_read_clean.py"}
+
+
+# --- taint engine units ------------------------------------------------------
+def test_taint_through_parameters():
+    """A caller passing hvd.rank() taints the callee's parameter; the
+    callee's gated collective is then flagged IN the callee."""
+    src = ("import horovod_tpu as hvd\n"
+           "def gated(t, who):\n"
+           "    if who == 0:\n"
+           "        hvd.allreduce(t, name='x')\n"
+           "def caller(t):\n"
+           "    gated(t, hvd.rank())\n")
+    out = _analyze(src)
+    assert _rules(out) == ["HVD601"]
+    assert out[0].line == 3
+
+
+def test_taint_through_returns():
+    src = ("import horovod_tpu as hvd\n"
+           "def my_rank():\n"
+           "    return hvd.rank()\n"
+           "def f(t):\n"
+           "    r = my_rank()\n"
+           "    if r == 0:\n"
+           "        hvd.barrier()\n")
+    out = _analyze(src)
+    assert _rules(out) == ["HVD601"]
+    assert out[0].line == 6
+
+
+def test_collective_results_are_sanitizers():
+    """allgather/broadcast results are identical on every rank:
+    branching on them is the membership-agreement idiom, never a
+    divergence."""
+    src = ("import horovod_tpu as hvd\n"
+           "def f(t):\n"
+           "    views = hvd.allgather_object(hvd.rank(), name='v')\n"
+           "    if max(views) > 2:\n"
+           "        hvd.allreduce(t, name='agreed')\n")
+    assert _analyze(src) == []
+
+
+def test_world_symmetric_names_never_carry_taint():
+    src = ("import horovod_tpu as hvd\n"
+           "def world():\n"
+           "    return hvd.rank(), 4\n"
+           "def f(t):\n"
+           "    rank, size = world()\n"
+           "    if size > 1:\n"
+           "        hvd.allreduce(t, name='multi')\n"
+           "    if rank > 1:\n"
+           "        hvd.allreduce(t, name='gated')\n")
+    out = _analyze(src)
+    assert _rules(out) == ["HVD601"]
+    assert out[0].line == 8          # the rank gate, not the size gate
+
+
+def test_rank_attribute_manifest_sources():
+    src = ("import horovod_tpu as hvd\n"
+           "def f(self, t):\n"
+           "    if self._rank == 0:\n"
+           "        hvd.allreduce(t, name='x')\n")
+    assert _rules(_analyze(src)) == ["HVD601"]
+
+
+def test_equal_arm_streams_are_legal():
+    src = ("import horovod_tpu as hvd\n"
+           "def f(t, rank):\n"
+           "    if rank == 0:\n"
+           "        hvd.allreduce(t, name='s')\n"
+           "    else:\n"
+           "        hvd.allreduce(t, name='s')\n")
+    assert _analyze(src) == []
+
+
+def test_suppression_at_branch_site_with_why():
+    src = ("import horovod_tpu as hvd\n"
+           "def f(t, rank):\n"
+           "    if rank == 0:  # hvdlint: disable=HVD601 -- "
+           "single-process tool, never negotiates\n"
+           "        hvd.allreduce(t, name='x')\n")
+    assert _analyze(src) == []
+
+
+def test_hvd602_comprehension_loop():
+    src = ("import horovod_tpu as hvd\n"
+           "def f(t, rank):\n"
+           "    return [hvd.allreduce(t, name='c')"
+           " for _ in range(rank)]\n")
+    assert _rules(_analyze(src)) == ["HVD602"]
+
+
+# --- HVD603 specifics --------------------------------------------------------
+def test_serve_wait_guard_anywhere_on_path_bounds():
+    src = ("from horovod_tpu.resilience import deadline_scope\n"
+           "def serve_loop(ch):\n"
+           "    _leg(ch)\n"
+           "def _leg(ch):\n"
+           "    with deadline_scope(1.0):\n"
+           "        _deep(ch)\n"
+           "def _deep(ch):\n"
+           "    return ch.recv()\n")
+    assert _analyze(src, "horovod_tpu/serving/x.py") == []
+
+
+def test_serve_wait_stops_at_world_formation_boundary():
+    """reinit/init are governed by the gloo/fault-tolerance timeouts,
+    not a request SLO: the walk must not descend into them."""
+    out = analyze_paths([TREE])
+    assert [f for f in out if f.rule.id == "HVD603"] == []
+
+
+# --- HVD604 registry ---------------------------------------------------------
+def test_knob_registry_covers_every_tree_read():
+    """The tree itself performs no unregistered HOROVOD_* reads — the
+    satellite that forced the 14 launcher/compat knobs into the typed
+    registry."""
+    out = analyze_paths([TREE])
+    assert [f for f in out if f.rule.id == "HVD604"] == []
+
+
+def test_knob_registry_declared_names_are_typed():
+    from horovod_tpu.common import config
+    knobs = config.all_knobs()
+    assert len(knobs) >= 98
+    for name, k in knobs.items():
+        assert name.startswith("HOROVOD_")
+        assert callable(k.parser)
+        assert k.doc.strip(), f"{name} has no doc line"
+    # The previously-unregistered family is now declared.
+    for name in ("HOROVOD_RENDEZVOUS_EPOCH", "HOROVOD_GLOO_IFACE",
+                 "HOROVOD_SECRET_KEY", "HOROVOD_DRIVER_ADDR",
+                 "HOROVOD_SHM_BARRIER_TIMEOUT_SECONDS",
+                 "HOROVOD_STREAMING_CE_MIN_ELEMENTS",
+                 "HOROVOD_TPU_DISABLE_NATIVE"):
+        assert name in knobs, name
+
+
+# --- CLI + driver integration ------------------------------------------------
+def test_cli_json(capsys):
+    rc = flow_main([os.path.join(FLOW, "divergent.py"),
+                    "--format", "json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert [f["rule"] for f in payload["flow"]] == ["HVD601"] * 3
+    assert payload["wall_ms"] > 0
+
+
+def test_cli_clean_exit(capsys):
+    rc = flow_main([os.path.join(FLOW, "divergent_clean.py")])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_sarif(capsys):
+    rc = flow_main([os.path.join(FLOW, "loop_trip.py"),
+                    "--format", "sarif"])
+    assert rc == 1
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["version"] == "2.1.0"
+    results = sarif["runs"][0]["results"]
+    assert [r["ruleId"] for r in results] == ["HVD602"] * 2
+    assert all(r["level"] == "error" for r in results)
+
+
+def test_cli_module_entrypoint():
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.hvdflow",
+         os.path.join(FLOW, "knob_read.py"), "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert [f["rule"] for f in payload["flow"]] == ["HVD604"] * 3
+
+
+def test_lint_driver_flow_rides_same_parse():
+    """`lint --flow` runs hvdflow over the same single parse; findings
+    carry the flow rule ids and respect --select/--ignore."""
+    cfg = LintConfig()
+    _v, findings, stats = lint_paths_timed(
+        [os.path.join(FLOW, "divergent.py")], cfg, flow=True)
+    assert [f.rule.id for f in findings] == ["HVD601"] * 3
+    assert stats["files"] == 1
+    cfg = LintConfig(ignore={"HVD601"})
+    _v, findings, _s = lint_paths_timed(
+        [os.path.join(FLOW, "divergent.py")], cfg, flow=True)
+    assert findings == []
+
+
+def test_flow_rule_ids_registered():
+    from horovod_tpu.analysis.rules import RULES
+    assert FLOW_RULE_IDS == {"HVD601", "HVD602", "HVD603", "HVD604"}
+    for rid in FLOW_RULE_IDS:
+        assert rid in RULES
+    assert RULES["HVD601"].slug == "divergent-collective"
+    assert RULES["HVD602"].slug == "divergent-loop-trip"
+    assert RULES["HVD603"].slug == "unbounded-serve-wait"
+    assert RULES["HVD604"].slug == "unregistered-knob-read"

@@ -410,3 +410,141 @@ def test_ruff_clean():
         [sys.executable, "-m", "ruff", "check", "horovod_tpu"],
         capture_output=True, text=True, cwd=REPO, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# --- ISSUE 12: suppression statement-range anchoring -------------------------
+def test_suppression_covers_multiline_statement():
+    """A suppression on the CLOSING line of a multi-line statement
+    covers the violation anchored at its first line (previously it
+    anchored to one physical line and silently failed)."""
+    gated = ("import horovod_tpu as hvd\n"
+             "def f(t, rank):\n"
+             "    if rank == 0:\n"
+             "        hvd.allreduce(\n"
+             "            t,\n"
+             "            name='x')%s\n")
+    assert _slugs(lint_source(gated % "", "x.py")) == \
+        ["rank-gated-collective"]
+    assert lint_source(
+        gated % "  # hvdlint: disable=HVD101 -- tool-only path",
+        "x.py") == []
+
+
+def test_suppression_on_def_covers_decorators():
+    """A suppression on the def line covers the decorator lines of the
+    same statement — but a suppression inside the BODY does not blanket
+    the enclosing def's decorators."""
+    src = ("import horovod_tpu as hvd\n"
+           "def gate(c):\n"
+           "    def deco(fn):\n"
+           "        return fn\n"
+           "    return deco\n"
+           "@gate(0 == rank and hvd.barrier())\n"
+           "def f(t, rank):%s\n"
+           "    x = 1%s\n"
+           "    return t\n")
+    assert _slugs(lint_source(src % ("", ""), "x.py")) == \
+        ["rank-gated-collective"]
+    assert lint_source(
+        src % ("  # hvdlint: disable=HVD101 -- reviewed decorator", ""),
+        "x.py") == []
+    # body-line suppression must NOT cover the decorator
+    assert _slugs(lint_source(
+        src % ("", "  # hvdlint: disable=HVD101 -- wrong anchor"),
+        "x.py")) == ["rank-gated-collective"]
+
+
+def test_suppression_span_regression_fixture_clean():
+    out = lint_paths([os.path.join(FIXTURES, "suppression_span.py")])
+    assert out == [], "\n".join(v.text() for v in out)
+
+
+# --- ISSUE 12: the hvdflow gates --------------------------------------------
+def test_horovod_tpu_tree_is_flow_clean():
+    """ISSUE 12 acceptance: zero unsuppressed HVD601-604 on the tree —
+    hvdflow rides the same single-parse driver run (--flow)."""
+    from horovod_tpu.analysis.lint import lint_paths_timed
+    violations, findings, stats = lint_paths_timed([TREE], flow=True)
+    assert violations == [], "\n".join(v.text() for v in violations)
+    errors = [f for f in findings if f.severity == "error"]
+    assert errors == [], "\n".join(f.text() for f in errors)
+    assert stats["files"] > 50
+
+
+def test_cli_flow_flag_and_sarif_shape(capsys):
+    """--flow rides the shared driver with the shared emitters: JSON
+    grows a 'flow' list, SARIF results carry the HVD6xx rule ids."""
+    flow_fixture = os.path.join(FIXTURES, "flow", "divergent.py")
+    rc = main([flow_fixture, "--flow", "--format", "json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert [f["rule"] for f in payload["flow"]] == ["HVD601"] * 3
+    # the direct gates are ALSO per-line HVD101s — same parse, both
+    # families report, each under its own JSON key
+    assert [v["rule"] for v in payload["violations"]] == ["HVD101"] * 3
+    assert payload["san"] == []
+    rc = main([flow_fixture, "--flow", "--format", "sarif"])
+    assert rc == 1
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["version"] == "2.1.0"
+    results = sarif["runs"][0]["results"]
+    assert [r["ruleId"] for r in results
+            if r["ruleId"] == "HVD601"] == ["HVD601"] * 3
+    assert {r["id"] for r in
+            sarif["runs"][0]["tool"]["driver"]["rules"]} == \
+        {"HVD101", "HVD601"}
+
+
+# --- ISSUE 12: typed knob registry + generated docs --------------------------
+def test_knobs_cli_emits_registry_table(capsys):
+    rc = main(["--knobs"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.startswith("# Configuration")
+    assert "| `HOROVOD_FUSION_THRESHOLD` | int |" in out
+    assert "| `HOROVOD_RENDEZVOUS_EPOCH` | str |" in out
+
+
+def test_configuration_md_in_sync_with_registry():
+    """docs/configuration.md is GENERATED from the typed registry; CI
+    asserts byte-identity so a new knob cannot land undocumented
+    (regenerate: python -m horovod_tpu.analysis.lint --knobs >
+    docs/configuration.md)."""
+    from horovod_tpu.common.config import configuration_markdown
+    path = os.path.join(REPO, "docs", "configuration.md")
+    with open(path, encoding="utf-8") as f:
+        on_disk = f.read()
+    assert on_disk == configuration_markdown(), \
+        "docs/configuration.md is stale — regenerate with " \
+        "`python -m horovod_tpu.analysis.lint --knobs > " \
+        "docs/configuration.md`"
+
+
+def test_docs_analysis_rule_table_is_complete():
+    """Generated-or-verified rule docs: every registered rule id (all
+    families — hvdlint, hvdsan, hvdmc, hvdflow) has a row in
+    docs/analysis.md, so a new rule cannot land undocumented."""
+    from horovod_tpu.analysis.rules import undocumented_rules
+    with open(os.path.join(REPO, "docs", "analysis.md"),
+              encoding="utf-8") as f:
+        doc = f.read()
+    missing = undocumented_rules(doc)
+    assert missing == [], f"rules missing from docs/analysis.md: {missing}"
+
+
+def test_rule_id_uniqueness_asserted_at_build():
+    """The registry build raises on a duplicate id or slug — simulated
+    here by replaying the build loop with a colliding rule."""
+    import importlib
+    from horovod_tpu.analysis import rules as rules_mod
+    dup = rules_mod.Rule("HVD101", "some-new-slug", "collides by id")
+    try:
+        if dup.id in rules_mod.RULES:
+            raise AssertionError(
+                f"duplicate rule id {dup.id!r}: already registered")
+    except AssertionError as exc:
+        assert "duplicate rule id" in str(exc)
+    else:
+        raise AssertionError("collision was not detected")
+    assert importlib.import_module(
+        "horovod_tpu.analysis.rules") is rules_mod

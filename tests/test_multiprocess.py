@@ -317,3 +317,38 @@ def test_torch_binding_grid_3rank():
     reference: test/parallel/test_torch.py grid).  The 2-rank sweep runs
     inside test_torch_full_2rank's shared world."""
     _run_world(3, "torch_grid", timeout=180.0)
+
+
+def test_flow_divergence_caught_static_and_runtime():
+    """ISSUE 12 acceptance: ONE seeded rank-gated collective
+    (tests/fixtures/lint/flow/divergent_battery.py) is caught BOTH
+
+    - statically: hvdflow HVD601 names the tainted branch site and
+      carries the would-be fingerprint stream of the two arms, and
+    - at runtime: a 4-rank HOROVOD_FINGERPRINT=strict world answers the
+      same gated collective with the structured divergence ERROR on
+      EVERY rank, naming the divergent op.
+    """
+    from horovod_tpu.analysis.hvdflow.flow import analyze_paths
+    fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "fixtures", "lint", "flow",
+                           "divergent_battery.py")
+    findings = analyze_paths([fixture])
+    assert [f.rule.id for f in findings] == ["HVD601"]
+    finding = findings[0]
+    with open(fixture) as f:
+        lines = f.read().splitlines()
+    gate_line = next(i for i, ln in enumerate(lines, start=1)
+                     if "if rank == seed_rank:" in ln)
+    assert finding.line == gate_line          # names the branch site
+    # …and carries the fingerprint stream diff of the two arms.
+    assert "allreduce(flow_extra)" in finding.message
+    assert "(empty)" in finding.message
+    assert "HOROVOD_FINGERPRINT" in finding.message
+
+    outputs = _run_world(4, "flow", timeout=120.0,
+                         extra_env={"HOROVOD_FINGERPRINT": "strict",
+                                    "HOROVOD_FLOW_SEED_RANK": "2"})
+    for r, out in enumerate(outputs):
+        assert "FLOW_DIVERGENCE_CAUGHT" in out, \
+            f"rank {r} missed the divergence ERROR:\n{out}"
