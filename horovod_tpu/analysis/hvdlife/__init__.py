@@ -1,0 +1,26 @@
+"""hvdlife — whole-program resource-lifecycle analysis + runtime
+census witness (HVD701-705; see docs/analysis.md).
+
+- :mod:`.life` — the static pass: acquisition harvest, release-verb
+  pairing, teardown reachability over the hvdsan call graph, the
+  epoch-scoped-leak rule, and the ``LIFECYCLE_ALLOWED`` manifest.
+- :mod:`.census` — the runtime twin: a thread/fd/socket/mmap census
+  snapshotted around world transitions (``HOROVOD_LIFE_CENSUS``),
+  dumped rank-stamped like the hvdsan witness, diffed against its own
+  baseline in CI.
+
+Rides the single-parse lint driver (``python -m
+horovod_tpu.analysis.lint --life``) and runs standalone as
+``python -m horovod_tpu.analysis.hvdlife``.
+"""
+from .census import (CensusWitness, census_diff, dump_census,
+                     load_census_dumps, take_census, witness)
+from .life import (LIFECYCLE_ALLOWED, LIFE_RULE_IDS, LifeAnalysis,
+                   LifeProgram, analyze_life, analyze_paths)
+
+__all__ = [
+    "CensusWitness", "LIFECYCLE_ALLOWED", "LIFE_RULE_IDS",
+    "LifeAnalysis", "LifeProgram", "analyze_life", "analyze_paths",
+    "census_diff", "dump_census", "load_census_dumps", "take_census",
+    "witness",
+]

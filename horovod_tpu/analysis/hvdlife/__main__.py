@@ -1,0 +1,64 @@
+"""``python -m horovod_tpu.analysis.hvdlife`` — standalone CLI for the
+resource-lifecycle pass (HVD701-705) and the census-witness diff."""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis.hvdlife",
+        description="Whole-program resource-lifecycle analysis "
+                    "(HVD701-705) with a runtime census witness "
+                    "(see docs/analysis.md).")
+    parser.add_argument("paths", nargs="*", default=["horovod_tpu"])
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text")
+    parser.add_argument("--census", nargs="*", default=[],
+                        help="rank-stamped census dumps "
+                             "(HOROVOD_LIFE_CENSUS_FILE) to check: "
+                             "each rank's return-to-baseline snapshot "
+                             "must equal its baseline")
+    args = parser.parse_args(argv)
+
+    from .census import check_dumps, load_census_dumps
+    from .life import analyze_paths
+
+    t0 = time.monotonic()
+    analysis = analyze_paths(args.paths)
+    drift = check_dumps(load_census_dumps(args.census)) \
+        if args.census else []
+    wall_ms = round((time.monotonic() - t0) * 1e3, 3)
+    findings = analysis.findings
+    errors = [f for f in findings if f.severity == "error"]
+
+    if args.format == "json":
+        print(json.dumps({
+            "life": [f.json() for f in findings],
+            "census": drift,
+            "allowed": sorted(set(analysis.allowed_hits)),
+            "threads": dict(sorted(analysis.thread_roots.items())),
+            "wall_ms": wall_ms,
+        }, indent=2))
+    elif args.format == "sarif":
+        from ..hvdsan.san import sarif_payload
+        print(json.dumps(sarif_payload(findings), indent=2))
+    else:
+        for line in analysis.report_lines():
+            print(line)
+        for f in findings:
+            print(f.text())
+        for p in drift:
+            print(f"hvdlife: CENSUS DRIFT: {p}")
+        print(f"hvdlife: {len(errors)} error(s), "
+              f"{len(findings) - len(errors)} warning(s) in "
+              f"{', '.join(args.paths)} ({wall_ms:.1f} ms)",
+              file=sys.stderr)
+    return 1 if (errors or drift) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,273 @@
+"""Runtime resource census — the dynamic twin of the hvdlife static
+pass (HVD704's witness).
+
+A census is one snapshot of the process's live resources:
+
+- **threads** by *normalized* name (``hvd-send-3`` → ``hvd-send-*``,
+  ``Thread-12`` → ``Thread-*``) with counts — the per-peer/per-stream
+  numbering must not make two healthy worlds look different;
+- **fds** from ``/proc/self/fd`` classified by target (``sockets``,
+  ``shm_fds``, ``pipes``, ``files``, total ``fds``);
+- **shm_maps**: ``/dev/shm``-backed regions in ``/proc/self/maps`` —
+  the shm staging plane's mmap footprint (anonymous maps are malloc
+  noise and deliberately excluded).
+
+Under ``HOROVOD_LIFE_CENSUS=1`` the process-global :class:`
+CensusWitness` snapshots around every world transition (``core.init``
+tail, ``core.reinit_world`` entry) and dumps rank-stamped JSON at
+shutdown/atexit (``HOROVOD_LIFE_CENSUS_FILE``), exactly like the
+hvdsan lock witness.  CI diffs the snapshots: after an elastic cycle
+returns the world to its original shape, the census must equal the
+baseline — a drift names the leaked resource class the static pass
+should have caught (and the seeded HVD704 fixture proves both halves
+fire on the same leak).
+
+Off mode is the usual zero-cost contract: one cached knob read, no
+snapshots, no /proc reads, no files.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+__all__ = ["CensusWitness", "census_diff", "dump_census",
+           "load_census_dumps", "take_census", "witness"]
+
+# Census keys compared by census_diff (fds total is reported but not
+# diffed by default: harness pipes/log handles churn legitimately).
+DIFF_KEYS = ("threads", "sockets", "shm_fds", "shm_maps")
+
+
+def _normalize_thread(name: str) -> str:
+    """Collapse per-peer/per-stream numbering so two healthy worlds of
+    the same shape census identically."""
+    base = name.rstrip("0123456789")
+    if base != name and base.endswith(("-", "_")):
+        return base + "*"
+    return name
+
+
+def take_census(label: str = "") -> dict:
+    threads: dict[str, int] = {}
+    for t in threading.enumerate():
+        if not t.is_alive():
+            continue
+        key = _normalize_thread(t.name)
+        threads[key] = threads.get(key, 0) + 1
+    out = {"label": label, "threads": dict(sorted(threads.items())),
+           "fds": 0, "sockets": 0, "shm_fds": 0, "pipes": 0,
+           "files": 0, "shm_maps": 0}
+    fd_dir = "/proc/self/fd"
+    try:
+        entries = os.listdir(fd_dir)
+    except OSError:
+        entries = []
+    for fd in entries:
+        try:
+            target = os.readlink(os.path.join(fd_dir, fd))
+        except OSError:
+            continue           # the fd of the listdir itself, races
+        out["fds"] += 1
+        if target.startswith("socket:"):
+            out["sockets"] += 1
+        elif target.startswith("/dev/shm/"):
+            out["shm_fds"] += 1
+        elif target.startswith("pipe:"):
+            out["pipes"] += 1
+        elif target.startswith("/"):
+            out["files"] += 1
+    try:
+        with open("/proc/self/maps") as f:
+            out["shm_maps"] = sum(1 for line in f
+                                  if "/dev/shm/" in line)
+    except OSError:
+        pass
+    return out
+
+
+def socket_details() -> list[str]:
+    """Endpoint description of every live socket fd ("tcp
+    127.0.0.1:4242 -> 127.0.0.1:9999 ESTABLISHED"), by joining
+    /proc/self/fd inodes against /proc/net/tcp{,6} — the census
+    drift diagnostic: a leaked-socket finding should name the peer."""
+    states = {"01": "ESTABLISHED", "02": "SYN_SENT", "03": "SYN_RECV",
+              "04": "FIN_WAIT1", "05": "FIN_WAIT2", "06": "TIME_WAIT",
+              "07": "CLOSE", "08": "CLOSE_WAIT", "09": "LAST_ACK",
+              "0A": "LISTEN", "0B": "CLOSING"}
+
+    def _addr(hexaddr: str) -> str:
+        ip, _, port = hexaddr.partition(":")
+        if len(ip) == 8:
+            octets = [str(int(ip[i:i + 2], 16))
+                      for i in range(6, -2, -2)]
+            host = ".".join(octets)
+        else:
+            host = ip
+        return f"{host}:{int(port, 16)}"
+
+    table: dict[str, str] = {}
+    for proto in ("tcp", "tcp6", "udp", "udp6"):
+        try:
+            with open(f"/proc/net/{proto}") as f:
+                next(f)
+                for line in f:
+                    parts = line.split()
+                    inode = parts[9]
+                    table[inode] = (
+                        f"{proto} {_addr(parts[1])} -> "
+                        f"{_addr(parts[2])} "
+                        f"{states.get(parts[3], parts[3])}")
+        except (OSError, StopIteration, IndexError):
+            continue
+    out = []
+    fd_dir = "/proc/self/fd"
+    try:
+        entries = os.listdir(fd_dir)
+    except OSError:
+        return out
+    for fd in entries:
+        try:
+            target = os.readlink(os.path.join(fd_dir, fd))
+        except OSError:
+            continue
+        if target.startswith("socket:["):
+            inode = target[len("socket:["):-1]
+            out.append(f"fd {fd}: "
+                       f"{table.get(inode, f'socket inode {inode}')}")
+    return sorted(out)
+
+
+def census_diff(baseline: dict, now: dict,
+                keys=DIFF_KEYS) -> list[str]:
+    """Human-readable drift of ``now`` against ``baseline`` (empty =
+    the resource fabric returned to its baseline shape)."""
+    problems: list[str] = []
+    for key in keys:
+        if key == "threads":
+            a = baseline.get("threads", {})
+            b = now.get("threads", {})
+            for name in sorted(set(a) | set(b)):
+                ca, cb = a.get(name, 0), b.get(name, 0)
+                if ca != cb:
+                    problems.append(
+                        f"threads[{name}]: {ca} -> {cb} "
+                        f"({'leaked' if cb > ca else 'lost'} "
+                        f"{abs(cb - ca)})")
+        else:
+            ca, cb = baseline.get(key, 0), now.get(key, 0)
+            if ca != cb:
+                problems.append(f"{key}: {ca} -> {cb} "
+                                f"({'+' if cb > ca else ''}{cb - ca})")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# The witness (HOROVOD_LIFE_CENSUS)
+# ---------------------------------------------------------------------------
+class CensusWitness:
+    """Labeled census snapshots around world transitions, dumped
+    rank-stamped at exit — the hvdsan witness mold."""
+
+    def __init__(self, enabled: bool) -> None:
+        self.enabled = enabled
+        self.rank = 0
+        self.snapshots: list[dict] = []
+        self._lock = threading.Lock()
+
+    def note(self, label: str, rank: int | None = None) -> dict | None:
+        if not self.enabled:
+            return None
+        snap = take_census(label)
+        with self._lock:
+            if rank is not None:
+                self.rank = rank
+            self.snapshots.append(snap)
+        return snap
+
+    def payload(self) -> dict:
+        with self._lock:
+            return {"rank": self.rank,
+                    "snapshots": list(self.snapshots)}
+
+
+_witness: CensusWitness | None = None
+_atexit_registered = False
+
+
+def witness() -> CensusWitness:
+    """The process witness; enabled iff HOROVOD_LIFE_CENSUS (checked
+    once — the knob is launcher-set, never flipped mid-run)."""
+    global _witness, _atexit_registered
+    if _witness is None:
+        from ...common import config
+        _witness = CensusWitness(bool(config.LIFE_CENSUS.get()))
+        if _witness.enabled and not _atexit_registered:
+            import atexit
+            atexit.register(dump_census)
+            _atexit_registered = True
+    return _witness
+
+
+def _rank_path(path: str, rank: int) -> str:
+    if "{rank}" in path:
+        return path.format(rank=rank)
+    if rank == 0:
+        return path
+    root, dot, ext = path.rpartition(".")
+    return f"{root}.r{rank}.{ext}" if dot else f"{path}.r{rank}"
+
+
+def dump_census(path: str | None = None) -> str | None:
+    """Write the witness snapshots as rank-stamped JSON (write-then-
+    rename, the flight-dump discipline: a concurrent reader never sees
+    a torn file); returns the path, or None when off/empty."""
+    w = _witness
+    if w is None or not w.enabled or not w.snapshots:
+        return None
+    payload = w.payload()
+    if path is None:
+        from ...common import config
+        path = config.LIFE_CENSUS_FILE.get()
+    path = _rank_path(path, payload["rank"])
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    return path
+
+
+def load_census_dumps(paths) -> list[dict]:
+    out = []
+    for p in paths:
+        with open(p) as f:
+            out.append(json.load(f))
+    return out
+
+
+def check_dumps(payloads) -> list[str]:
+    """CI check: within each rank's dump, the LAST snapshot labeled
+    like the FIRST (same world shape) must census-equal it.  The
+    convention: the battery labels its baseline and its return-to-
+    baseline snapshot with the same ``baseline:`` prefix."""
+    problems: list[str] = []
+    for payload in payloads:
+        rank = payload.get("rank", "?")
+        snaps = payload.get("snapshots", [])
+        base = next((s for s in snaps
+                     if s.get("label", "").startswith("baseline")),
+                    None)
+        if base is None:
+            continue
+        finals = [s for s in snaps
+                  if s.get("label", "").startswith("baseline")
+                  and s is not base]
+        for fin in finals:
+            for problem in census_diff(base, fin):
+                problems.append(
+                    f"rank {rank} [{base['label']} -> "
+                    f"{fin['label']}]: {problem}")
+    return sorted(problems)

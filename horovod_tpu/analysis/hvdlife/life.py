@@ -1,0 +1,1004 @@
+"""hvdlife — whole-program resource-lifecycle analysis (HVD701-705).
+
+Every prior pass verifies *use*: hvdlint checks call symmetry per line,
+hvdsan checks lock order and ownership, hvdmc checks protocol shape,
+hvdflow checks rank dataflow.  Nothing verifies **release** — and the
+runtime is a per-process fabric of long-lived machinery (the background
+loop, per-peer sender lanes, shm regions, rendezvous watchers, stream
+workers, the timeline writer, heartbeat monitor, autoscale controller,
+statesync watcher, the preempt backstop timer, the metrics exporter,
+per-epoch PeerMesh channel sets) that is re-created on **every elastic
+world transition**.  A resource leaked once per ``reinit_world`` is a
+production outage at fleet scale.
+
+Model (riding the shared single-parse driver, ``lint --life``):
+
+1. **Harvest**: every acquisition site — ``threading.Thread``/``Timer``
+   starts (including package Thread *subclasses*), socket /
+   ``_PeerChannel`` / ``PeerMesh`` / HTTP-server creation, ``mmap``
+   regions, opened files, registered signal handlers — becomes a typed
+   resource keyed by its creation ``file:line`` (the hvdsan identity
+   scheme) and, when stored, by its binding ``module.Class.attr``.
+2. **Release pairing**: each resource kind carries required release
+   verbs (``join``/``cancel``/``close``/``shutdown``/``munmap``/
+   re-``signal``).  A release site counts when its receiver resolves to
+   the resource's binding attribute — directly, through a loop over the
+   owning container (``for ch in self._channels.values(): ch.close()``),
+   or through a local alias (``writer, self._writer = self._writer,
+   None`` then ``writer.join()``).
+3. **Teardown reachability**: the release must live in a function
+   reachable from a *teardown root* (``shutdown``/``close``/``stop``/
+   ``__exit__``/``__del__``/``cancel``/``finalize``/``reinit_world``)
+   through the hvdsan call graph (typed resolution — the
+   release-via-helper case is exactly a one-hop walk), or in the
+   acquiring function itself (the ``listener.close()``-after-formation
+   shape and ``finally`` blocks).
+4. **Epoch scoping** (HVD704): an acquisition reachable from the world
+   formation roots (module-level ``init``/``reinit_world``) whose
+   release is NOT reachable from the teardown half of the transition is
+   the elastic-specific leak — correct once, leaked once per
+   grow/shrink cycle.  The runtime census witness
+   (:mod:`.census`, ``HOROVOD_LIFE_CENSUS``) is the dynamic twin.
+
+Ownership-transfer rules keep the pass quiet on the tree's sanctioned
+idioms: a ``with``-managed acquisition is released by construction;
+registration into a ``*resources*`` container (``_global.resources``)
+transfers ownership to ``core.shutdown``'s drain loop; a local that is
+passed onward (``self._attach(r, mm, path)``) transfers to the callee's
+owner.  Intentional process-lifetime holds go into the reviewed
+:data:`LIFECYCLE_ALLOWED` manifest (the ``LOCK_HOLD_ALLOWED`` mold) —
+every report lists the matched allowances so the justification stays
+visible.
+
+Like every pass here the heuristics are deliberately lexical where
+types run out; imprecision is tuned to lose findings, never to invent
+them, and the census witness closes the gap from the runtime side.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from ..hvdsan.lockgraph import (Analysis, CallEvent, Finding, Program,
+                                module_label, norm_path, _spine)
+from ..rules import RULES
+
+__all__ = ["LIFECYCLE_ALLOWED", "LIFE_RULE_IDS", "LifeAnalysis",
+           "LifeProgram", "analyze_life", "analyze_paths"]
+
+LIFE_RULE_IDS = frozenset({"HVD701", "HVD702", "HVD703", "HVD704",
+                           "HVD705"})
+
+# --- the resource taxonomy ---------------------------------------------------
+# ctor terminal -> (kind, release verbs).  Threading ctors are handled
+# separately (Thread subclasses join the set per program).  Thread
+# releases accept the owner-API verbs too: a package Thread subclass's
+# stop()/close() encapsulates its own poison+join (StreamDispatcher,
+# the heartbeat monitor), and requiring the literal join would force
+# every owner to reach through the abstraction.
+_THREAD_VERBS = frozenset({"join", "stop", "close", "shutdown",
+                           "cancel"})
+_TIMER_VERBS = frozenset({"cancel", "join", "close", "stop"})
+_CLOSE_VERBS = frozenset({"close", "server_close", "shutdown", "stop"})
+_SIGNAL_VERBS = frozenset({"signal"})
+
+# Package classes owning a closeable kernel object (sockets, fds, shm
+# regions, an HTTP server + its pool).  Curated, reviewable — exactly
+# like hvdlint's vocabularies; a new resource class gets a row here and
+# a doc line in docs/analysis.md.
+_CHANNEL_CTORS = frozenset({
+    "PeerMesh", "_PeerChannel", "ShmWorld", "MetricsExporter",
+    "RendezvousServer", "ThreadingHTTPServer", "HTTPServer",
+})
+
+_KIND_RULE = {
+    "thread": "unjoined-thread",
+    "timer": "unjoined-thread",
+    "channel": "unreleased-channel",
+    "socket": "unreleased-channel",
+    "signal": "unreleased-channel",
+    "mmap": "unreleased-region",
+    "file": "unreleased-region",
+}
+_KIND_VERBS = {
+    "thread": _THREAD_VERBS,
+    "timer": _TIMER_VERBS,
+    "channel": _CLOSE_VERBS,
+    "socket": _CLOSE_VERBS,
+    "signal": _SIGNAL_VERBS,
+    "mmap": frozenset({"close"}),
+    "file": frozenset({"close"}),
+}
+
+# Teardown roots: a release is proven only when its function is one of
+# these (by name) or reachable from one through the call graph.
+_TEARDOWN_NAMES = frozenset({
+    "shutdown", "close", "stop", "finalize", "cancel", "teardown",
+    "reinit_world", "exit",
+})
+_TEARDOWN_DUNDERS = frozenset({"__exit__", "__del__"})
+
+# World-formation roots for HVD704: module-level functions only —
+# ``Trainer.init`` and friends are per-object lifecycles, not world
+# epochs.
+_EPOCH_ROOT_NAMES = frozenset({"init", "reinit_world"})
+
+# HVD705: blocking primitives a thread body can wedge on, and the
+# wakeup verbs an owner must be able to reach to unblock it (poison
+# put(None) is detected separately).
+_BLOCK_NAMES = frozenset({
+    "get", "recv", "recv_into", "recv_bytes", "accept", "wait",
+    "select", "serve_forever", "join",
+})
+_WAKEUP_VERBS = frozenset({
+    "close", "shutdown", "cancel", "set", "server_close", "stop",
+})
+_BOUND_HINTS = ("timeout", "deadline", "poll", "interval", "grace")
+_MAX_THREAD_DEPTH = 8
+
+# ---------------------------------------------------------------------------
+# Reviewed process-lifetime allowances (the LOCK_HOLD_ALLOWED mold):
+# resource key -> why the missing release is intentional.  Keys are the
+# binding identity ("module.Class.attr") or, for unbound acquisitions,
+# the acquiring function ("module.Class.func").  Every report lists the
+# entries that matched, so the justification stays reviewable in one
+# place instead of scattering inline suppressions.
+# ---------------------------------------------------------------------------
+LIFECYCLE_ALLOWED: dict[str, str] = {
+    "elastic.rpc.RpcServer._accept_loop":
+        "one daemon thread per accepted RPC connection, by design "
+        "(workers keep one connection open for the job's lifetime): "
+        "each thread exits when its client disconnects or when "
+        "RpcServer.close() closes the listener and the conn sockets' "
+        "peers vanish — there is no handle list to join because the "
+        "connection set is the client population, not owned state",
+    "elastic.driver.ElasticDriver._launch_worker":
+        "one fire-and-forget thread per spawned worker process whose "
+        "body IS create_worker_fn's blocking wait on that process: it "
+        "exits exactly when the worker exits, and ElasticDriver.join "
+        "awaits the results table the threads feed — joining the "
+        "threads themselves would duplicate the worker-exit protocol",
+    "statesync.service.StateSyncService._install_preempt_handler":
+        "the SIGTERM grace handler is PROCESS-lifetime by design: the "
+        "StateSyncService survives every world transition (it is not "
+        "owned by core), and a preemption must be catchable at any "
+        "epoch — restoring SIG_DFL at close would turn the scheduler's "
+        "next SIGTERM into an instant kill with no bye| stamp",
+    "telemetry.flight._chain_sigterm":
+        "the flight recorder's SIGTERM chain handler is process-"
+        "lifetime: it wraps whatever handler exists and re-raises, and "
+        "unregistering would drop the crash evidence exactly on the "
+        "path that needs it",
+    "runner.safe_shell_exec.execute":
+        "the kill-event watcher thread exits with the watched child "
+        "(daemon; the event wait is its wakeup), and execute() itself "
+        "awaits the child before returning",
+    "runner.launch.launch_static":
+        "per-slot runner threads are the launcher's foreground work: "
+        "launch_static joins them inline (same function, including the "
+        "KeyboardInterrupt arm) and their blocking wait is the child "
+        "process itself — the terminate event set by the signal "
+        "handler is the wakeup, and the process exits with them",
+    "runner.run_api.run":
+        "per-host remote-dispatch threads are joined inline by the "
+        "same call (foreground fan-out, not background machinery)",
+}
+
+
+def blocking_allowed(key: str) -> bool:
+    return key in LIFECYCLE_ALLOWED
+
+
+# ---------------------------------------------------------------------------
+# Per-file facts
+# ---------------------------------------------------------------------------
+@dataclass
+class Acquisition:
+    kind: str
+    ctor: str
+    path: str
+    line: int
+    col: int
+    module: str
+    cls: str | None
+    funckey: str | None          # None = module level (import time)
+    funcname: str | None
+    attr: str | None             # binding attribute (owner field)
+    local: str | None            # local name when bound to a plain local
+    managed: bool = False        # `with` context expression
+    registered: bool = False     # appended into a *resources* registry
+    transferred: bool = False    # passed onward / returned
+    unbound: bool = False        # Thread(...).start() style
+    end_line: int = 0
+    thread_name: str | None = None
+    thread_target: tuple | None = None
+
+    @property
+    def key(self) -> str:
+        parts = [self.module] if self.module else []
+        if self.cls:
+            parts.append(self.cls)
+        if self.attr:
+            parts.append(self.attr)
+        elif self.funcname:
+            parts.append(self.funcname)
+        return ".".join(parts)
+
+    @property
+    def site(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+@dataclass
+class ReleaseSite:
+    verb: str
+    attr: str                    # resolved binding attribute ("" unknown)
+    funckey: str
+    path: str
+    line: int
+
+
+@dataclass
+class _FuncFacts:
+    key: str
+    name: str
+    cls: str | None
+    module: str
+    path: str
+    # local alias -> source binding attribute (writer = self._writer)
+    aliases: dict = field(default_factory=dict)
+    # loop var -> container binding attribute (for ch in self._chans...)
+    loop_binds: dict = field(default_factory=dict)
+    # unbounded blocking calls for HVD705: [(name, line)]
+    blocking: list = field(default_factory=list)
+    # bare names passed as call arguments (local-escape detection)
+    arg_names: set = field(default_factory=set)
+    # this scope establishes a deadline guard (resilience=/StreamGuard)
+    guarded: bool = False
+    # owner-side wakeup evidence: verbs + poison put(None)
+    wakeups: set = field(default_factory=set)
+    poisons: bool = False
+
+
+@dataclass
+class LifeProgram:
+    acquisitions: list = field(default_factory=list)
+    releases: list = field(default_factory=list)
+    funcs: dict = field(default_factory=dict)         # key -> _FuncFacts
+    thread_classes: dict = field(default_factory=dict)  # Cls -> run key
+    # Capitalized ctor calls not (yet) classifiable: a Thread SUBCLASS
+    # may be defined in a file collected after its construction site,
+    # so classification completes at analysis time.
+    candidates: list = field(default_factory=list)
+
+    def collect_source(self, path: str, source: str,
+                       tree: ast.AST | None = None) -> None:
+        if tree is None:
+            tree = ast.parse(source, filename=path)
+        _LifeCollector(self, norm_path(path),
+                       module_label(path)).collect(tree)
+
+
+def _is_bounded(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg and any(h in kw.arg.lower() for h in _BOUND_HINTS):
+            return True
+    for arg in node.args:
+        for sub in ast.walk(arg):
+            ident = sub.id if isinstance(sub, ast.Name) else (
+                sub.attr if isinstance(sub, ast.Attribute) else None)
+            if ident and any(h in ident.lower() for h in _BOUND_HINTS):
+                return True
+    return False
+
+
+def _join_exempt(node: ast.Call) -> bool:
+    """str.join / os.path.join — mirrors hvdlint/hvdsan."""
+    if not isinstance(node.func, ast.Attribute):
+        return True
+    base = node.func.value
+    if isinstance(base, ast.Constant) and isinstance(base.value, str):
+        return True
+    sp = _spine(node.func)
+    return bool(sp and set(sp[:-1]) & {"path", "sep", "pathsep",
+                                       "linesep", "os", "posixpath",
+                                       "ntpath"})
+
+
+def _name_literal(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        head = ""
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                head += str(v.value)
+            else:
+                return head + "*"
+        return head
+    return None
+
+
+def _binding_attr(spine: tuple | None) -> tuple[str | None, str | None]:
+    """(attr, local) binding of an assignment-target spine.
+
+    ``self._watcher`` / ``_global.background_thread`` /
+    ``self._socks[peer]`` bind to the named attribute; a bare local
+    (``mm``) binds locally; a plain-local container store
+    (``accepted[peer] = conn``) is an ownership transfer the container's
+    consumer owns."""
+    if not spine:
+        return None, None
+    named = [p for p in spine if p not in ("[]", "()")]
+    if not named:
+        return None, None
+    if len(spine) == 1:
+        return None, spine[0]                # plain local binding
+    root = spine[0]
+    if root in ("self", "cls") or root.startswith("_") or \
+            root[:1].isupper():
+        return named[-1] if named[-1] not in ("self", "cls") \
+            else None, None
+    return None, None                        # local container: transfer
+
+
+class _LifeCollector:
+    """One walk per file with a parent map: acquisition context
+    (with/assign/arg/return) needs one level of ancestry the visitor
+    pattern hides."""
+
+    def __init__(self, program: LifeProgram, path: str,
+                 module: str) -> None:
+        self.p = program
+        self.path = path
+        self.module = module
+
+    # -- entry ------------------------------------------------------------
+    def collect(self, tree: ast.AST) -> None:
+        parents: dict[int, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        # function scope map: node -> (funckey, funcname, cls)
+        self._scopes(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self._note_class(node)
+            elif isinstance(node, ast.Call):
+                self._note_call(node, parents)
+        self._gather_stmt_facts(tree)
+
+    def _scopes(self, tree: ast.AST) -> None:
+        """Assign every node its enclosing (funckey, name, cls) using
+        lockgraph's _qual convention so funckeys line up with the
+        hvdsan call graph."""
+        self._scope_of: dict[int, tuple] = {}
+
+        def walk(node, cls, fnparts):
+            for child in ast.iter_child_nodes(node):
+                ncls, nparts = cls, fnparts
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    nparts = fnparts + [child.name]
+                elif isinstance(child, ast.ClassDef):
+                    ncls, nparts = child.name, []
+                if nparts:
+                    parts = [self.module] if self.module else []
+                    if ncls:
+                        parts.append(ncls)
+                    parts.extend(nparts)
+                    self._scope_of[id(child)] = (".".join(parts),
+                                                 nparts[-1], ncls)
+                walk(child, ncls, nparts)
+
+        walk(tree, None, [])
+        # ensure facts rows exist for every function
+        for key, name, cls in set(self._scope_of.values()):
+            self.p.funcs.setdefault(key, _FuncFacts(
+                key=key, name=name, cls=cls, module=self.module,
+                path=self.path))
+
+    def _scope(self, node: ast.AST):
+        return self._scope_of.get(id(node), (None, None, None))
+
+    def _note_class(self, node: ast.ClassDef) -> None:
+        for b in node.bases:
+            sp = _spine(b)
+            if sp and sp[-1] == "Thread":
+                parts = [self.module] if self.module else []
+                parts += [node.name, "run"]
+                self.p.thread_classes[node.name] = ".".join(parts)
+
+    # -- calls ------------------------------------------------------------
+    def _classify_ctor(self, sp: tuple,
+                       node: ast.Call) -> tuple[str, str] | None:
+        name = sp[-1]
+        if name == "Thread":
+            return ("thread", name)
+        if name == "Timer":
+            return ("timer", name)
+        if name in _CHANNEL_CTORS:
+            return ("channel", name)
+        if name in self.p.thread_classes:
+            return ("thread", name)
+        if name == "socket" and len(sp) >= 2 and sp[-2] == "socket":
+            return ("socket", name)
+        if name == "create_connection":
+            return ("socket", name)
+        if name == "mmap" and (len(sp) == 1 or sp[-2] == "mmap"):
+            return ("mmap", name)
+        if name == "open" and len(sp) == 1:
+            return ("file", name)
+        if name == "signal" and len(sp) >= 2 and sp[-2] == "signal" \
+                and len(node.args) >= 2:
+            return ("signal", name)
+        return None
+
+    def _note_call(self, node: ast.Call, parents: dict) -> None:
+        sp = _spine(node.func)
+        funckey, funcname, cls = self._scope(node)
+        if sp:
+            self._note_release(sp, node, funckey)
+            self._note_func_facts(sp, node, funckey)
+        ctor = self._classify_ctor(sp, node) if sp else None
+        if funckey is None:
+            return
+        if ctor is None:
+            name = sp[-1] if sp else ""
+            if name[:1].isupper() and len(sp) <= 2:
+                acq = Acquisition(
+                    kind="candidate", ctor=name, path=self.path,
+                    line=node.lineno, col=node.col_offset + 1,
+                    module=self.module, cls=cls, funckey=funckey,
+                    funcname=funcname, attr=None, local=None,
+                    end_line=node.end_lineno or node.lineno)
+                self._classify_context(acq, node, parents)
+                self.p.candidates.append(acq)
+            return
+        kind, name = ctor
+        acq = Acquisition(
+            kind=kind, ctor=name, path=self.path, line=node.lineno,
+            col=node.col_offset + 1, module=self.module, cls=cls,
+            funckey=funckey, funcname=funcname, attr=None, local=None,
+            end_line=node.end_lineno or node.lineno)
+        if kind in ("thread", "timer"):
+            if name in self.p.thread_classes:
+                acq.thread_target = (name, "run")
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    acq.thread_target = _spine(kw.value)
+                elif kw.arg in ("name", "function"):
+                    if kw.arg == "function":
+                        acq.thread_target = _spine(kw.value)
+                    else:
+                        acq.thread_name = _name_literal(kw.value)
+            if kind == "timer" and acq.thread_target is None and \
+                    len(node.args) >= 2:
+                acq.thread_target = _spine(node.args[1])
+        if kind == "signal":
+            acq.attr = None          # registration is inherently unbound
+        self._classify_context(acq, node, parents)
+        self.p.acquisitions.append(acq)
+
+    def _classify_context(self, acq: Acquisition, node: ast.Call,
+                          parents: dict) -> None:
+        """Walk up: with-item, assignment target, registration,
+        transfer, or unbound chained call."""
+        cur: ast.AST = node
+        while True:
+            parent = parents.get(id(cur))
+            if parent is None:
+                return
+            if isinstance(parent, ast.withitem) and \
+                    parent.context_expr is cur:
+                acq.managed = True
+                return
+            if isinstance(parent, (ast.Assign, ast.AnnAssign)) and \
+                    getattr(parent, "value", None) is not None:
+                targets = parent.targets \
+                    if isinstance(parent, ast.Assign) else [parent.target]
+                for t in targets:
+                    attr, local = _binding_attr(_spine(t))
+                    if attr or local:
+                        acq.attr, acq.local = attr, local
+                        return
+                acq.transferred = True       # tuple/starred target etc.
+                return
+            if isinstance(parent, ast.Call) and cur is not parent.func:
+                # ctor appears as an argument: registration or transfer
+                psp = _spine(parent.func)
+                if psp and psp[-1] in ("append", "extend", "add") and \
+                        any("resources" in s for s in psp[:-1]
+                            if s not in ("[]", "()")):
+                    acq.registered = True
+                else:
+                    acq.transferred = True
+                return
+            if isinstance(parent, ast.Attribute) and parent.value is cur:
+                acq.unbound = True           # Thread(...).start()
+                return
+            if isinstance(parent, ast.Return):
+                acq.transferred = True       # factory: caller owns it
+                return
+            if isinstance(parent, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.List,
+                                   ast.Tuple, ast.Starred, ast.IfExp,
+                                   ast.expr)) and not \
+                    isinstance(parent, ast.Call):
+                cur = parent
+                continue
+            cur = parent
+
+    # -- releases + per-function facts -----------------------------------
+    def _note_release(self, sp: tuple, node: ast.Call,
+                      funckey: str | None) -> None:
+        verb = sp[-1]
+        if funckey is None:
+            return
+        if verb == "signal" and len(sp) >= 2 and sp[-2] == "signal":
+            self.p.releases.append(ReleaseSite(
+                verb="signal", attr="", funckey=funckey,
+                path=self.path, line=node.lineno))
+            return
+        if verb not in (_THREAD_VERBS | _TIMER_VERBS | _CLOSE_VERBS):
+            return
+        if verb == "join" and _join_exempt(node):
+            return
+        recv = sp[:-1]
+        named = [p for p in recv if p not in ("[]", "()",
+                                              "self", "cls")]
+        attr = named[-1] if named else (recv[0] if recv else "")
+        self.p.releases.append(ReleaseSite(
+            verb=verb, attr=attr, funckey=funckey, path=self.path,
+            line=node.lineno))
+
+    def _note_func_facts(self, sp: tuple, node: ast.Call,
+                         funckey: str | None) -> None:
+        if funckey is None:
+            return
+        fn = self.p.funcs.get(funckey)
+        if fn is None:
+            return
+        name = sp[-1]
+        if name in _BLOCK_NAMES and not _is_bounded(node):
+            exempt = name == "join" and _join_exempt(node)
+            if name == "get":
+                # dict/config .get() lookalikes: the blocking-get half
+                # bites only on queue-reading receivers (hvdlint
+                # HVD1006's receiver filter).
+                recv = [s.lower() for s in sp[:-1]
+                        if s not in ("[]", "()", "self", "cls")]
+                exempt = not any(r == "q" or "queue" in r
+                                 or r.endswith("_q") for r in recv)
+            if not exempt:
+                fn.blocking.append((name, node.lineno))
+        if name in _WAKEUP_VERBS:
+            fn.wakeups.add(name)
+        if name in ("put", "put_nowait") and any(
+                isinstance(a, ast.Constant) and a.value is None
+                for a in node.args):
+            fn.poisons = True
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name):
+                    fn.arg_names.add(sub.id)
+        for kw in node.keywords:
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Name):
+                    fn.arg_names.add(sub.id)
+        if "Guard" in name:
+            fn.guarded = True
+        for kw in node.keywords:
+            if kw.arg == "resilience":
+                fn.guarded = True
+
+    # -- statement facts: alias forwarding + loop binds -------------------
+    def _gather_stmt_facts(self, tree: ast.AST) -> None:
+        """Loop-variable and local-alias binds the release matcher
+        resolves receivers through (``for ch in self._channels.
+        values(): ch.close()``; ``writer, self._writer = self._writer,
+        None`` then ``writer.join()``), plus local→attr forwarding for
+        acquisitions bound to a local first (``timer = Timer(...)``
+        then ``self._grace_timer = timer``)."""
+        fwd: dict[tuple, str] = {}     # (funckey, local) -> attr
+        for node in ast.walk(tree):
+            funckey, _name, _cls = self._scope(node)
+            if funckey is None:
+                continue
+            fn = self.p.funcs.get(funckey)
+            if fn is None:
+                continue
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                targets = [node.target] if isinstance(node.target,
+                                                      ast.Name) \
+                    else (node.target.elts
+                          if isinstance(node.target, ast.Tuple) else [])
+                it = node.iter
+                # descend through list(...)/sorted(...)-style wrappers
+                # (snapshot-copy iteration: `for k, v in
+                # list(self._donors.items())`)
+                while isinstance(it, ast.Call) and \
+                        isinstance(it.func, ast.Name) and \
+                        it.func.id in ("list", "sorted", "tuple",
+                                       "set", "reversed") and \
+                        len(it.args) == 1:
+                    it = it.args[0]
+                isp = _spine(it)
+                if isp and targets:
+                    named = [s for s in isp
+                             if s not in ("[]", "()", "self", "cls",
+                                          "values", "items", "keys")]
+                    if named:
+                        # tuple unpacking over .items(): every element
+                        # binds to the container (lexically — the
+                        # release matcher only needs the attr)
+                        for t in targets:
+                            if isinstance(t, ast.Name):
+                                fn.loop_binds[t.id] = named[-1]
+            elif isinstance(node, ast.Assign):
+                if len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Tuple) and \
+                        isinstance(node.value, ast.Tuple) and \
+                        len(node.targets[0].elts) == \
+                        len(node.value.elts):
+                    pairs = list(zip(node.targets[0].elts,
+                                     node.value.elts))
+                else:
+                    pairs = [(t, node.value) for t in node.targets]
+                for t, v in pairs:
+                    if isinstance(t, ast.Name):
+                        vsp = _spine(v)
+                        if vsp is None and isinstance(v, ast.Call) \
+                                and len(v.args) == 1:
+                            # resources = list(_global.resources)
+                            vsp = _spine(v.args[0])
+                        if vsp and len(vsp) > 1:
+                            named = [s for s in vsp
+                                     if s not in ("[]", "()", "self",
+                                                  "cls")]
+                            if named:
+                                fn.aliases[t.id] = named[-1]
+                    elif isinstance(v, ast.Name):
+                        # self._grace_timer = timer: forward the
+                        # local-bound acquisition to the attr
+                        attr, _local = _binding_attr(_spine(t))
+                        if attr:
+                            fwd[(funckey, v.id)] = attr
+        for acq in self.p.acquisitions + self.p.candidates:
+            if acq.local is not None and acq.attr is None:
+                attr = fwd.get((acq.funckey, acq.local))
+                if attr:
+                    acq.attr, acq.local = attr, None
+
+
+# ---------------------------------------------------------------------------
+# Analysis
+# ---------------------------------------------------------------------------
+class LifeAnalysis:
+    """Release-reachability over the hvdsan call graph + the census of
+    thread roots the runtime witness normalizes against."""
+
+    def __init__(self, program: Program, life: LifeProgram) -> None:
+        self.program = program
+        self.life = life
+        self.an = Analysis(program)
+        self.an._build_indexes()
+        self.findings: list[Finding] = []
+        self.allowed_hits: list[tuple[str, str]] = []
+        self._adj: dict[str, list[str]] = {}
+        self._resolve_cache: dict = {}
+        self.teardown_reach: set[str] = set()
+        self.epoch_reach: set[str] = set()
+        # thread name -> body funckey (the hvdlife thread universe)
+        self.thread_roots: dict[str, str] = {}
+
+    # -- call graph -------------------------------------------------------
+    def _build_adj(self) -> None:
+        for fraw in self.program.functions.values():
+            outs: list[str] = []
+            for ev in fraw.calls:
+                for tkey, _conf in self.an.resolve_call(fraw, ev):
+                    if tkey:
+                        outs.append(tkey)
+            self._adj[fraw.key] = outs
+
+    def _reach_from(self, roots) -> set[str]:
+        seen: set[str] = set()
+        stack = list(roots)
+        while stack:
+            k = stack.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            stack.extend(self._adj.get(k, ()))
+        return seen
+
+    def _teardown_roots(self) -> list[str]:
+        out = []
+        for fraw in self.program.functions.values():
+            name = fraw.name
+            if name in _TEARDOWN_DUNDERS or \
+                    name.lstrip("_") in _TEARDOWN_NAMES:
+                out.append(fraw.key)
+        return out
+
+    def _epoch_roots(self) -> list[str]:
+        out = []
+        for f in self.program.functions.values():
+            if f.name not in _EPOCH_ROOT_NAMES or f.cls is not None:
+                continue
+            # module-level only (not nested): key == "<module>.<name>"
+            expect = f"{f.module}.{f.name}" if f.module else f.name
+            if f.key == expect:
+                out.append(f.key)
+        return out
+
+    # -- release matching -------------------------------------------------
+    def _release_attr(self, rel: ReleaseSite) -> str:
+        """Resolve the release receiver through the function's loop
+        binds and local aliases."""
+        fn = self.life.funcs.get(rel.funckey)
+        attr = rel.attr
+        if fn is not None and attr:
+            attr = fn.loop_binds.get(attr, fn.aliases.get(attr, attr))
+        return attr
+
+    def _rel_module(self, rel: ReleaseSite) -> str | None:
+        fn = self.life.funcs.get(rel.funckey)
+        return fn.module if fn is not None else None
+
+    def _released(self, acq: Acquisition) -> bool:
+        verbs = _KIND_VERBS[acq.kind]
+        if acq.kind == "signal":
+            # release = a re-registration reachable from teardown
+            return any(r.verb == "signal"
+                       and r.funckey != acq.funckey
+                       and r.funckey in self.teardown_reach
+                       and self._rel_module(r) == acq.module
+                       for r in self.life.releases)
+        for rel in self.life.releases:
+            if rel.verb not in verbs:
+                continue
+            # Same-module discipline: a same-named attribute in another
+            # module must never count as this resource's release (the
+            # heartbeat monitor's `_thread.join` is not the exporter's).
+            if self._rel_module(rel) != acq.module:
+                continue
+            attr = self._release_attr(rel)
+            if acq.attr is not None:
+                if attr != acq.attr:
+                    continue
+                if rel.funckey in self.teardown_reach or \
+                        rel.funckey == acq.funckey:
+                    return True
+            elif acq.local is not None:
+                # local-bound: a release on the same local (or its
+                # forwarded attr) inside the same function suffices
+                if rel.funckey != acq.funckey:
+                    continue
+                if rel.attr == acq.local or attr == acq.local:
+                    return True
+        return False
+
+    # -- HVD705 -----------------------------------------------------------
+    def _resolve_target(self, acq: Acquisition) -> str | None:
+        if acq.thread_target is None:
+            return None
+        if len(acq.thread_target) == 2 and \
+                acq.thread_target[0] in self.life.thread_classes:
+            return self.life.thread_classes[acq.thread_target[0]]
+        fraw = self.program.functions.get(acq.funckey or "")
+        if fraw is None:
+            return None
+        cached = self._resolve_cache.get((acq.funckey,
+                                          acq.thread_target))
+        if cached is not None:
+            return cached or None
+        ev = CallEvent(spine=acq.thread_target, held=(), line=acq.line)
+        targets = self.an._resolve_call_uncached(fraw, ev)
+        hit = targets[0][0] if targets else ""
+        self._resolve_cache[(acq.funckey, acq.thread_target)] = hit
+        return hit or None
+
+    def _thread_blocks_unbounded(self, root: str) -> tuple | None:
+        """(name, path, line) of the first unbounded blocking call
+        reachable from the thread body, honoring deadline guards."""
+        stack = [(root, 0, False)]
+        seen: set = set()
+        while stack:
+            key, depth, guarded = stack.pop()
+            fn = self.life.funcs.get(key)
+            g = guarded or (fn.guarded if fn else False)
+            if (key, g) in seen or depth > _MAX_THREAD_DEPTH:
+                continue
+            seen.add((key, g))
+            if fn is not None and not g and fn.blocking:
+                name, line = fn.blocking[0]
+                return name, fn.path, line
+            for nxt in self._adj.get(key, ()):
+                stack.append((nxt, depth + 1, g))
+        return None
+
+    def _owner_has_wakeup(self, acq: Acquisition) -> bool:
+        """Any teardown-root (or teardown-reachable) function of the
+        acquiring class/module carries a poison put(None) or a wakeup
+        verb — the path that can unblock the thread before its join."""
+        prefix = ".".join(filter(None, [acq.module, acq.cls]))
+        for fn in self.life.funcs.values():
+            if acq.cls:
+                if not fn.key.startswith(prefix + "."):
+                    continue
+            elif fn.module != acq.module:
+                continue
+            if fn.key not in self.teardown_reach:
+                continue
+            if fn.poisons or fn.wakeups:
+                return True
+        return False
+
+    # -- findings ---------------------------------------------------------
+    def _suppressed(self, path: str, start: int, end: int, rule) -> bool:
+        sup = self.program.suppressions.get(path)
+        return bool(sup and sup.active_span(start, max(start, end),
+                                            rule))
+
+    def _emit(self, rule_key: str, severity: str, acq: Acquisition,
+              message: str) -> None:
+        rule = RULES[rule_key]
+        if self._suppressed(acq.path, acq.line, acq.end_line, rule):
+            return
+        self.findings.append(Finding(
+            rule=rule, severity=severity, path=acq.path, line=acq.line,
+            message=message, sites=((acq.path, acq.line),)))
+
+    def _check_releases(self) -> None:
+        for acq in self.life.acquisitions:
+            if acq.managed or acq.registered or acq.transferred:
+                continue
+            if acq.funckey is None:
+                continue            # import-time: process lifetime
+            if blocking_allowed(acq.key):
+                self.allowed_hits.append((acq.key,
+                                          LIFECYCLE_ALLOWED[acq.key]))
+                continue
+            if acq.unbound and acq.kind in ("thread", "timer"):
+                # fire-and-forget Thread(...).start(): no handle exists
+                # to join — same leak, clearer message
+                self._emit(
+                    "unjoined-thread", "error", acq,
+                    f"'{acq.ctor}' started at {acq.site} without "
+                    f"keeping a handle: nothing can ever join it — "
+                    f"bind it to an owner field and join from the "
+                    f"owner's teardown (poison first), or record the "
+                    f"intentional hold in LIFECYCLE_ALLOWED")
+                continue
+            if self._released(acq):
+                continue
+            verbs = "/".join(sorted(_KIND_VERBS[acq.kind]))
+            epoch = acq.funckey in self.epoch_reach
+            if epoch:
+                self._emit(
+                    "epoch-scoped-leak", "error", acq,
+                    f"{acq.kind} '{acq.ctor}' acquired at {acq.site} "
+                    f"(binding {acq.key}) is reachable from the world "
+                    f"formation path (init/reinit_world) but NO "
+                    f"{verbs} release on it is reachable from the "
+                    f"teardown half of the transition "
+                    f"(shutdown/reinit_world): one {acq.kind} leaks "
+                    f"per elastic world cycle — release it in the "
+                    f"owner's teardown, register it in the resources "
+                    f"drain, or record the hold in LIFECYCLE_ALLOWED")
+            else:
+                self._emit(
+                    _KIND_RULE[acq.kind], "error", acq,
+                    f"{acq.kind} '{acq.ctor}' acquired at {acq.site} "
+                    f"(binding {acq.key}) has no {verbs} release "
+                    f"reachable from a teardown path "
+                    f"(shutdown/close/stop/__exit__): the {acq.kind} "
+                    f"outlives its owner — release it from the owner's "
+                    f"teardown, or record the intentional hold in "
+                    f"LIFECYCLE_ALLOWED with its justification")
+
+    def _check_wakeups(self) -> None:
+        for acq in self.life.acquisitions:
+            if acq.kind != "thread" or acq.funckey is None:
+                continue
+            if blocking_allowed(acq.key):
+                continue
+            root = self._resolve_target(acq)
+            if root is None:
+                continue
+            hit = self._thread_blocks_unbounded(root)
+            if hit is None:
+                continue
+            if self._owner_has_wakeup(acq):
+                continue
+            name, bpath, bline = hit
+            self._emit(
+                "blocking-thread-without-wakeup", "error", acq,
+                f"thread started at {acq.site} blocks unboundedly on "
+                f"'{name}' ({bpath}:{bline}) and its owner has no "
+                f"wakeup path — no poison put(None), no close/shutdown/"
+                f"cancel/set in any teardown-reachable function: a "
+                f"join can only wait out the grace and leak the thread "
+                f"(the wedged-sender shape).  Poison the queue or shut "
+                f"the socket down first, then join")
+
+    def _harvest_thread_roots(self) -> None:
+        for acq in self.life.acquisitions:
+            if acq.kind not in ("thread", "timer"):
+                continue
+            root = self._resolve_target(acq)
+            if root is None:
+                continue
+            name = acq.thread_name or f"thread@{acq.site}"
+            if root not in self.thread_roots or (
+                    acq.thread_name and
+                    self.thread_roots[root].startswith("thread@")):
+                self.thread_roots[root] = name
+        # Manifest names OVERRIDE harvest placeholders (hvdsan's
+        # _fix_threads order): Thread subclasses and Timer callbacks
+        # get their stable names from ownership.THREAD_ROOTS.
+        from ..hvdsan.ownership import THREAD_ROOTS
+        for tname, (funckey, _why) in THREAD_ROOTS.items():
+            if funckey in self.program.functions:
+                self.thread_roots[funckey] = tname
+
+    def analyze(self) -> "LifeAnalysis":
+        # Late classification: Thread-subclass constructions recorded
+        # as candidates (the class may live in a later-collected file).
+        for acq in self.life.candidates:
+            if acq.ctor in self.life.thread_classes:
+                acq.kind = "thread"
+                if acq.thread_target is None:
+                    acq.thread_target = (acq.ctor, "run")
+                self.life.acquisitions.append(acq)
+        # Local escape: a local-bound resource later passed as an
+        # argument transfers ownership to the callee's owner (the
+        # `self._attach(r, mm, path)` / resources-registration shapes).
+        for acq in self.life.acquisitions:
+            if acq.local is not None and not acq.transferred:
+                fn = self.life.funcs.get(acq.funckey)
+                if fn is not None and acq.local in fn.arg_names:
+                    acq.transferred = True
+        self._build_adj()
+        self.teardown_reach = self._reach_from(self._teardown_roots())
+        self.epoch_reach = self._reach_from(self._epoch_roots())
+        self._harvest_thread_roots()
+        self._check_releases()
+        self._check_wakeups()
+        self.findings.sort(key=lambda f: (f.path, f.line, f.rule.id))
+        return self
+
+    def report_lines(self) -> list[str]:
+        lines = [f"hvdlife: {len(self.life.acquisitions)} acquisition "
+                 f"site(s), {len(self.life.releases)} release site(s), "
+                 f"{len(self.thread_roots)} thread root(s)"]
+        for key, why in sorted(set(self.allowed_hits)):
+            lines.append(f"  allowed-hold {key} -- {why}")
+        return lines
+
+
+def analyze_life(program: Program, life: LifeProgram,
+                 cfg=None) -> list[Finding]:
+    findings = LifeAnalysis(program, life).analyze().findings
+    if cfg is not None:
+        findings = [f for f in findings if cfg.wants(f.rule)]
+    return findings
+
+
+def analyze_paths(paths) -> LifeAnalysis:
+    from ..lint import iter_python_files
+    program = Program()
+    life = LifeProgram()
+    for p in iter_python_files(list(paths)):
+        try:
+            with open(p, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=p)
+        except (OSError, SyntaxError):
+            continue
+        program.collect_source(p, src, tree)
+        life.collect_source(p, src, tree)
+    return LifeAnalysis(program, life).analyze()

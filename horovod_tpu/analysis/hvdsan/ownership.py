@@ -194,6 +194,16 @@ THREAD_ROOTS: dict[str, tuple[str, str]] = {
         "statesync.service.StateSyncService._grace_expired",
         "SIGTERM-grace Timer: stamps bye| and exits 143 when no step "
         "boundary arrives inside the grace window"),
+    # hvdlife harvest (ISSUE 13): Thread SUBCLASSES whose run() the
+    # static Thread(target=) scan cannot see — registered here so
+    # hvdsan and hvdlife share ONE root manifest (the two passes'
+    # thread universes are asserted equal in tests/test_hvdlife.py).
+    "hvd-statesync-donor-*": (
+        "statesync.stream.DonorServer.run",
+        "one incumbent's donor half of a join event: serves the frozen "
+        "snapshot over the dedicated sync mesh until BYE or the round "
+        "deadline; reaped by StateSyncService._reap_donors at the next "
+        "boundary/close"),
 }
 
 

@@ -6,7 +6,7 @@ CLI::
                                         [--format text|json|sarif]
                                         [--select RULES] [--ignore RULES]
                                         [--changed-only] [--san]
-                                        [--flow] [--knobs]
+                                        [--flow] [--life] [--knobs]
 
 ``--changed-only`` lints only files git reports as modified/untracked
 (sub-second gate as the rule count grows; cross-file rules see only the
@@ -14,7 +14,9 @@ changed set).  ``--san`` additionally runs the hvdsan whole-program
 concurrency analysis (HVD501-505, analysis/hvdsan/) over the SAME parse
 of each file — one AST per file serves both rule families.  ``--flow``
 does the same for the hvdflow interprocedural rank-divergence dataflow
-analysis (HVD601-604, analysis/hvdflow/).  ``--knobs`` prints the
+analysis (HVD601-604, analysis/hvdflow/), ``--life`` for the hvdlife
+whole-program resource-lifecycle analysis (HVD701-705,
+analysis/hvdlife/).  ``--knobs`` prints the
 generated typed-knob registry table (docs/configuration.md) and exits.
 ``--sarif`` emits SARIF 2.1.0 so findings annotate PRs.
 
@@ -955,11 +957,12 @@ def changed_py_files(paths: list[str], diff_base: str | None = None
 
 def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
                      san: bool = False, changed_only: bool = False,
-                     diff_base: str | None = None, flow: bool = False
+                     diff_base: str | None = None, flow: bool = False,
+                     life: bool = False
                      ) -> tuple[list[Violation], list, dict]:
-    """One parse + one rule walk per file; hvdsan (``san=True``) and
-    hvdflow (``flow=True``) ride the SAME trees.  Returns
-    (violations, san+flow findings, stats)."""
+    """One parse + one rule walk per file; hvdsan (``san=True``),
+    hvdflow (``flow=True``) and hvdlife (``life=True``) ride the SAME
+    trees.  Returns (violations, san+flow+life findings, stats)."""
     import time as _time
     cfg = cfg or LintConfig()
     out: list[Violation] = []
@@ -967,12 +970,16 @@ def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
     barrier_sites: dict[str, _BarrierSite] = {}
     program = None
     flowprog = None
-    if san or flow:
+    lifeprog = None
+    if san or flow or life:
         from .hvdsan.lockgraph import Program
         program = Program()
     if flow:
         from .hvdflow.flow import FlowProgram
         flowprog = FlowProgram()
+    if life:
+        from .hvdlife.life import LifeProgram
+        lifeprog = LifeProgram()
     files = list(iter_python_files(paths))
     if changed_only:
         changed, warning = changed_py_files(paths,
@@ -1005,6 +1012,8 @@ def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
             program.collect_source(path, source, tree)
         if flowprog is not None:
             flowprog.collect_source(path, source, tree)
+        if lifeprog is not None:
+            lifeprog.collect_source(path, source, tree)
     findings: list = []
     if san and program is not None:
         from .hvdsan.lockgraph import Analysis
@@ -1013,6 +1022,9 @@ def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
     if flowprog is not None:
         from .hvdflow.flow import analyze_flow
         findings.extend(analyze_flow(program, flowprog, cfg))
+    if lifeprog is not None:
+        from .hvdlife.life import analyze_life
+        findings.extend(analyze_life(program, lifeprog, cfg))
     stats = {"files": nfiles,
              "wall_ms": round((_time.monotonic() - t0) * 1e3, 3),
              "warnings": warnings}
@@ -1072,6 +1084,11 @@ def main(argv: list[str] | None = None) -> int:
                              "rank-divergence dataflow analysis "
                              "(HVD601-604) over the same parse of "
                              "each file")
+    parser.add_argument("--life", action="store_true",
+                        help="also run the hvdlife whole-program "
+                             "resource-lifecycle analysis "
+                             "(HVD701-705) over the same parse of "
+                             "each file")
     parser.add_argument("--knobs", action="store_true",
                         help="print the generated typed-knob registry "
                              "table (the docs/configuration.md "
@@ -1091,11 +1108,14 @@ def main(argv: list[str] | None = None) -> int:
                                 if b.strip()}
     violations, findings, stats = lint_paths_timed(
         args.paths, cfg, san=args.san, changed_only=args.changed_only,
-        diff_base=args.diff_base, flow=args.flow)
+        diff_base=args.diff_base, flow=args.flow, life=args.life)
     from .hvdflow.flow import FLOW_RULE_IDS
+    from .hvdlife.life import LIFE_RULE_IDS
     san_findings = [f for f in findings
-                    if f.rule.id not in FLOW_RULE_IDS]
+                    if f.rule.id not in FLOW_RULE_IDS
+                    and f.rule.id not in LIFE_RULE_IDS]
     flow_findings = [f for f in findings if f.rule.id in FLOW_RULE_IDS]
+    life_findings = [f for f in findings if f.rule.id in LIFE_RULE_IDS]
     errors = [f for f in findings if f.severity == "error"]
     for w in stats["warnings"]:
         print(f"hvdlint: warning: {w}", file=sys.stderr)
@@ -1104,6 +1124,7 @@ def main(argv: list[str] | None = None) -> int:
             "violations": [v.json() for v in violations],
             "san": [f.json() for f in san_findings],
             "flow": [f.json() for f in flow_findings],
+            "life": [f.json() for f in life_findings],
             "files": stats["files"],
             "wall_ms": stats["wall_ms"],
             "warnings": stats["warnings"],
@@ -1118,9 +1139,9 @@ def main(argv: list[str] | None = None) -> int:
         for f in findings:
             print(f.text())
         print(f"hvdlint: {len(violations)} violation(s)"
-              + (f", {len(errors)} san/flow error(s), "
+              + (f", {len(errors)} san/flow/life error(s), "
                  f"{len(findings) - len(errors)} warning(s)"
-                 if (args.san or args.flow) else "")
+                 if (args.san or args.flow or args.life) else "")
               + f" in {', '.join(args.paths)} "
               f"({stats['files']} file(s), {stats['wall_ms']:.1f} ms)",
               file=sys.stderr)

@@ -116,6 +116,43 @@ _RULE_LIST = [
          "console — register the knob (name, type, default, doc) and "
          "read it through the registry, or justify the raw read with "
          "a suppression."),
+    Rule("HVD701", "unjoined-thread",
+         "Thread/Timer started with no join/cancel reachable from the "
+         "owner's teardown path (hvdlife): every start leaks one live "
+         "thread per acquisition — across elastic reinit cycles that is "
+         "one thread per epoch, forever.  Join it from shutdown/close/"
+         "stop (poison first, like _PeerChannel.close), record the "
+         "intentional hold in LIFECYCLE_ALLOWED with its justification, "
+         "or suppress at the start site."),
+    Rule("HVD702", "unreleased-channel",
+         "Socket/_PeerChannel/PeerMesh/HTTP-server acquisition with no "
+         "close reachable from the owner's teardown path (hvdlife): the "
+         "fd and its kernel buffers survive the world that created them "
+         "— a long-lived process re-forming its world per elastic "
+         "transition accumulates one dead connection set per epoch."),
+    Rule("HVD703", "unreleased-region",
+         "mmap region or opened file with no close/munmap reachable "
+         "from the owner's teardown path (hvdlife): the mapping pins "
+         "pages (and /dev/shm backing) past the world that staged "
+         "through it; an unflushed file handle also loses its tail on "
+         "hard exit."),
+    Rule("HVD704", "epoch-scoped-leak",
+         "Resource acquired under a world epoch (reachable from "
+         "core.init/reinit_world) with NO release reachable from the "
+         "teardown half of the transition (core.shutdown / "
+         "reinit_world) — the elastic-specific leak no per-site rule "
+         "can see: correct for one world, it leaks one resource per "
+         "grow/shrink/recovery cycle, and ROADMAP's unified-fleet "
+         "posture makes those cycles routine.  The runtime census "
+         "witness (HOROVOD_LIFE_CENSUS) is this rule's dynamic twin."),
+    Rule("HVD705", "blocking-thread-without-wakeup",
+         "Thread whose body blocks unboundedly (queue get, recv, "
+         "accept, wait) while its owner has no wakeup path — no "
+         "poison-pill put(None), no close/shutdown/cancel/set in any "
+         "teardown-reachable function (hvdlife): the static twin of "
+         "the PR 5 wedged-sender fix — join-without-poison waits out "
+         "the full grace and then leaks the thread anyway.  Poison "
+         "first, then join."),
     Rule("HVD901", "bare-suppression",
          "hvdlint suppression without a '-- <justification>' comment."),
     Rule("HVD902", "syntax-error",

@@ -274,6 +274,23 @@ SAN_FILE = register(
     "'.r<rank>' is inserted before the extension (the "
     "HOROVOD_METRICS_FILE convention).")
 
+# --- hvdlife runtime census witness (analysis/hvdlife/; docs/analysis.md) ---
+LIFE_CENSUS = register(
+    "HOROVOD_LIFE_CENSUS", False, _parse_bool,
+    "hvdlife runtime resource census: snapshot the process's live "
+    "threads (normalized names), fds (sockets / shm / pipes / files) "
+    "and /dev/shm mmap regions around every world transition "
+    "(core.init, reinit_world) and dump the labeled snapshots as "
+    "rank-stamped JSON at exit.  CI diffs an elastic cycle's "
+    "return-to-baseline snapshot against its baseline — the dynamic "
+    "twin of the HVD704 epoch-scoped-leak rule.  Off (the default) "
+    "takes no snapshots and reads no /proc files — zero overhead.")
+LIFE_CENSUS_FILE = register(
+    "HOROVOD_LIFE_CENSUS_FILE", "hvdlife_census.json", str,
+    "Path of the hvdlife census dump; '{rank}' substitutes, otherwise "
+    "'.r<rank>' is inserted before the extension (the "
+    "HOROVOD_METRICS_FILE convention).")
+
 # --- Resilience (resilience/ subsystem; docs/resilience.md) -----------------
 FAULT_TOLERANCE = register(
     "HOROVOD_FAULT_TOLERANCE", False, _parse_bool,

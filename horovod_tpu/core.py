@@ -530,6 +530,15 @@ def init(*, rank: int | None = None, size: int | None = None,
             import atexit
             atexit.register(shutdown)
             _atexit_registered = True
+        # hvdlife census witness (HOROVOD_LIFE_CENSUS): snapshot the
+        # live thread/fd/socket/mmap fabric of the freshly formed world
+        # — the elastic batteries diff these around grow/shrink cycles
+        # (off mode: one cached knob read, nothing else).
+        from .analysis.hvdlife import census as _census
+        w = _census.witness()
+        if w.enabled:
+            w.note(f"world:{epoch if size > 1 else '0'}:{size}",
+                   rank=rank)
         logger.debug("horovod_tpu initialized: rank=%d size=%d", rank, size)
 
 
@@ -559,6 +568,19 @@ def shutdown() -> None:
         telemetry = _global.telemetry
         resources = list(_global.resources)
         _global.resources.clear()
+        # Drop the per-epoch object graph NOW, not at the next init():
+        # the backend chains pin the TcpCollectives' per-(peer, dtype)
+        # scratch views, which pin every closed channel's receive
+        # scratch (multi-MB bytearrays) — without these resets one full
+        # epoch's staging memory survived each reinit_world until the
+        # next world happened to form (hvdlife's epoch-leak census
+        # motivated the sweep, same shape as the HVD704 rule).
+        _global.controller = None
+        _global.op_manager = None
+        _global.op_managers = []
+        _global.tcp_collectives = []
+        _global.parameter_manager = None
+        _global.active_streams = 1
         _global.initialized = False
         _global.background_thread = None
     if dispatcher is not None:
@@ -583,6 +605,11 @@ def shutdown() -> None:
     resilience.shutdown()   # stop the heartbeat monitor (if any)
     from .parallel import multihost
     multihost.shutdown_jax_distributed()
+    from .analysis.hvdlife import census as _census
+    w = _census.witness()
+    if w.enabled:
+        w.note("down:%s" % os.environ.get("HOROVOD_RENDEZVOUS_EPOCH",
+                                          "0"))
 
 
 def reinit_world(*, rank: int, size: int, epoch: str) -> None:

@@ -98,6 +98,14 @@ class ElasticDriver:
         self._finished.set()
         with self._round_cond:
             self._round_cond.notify_all()
+        # Reap the discovery loop (hvdlife HVD701): _finished is its
+        # wakeup (the loop polls it every DISCOVERY_INTERVAL_SECS).
+        # stop() can be invoked from the discovery thread itself on the
+        # failed-resume path — never self-join.
+        t = self._discovery_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=DISCOVERY_INTERVAL_SECS + 5.0)
+            self._discovery_thread = None
 
     def finished(self) -> bool:
         return self._finished.is_set()

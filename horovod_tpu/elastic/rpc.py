@@ -69,7 +69,7 @@ class RpcServer:
             except OSError:
                 return
             threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+                             daemon=True, name="hvd-rpc-conn").start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -100,6 +100,11 @@ class RpcServer:
             self._listener.close()
         except OSError:
             pass
+        # Reap the accept loop (hvdlife HVD701): the listener close
+        # above is its wakeup (accept raises OSError and the loop
+        # returns).  Per-connection threads stay daemon by design —
+        # see LIFECYCLE_ALLOWED in analysis/hvdlife/life.py.
+        self._thread.join(timeout=5.0)
 
 
 class RpcClient:

@@ -64,7 +64,8 @@ class ProbeServer:
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
         self._closed = False
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="hvd-probe")
         self._thread.start()
 
     def _loop(self) -> None:
@@ -86,6 +87,9 @@ class ProbeServer:
             self._sock.close()
         except OSError:
             pass
+        # Reap the accept loop (hvdlife HVD701): the socket close is
+        # its wakeup.
+        self._thread.join(timeout=5.0)
 
 
 def probe(addresses: Sequence[str], port: int,

@@ -278,7 +278,8 @@ def launch_static(args, command: list[str]) -> int:
             exit_codes[i] = safe_shell_exec.execute(
                 remote, env=base_env, index=slot.rank, events=[terminate])
 
-    threads = [threading.Thread(target=_run_slot, args=(i, s), daemon=True)
+    threads = [threading.Thread(target=_run_slot, args=(i, s),
+                                daemon=True, name=f"hvd-slot-{i}")
                for i, s in enumerate(slots)]
     prev_handlers = {}
     if threading.current_thread() is threading.main_thread():

@@ -223,6 +223,11 @@ class RendezvousServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._thread is not None:
+            # Reap the serve thread (hvdlife HVD701): shutdown() above
+            # is its wakeup, so the join is prompt.
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
 
 class RendezvousClient:
@@ -576,7 +581,8 @@ class PeerMesh:
                 _tune(conn)
                 accepted[peer] = conn
 
-        acceptor = threading.Thread(target=_accept, daemon=True)
+        acceptor = threading.Thread(target=_accept, daemon=True,
+                                    name="hvd-mesh-accept")
         acceptor.start()
 
         for peer in range(rank):   # dial every lower-ranked peer

@@ -199,7 +199,8 @@ def run(func: Callable, args: Sequence = (), kwargs: dict | None = None,
                         remote_rcs[rank] = -1
                         traceback.print_exc()
 
-                t = threading.Thread(target=_remote, daemon=True)
+                t = threading.Thread(target=_remote, daemon=True,
+                                     name="hvd-remote-launch")
                 t.start()
                 remote_threads.append(t)
 

@@ -61,10 +61,10 @@ def execute(command, env: dict | None = None, index: int | None = None,
     err_prefix = f"[{index}]<stderr>: " if prefix_output and index is not None \
         else ""
     threads = [
-        threading.Thread(target=_tail,
+        threading.Thread(target=_tail, name="hvd-tail",
                          args=(proc.stdout, out_prefix, stdout, capture),
                          daemon=True),
-        threading.Thread(target=_tail,
+        threading.Thread(target=_tail, name="hvd-tail",
                          args=(proc.stderr, err_prefix, stderr, capture),
                          daemon=True),
     ]
@@ -88,7 +88,8 @@ def execute(command, env: dict | None = None, index: int | None = None,
                         _kill_group(signal.SIGKILL)
                     return
                 stop_watch.wait(0.1)
-        threading.Thread(target=_watch, daemon=True).start()
+        threading.Thread(target=_watch, daemon=True,
+                         name="hvd-exec-watch").start()
 
     prev_handlers = {}
     if threading.current_thread() is threading.main_thread():

@@ -197,18 +197,25 @@ def run(args: argparse.Namespace) -> dict:
         executor.attach_statesync(statesync_service)
     done = threading.Event()
     t0 = time.monotonic()
+    ingress = None
     if executor.rank == executor.front:
         rng = random.Random(args.seed)
         times = arrival_times(rng, args.requests, args.duration,
                               args.rate, args.profile)
-        threading.Thread(
+        ingress = threading.Thread(
             target=drive_ingress, daemon=True, name="serve-ingress",
             args=(executor, times, rng),
             kwargs=dict(prompt_tokens=args.prompt_tokens,
                         max_new_tokens=args.max_new_tokens,
-                        slo_ms=args.slo_ms, done=done)).start()
+                        slo_ms=args.slo_ms, done=done))
+        ingress.start()
     executor.serve_loop(stop_when=done.is_set)
     wall = time.monotonic() - t0
+    if ingress is not None:
+        # Reap the ingress driver (hvdlife HVD701): it sets `done` as
+        # its last act, so by the time serve_loop returned it is at
+        # most one submit away from exit.
+        ingress.join(timeout=10.0)
     report = build_report(
         executor, offered=executor.stats["offered"], wall_s=wall,
         args_echo={"requests": args.requests, "duration": args.duration,

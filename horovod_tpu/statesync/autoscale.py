@@ -269,3 +269,9 @@ class AutoscaleController(threading.Thread):
 
     def stop(self) -> None:
         self._stop.set()
+        # Reap the loop (hvdlife HVD701): the event is its wakeup (the
+        # run loop polls it every interval).  tick() can call into
+        # code that stops the controller — never self-join.
+        if self.is_alive() and \
+                self is not threading.current_thread():
+            self.join(timeout=self.interval + 5.0)

@@ -136,11 +136,28 @@ class StateSyncService:
                                          name="hvd-statesync-watch")
         self._watcher.start()
 
+    # -- donor lifecycle -------------------------------------------------
+    def _reap_donors(self, grace: float = 2.0) -> None:
+        """Join and drop finished DonorServer threads.  Without the
+        reap, one DonorServer object per admitted join survived every
+        grow forever — the ``_donors`` dict pinned the thread AND its
+        snapshot queue (a full state image per round) across all later
+        epochs (hvdlife HVD701; the census witness shows the
+        ``hvd-statesync-donor-*`` count ratcheting per cycle).  A donor
+        still serving (the joiner pulls the final round while
+        incumbents rebuild channels) gets a bounded join and is left
+        for the next boundary's reap — never blocked on."""
+        for join_id, donor in list(self._donors.items()):
+            donor.join(timeout=grace if donor.is_alive() else 0.0)
+            if not donor.is_alive():
+                del self._donors[join_id]
+
     # -- world identity --------------------------------------------------
     def _refresh_world(self) -> None:
         from .. import core
 
         st = core.global_state()
+        self._reap_donors()
         with self._lock:
             self.rank = st.rank
             self.size = st.size
@@ -197,6 +214,9 @@ class StateSyncService:
                               f"the next step boundary")
         timer = threading.Timer(self._grace, self._grace_expired)
         timer.daemon = True
+        # The ownership manifest (hvdsan/hvdlife THREAD_ROOTS) and the
+        # census normalize by thread name; Timer defaults to Thread-N.
+        timer.name = "hvd-preempt-backstop"
         timer.start()
         self._grace_timer = timer
         logger.warning("statesync: SIGTERM received; departing within "
@@ -370,7 +390,12 @@ class StateSyncService:
             old_rank, old_size = self.rank, self.size
         if old_rank in departing:
             if self._grace_timer is not None:
+                # Cancel AND reap: cancel() only marks the timer; the
+                # backstop thread itself must be gone before the census
+                # around the clean departure (hvdlife HVD701).
                 self._grace_timer.cancel()
+                self._grace_timer.join(timeout=2.0)
+                self._grace_timer = None
             self._fast_donate(epoch)
             from ..telemetry import flight
 
@@ -470,7 +495,10 @@ class StateSyncService:
         self._stop.set()
         if self._grace_timer is not None:
             self._grace_timer.cancel()
+            self._grace_timer.join(timeout=2.0)
+            self._grace_timer = None
         self._watcher.join(timeout=2.0)
+        self._reap_donors()
 
 
 def resync_replicated(state_tree: Any, version: int,

@@ -78,8 +78,14 @@ class MetricsExporter:
         return self._httpd.server_address[1]
 
     def close(self) -> None:
+        """shutdown() wakes the serve loop, server_close() releases the
+        listening socket, and the join reaps the serve thread — without
+        it one hvd-metrics thread (and its poll loop) leaked per
+        elastic world cycle (hvdlife HVD704: the exporter is rebuilt by
+        every core.init when the port knob is set)."""
         self._httpd.shutdown()
         self._httpd.server_close()
+        self._thread.join(timeout=5.0)
 
 
 def resolve_dump_path(path: str, rank: int) -> str:

@@ -1,0 +1,108 @@
+"""Shared resource-census helper for tests (ISSUE 13 satellite).
+
+Before this module, thread-census assertions were scattered ad hoc
+(test_telemetry's off-mode no-op contract, test_resilience's
+monitor start/stop, test_trace's writer-thread checks, the mp_worker
+resilience_off battery) — each with its own ``threading.enumerate()``
+dance and none covering fds or sockets.  Everything funnels through
+here now, on top of the product census (analysis/hvdlife/census.py)
+so tests and the runtime witness measure with ONE ruler.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from horovod_tpu.analysis.hvdlife.census import (census_diff,  # noqa: F401
+                                                 take_census)
+
+
+def thread_names() -> set:
+    """Live thread names, raw (the historical assertion surface)."""
+    return {t.name for t in threading.enumerate() if t.is_alive()}
+
+
+def new_threads(before: set) -> set:
+    """Threads alive now that were not in ``before``."""
+    return thread_names() - set(before)
+
+
+def assert_no_new_threads(before: set, allow=frozenset(),
+                          context: str = "") -> None:
+    """Every thread added since ``before`` must be in ``allow``."""
+    extra = new_threads(before) - set(allow)
+    assert not extra, (f"unexpected surviving threads "
+                       f"{sorted(extra)}"
+                       + (f" ({context})" if context else ""))
+
+
+def assert_thread_absent(substring: str) -> None:
+    names = thread_names()
+    assert not any(substring in n for n in names), \
+        f"thread matching {substring!r} alive: {sorted(names)}"
+
+
+def snapshot(label: str = "") -> dict:
+    """Full census (threads normalized + fds/sockets/shm), the
+    baseline-equality surface of the elastic batteries."""
+    return take_census(label)
+
+
+def fd_count() -> int:
+    return take_census()["fds"]
+
+
+def open_sockets() -> int:
+    return take_census()["sockets"]
+
+
+def stable_snapshot(label: str = "", attempts: int = 25,
+                    delay: float = 0.08) -> dict:
+    """A census confirmed by a second, identical sample one delay
+    later — a baseline that happened to catch a transient KV-poll
+    socket would poison every later comparison."""
+    prev = take_census(label)
+    for _ in range(attempts):
+        time.sleep(delay)
+        now = take_census(label)
+        if census_diff(prev, now) == []:
+            return now
+        prev = now
+    return prev
+
+
+def settle_census(baseline: dict, expect=(), attempts: int = 25,
+                  delay: float = 0.08, label: str = "",
+                  context: str = "") -> dict:
+    """Census with transient tolerance: the statesync watcher and the
+    heartbeat monitor open a KV HTTP socket for ~1 ms per poll, so a
+    single snapshot can flicker by a socket or two.  Retry until the
+    diff against ``baseline`` equals ``expect`` exactly — sound
+    because a REAL leak never disappears between attempts — and return
+    the settled census.  Raises with the last diff otherwise."""
+    last: list | None = None
+    for _ in range(attempts):
+        now = take_census(label)
+        diff = census_diff(baseline, now)
+        if diff == list(expect):
+            return now
+        last = diff
+        time.sleep(delay)
+    from horovod_tpu.analysis.hvdlife.census import socket_details
+    raise AssertionError(
+        f"census never settled to {list(expect)!r}"
+        + (f" ({context})" if context else "")
+        + "; last diff:\n  " + "\n  ".join(last or ["<none>"])
+        + "\nlive sockets:\n  " + "\n  ".join(socket_details()))
+
+
+def assert_census_baseline(baseline: dict, now: dict | None = None,
+                           context: str = "") -> None:
+    """The grow-shrink acceptance check: the census must have returned
+    to its baseline shape (threads by normalized name, sockets, shm
+    fds and mappings)."""
+    now = now if now is not None else take_census("now")
+    problems = census_diff(baseline, now)
+    assert not problems, (f"census drifted from baseline"
+                          + (f" ({context})" if context else "")
+                          + ":\n  " + "\n  ".join(problems))

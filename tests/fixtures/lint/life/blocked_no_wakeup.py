@@ -1,0 +1,23 @@
+"""Seeded hvdlife fixture: HVD705 blocking-thread-without-wakeup —
+the wedged-sender shape: the worker blocks on an unbounded queue get
+and the owner's teardown only joins (no poison pill, no close/shutdown
+to unblock it), so stop() waits out its grace and leaks the thread."""
+import queue
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=8)
+        self._thread = threading.Thread(target=self._loop,
+                                        daemon=True)          # HVD705
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            item = self._q.get()     # unbounded; nothing ever wakes it
+            if item is Ellipsis:
+                return
+
+    def stop(self):
+        self._thread.join(timeout=10.0)   # join-without-poison

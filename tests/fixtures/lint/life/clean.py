@@ -1,0 +1,92 @@
+"""hvdlife clean fixture: every sanctioned lifecycle shape must report
+ZERO findings — with-managed acquisition, registration into the
+resources drain, same-function formation release, loop release over
+the owning container, local-alias release, poison-then-join through a
+helper (the interprocedural release-via-helper case), a cancelled
+timer, and a justified suppression."""
+import mmap
+import queue
+import socket
+import threading
+
+
+class CleanOwner:
+    """Poison-first teardown, with the actual releases one call DEEPER
+    than the teardown root (close -> _teardown): the pass must prove
+    reachability through the call graph, not just scan close()."""
+
+    def __init__(self, path):
+        self._q = queue.Queue(maxsize=8)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fx-clean")
+        self._thread.start()
+        self._sock = socket.socket()
+        self._log = open(path, "a")
+        self._timer = threading.Timer(1.0, self._fire)
+        self._timer.start()
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+
+    def _fire(self):
+        pass
+
+    def close(self):
+        self._q.put(None)            # poison first (the HVD705 wakeup)
+        self._timer.cancel()
+        self._teardown()
+
+    def _teardown(self):
+        self._thread.join(timeout=5.0)
+        self._sock.close()
+        self._log.close()
+
+
+class CleanMesh:
+    """Container-held sockets released by iterating the container."""
+
+    def __init__(self, n):
+        self._socks = {}
+        for peer in range(n):
+            self._socks[peer] = socket.socket()
+
+    def close(self):
+        for sock in self._socks.values():
+            sock.close()
+        self._socks.clear()
+
+
+class CleanRegion:
+    """Local-alias release: the teardown swaps the field out first."""
+
+    def __init__(self, fd):
+        self._map = mmap.mmap(fd, 4096)
+
+    def close(self):
+        mm, self._map = self._map, None
+        if mm is not None:
+            mm.close()
+
+
+def managed(path):
+    with open(path) as f:            # context manager: auto-released
+        return f.read()
+
+
+def registered(world):
+    world.resources.append(socket.socket())   # drained by shutdown
+
+
+def formation():
+    listener = socket.socket()       # same-function formation release
+    port = listener.getsockname()
+    listener.close()
+    return port
+
+
+class Documented:
+    def __init__(self):
+        self._beacon = socket.socket()  # hvdlint: disable=HVD702 -- fixture: documenting the suppression form; the beacon rides the process lifetime by design

@@ -1,0 +1,48 @@
+"""Seeded hvdlife fixture: HVD704 epoch-scoped-leak — AND the runtime
+census seed.
+
+The module mimics the world-transition shape: ``init`` acquires a
+per-epoch staging handle, ``reinit_world`` re-forms the world by
+calling it again (interprocedurally — the acquisition itself is one
+hop below the epoch root), and ``shutdown`` tears down *nothing*.
+Statically this is exactly HVD704: the acquisition is reachable from
+the formation path with no release reachable from the teardown half.
+
+The same file is IMPORTED by the 4-rank grow-shrink battery
+(tests/mp_worker.py, ``life_census``) with the leak armed: each elastic
+transition then pins one more real socket fd, and the runtime census
+witness catches the identical leak the static rule names — the two
+halves of the acceptance criterion fire on one seed.
+"""
+import socket
+
+_scratch_by_epoch = {}
+_epoch = 0
+
+
+def init():
+    """Acquire this epoch's staging handle (and never release the
+    previous epoch's — the seeded leak)."""
+    global _epoch
+    _epoch += 1
+    _scratch_by_epoch[_epoch] = socket.socket()               # HVD704
+
+
+def reinit_world():
+    init()
+
+
+def shutdown():
+    pass                        # no close anywhere: the leak
+
+
+def leaked_count() -> int:
+    return len(_scratch_by_epoch)
+
+
+def release_all():
+    """Test epilogue only (never reachable from shutdown, so the
+    static finding stands)."""
+    for sock in _scratch_by_epoch.values():
+        sock.close()
+    _scratch_by_epoch.clear()

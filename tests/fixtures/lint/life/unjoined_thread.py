@@ -1,0 +1,27 @@
+"""Seeded hvdlife fixture: HVD701 unjoined-thread — a Thread and a
+Timer bound to owner fields with a teardown that releases neither, plus
+the fire-and-forget shape that keeps no handle at all."""
+import threading
+
+
+class Monitor:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fx-monitor")    # HVD701
+        self._thread.start()
+        self._timer = threading.Timer(5.0, self._fire)        # HVD701
+        self._timer.start()
+
+    def _loop(self):
+        while not getattr(self, "_done", False):
+            pass
+
+    def _fire(self):
+        pass
+
+    def close(self):
+        self._done = True        # flips the flag, reaps nothing
+
+
+def fire_and_forget(work):
+    threading.Thread(target=work, daemon=True).start()        # HVD701

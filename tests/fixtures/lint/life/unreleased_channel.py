@@ -1,0 +1,12 @@
+"""Seeded hvdlife fixture: HVD702 unreleased-channel — sockets bound
+to owner fields with a teardown that never closes them."""
+import socket
+
+
+class Lane:
+    def __init__(self, addr):
+        self._sock = socket.create_connection(addr)           # HVD702
+        self._listener = socket.socket()                      # HVD702
+
+    def stop(self):
+        self._connected = False    # forgets both sockets

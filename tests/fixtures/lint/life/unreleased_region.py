@@ -1,0 +1,12 @@
+"""Seeded hvdlife fixture: HVD703 unreleased-region — an mmap region
+and an opened file whose owner teardown releases neither."""
+import mmap
+
+
+class Region:
+    def __init__(self, fd, path):
+        self._map = mmap.mmap(fd, 4096)                       # HVD703
+        self._log = open(path, "a")                           # HVD703
+
+    def close(self):
+        self._attached = False     # drops neither the map nor the file

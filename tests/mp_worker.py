@@ -1470,7 +1470,7 @@ def battery_resilience_off(hvd, rank, size):
     HOROVOD_CHAOS unset there must be NO monitor thread, NO chaos
     engine, NO socket timeouts and NO resilience state captured by the
     meshes — byte-identical hot paths to the pre-resilience tree."""
-    import threading as _threading
+    from census import assert_thread_absent
 
     from horovod_tpu import resilience
     from horovod_tpu.core import _global
@@ -1478,8 +1478,7 @@ def battery_resilience_off(hvd, rank, size):
     assert resilience.active_state() is None
     assert resilience.chaos.active() is None
     assert _global.chaos is None
-    names = [t.name for t in _threading.enumerate()]
-    assert not any("heartbeat" in n for n in names), names
+    assert_thread_absent("heartbeat")
     for coll in _global.tcp_collectives:
         mesh = coll.mesh
         assert mesh._resilience is None and mesh._chaos is None
@@ -1494,8 +1493,7 @@ def battery_resilience_off(hvd, rank, size):
     out = hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum, name="off0")
     np.testing.assert_allclose(out, np.full(8, float(size)))
     # Still none after traffic (lazy paths must not re-resolve).
-    names = [t.name for t in _threading.enumerate()]
-    assert not any("heartbeat" in n for n in names), names
+    assert_thread_absent("heartbeat")
 
 
 def battery_torch_grid(hvd, rank, size):
@@ -2225,6 +2223,11 @@ def battery_statesync_joiner(port):
         svc.step_boundary()
     _statesync_digest_check(hvd, state)
     _statesync_witness_dump("grow battery joiner", "J")
+    if os.environ.get("HOROVOD_LIFE_CENSUS") == "1":
+        # The life battery's census-done sync: incumbents census their
+        # fabric after the last training step; this rank must not tear
+        # the shared world down under them (see battery_statesync_life).
+        hvd.allgather_object("J", name="life.census.done")
     svc.close()
     print(f"joiner: catch-up {info.catch_up_ms:.0f} ms for "
           f"{info.bulk_bytes} bytes from {len(info.donor_stats)} "
@@ -2297,6 +2300,138 @@ def battery_statesync_preempt(hvd, rank, size):
     svc.close()
     print(f"survivor {launch_rank}: proactive shrink at step "
           f"{shrunk_at}, no RanksFailedError anywhere")
+
+
+def battery_statesync_life(hvd, rank, size):
+    """ISSUE 13 acceptance battery (4-rank, rides 4->3->4 via
+    statesync): every survivor censuses its live thread/fd/socket/mmap
+    fabric before and after one full grow-shrink cycle, with the
+    seeded HVD704 epoch-leak fixture ARMED — one real socket leaks per
+    world transition.  The runtime census witness must (a) catch
+    EXACTLY the seeded drift (+2 sockets on survivors, nothing else),
+    proving the dynamic half fires on the same leak the static rule
+    flags, and (b) census baseline-equal once the seed is released,
+    proving the product fabric itself leaks nothing across elastic
+    reinit cycles."""
+    import importlib.util
+    import subprocess as _subprocess
+    import sys as _sys
+    import time as _time
+
+    from census import settle_census, stable_snapshot
+
+    from horovod_tpu import statesync
+    from horovod_tpu.analysis.hvdlife import census as life_census
+
+    fixture_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "fixtures", "lint", "life", "epoch_leak.py")
+    spec = importlib.util.spec_from_file_location("epoch_leak_fx",
+                                                  fixture_path)
+    leak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(leak)
+    # NOT armed yet: the first leak.reinit_world() below fires at the
+    # first world transition, so the baseline census predates every
+    # leaked socket and release_all() returns exactly to it.
+
+    state = _statesync_state()
+    svc = statesync.StateSyncService(lambda: state)
+    launch_rank = rank
+    # Warm the fabric so the lazy machinery (sender lanes) exists on
+    # both sides of the comparison, then baseline.
+    for _ in range(3):
+        _statesync_train_step(hvd, state)
+        svc.step_boundary()
+    baseline = stable_snapshot(f"baseline:world{size}")
+    w = life_census.witness()
+    assert w.enabled, "battery must run under HOROVOD_LIFE_CENSUS=1"
+    w.snapshots.append(baseline)
+    w.rank = launch_rank
+
+    shrunk = grown = False
+    stop_at = None
+    joiner_proc = None
+    transitions = 0
+    deadline = _time.monotonic() + 150.0
+    while _time.monotonic() < deadline:
+        try:
+            _statesync_train_step(hvd, state)
+            change = svc.step_boundary()
+        except hvd.RanksFailedError as exc:
+            assert not shrunk, f"step failed AFTER the shrink: {exc}"
+            change = svc.shrink_on_failure(exc)
+        if change is not None and change.kind in ("shrink", "grow"):
+            # The seeded leak: one unreleased socket per world epoch.
+            leak.reinit_world()
+            transitions += 1
+        if change is not None and change.kind == "shrink":
+            shrunk = True
+            assert hvd.size() == size - 1, hvd.size()
+            state = statesync.resync_replicated(state,
+                                                int(state["step"]))
+            if hvd.rank() == 0:
+                env = dict(os.environ)
+                for k in ("HOROVOD_CHAOS", "HOROVOD_RANK",
+                          "HOROVOD_SIZE"):
+                    env.pop(k, None)
+                joiner_proc = _subprocess.Popen(
+                    [_sys.executable, os.path.abspath(__file__),
+                     "0", "0",
+                     os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"],
+                     "statesync_joiner"],
+                    env=env, stdout=_subprocess.PIPE,
+                    stderr=_subprocess.STDOUT)
+        elif change is not None and change.kind == "grow":
+            grown = True
+            assert hvd.size() == size, hvd.size()
+            stop_at = int(state["step"]) + 3
+        if stop_at is not None and int(state["step"]) >= stop_at:
+            break
+    assert shrunk and grown and transitions == 2, \
+        (shrunk, grown, transitions)
+    _statesync_digest_check(hvd, state)
+
+    # (a) The census catches the seeded leak — and ONLY it: the diff
+    # against the size-4 baseline settles to exactly the two leaked
+    # sockets (threads, shm, and the product's own sockets all
+    # returned; the watcher/heartbeat KV polls flicker a transient
+    # socket, which settle_census rides out).
+    leak.shutdown()                  # the seeded teardown: releases nothing
+    expected_drift = (f"sockets: {baseline['sockets']} -> "
+                      f"{baseline['sockets'] + 2} (+2)",)
+    armed = settle_census(baseline, expect=expected_drift,
+                          label=f"armed:world{size}",
+                          context=f"launch rank {launch_rank}")
+    w.snapshots.append(armed)
+    assert leak.leaked_count() == 2
+    print(f"launch rank {launch_rank}: census caught the seeded "
+          f"epoch leak: {expected_drift[0]}")
+
+    # (b) Release the seed: the fabric itself is baseline-equal after
+    # a full 4->3->4 cycle.
+    leak.release_all()
+    final = settle_census(baseline, expect=(),
+                          label=f"baseline:world{size}:final",
+                          context=f"4->{size - 1}->4 cycle, launch "
+                                  f"rank {launch_rank}")
+    w.snapshots.append(final)
+    # Census-done sync: until EVERY rank (joiner included) has taken
+    # its final census, nobody may start shutdown — a peer's shutdown
+    # broadcast retires this rank's background loop mid-census and the
+    # settle loop would read it as a lost thread.
+    hvd.allgather_object(launch_rank, name="life.census.done")
+    path = life_census.dump_census()
+    if path:
+        print(f"CENSUS_DUMP {path}")
+    svc.close()
+    if joiner_proc is not None:
+        out, _ = joiner_proc.communicate(timeout=60.0)
+        text = out.decode(errors="replace")
+        print("--- joiner output ---\n" + text)
+        assert joiner_proc.returncode == 0, \
+            f"joiner failed rc={joiner_proc.returncode}:\n{text}"
+    print(f"launch rank {launch_rank}: census baseline-equal after "
+          f"{size}->{size - 1}->{size} at step {int(state['step'])}")
 
 
 _SERVE_GROW_CFG = dict(max_batch=4, token_budget=64, max_seq=64,
@@ -2471,6 +2606,10 @@ BATTERIES = {
     "statesync_grow": battery_statesync_grow,
     "statesync_preempt": battery_statesync_preempt,
     "statesync_serve": battery_statesync_serve,
+    # hvdlife runtime census witness (ISSUE 13): the 4->3->4 cycle must
+    # census baseline-equal on every survivor, and the seeded HVD704
+    # fixture must be caught by the census diff.
+    "statesync_life": battery_statesync_life,
     # hvdflow runtime cross-check (ISSUE 12): the seeded rank-gated
     # collective must die as a structured fingerprint ERROR, not a hang.
     "flow": battery_flow,
@@ -2559,6 +2698,15 @@ def main() -> int:
         # three responses — the train allreduce + the two halves of the
         # membership allgather).
         os.environ.setdefault("HOROVOD_CHAOS", "kill:rank=2,op=13,sig=9")
+    if battery == "statesync_life":
+        os.environ.setdefault("HOROVOD_FAULT_TIMEOUT", "5")
+        os.environ.setdefault("HOROVOD_CHAOS", "kill:rank=2,op=13,sig=9")
+        # The runtime census witness around every world transition,
+        # dumped rank-stamped to /tmp for the driver's check_dumps.
+        os.environ["HOROVOD_LIFE_CENSUS"] = "1"
+        os.environ["HOROVOD_LIFE_CENSUS_FILE"] = \
+            f"/tmp/hvd_census_" \
+            f"{os.environ['HOROVOD_RENDEZVOUS_EPOCH']}.json"
     if battery == "statesync_preempt":
         # Grace must beat the heartbeat: generous fault timeout, SIGTERM
         # at collective 6, 20 s to reach the next step boundary.

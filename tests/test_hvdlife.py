@@ -1,0 +1,371 @@
+"""hvdlife tests (ISSUE 13): the resource-lifecycle pass over seeded
+fixtures and the live tree, the LIFECYCLE_ALLOWED manifest contract,
+the hvdsan/hvdlife shared thread universe, and the runtime census
+witness (including the seeded epoch-leak fixture caught BOTH ways at
+unit scale — the 4-rank battery proves it across a real 4->3->4
+cycle)."""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from horovod_tpu.analysis.hvdlife import (  # noqa: E402
+    LIFECYCLE_ALLOWED, CensusWitness, analyze_paths, census_diff,
+    take_census)
+from horovod_tpu.analysis.hvdlife.census import (  # noqa: E402
+    _normalize_thread, check_dumps, dump_census)
+from horovod_tpu.analysis.hvdlife.life import LifeAnalysis  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TREE = os.path.join(REPO, "horovod_tpu")
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint", "life")
+
+
+def _fx(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def _ids(analysis: LifeAnalysis):
+    return [(f.rule.id, f.line) for f in analysis.findings]
+
+
+# ---------------------------------------------------------------------------
+# Seeded fixtures: every rule detected, the clean file silent
+# ---------------------------------------------------------------------------
+def test_fixture_unjoined_thread():
+    out = analyze_paths([_fx("unjoined_thread.py")])
+    assert _ids(out) == [("HVD701", 9), ("HVD701", 12), ("HVD701", 27)]
+    # the fire-and-forget shape gets the no-handle message
+    assert "without keeping a handle" in out.findings[2].message
+
+
+def test_fixture_unreleased_channel():
+    out = analyze_paths([_fx("unreleased_channel.py")])
+    assert _ids(out) == [("HVD702", 8), ("HVD702", 9)]
+
+
+def test_fixture_unreleased_region():
+    out = analyze_paths([_fx("unreleased_region.py")])
+    assert _ids(out) == [("HVD703", 8), ("HVD703", 9)]
+
+
+def test_fixture_epoch_leak_names_site_and_teardown_path():
+    """ISSUE 13 acceptance: the HVD704 finding names the acquisition
+    site AND the teardown path the release is missing from."""
+    out = analyze_paths([_fx("epoch_leak.py")])
+    assert _ids(out) == [("HVD704", 28)]
+    msg = out.findings[0].message
+    assert "epoch_leak.py:28" in msg           # the acquisition site
+    assert "init/reinit_world" in msg          # the formation path
+    assert "shutdown/reinit_world" in msg      # the missing teardown
+
+
+def test_fixture_blocked_no_wakeup():
+    out = analyze_paths([_fx("blocked_no_wakeup.py")])
+    assert _ids(out) == [("HVD705", 12)]
+    assert "poison" in out.findings[0].message
+
+
+def test_fixture_clean_zero_findings():
+    """Every sanctioned shape — with-managed, resources registration,
+    same-function formation release, loop release, alias release,
+    poison-then-join THROUGH A HELPER (the interprocedural
+    release-via-helper case), cancelled timer, justified suppression —
+    reports nothing."""
+    out = analyze_paths([_fx("clean.py")])
+    assert out.findings == [], [f.text() for f in out.findings]
+
+
+def test_suppression_silences_at_acquisition_site(tmp_path):
+    src = open(_fx("unreleased_channel.py")).read()
+    src = src.replace(
+        "self._listener = socket.socket()                      "
+        "# HVD702",
+        "self._listener = socket.socket()  # hvdlint: "
+        "disable=HVD702 -- tool beacon, process lifetime")
+    p = tmp_path / "suppressed.py"
+    p.write_text(src)
+    out = analyze_paths([str(p)])
+    assert [f.rule.id for f in out.findings] == ["HVD702"]
+    assert out.findings[0].line == 8        # only the other one left
+
+
+def test_whole_fixture_dir():
+    out = analyze_paths([FIXTURES])
+    assert sorted({f.rule.id for f in out.findings}) == \
+        ["HVD701", "HVD702", "HVD703", "HVD704", "HVD705"]
+
+
+# ---------------------------------------------------------------------------
+# The live tree
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tree_life() -> LifeAnalysis:
+    return analyze_paths([TREE])
+
+
+def test_tree_is_lifecycle_clean(tree_life):
+    errors = [f for f in tree_life.findings if f.severity == "error"]
+    assert errors == [], "\n".join(f.text() for f in errors)
+
+
+def test_tree_harvest_covers_the_fabric(tree_life):
+    """The harvest sees the long-lived machinery the motivation names:
+    background thread, sender lanes, exporter server, statesync
+    watcher+timer+donors, shm regions, per-epoch meshes."""
+    keys = {a.key for a in tree_life.life.acquisitions}
+    assert "core.background_thread" in keys or \
+        "core._global.background_thread" in keys or \
+        any(k.endswith("background_thread") for k in keys), keys
+    for expect in ("runner.network._PeerChannel._sender",
+                   "telemetry.exporter.MetricsExporter._thread",
+                   "telemetry.exporter.MetricsExporter._httpd",
+                   "statesync.service.StateSyncService._watcher",
+                   "statesync.service.StateSyncService._grace_timer",
+                   "statesync.service.StateSyncService._donors",
+                   "resilience.heartbeat.HeartbeatMonitor._thread"):
+        assert expect in keys, expect
+    kinds = {a.kind for a in tree_life.life.acquisitions}
+    assert {"thread", "timer", "channel", "socket", "mmap", "file",
+            "signal"} <= kinds
+
+
+def test_lifecycle_allowances_resolve_and_matched(tree_life):
+    """Every manifest allowance carries a real justification AND
+    matches a live acquisition at head — a stale entry would silently
+    blanket future code (the LOCK_HOLD_ALLOWED review discipline)."""
+    acq_keys = {a.key for a in tree_life.life.acquisitions}
+    matched = {k for k, _ in tree_life.allowed_hits}
+    for key, why in LIFECYCLE_ALLOWED.items():
+        assert len(why) > 40, key
+        assert key in acq_keys, f"stale allowance {key}"
+        assert key in matched, f"allowance {key} never consulted"
+
+
+def test_thread_universe_agreement_with_hvdsan(tree_life):
+    """ISSUE 13 satellite: hvdsan and hvdlife share ONE root manifest
+    (ownership.THREAD_ROOTS) and must agree on the thread universe —
+    every thread body hvdlife harvests resolves in hvdsan's roots and
+    vice versa."""
+    from horovod_tpu.analysis.hvdsan.lockgraph import analyze_paths \
+        as san_analyze
+    san = san_analyze([TREE])
+    life_bodies = set(tree_life.thread_roots)
+    san_bodies = set(san.thread_roots)
+    assert life_bodies == san_bodies, (
+        sorted(life_bodies - san_bodies),
+        sorted(san_bodies - life_bodies))
+    # and the names agree too (census normalization keys on them)
+    for key in life_bodies:
+        assert tree_life.thread_roots[key] == san.thread_roots[key]
+
+
+def test_tree_thread_roots_are_named(tree_life):
+    """Unnamed roots defeat census normalization; the harvest satellite
+    named the stragglers (mesh acceptor, probe/rpc servers)."""
+    unnamed = [name for name in tree_life.thread_roots.values()
+               if name.startswith("thread@")]
+    assert unnamed == [], unnamed
+    assert {"hvd-mesh-accept", "hvd-probe", "hvd-statesync-donor-*",
+            "hvd-background"} <= set(tree_life.thread_roots.values())
+
+
+# ---------------------------------------------------------------------------
+# Runtime census
+# ---------------------------------------------------------------------------
+class TestCensus:
+    def test_take_census_shape(self):
+        c = take_census("t")
+        assert c["label"] == "t"
+        assert c["fds"] > 0
+        assert "MainThread" in c["threads"]
+        assert c["fds"] >= c["sockets"] + c["shm_fds"] + c["pipes"]
+
+    def test_thread_name_normalization(self):
+        assert _normalize_thread("hvd-send-3") == "hvd-send-*"
+        assert _normalize_thread("Thread-12") == "Thread-*"
+        assert _normalize_thread("hvd-stream-0") == "hvd-stream-*"
+        assert _normalize_thread("MainThread") == "MainThread"
+        assert _normalize_thread("serve-ingress") == "serve-ingress"
+
+    def test_normalized_counts_merge(self):
+        stop = threading.Event()
+        threads = [threading.Thread(target=stop.wait, daemon=True,
+                                    name=f"fx-census-{i}")
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            c = take_census()
+            assert c["threads"]["fx-census-*"] == 3
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+
+    def test_census_diff_reports_both_directions(self):
+        a = {"threads": {"x": 1}, "sockets": 3, "shm_fds": 0,
+             "shm_maps": 0}
+        b = {"threads": {"x": 2, "y": 1}, "sockets": 2, "shm_fds": 0,
+             "shm_maps": 0}
+        problems = census_diff(a, b)
+        assert any("threads[x]: 1 -> 2" in p for p in problems)
+        assert any("threads[y]: 0 -> 1" in p for p in problems)
+        assert any("sockets: 3 -> 2" in p for p in problems)
+        assert census_diff(a, dict(a)) == []
+
+    def test_witness_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_LIFE_CENSUS", raising=False)
+        import horovod_tpu.analysis.hvdlife.census as census_mod
+        monkeypatch.setattr(census_mod, "_witness", None)
+        w = census_mod.witness()
+        assert not w.enabled
+        assert w.note("x") is None and w.snapshots == []
+
+    def test_witness_dump_and_check(self, tmp_path, monkeypatch):
+        import horovod_tpu.analysis.hvdlife.census as census_mod
+        w = CensusWitness(enabled=True)
+        w.note("baseline:world4", rank=2)
+        w.note("transition:shrink")
+        w.note("baseline:world4-again")
+        monkeypatch.setattr(census_mod, "_witness", w)
+        path = dump_census(str(tmp_path / "c_{rank}.json"))
+        assert path == str(tmp_path / "c_2.json")
+        payload = json.load(open(path))
+        assert payload["rank"] == 2
+        assert [s["label"] for s in payload["snapshots"]] == \
+            ["baseline:world4", "transition:shrink",
+             "baseline:world4-again"]
+        # identical process state between the notes: no drift
+        assert check_dumps([payload]) == []
+        # seed a drift and the check names it, rank-stamped
+        payload["snapshots"][2]["sockets"] += 3
+        problems = check_dumps([payload])
+        assert problems and "rank 2" in problems[0] and \
+            "sockets" in problems[0]
+
+
+def test_epoch_leak_fixture_caught_both_ways():
+    """The acceptance seed at unit scale: the SAME fixture file is
+    flagged statically by HVD704 and, when exercised, drifts the
+    runtime census by exactly its leaked sockets."""
+    out = analyze_paths([_fx("epoch_leak.py")])
+    assert [f.rule.id for f in out.findings] == ["HVD704"]
+
+    spec = importlib.util.spec_from_file_location("epoch_leak_fx",
+                                                  _fx("epoch_leak.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    try:
+        baseline = take_census("baseline")
+        mod.init()
+        for _ in range(3):
+            mod.reinit_world()
+        mod.shutdown()          # the seeded teardown releases nothing
+        assert mod.leaked_count() == 4
+        now = take_census("after 4 epochs")
+        problems = census_diff(baseline, now)
+        assert any("sockets: " in p and "+4" in p for p in problems), \
+            problems
+    finally:
+        mod.release_all()
+    time.sleep(0)               # fd table settles synchronously
+    assert census_diff(take_census(), take_census()) == []
+
+
+# ---------------------------------------------------------------------------
+# The 4-rank grow-shrink acceptance battery
+# ---------------------------------------------------------------------------
+def test_census_battery_4_3_4_with_seeded_leak():
+    """ISSUE 13 acceptance: the 4-rank battery rides 4->3->4 via
+    statesync (chaos SIGKILL of rank 2, peer-streamed rejoin) with the
+    seeded HVD704 fixture armed.  Every survivor must (a) catch the
+    seeded leak in its census diff — exactly +2 sockets, one per world
+    transition — and (b) census baseline-equal once the seed is
+    released.  The driver then re-checks the rank-stamped witness
+    dumps offline, exactly like the hvdsan witness flow; the STATIC
+    half of the acceptance (HVD704 on the same fixture file, naming
+    the acquisition site and the missing teardown path) is asserted in
+    test_fixture_epoch_leak_names_site_and_teardown_path."""
+    import glob
+    import signal
+
+    from test_multiprocess import _run_world
+
+    for stale in glob.glob("/tmp/hvd_census_statesync_life4*"):
+        os.unlink(stale)
+    outputs = _run_world(4, "statesync_life", timeout=240.0,
+                         expected_rcs={2: -signal.SIGKILL})
+    for r in (0, 1, 3):
+        assert "census caught the seeded epoch leak" in outputs[r], \
+            outputs[r]
+        assert "census baseline-equal after 4->3->4" in outputs[r], \
+            outputs[r]
+    # Offline witness check over the rank-stamped dumps.
+    dumps = sorted({line.split(" ", 1)[1].strip()
+                    for out in outputs for line in out.splitlines()
+                    if line.startswith("CENSUS_DUMP ")})
+    assert len(dumps) == 3, dumps            # one per survivor
+    from horovod_tpu.analysis.hvdlife.census import load_census_dumps
+    payloads = load_census_dumps(dumps)
+    assert check_dumps(payloads) == []
+    for payload in payloads:
+        labels = [s["label"] for s in payload["snapshots"]]
+        # the battery's labeled points plus core's transition notes
+        assert any(lb.startswith("baseline:world4") for lb in labels)
+        assert any(lb.startswith("armed:world4") for lb in labels)
+        assert any(lb.startswith("world:") and lb.endswith(":3")
+                   for lb in labels), labels   # the shrunk world
+        assert any(lb.startswith("down:") for lb in labels)
+        base = next(s for s in payload["snapshots"]
+                    if s["label"].startswith("baseline"))
+        armed = next(s for s in payload["snapshots"]
+                     if s["label"].startswith("armed"))
+        drift = census_diff(base, armed)
+        assert drift == [f"sockets: {base['sockets']} -> "
+                         f"{base['sockets'] + 2} (+2)"], drift
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def test_cli_json_and_exit_codes(capsys):
+    from horovod_tpu.analysis.hvdlife.__main__ import main
+    rc = main([_fx("unjoined_thread.py"), "--format", "json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert [f["rule"] for f in payload["life"]] == ["HVD701"] * 3
+    assert payload["wall_ms"] > 0
+    rc = main([_fx("clean.py"), "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["life"] == []
+
+
+def test_cli_census_drift_fails(tmp_path, capsys):
+    from horovod_tpu.analysis.hvdlife.__main__ import main
+    base = take_census("baseline:w")
+    drifted = dict(take_census("baseline:w2"))
+    drifted["sockets"] += 1
+    dump = tmp_path / "c.json"
+    dump.write_text(json.dumps(
+        {"rank": 0, "snapshots": [base, drifted]}))
+    rc = main([_fx("clean.py"), "--census", str(dump)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "CENSUS DRIFT" in out
+
+
+def test_cli_module_entrypoint():
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.hvdlife", TREE],
+        capture_output=True, text=True, cwd=REPO, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "allowed-hold" in proc.stdout

@@ -367,17 +367,17 @@ def test_suspect_mark_is_not_confirmed(kv):
 
 
 def test_monitor_thread_starts_and_stops(kv):
+    from census import assert_no_new_threads, assert_thread_absent, \
+        thread_names
     m = HeartbeatMonitor(0, 2, kv, "hb-t5", fault_timeout=5.0,
                          interval=0.05)
-    before = {t.name for t in threading.enumerate()}
+    before = thread_names()
     m.start()
-    assert any(t.name == "hvd-heartbeat" for t in threading.enumerate())
+    assert "hvd-heartbeat" in thread_names()
     m.stop()
     time.sleep(0.05)
-    after = {t.name for t in threading.enumerate()}
-    assert after <= before | {"hvd-heartbeat"}
-    assert not any(t.is_alive() and t.name == "hvd-heartbeat"
-                   for t in threading.enumerate())
+    assert_no_new_threads(before, context="monitor stop")
+    assert_thread_absent("hvd-heartbeat")
 
 
 def test_configure_off_returns_none(kv, monkeypatch):

@@ -328,7 +328,9 @@ def test_metrics_off_world_is_noop(monkeypatch):
     import horovod_tpu as hvd
     from horovod_tpu import core
 
-    before = {t.name for t in threading.enumerate()}
+    from census import assert_no_new_threads, assert_thread_absent, \
+        thread_names
+    before = thread_names()
     hvd.init()
     try:
         st = core.global_state()
@@ -336,10 +338,10 @@ def test_metrics_off_world_is_noop(monkeypatch):
         out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
                             name="tm_off")
         np.testing.assert_allclose(out, np.ones(4))
-        after = {t.name for t in threading.enumerate()}
-        assert "hvd-metrics" not in after
+        assert_thread_absent("hvd-metrics")
         # Only the background loop was added to the census.
-        assert after - before <= {"hvd-background"}, after - before
+        assert_no_new_threads(before, allow={"hvd-background"},
+                              context="metrics-off world")
         assert st.telemetry.snapshot()["metrics"] == []
     finally:
         hvd.shutdown()

@@ -230,13 +230,14 @@ def test_flight_configure_uses_env(monkeypatch, tmp_path):
     monkeypatch.setenv("HOROVOD_FLIGHT_EVENTS", "32")
     monkeypatch.setenv("HOROVOD_FLIGHT_FILE",
                        str(tmp_path / "fl_{rank}.json"))
-    before = {t.name for t in threading.enumerate()}
+    from census import assert_no_new_threads, thread_names
+    before = thread_names()
     rec = flight_mod.configure(2)
     assert rec.enabled
     assert rec.path == str(tmp_path / "fl_2.json")
     assert rec._ring.maxlen == 32
     # The recorder never owns a thread (zero-overhead contract).
-    assert {t.name for t in threading.enumerate()} == before
+    assert_no_new_threads(before, context="flight configure")
 
 
 def test_flight_sigterm_handler_chained(monkeypatch, tmp_path):
@@ -315,7 +316,8 @@ def test_flight_off_world_thread_census(monkeypatch):
     import horovod_tpu as hvd
     from horovod_tpu import core
 
-    before = {t.name for t in threading.enumerate()}
+    from census import assert_no_new_threads, thread_names
+    before = thread_names()
     hvd.init()
     try:
         st = core.global_state()
@@ -323,7 +325,7 @@ def test_flight_off_world_thread_census(monkeypatch):
         out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
                             name="fl_off")
         np.testing.assert_allclose(out, np.ones(4))
-        after = {t.name for t in threading.enumerate()}
-        assert after - before <= {"hvd-background"}, after - before
+        assert_no_new_threads(before, allow={"hvd-background"},
+                              context="flight-off world")
     finally:
         hvd.shutdown()
