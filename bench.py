@@ -464,32 +464,84 @@ def main() -> int:
 
 
 def bench_serve(args) -> int:
-    """Serving loadgen smoke (ISSUE 9 CI satellite): the open-loop SLO
-    harness (--requests 64 --duration 5) in a CPU subprocess; the full
-    per-rank report lands in SERVE_r{rank}.json next to the BENCH
-    payloads, and goodput + p50/p99 latency ride the structured line."""
-    out = subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.serving.loadgen",
-         "--requests", "64", "--duration", "5", "--rate", "40",
-         "--max-new-tokens", "4", "--prompt-tokens", "8",
-         "--output", "SERVE_r{rank}.json"],
-        capture_output=True, text=True, timeout=600,
-        env={**os.environ, "JAX_PLATFORMS": "cpu"})
-    if out.returncode != 0:
-        _emit({"metric": "serve_failed", "value": 0.0, "unit": "error",
-               "vs_baseline": 0.0,
-               "error": out.stderr[-500:] or out.stdout[-500:]})
+    """Serving loadgen A/B (ISSUE 9 smoke + ISSUE 14 paged leg): the
+    open-loop SLO harness runs TWICE at fixed hardware — the dense
+    baseline, then the paged+prefix configuration — under the same
+    burst arrival profile and the same repeated-prompt pool.  The dense
+    numbers keep the trajectory comparable (serve_goodput); the paged
+    leg adds serve_goodput_paged / serve_p99_paged and the
+    max_concurrent_seqs the block pool sustained next to the dense
+    batch bound, so the trajectory finally records a serving perf
+    delta."""
+    # Saturating burst (4x through the middle fifth) with a tight SLO:
+    # below saturation both configs serve everything and the A/B says
+    # nothing; at this load the dense leg queues behind prefills while
+    # the paged leg's prefix hits + wider slot packing absorb the burst.
+    common = ["--requests", "96", "--duration", "5", "--rate", "120",
+              "--max-new-tokens", "4", "--prompt-tokens", "8",
+              "--profile", "burst", "--prompt-pool", "6",
+              "--max-batch", "4", "--slo-ms", "400"]
+
+    def leg(name: str, extra_env: dict, output: str) -> dict | None:
+        out = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.serving.loadgen",
+             *common, "--output", output],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu", **extra_env})
+        if out.returncode != 0:
+            _emit({"metric": f"serve_{name}_failed", "value": 0.0,
+                   "unit": "error", "vs_baseline": 0.0,
+                   "error": out.stderr[-500:] or out.stdout[-500:]})
+            return None
+        with open(output.replace("{rank}", "0")) as f:
+            return json.load(f)
+
+    dense = leg("dense", {"HOROVOD_SERVE_PAGED": "0"},
+                "SERVE_r{rank}.json")
+    if dense is None:
         return 1
-    with open("SERVE_r0.json") as f:
-        report = json.load(f)
-    _emit({"metric": "serve_goodput", "value": report["goodput_rps"],
+    _emit({"metric": "serve_goodput", "value": dense["goodput_rps"],
            "unit": "req/s", "vs_baseline": 0.0, "backend": "cpu-eager",
-           "offered_rps": report["offered_rps"],
-           "served": report["served"], "shed": report["shed"],
-           "expired": report["expired"],
-           "latency_ms": report["latency_ms"],
-           "step_ms": report["step_ms"],
+           "offered_rps": dense["offered_rps"],
+           "served": dense["served"], "shed": dense["shed"],
+           "expired": dense["expired"],
+           "latency_ms": dense["latency_ms"],
+           "step_ms": dense["step_ms"],
            "report": "SERVE_r0.json"})
+    # Paged leg at EQUAL memory budget: the pool auto-sizes to the
+    # dense layout's token memory (max_batch x max_seq), slots widen to
+    # 2 x max_batch — concurrency beyond the dense batch shape comes
+    # from residency, not extra HBM.
+    paged = leg("paged", {"HOROVOD_SERVE_PAGED": "1"},
+                "SERVE_PAGED_r{rank}.json")
+    if paged is None:
+        return 1
+    kv = paged.get("kv") or {}
+    _emit({"metric": "serve_goodput_paged",
+           "value": paged["goodput_rps"], "unit": "req/s",
+           "vs_baseline": (paged["goodput_rps"] / dense["goodput_rps"]
+                           if dense["goodput_rps"] else 0.0),
+           "backend": "cpu-eager",
+           "served": paged["served"], "shed": paged["shed"],
+           "latency_ms": paged["latency_ms"],
+           "dense_goodput": dense["goodput_rps"],
+           "dense_p99_ms": dense["latency_ms"]["p99"],
+           "prefix_hits": kv.get("prefix_hits", 0),
+           "prefix_misses": kv.get("prefix_misses", 0),
+           "report": "SERVE_PAGED_r0.json"})
+    _emit({"metric": "serve_p99_paged",
+           "value": paged["latency_ms"]["p99"], "unit": "ms",
+           "vs_baseline": (paged["latency_ms"]["p99"]
+                           / dense["latency_ms"]["p99"]
+                           if dense["latency_ms"]["p99"] else 0.0),
+           "dense_p99_ms": dense["latency_ms"]["p99"]})
+    _emit({"metric": "max_concurrent_seqs",
+           "value": float(paged["max_concurrent_seqs"]), "unit": "seqs",
+           "vs_baseline": 0.0,
+           "dense_max_batch": 4,
+           "dense_max_concurrent": dense["max_concurrent_seqs"],
+           "pool_blocks": kv.get("pool_blocks", 0),
+           "block_tokens": kv.get("block_tokens", 0)})
     return 0
 
 

@@ -115,8 +115,19 @@ def _pretty(descriptor: str) -> str:
 
 
 def describe(req: Request) -> str:
-    """Canonical descriptor folded into the hash: op|name|dtype|dims|codec."""
-    dims = "x".join(str(int(d)) for d in req.tensor_shape)
+    """Canonical descriptor folded into the hash: op|name|dtype|dims|codec.
+
+    ALLGATHER's FIRST dim is rank-local by contract (uneven-row gather
+    is the documented semantic — allgather_object payloads, serving
+    completion exchanges), so it folds as ``*``: a cross-rank digest
+    that included it would flag every legitimate uneven gather as a
+    divergence.  Trailing dims must still agree."""
+    shape = list(req.tensor_shape)
+    parts = [str(int(d)) for d in shape]
+    from ..common.message import RequestType
+    if req.request_type == RequestType.ALLGATHER and parts:
+        parts[0] = "*"
+    dims = "x".join(parts)
     return (f"{req.request_type.name}|{req.tensor_name}|"
             f"{req.tensor_type.name}|{dims}|"
             f"{req.codec}/{req.codec_block_size}")

@@ -86,10 +86,15 @@ _SIGNAL_VERBS = frozenset({"signal"})
 # Package classes owning a closeable kernel object (sockets, fds, shm
 # regions, an HTTP server + its pool).  Curated, reviewable — exactly
 # like hvdlint's vocabularies; a new resource class gets a row here and
-# a doc line in docs/analysis.md.
+# a doc line in docs/analysis.md.  KVBlockPool (ISSUE 14) and
+# KVStreamMesh qualify: the pool's blocks index HBM rows in the model
+# cache and its residency accounting must not outlive the executor
+# across reinit_world cycles (the refcount-leak census), and the
+# stream mesh owns sockets plus drain threads.
 _CHANNEL_CTORS = frozenset({
     "PeerMesh", "_PeerChannel", "ShmWorld", "MetricsExporter",
     "RendezvousServer", "ThreadingHTTPServer", "HTTPServer",
+    "KVBlockPool", "KVStreamMesh",
 })
 
 _KIND_RULE = {

@@ -442,6 +442,51 @@ SERVE_GROUP_SIZE = register(
     "model-parallel groups reuse parallel/ meshes inside the model).  "
     "Must divide the world size; falls back to 1 after an elastic "
     "shrink breaks divisibility.")
+SERVE_PAGED = register(
+    "HOROVOD_SERVE_PAGED", False, _parse_bool,
+    "Paged KV cache (serving/kvpool.py): decode-slot KV state lives in "
+    "fixed-size blocks drawn from a per-replica free-list pool instead "
+    "of dense per-slot arrays, so concurrent-sequence count is bounded "
+    "by live token residency (the pool), not the batch shape.  Enables "
+    "prefix/prompt caching and copy-on-write block sharing.")
+SERVE_BLOCK_TOKENS = register(
+    "HOROVOD_SERVE_BLOCK_TOKENS", 16, int,
+    "Tokens per KV block under HOROVOD_SERVE_PAGED: the paged "
+    "allocator's unit of allocation, prefix-hash granularity (one FNV "
+    "chain link per full block) and copy-on-write granularity.")
+SERVE_POOL_BLOCKS = register(
+    "HOROVOD_SERVE_POOL_BLOCKS", 0, int,
+    "KV blocks in the per-replica paged pool (0 = auto: "
+    "HOROVOD_SERVE_MAX_BATCH x ceil(max_seq / block_tokens), i.e. the "
+    "same token memory the dense layout reserves).  The pool — not the "
+    "slot count — bounds max concurrent sequences.")
+SERVE_PAGED_SLOTS = register(
+    "HOROVOD_SERVE_PAGED_SLOTS", 0, int,
+    "Decode slots per replica under HOROVOD_SERVE_PAGED (0 = auto: "
+    "2 x HOROVOD_SERVE_MAX_BATCH).  Slots beyond the dense batch are "
+    "backed by the shared block pool, so short sequences pack more "
+    "concurrency into the same KV memory; admission defers when the "
+    "pool cannot cover a prompt's worst-case blocks.")
+SERVE_MAX_DEFERRALS = register(
+    "HOROVOD_SERVE_MAX_DEFERRALS", 8, int,
+    "Steps a queued prompt may be deferred for budget/slot pressure "
+    "before the batcher turns it urgent: an urgent prompt reserves the "
+    "step's admission budget (nothing behind it is admitted) and "
+    "bypasses the token budget for its own admission, so a stream of "
+    "small prompts can never starve a large one indefinitely.")
+SERVE_PREFILL_RANKS = register(
+    "HOROVOD_SERVE_PREFILL_RANKS", 0, int,
+    "Disaggregated prefill/decode: the highest N ranks of the serving "
+    "world run prompt prefill only and stream finished KV blocks to "
+    "the decode ranks over a dedicated PeerMesh (serving/kvstream.py, "
+    "CRC'd addressed chunks), so long prompts never occupy a decode "
+    "step.  0 = every rank prefills its own admissions (clamped so at "
+    "least one decode rank remains).")
+SERVE_KVSTREAM_CHUNK_BYTES = register(
+    "HOROVOD_SERVE_KVSTREAM_CHUNK_BYTES", 1 << 18, int,
+    "Chunk size of one prefill-to-decode KV-block stream frame "
+    "(serving/kvstream.py); each chunk is independently addressed and "
+    "CRC-verified on arrival.")
 
 # --- Collective fingerprinting (analysis/fingerprint.py) --------------------
 FINGERPRINT = register(

@@ -73,6 +73,18 @@ class TransformerConfig:
     # Parameters are identical to the decode=False model; see prefill()
     # and decode_step() below.  Mutually exclusive with ring/ulysses.
     decode: bool = False
+    # Paged KV cache (ISSUE 14, serving/kvpool.py): with decode=True and
+    # paged=True each layer's KV state is a shared block pool
+    # [kv_pool_blocks + 1, kv_block_tokens, H, D] (the last row is a
+    # write sink for padded positions) instead of dense per-slot
+    # arrays; every apply takes explicit block_tables [B, M] (logical
+    # block i of row b lives in pool row block_tables[b, i]) and
+    # cursors [B] (each row's write position).  Storage scales with
+    # live token residency; parameters are unchanged, and the math is
+    # parity-tested against the dense decode path.
+    paged: bool = False
+    kv_pool_blocks: int = 0
+    kv_block_tokens: int = 16
 
     @property
     def head_dim(self) -> int:
@@ -205,7 +217,8 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, block_tables=None, cursors=None,
+                 lengths=None) -> jax.Array:
         cfg = self.cfg
         b, t, _ = x.shape
         dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
@@ -221,7 +234,15 @@ class Attention(nn.Module):
                     "cfg.decode is incompatible with sequence-parallel "
                     f"attention ('{cfg.attention}'): the KV cache is a "
                     "whole-sequence structure")
-            out = self._decode_attend(q, k, v)
+            if cfg.paged:
+                if block_tables is None or cursors is None:
+                    raise ValueError(
+                        "paged decode needs block_tables [B, M] and "
+                        "cursors [B] on every apply")
+                out = self._decode_attend_paged(q, k, v, block_tables,
+                                                cursors, lengths)
+            else:
+                out = self._decode_attend(q, k, v)
         else:
             if cfg.attention in ("ring", "ulysses") and \
                     _axis_is_manual(cfg.sp_axis) and \
@@ -287,6 +308,66 @@ class Attention(nn.Module):
         probs = jax.nn.softmax(logits, axis=-1)
         return jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
 
+    def _decode_attend_paged(self, q: jax.Array, k: jax.Array,
+                             v: jax.Array, block_tables, cursors,
+                             lengths) -> jax.Array:
+        """Incremental attention over the shared block pool (ISSUE 14):
+        this call's K/V scatter into pool rows addressed through each
+        row's block table, then the table gathers the sequence back as
+        [B, M*bt, H, D] (one block-table-indexed gather — logical
+        position p of row b lives at pool[tables[b, p//bt], p%bt]) for
+        the same absolute-position causal attention as the dense path.
+        ``lengths`` masks right-padded prefill calls: padded positions
+        write to the pool's sink row (never a real block) and padded
+        logits are garbage the caller ignores, exactly like the dense
+        path's masked tail."""
+        cfg = self.cfg
+        b, t, h, d = q.shape
+        bt = cfg.kv_block_tokens
+        if cfg.kv_pool_blocks <= 0:
+            raise ValueError(
+                "cfg.paged needs kv_pool_blocks > 0 (the per-layer "
+                "block pool size)")
+        sink = cfg.kv_pool_blocks                    # the write sink row
+        key_pool = self.variable("cache", "key_pool", jnp.zeros,
+                                 (sink + 1, bt, h, d), cfg.dtype)
+        value_pool = self.variable("cache", "value_pool", jnp.zeros,
+                                   (sink + 1, bt, h, d), cfg.dtype)
+        tables = jnp.asarray(block_tables, jnp.int32)      # [B, M]
+        cursors = jnp.asarray(cursors, jnp.int32)          # [B]
+        m = tables.shape[1]
+        if lengths is None:
+            valid = jnp.ones((b, t), bool)
+        else:
+            valid = jnp.arange(t)[None, :] \
+                < jnp.asarray(lengths, jnp.int32)[:, None]
+        positions = cursors[:, None] + jnp.arange(t)[None, :]   # [B,T]
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        logical = jnp.minimum(positions // bt, m - 1)
+        phys = jnp.take_along_axis(tables, logical, axis=1)     # [B,T]
+        phys = jnp.where(valid, phys, sink)
+        offs = positions % bt
+        kp = key_pool.value.at[phys.reshape(-1), offs.reshape(-1)].set(
+            k.astype(cfg.dtype).reshape(b * t, h, d))
+        vp = value_pool.value.at[phys.reshape(-1), offs.reshape(-1)].set(
+            v.astype(cfg.dtype).reshape(b * t, h, d))
+        key_pool.value, value_pool.value = kp, vp
+        # Gather each row's sequence back in logical order; positions
+        # past the cursor (stale or sink-backed) are masked exactly like
+        # the dense path's not-yet-overwritten tail.
+        k_seq = jnp.take(kp, tables, axis=0).reshape(b, m * bt, h, d)
+        v_seq = jnp.take(vp, tables, axis=0).reshape(b, m * bt, h, d)
+        key_pos = jnp.arange(m * bt)
+        mask = key_pos[None, None, :] <= positions[:, :, None]  # [B,T,S]
+        qf = q.astype(jnp.float32)
+        kf = k_seq.astype(jnp.float32)
+        vf = v_seq.astype(jnp.float32)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) / math.sqrt(d)
+        logits = jnp.where(mask[:, None, :, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+
 
 class MLP(nn.Module):
     cfg: TransformerConfig
@@ -305,10 +386,12 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, block_tables=None, cursors=None,
+                 lengths=None) -> jax.Array:
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(cfg.dtype, cfg.param_dtype, name="attn_norm")(x))
+            RMSNorm(cfg.dtype, cfg.param_dtype, name="attn_norm")(x),
+            block_tables, cursors, lengths)
         if cfg.moe_experts > 0:
             from .moe import MoEMLP
             ffn = MoEMLP(num_experts=cfg.moe_experts, d_ff=cfg.ff_dim,
@@ -333,7 +416,9 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array, train: bool = False) -> jax.Array:
+    def __call__(self, tokens: jax.Array, train: bool = False,
+                 block_tables=None, cursors=None,
+                 lengths=None) -> jax.Array:
         cfg = self.cfg
         embed = nn.Embed(cfg.vocab_size, cfg.d_model,
                          dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -350,7 +435,8 @@ class TransformerLM(nn.Module):
                     "(expected 'full' or 'dots')")
             block = nn.remat(Block, prevent_cse=False, policy=policy)
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"layer_{i}")(x)
+            x = block(cfg, name=f"layer_{i}")(x, block_tables, cursors,
+                                              lengths)
         x = RMSNorm(cfg.dtype, cfg.param_dtype, name="final_norm")(x)
         return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype, name="lm_head")(x)
@@ -407,6 +493,40 @@ def decode_step(model: TransformerLM, variables: dict, cache: dict,
     logits, mut = model.apply({**variables, "cache": cache}, tokens,
                               mutable=["cache"])
     return logits, unfreeze(mut["cache"])
+
+
+def paged_apply(model: TransformerLM, variables: dict, cache: dict,
+                tokens: jax.Array, block_tables, cursors,
+                lengths=None) -> tuple[jax.Array, dict]:
+    """One paged-cache apply (``decode=True, paged=True``): prefill and
+    decode are the SAME call — ``tokens [B, T]`` (T = 1 for a decode
+    step, a padded prompt bucket for prefill) write into the pool
+    through each row's ``block_tables`` entry at its ``cursors``
+    position and attend over the gathered prefix.  No write-cursor
+    rewinding: ``lengths`` keeps padded positions out of real blocks
+    entirely (they land in the pool's sink row)."""
+    from flax.core import unfreeze
+    if tokens.ndim == 1:
+        tokens = tokens[:, None]
+    logits, mut = model.apply({**variables, "cache": cache}, tokens,
+                              block_tables=block_tables,
+                              cursors=cursors, lengths=lengths,
+                              mutable=["cache"])
+    return logits, unfreeze(mut["cache"])
+
+
+def paged_copy_block(cache: dict, src: int, dst: int) -> dict:
+    """The tensor half of a copy-on-write: copy pool row ``src`` to
+    ``dst`` in every layer's key/value pool (the id half lives in
+    serving/kvpool.py ``cow``)."""
+    def fix(node):
+        if not isinstance(node, dict):
+            return node
+        return {key: (val.at[dst].set(val[src])
+                      if key in ("key_pool", "value_pool") else fix(val))
+                for key, val in node.items()}
+    from flax.core import unfreeze
+    return fix(unfreeze(cache))
 
 
 # ---------------------------------------------------------------------------

@@ -18,6 +18,14 @@ never produces:
   replicas never diverge on a collective), per-request deadlines
   propagated into resilience per-op deadlines, and elastic shrink
   mid-serve on RanksFailedError (survivors keep serving).
+- :class:`~.kvpool.KVBlockPool` — paged KV blocks (ISSUE 14): free-list
+  allocation with refcounts, FNV-chain prefix caching, copy-on-write
+  and LRU eviction, so concurrency scales with live token residency
+  instead of the batch shape.
+- ``serving/kvstream.py`` — disaggregated prefill/decode: prefill-only
+  ranks stream finished KV blocks to decode replicas over a dedicated
+  PeerMesh (addressed CRC'd chunks, the STATE_MAGIC mold), keeping
+  long prompts out of decode steps.
 - ``python -m horovod_tpu.serving.loadgen`` — open-loop Poisson SLO
   load harness; reports p50/p99/p999 latency, goodput vs offered load
   and shed rate to ``SERVE_r{rank}.json``.
@@ -26,11 +34,12 @@ from __future__ import annotations
 
 from .admission import AdmissionController
 from .batcher import Assignment, BatchPlan, ContinuousBatcher
+from .kvpool import KVBlockPool
 from .queue import RequestQueue, ServeRequest
 from .replica import ReplicaExecutor, ServeConfig
 
 __all__ = [
     "AdmissionController", "Assignment", "BatchPlan",
-    "ContinuousBatcher", "ReplicaExecutor", "RequestQueue",
-    "ServeConfig", "ServeRequest",
+    "ContinuousBatcher", "KVBlockPool", "ReplicaExecutor",
+    "RequestQueue", "ServeConfig", "ServeRequest",
 ]

@@ -59,18 +59,29 @@ def arrival_times(rng: random.Random, n: int, duration: float,
 def drive_ingress(executor: ReplicaExecutor, times: list[float],
                   rng: random.Random, *, prompt_tokens: int,
                   max_new_tokens: int, slo_ms: float | None,
-                  done: threading.Event) -> None:
+                  done: threading.Event, prompt_pool: int = 0) -> None:
     """Submit one request per arrival time (front-end thread); closes
-    the queue and sets ``done`` when the schedule is exhausted."""
+    the queue and sets ``done`` when the schedule is exhausted.
+    ``prompt_pool > 0`` draws prompts from that many fixed token lists
+    instead of fresh randomness — the repeated-prompt profile that
+    exercises the paged prefix cache (ISSUE 14)."""
     vocab = executor.model.cfg.vocab_size
+    pool = None
+    if prompt_pool > 0:
+        pool = [[rng.randrange(2, vocab)
+                 for _ in range(rng.randint(2, max(2, prompt_tokens)))]
+                for _ in range(prompt_pool)]
     start = time.monotonic()
     try:
-        for t in times:
+        for i, t in enumerate(times):
             delay = start + t - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
-            n = rng.randint(2, max(2, prompt_tokens))
-            toks = [rng.randrange(2, vocab) for _ in range(n)]
+            if pool is not None:
+                toks = pool[i % len(pool)]
+            else:
+                n = rng.randint(2, max(2, prompt_tokens))
+                toks = [rng.randrange(2, vocab) for _ in range(n)]
             executor.stats["offered"] += 1
             executor.queue.submit(toks, max_new_tokens, slo_ms)
     finally:
@@ -129,6 +140,10 @@ def build_report(executor: ReplicaExecutor, *, offered: int,
         "wall_s": wall_s,
         "steps": executor._step,
         "step_metrics_present": bool(reg_snapshot),
+        # Paged-KV residency/reuse (None in dense mode): the A/B
+        # numbers bench.py --model serve reports next to the dense leg.
+        "kv": executor.kv_stats(),
+        "max_concurrent_seqs": executor.batcher.max_concurrent,
     }
     return report
 
@@ -207,7 +222,8 @@ def run(args: argparse.Namespace) -> dict:
             args=(executor, times, rng),
             kwargs=dict(prompt_tokens=args.prompt_tokens,
                         max_new_tokens=args.max_new_tokens,
-                        slo_ms=args.slo_ms, done=done))
+                        slo_ms=args.slo_ms, done=done,
+                        prompt_pool=args.prompt_pool))
         ingress.start()
     executor.serve_loop(stop_when=done.is_set)
     wall = time.monotonic() - t0
@@ -224,6 +240,8 @@ def run(args: argparse.Namespace) -> dict:
                    "max_new_tokens": args.max_new_tokens,
                    "slo_ms": args.slo_ms
                    or config.SERVE_SLO_MS.get(),
+                   "prompt_pool": args.prompt_pool,
+                   "paged": executor.cfg.paged,
                    "seed": args.seed})
     path = write_report(report, args.output, executor.rank)
     if executor.rank == executor.front:
@@ -233,6 +251,7 @@ def run(args: argparse.Namespace) -> dict:
         print(f"loadgen: report written to {path}")
     if statesync_service is not None:
         statesync_service.close()
+    executor.close()
     hvd.shutdown()
     return report
 
@@ -258,6 +277,11 @@ def make_parser() -> argparse.ArgumentParser:
                         help="per-request SLO (0 = HOROVOD_SERVE_SLO_MS)")
     parser.add_argument("--max-batch", type=int, default=0)
     parser.add_argument("--token-budget", type=int, default=0)
+    parser.add_argument("--prompt-pool", type=int, default=0,
+                        help="draw prompts from N fixed token lists "
+                             "(0 = fresh random per request); the "
+                             "repeated-prompt profile that exercises "
+                             "the paged prefix cache")
     parser.add_argument("--seed", type=int, default=1234)
     parser.add_argument("--output", default="SERVE_r{rank}.json",
                         help="report path; {rank} substitutes")

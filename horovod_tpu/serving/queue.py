@@ -37,6 +37,10 @@ class ServeRequest:
     slo_ms: float
     replica: int = -1                  # assigned replica group (batcher)
     generated: list[int] = field(default_factory=list)
+    # Steps the batcher has deferred this request for budget/slot/block
+    # pressure; past HOROVOD_SERVE_MAX_DEFERRALS it turns urgent and
+    # reserves the step's admission budget (starvation fix, ISSUE 14).
+    deferrals: int = 0
 
     def remaining_ms(self, now: float | None = None) -> float:
         now = time.monotonic() if now is None else now

@@ -1,7 +1,7 @@
 """Replica executor: the serve loop every rank runs, on the same
 core/controller dispatch path training uses.
 
-Execution model (ISSUE 9 tentpole):
+Execution model (ISSUE 9 tentpole, extended by ISSUE 14):
 
 - The **front end** (lowest live rank) owns the ingress queue, the
   continuous batcher and admission control.  Every serve step it
@@ -12,9 +12,22 @@ Execution model (ISSUE 9 tentpole):
   schedule.
 - Each **replica group** (``HOROVOD_SERVE_GROUP_SIZE`` ranks; 1 = pure
   data-parallel) prefills newly assigned requests into free KV-cache
-  slots and advances every in-flight slot by one greedy decode token per
-  step (models/transformer.py ``prefill``/``decode_step`` — continuous
-  batching, not run-to-completion).
+  slots and advances every in-flight slot by one greedy token per step
+  (models/transformer.py — continuous batching, not run-to-completion).
+- **Paged KV** (``HOROVOD_SERVE_PAGED``, ISSUE 14): slot KV state lives
+  in fixed-size blocks from a per-replica :class:`~.kvpool.KVBlockPool`
+  instead of dense per-slot arrays, so slot count is bounded by live
+  token residency (the pool), not the batch shape.  Prompt blocks are
+  content-addressed (FNV chain hash): a request whose prefix blocks are
+  already resident bumps refcounts instead of re-prefilling, with
+  copy-on-write on the first divergent write and LRU eviction of
+  refcount-0 cached blocks.
+- **Disaggregated prefill/decode** (``HOROVOD_SERVE_PREFILL_RANKS``):
+  the highest N ranks run prompt prefill only and stream finished KV
+  blocks to decode replicas over the dedicated kvstream mesh, so a long
+  prompt overlaps decode steps instead of stalling them.  Streaming is
+  point-to-point — the plan broadcast stays the only schedule source
+  and the collective fingerprint stream is identical on every rank.
 - Completions ride back on an **allgather** each step, so the front end
   frees slots and records latencies without any side channel.
 - **Deadline propagation**: the earliest in-flight request deadline
@@ -27,8 +40,9 @@ Execution model (ISSUE 9 tentpole):
   heartbeat-confirmed dead set, deterministically renumbers itself,
   rebuilds the world one rank smaller (fresh rendezvous epoch), resyncs
   the in-flight map from ground truth, and keeps serving.  In-flight
-  requests on surviving replicas are untouched — their KV caches are
-  process-local JAX arrays that do not care about the mesh.
+  requests on surviving replicas are untouched — their KV state
+  (dense caches or paged block pools) is process-local and does not
+  care about the mesh.
 """
 from __future__ import annotations
 
@@ -46,6 +60,7 @@ from ..common.logging import logger
 from ..models import transformer as tfm
 from .admission import AdmissionController
 from .batcher import Assignment, BatchPlan, ContinuousBatcher
+from .kvpool import FNV_SEED, KVBlockPool, chain_hash
 from .queue import RequestQueue
 
 
@@ -61,6 +76,18 @@ class ServeConfig:
     eos_id: int = -1                   # -1 disables EOS stopping
     seed: int = 0
     model_cfg: object | None = None    # TransformerConfig; None = tiny LM
+    # Paged KV cache (ISSUE 14): blocks of block_tokens from a
+    # pool_blocks pool; 0 = auto (max_batch x ceil(max_seq/bt), the
+    # dense layout's token memory).  paged_slots (0 = auto: 2 x
+    # max_batch) is the decode batch width — the pool, not the batch
+    # shape, bounds concurrency.
+    paged: bool = False
+    block_tokens: int = 16
+    pool_blocks: int = 0
+    paged_slots: int = 0
+    # Disaggregated prefill/decode: highest N ranks prefill-only
+    # (requires paged; clamped so at least one decode rank remains).
+    prefill_ranks: int = 0
     # Prefill shape buckets compiled at startup so the first real
     # requests never stall a broadcast-consistent step on an XLA
     # compile (a multi-second stall looks exactly like a wedged rank
@@ -75,9 +102,35 @@ class ServeConfig:
             max_seq=config.SERVE_MAX_SEQ.get(),
             group_size=config.SERVE_GROUP_SIZE.get(),
             slo_ms=config.SERVE_SLO_MS.get(),
-            queue_depth=config.SERVE_QUEUE_DEPTH.get())
+            queue_depth=config.SERVE_QUEUE_DEPTH.get(),
+            paged=config.SERVE_PAGED.get(),
+            block_tokens=config.SERVE_BLOCK_TOKENS.get(),
+            pool_blocks=config.SERVE_POOL_BLOCKS.get(),
+            paged_slots=config.SERVE_PAGED_SLOTS.get(),
+            prefill_ranks=config.SERVE_PREFILL_RANKS.get())
         base.update(overrides)
         return cls(**base)
+
+    @property
+    def slots(self) -> int:
+        """Decode slots per replica: the dense batch, or the (wider)
+        paged slot count backed by the shared pool."""
+        if not self.paged:
+            return self.max_batch
+        return self.paged_slots if self.paged_slots > 0 \
+            else 2 * self.max_batch
+
+    @property
+    def table_width(self) -> int:
+        return -(-self.max_seq // self.block_tokens)
+
+    @property
+    def resolved_pool_blocks(self) -> int:
+        """Pool size; the auto default reserves exactly the dense
+        layout's token memory (max_batch x max_seq tokens)."""
+        if self.pool_blocks > 0:
+            return self.pool_blocks
+        return self.max_batch * self.table_width
 
 
 @dataclasses.dataclass
@@ -90,6 +143,15 @@ class _Slot:
     age_ms: float                      # ingress age when assigned
     slo_ms: float
     generated: list[int]
+    # Paged mode: physical block ids in logical order (each held once
+    # by this slot) and the sequence write cursor.
+    blocks: list = dataclasses.field(default_factory=list)
+    seq_len: int = 0
+    # Disaggregated mode: the original assignment while the streamed
+    # prefill is still in flight (slot skips decode until it lands or
+    # the fallback re-prefills locally), and when it went pending.
+    pending: Assignment | None = None
+    pending_since: float = 0.0
 
 
 class ReplicaExecutor:
@@ -113,6 +175,11 @@ class ReplicaExecutor:
             model_cfg = tfm.gpt_tiny(dtype=jnp.float32)
         model_cfg = dataclasses.replace(model_cfg, decode=True,
                                         max_seq_len=self.cfg.max_seq)
+        if self.cfg.paged:
+            model_cfg = dataclasses.replace(
+                model_cfg, paged=True,
+                kv_pool_blocks=self.cfg.resolved_pool_blocks,
+                kv_block_tokens=self.cfg.block_tokens)
         self.model = tfm.TransformerLM(model_cfg)
         if params is None:
             # Seeded, deterministic: every replica materializes identical
@@ -123,8 +190,8 @@ class ReplicaExecutor:
                 jnp.zeros((1, 8), jnp.int32))["params"]
         self.params = params
 
-        self.slots: list[_Slot | None] = [None] * self.cfg.max_batch
-        self._last_tokens = np.zeros(self.cfg.max_batch, np.int32)
+        self.slots: list[_Slot | None] = [None] * self.cfg.slots
+        self._last_tokens = np.zeros(self.cfg.slots, np.int32)
         self.completed: dict[int, dict] = {}
         self.prefilled: set[int] = set()
         # Completions not yet acknowledged by a successful exchange: a
@@ -135,7 +202,9 @@ class ReplicaExecutor:
         self.stats = {"offered": 0, "expired": 0, "served": 0,
                       "served_slo": 0, "lost": 0,
                       "latencies_ms": [], "completed_at": [],
-                      "shrinks": [], "grows": []}
+                      "shrinks": [], "grows": [],
+                      "prefill_streams": 0, "prefill_fallbacks": 0,
+                      "prefill_skipped": 0}
         # Elastic grow mid-serve (statesync/): attach_statesync wires a
         # membership service in; None = the pre-ISSUE-10 behavior with
         # zero extra collectives.
@@ -145,28 +214,81 @@ class ReplicaExecutor:
                                   default_slo_ms=self.cfg.slo_ms)
         self.admission = AdmissionController(
             queue_depth_limit=self.cfg.queue_depth)
-        self.batcher = ContinuousBatcher(
-            self.num_groups, slots_per_replica=self.cfg.max_batch,
-            token_budget=self.cfg.token_budget)
+        self.batcher = self._make_batcher()
 
-        self._decode_jit = jax.jit(self._decode_impl)
-        self._prefill_jit = jax.jit(self._prefill_impl)
+        # Paged state: the block pool (id bookkeeping), the per-slot
+        # block tables/cursors (the model's addressing arguments) and
+        # the paged cache (the pools themselves).
+        self.pool: KVBlockPool | None = None
+        if self.cfg.paged:
+            self.pool = KVBlockPool(self.cfg.resolved_pool_blocks,
+                                    self.cfg.block_tokens)
+            self._sink = self.cfg.resolved_pool_blocks
+            self._tables = np.full((self.cfg.slots,
+                                    self.cfg.table_width),
+                                   self._sink, np.int32)
+            self._cursors = np.zeros(self.cfg.slots, np.int32)
+            self._paged_jit = jax.jit(self._paged_impl)
+            self._paged_prefill_jit = jax.jit(self._paged_prefill_impl)
+            self._copy_block_jit = jax.jit(tfm.paged_copy_block)
+        else:
+            self._decode_jit = jax.jit(self._decode_impl)
+            self._prefill_jit = jax.jit(self._prefill_impl)
+        self._kvstream = None
         self._init_cache()
         self._warmup()
+        if self.prefill_rank_list:
+            self._rebuild_kvstream()
 
     # -- topology --------------------------------------------------------
     def _configure_groups(self) -> None:
+        n_pref = 0
+        if self.cfg.prefill_ranks > 0:
+            if not self.cfg.paged:
+                logger.warning(
+                    "serving: HOROVOD_SERVE_PREFILL_RANKS needs "
+                    "HOROVOD_SERVE_PAGED (block streaming); ignoring")
+            else:
+                n_pref = min(self.cfg.prefill_ranks, self.size - 1)
+        self.decode_size = self.size - n_pref
+        self.prefill_rank_list = list(range(self.decode_size, self.size))
+        self.is_prefill = self.rank >= self.decode_size
         gs = self.cfg.group_size
-        if gs <= 0 or self.size % gs:
+        if gs <= 0 or self.decode_size % gs:
             if gs > 1:
                 logger.warning(
-                    "serving: group size %d does not divide world size "
-                    "%d; falling back to per-rank replicas", gs, self.size)
+                    "serving: group size %d does not divide decode size "
+                    "%d; falling back to per-rank replicas", gs,
+                    self.decode_size)
             gs = 1
         self.group_size = gs
-        self.group = self.rank // gs
-        self.num_groups = self.size // gs
-        self.group_leader = self.rank % gs == 0
+        self.group = self.rank // gs if not self.is_prefill else -1
+        self.num_groups = self.decode_size // gs
+        self.group_leader = (not self.is_prefill
+                             and self.rank % gs == 0)
+
+    def _make_batcher(self) -> ContinuousBatcher:
+        return ContinuousBatcher(
+            self.num_groups, slots_per_replica=self.cfg.slots,
+            token_budget=self.cfg.token_budget,
+            block_capacity=self.cfg.resolved_pool_blocks
+            if self.cfg.paged else 0,
+            block_tokens=self.cfg.block_tokens)
+
+    def _rebuild_kvstream(self) -> None:
+        """(Re)form the dedicated prefill-stream mesh — collectively,
+        every serving rank, epoch+generation-scoped so a post-shrink
+        mesh never collides with the dying one's sockets."""
+        from ..statesync.service import _kv_client
+        from .kvstream import KVStreamMesh, kvstream_scope
+
+        if self._kvstream is not None:
+            self._kvstream.close()
+            self._kvstream = None
+        base = os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", "0")
+        self._kvstream = KVStreamMesh(
+            _kv_client(), kvstream_scope(base, self._gen), self.rank,
+            self.size, self.prefill_rank_list)
 
     # -- model plumbing --------------------------------------------------
     def _decode_impl(self, params, cache, tokens):
@@ -180,13 +302,64 @@ class ReplicaExecutor:
                                     tokens, lengths=n)
         return (jnp.argmax(logits[0, n - 1, :]).astype(jnp.int32), cache)
 
+    def _paged_impl(self, params, cache, tokens, tables, cursors):
+        """One paged decode step for the whole slot array: inactive
+        slots' tables point at the pool sink row, so their writes land
+        in garbage space and their outputs are ignored."""
+        logits, cache = tfm.paged_apply(
+            self.model, {"params": params}, cache, tokens, tables,
+            cursors)
+        return (jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32),
+                cache)
+
+    def _paged_prefill_impl(self, params, cache, tokens, table, cursor,
+                            n):
+        """Paged prefill of ONE request (B=1) straight into the shared
+        pool through the slot's block table; ``cursor`` > 0 resumes
+        past prefix-cache hits and ``n`` masks the padded tail."""
+        logits, cache = tfm.paged_apply(
+            self.model, {"params": params}, cache, tokens, table,
+            cursor, lengths=n)
+        return (jnp.argmax(logits[0, n[0] - 1, :]).astype(jnp.int32),
+                cache)
+
     def _init_cache(self) -> None:
-        zeros = jnp.zeros((self.cfg.max_batch, 1), jnp.int32)
+        if self.cfg.paged:
+            zeros = jnp.zeros((1, 1), jnp.int32)
+            _, mut = self.model.apply(
+                {"params": self.params}, zeros,
+                block_tables=jnp.full((1, self.cfg.table_width),
+                                      self._sink, jnp.int32),
+                cursors=jnp.zeros((1,), jnp.int32),
+                mutable=["cache"])
+            from flax.core import unfreeze
+            self._cache = unfreeze(mut["cache"])
+            return
+        zeros = jnp.zeros((self.cfg.slots, 1), jnp.int32)
         _, mut = self.model.apply({"params": self.params}, zeros,
                                   mutable=["cache"])
         self._cache = tfm._with_cache_index(mut["cache"], 0)
 
     def _warmup(self) -> None:
+        if self.cfg.paged:
+            table1 = jnp.full((1, self.cfg.table_width), self._sink,
+                              jnp.int32)
+            for bucket in self.cfg.warmup_buckets:
+                if bucket > self.cfg.max_seq:
+                    continue
+                tok, _ = self._paged_prefill_jit(
+                    self.params, self._cache,
+                    jnp.zeros((1, bucket), jnp.int32), table1,
+                    jnp.zeros((1,), jnp.int32),
+                    jnp.ones((1,), jnp.int32))
+                jax.block_until_ready(tok)
+            nxt, _ = self._paged_jit(
+                self.params, self._cache,
+                jnp.asarray(self._last_tokens[:, None]),
+                jnp.asarray(self._tables), jnp.asarray(self._cursors))
+            jax.block_until_ready(nxt)
+            self._init_cache()         # discard warmup sink writes
+            return
         for bucket in self.cfg.warmup_buckets:
             if bucket > self.cfg.max_seq:
                 continue
@@ -209,7 +382,8 @@ class ReplicaExecutor:
         stop = (self._stop_requested and self.queue.depth() == 0
                 and self.batcher.inflight_count() == 0)
         plan, expired = self.batcher.assemble(
-            self._step, self.queue, self.admission, stop=stop)
+            self._step, self.queue, self.admission, stop=stop,
+            prefill_ranks=self.prefill_rank_list)
         for req in expired:
             # Expired while queued: shed at admission, never executed.
             self.admission.count("expired")
@@ -227,11 +401,21 @@ class ReplicaExecutor:
     def _apply_plan(self, plan: BatchPlan) -> None:
         now = time.monotonic()
         for a in plan.assign:
+            if self.is_prefill:
+                if a.prefill == self.rank:
+                    self._prefill_and_stream(a)
+                continue
             if a.replica != self.group:
                 continue
             slot = next(i for i, s in enumerate(self.slots) if s is None)
-            self._prefill_slot(slot, a, now)
+            if a.prefill >= 0:
+                self._admit_disaggregated(slot, a, now)
+            elif self.cfg.paged:
+                self._prefill_slot_paged(slot, a, now)
+            else:
+                self._prefill_slot(slot, a, now)
 
+    # -- dense prefill (the PR 9 path, unchanged) ------------------------
     def _prefill_slot(self, slot: int, a: Assignment, now: float) -> None:
         # Clamp so prompt + generation always fits the KV cache.
         limit = self.cfg.max_seq - a.max_new_tokens
@@ -244,28 +428,291 @@ class ReplicaExecutor:
         self._cache = jax.tree_util.tree_map(
             lambda big, small: big.at[slot].set(small[0]),
             self._cache, cache1)
-        first = int(first)
+        self._activate_slot(slot, a, now, int(first))
+
+    def _activate_slot(self, slot: int, a: Assignment, now: float,
+                       first: int, blocks: list | None = None,
+                       seq_len: int = 0) -> None:
         self._last_tokens[slot] = first
         self.slots[slot] = _Slot(
             rid=a.rid, remaining=a.max_new_tokens - 1,
             deadline=now + a.deadline_rel_ms / 1e3, assigned_at=now,
-            age_ms=a.age_ms, slo_ms=a.slo_ms, generated=[first])
+            age_ms=a.age_ms, slo_ms=a.slo_ms, generated=[first],
+            blocks=blocks or [], seq_len=seq_len)
         self.prefilled.add(a.rid)
 
+    # -- paged prefill + prefix cache ------------------------------------
+    def _clamped_tokens(self, a: Assignment) -> list[int]:
+        limit = self.cfg.max_seq - a.max_new_tokens
+        return a.tokens[:max(1, limit)]
+
+    def _lookup_prefix(self, toks: list[int]) -> tuple[list, int]:
+        """Walk the prompt's block chain through the prefix cache:
+        returns (hit block ids — refcounts already bumped, tokens
+        covered)."""
+        bt = self.cfg.block_tokens
+        parent = FNV_SEED
+        hits: list[int] = []
+        pos = 0
+        while pos < len(toks):
+            seg = toks[pos:pos + bt]
+            blk = self.pool.lookup(parent, seg)
+            if blk is None:
+                break
+            hits.append(blk)
+            parent = chain_hash(parent, seg)
+            pos += len(seg)
+        return hits, pos
+
+    def _publish_prompt(self, toks: list[int], blocks: list) -> None:
+        """Content-address every prompt block (full blocks and the
+        partial tail) so later identical prefixes hit instead of
+        re-prefilling.  Publishing makes a block immutable — the next
+        write into the tail COWs it (the first divergent write)."""
+        bt = self.cfg.block_tokens
+        parent = FNV_SEED
+        for i in range(0, len(toks), bt):
+            parent = self.pool.publish(blocks[i // bt], parent,
+                                       toks[i:i + bt])
+
+    def _ensure_writable(self, slot_blocks: list, j: int) -> bool:
+        """COW guard before writing into logical block ``j``: a shared
+        or published block gets a private copy (pool ids + tensor rows)
+        and the slot's table repoints.  Returns True when a copy
+        happened."""
+        old = slot_blocks[j]
+        new, copied = self.pool.cow(old)
+        if copied:
+            self._cache = self._copy_block_jit(
+                self._cache, jnp.int32(old), jnp.int32(new))
+            slot_blocks[j] = new
+        return copied
+
+    def _prefill_slot_paged(self, slot: int, a: Assignment,
+                            now: float) -> None:
+        bt = self.cfg.block_tokens
+        toks = self._clamped_tokens(a)
+        hits, pos = self._lookup_prefix(toks)
+        full_hit = pos >= len(toks)
+        if full_hit:
+            # Whole prompt resident: no prefill at all — re-run just the
+            # last prompt token (its K/V rewrite is value-identical;
+            # COW below keeps shared blocks untouched) to get the
+            # next-token logits.
+            pos = len(toks) - 1
+            self.stats["prefill_skipped"] += 1
+        total = -(-(len(toks) + a.max_new_tokens) // bt)
+        fresh = self.pool.alloc(total - len(hits))
+        if fresh is None:
+            # The front end reserves worst-case blocks per admission, so
+            # this is unreachable unless accounting drifted; fail loud.
+            for b in hits:
+                self.pool.deref(b)
+            raise RuntimeError(
+                f"KV pool exhausted admitting rid {a.rid}: "
+                f"{self.pool.free_count()} free of {self.pool.num_blocks}")
+        blocks = hits + fresh
+        j0 = pos // bt
+        self._ensure_writable(blocks, j0)
+        rem = toks[pos:]
+        bucket = min(self._bucket(len(rem)), self.cfg.max_seq)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(rem)] = rem
+        row = np.full(self.cfg.table_width, self._sink, np.int32)
+        row[:total] = blocks
+        first, self._cache = self._paged_prefill_jit(
+            self.params, self._cache, jnp.asarray(padded),
+            jnp.asarray(row[None]), jnp.asarray([pos], np.int32),
+            jnp.asarray([len(rem)], np.int32))
+        self._publish_prompt(toks, blocks)
+        self._tables[slot] = row
+        self._activate_slot(slot, a, now, int(first), blocks=blocks,
+                            seq_len=len(toks))
+
+    # -- disaggregated prefill/decode ------------------------------------
+    def _admit_disaggregated(self, slot: int, a: Assignment,
+                             now: float) -> None:
+        """Decode-rank admission of a prefill-rank-assigned request: a
+        full local prefix hit admits immediately (the stream, when it
+        lands, is discarded); otherwise the slot parks PENDING — it
+        skips decode until the streamed blocks arrive (or the fallback
+        re-prefills locally), so the long prompt never stalls a step."""
+        toks = self._clamped_tokens(a)
+        hits, pos = self._lookup_prefix(toks)
+        if pos >= len(toks):
+            for b in hits:          # _prefill_slot_paged re-looks-up
+                self.pool.deref(b)
+            self._prefill_slot_paged(slot, a, now)
+            if self._kvstream is not None:
+                self._kvstream.discard(a.rid)
+            return
+        for b in hits:
+            self.pool.deref(b)
+        self.slots[slot] = _Slot(
+            rid=a.rid, remaining=a.max_new_tokens,
+            deadline=now + a.deadline_rel_ms / 1e3, assigned_at=now,
+            age_ms=a.age_ms, slo_ms=a.slo_ms, generated=[],
+            pending=a, pending_since=now)
+        self.prefilled.add(a.rid)
+
+    def _prefill_and_stream(self, a: Assignment) -> None:
+        """Prefill-rank half: compute the prompt's KV blocks in the
+        local scratch pool (identity table) and stream them to every
+        rank of the decode replica group."""
+        bt = self.cfg.block_tokens
+        toks = self._clamped_tokens(a)
+        nblk = -(-len(toks) // bt)
+        row = np.full(self.cfg.table_width, self._sink, np.int32)
+        row[:nblk] = np.arange(nblk)
+        bucket = min(self._bucket(len(toks)), self.cfg.max_seq)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(toks)] = toks
+        first, self._cache = self._paged_prefill_jit(
+            self.params, self._cache, jnp.asarray(padded),
+            jnp.asarray(row[None]), jnp.zeros((1,), np.int32),
+            jnp.asarray([len(toks)], np.int32))
+        image = self._extract_blocks(nblk)
+        dests = list(range(a.replica * self.group_size,
+                           (a.replica + 1) * self.group_size))
+        from ..resilience import deadline_scope
+
+        # The stream is bounded twice over: the request's SLO deadline
+        # scopes the step, and the KVStreamGuard silence timeout aborts
+        # a send wedged on a dead decode peer (the decode side then
+        # re-prefills locally — degradation, never a stall).
+        try:
+            with deadline_scope(time.monotonic()
+                                + a.deadline_rel_ms / 1e3):
+                self._kvstream.send_image(
+                    a.rid, dests, image.tobytes(), first=int(first),
+                    plen=len(toks), cursor=len(toks), shape=image.shape,
+                    dtype=str(image.dtype))
+        except (ConnectionError, OSError) as exc:
+            # The decode side's pending-patience fallback re-prefills
+            # locally; a broken stream is degradation, never a stall.
+            logger.warning("serving: prefill stream for rid %d failed: "
+                           "%s", a.rid, exc)
+            return
+        self.stats["prefill_streams"] += 1
+
+    def _cache_pool_leaves(self) -> list:
+        """The per-layer key/value pool arrays in a deterministic
+        traversal order (identical on sender and receiver: same model,
+        same cache tree)."""
+        leaves = []
+
+        def walk(node):
+            if not isinstance(node, dict):
+                return
+            for key in sorted(node):
+                if key in ("key_pool", "value_pool"):
+                    leaves.append((key, node))
+                else:
+                    walk(node[key])
+        walk(self._cache)
+        return leaves
+
+    def _extract_blocks(self, nblk: int) -> np.ndarray:
+        """[n_leaves, nblk, bt, H, D]: the prompt's pool rows across
+        every layer, ready to serialize."""
+        return np.stack([np.asarray(node[key][:nblk])
+                         for key, node in self._cache_pool_leaves()])
+
+    def _insert_blocks(self, ids: list, image: np.ndarray) -> None:
+        idx = jnp.asarray(np.asarray(ids, np.int32))
+        for i, (key, node) in enumerate(self._cache_pool_leaves()):
+            node[key] = node[key].at[idx].set(jnp.asarray(image[i]))
+
+    def _integrate_prefills(self) -> None:
+        """Decode-rank step hook: land fully streamed transfers into
+        pending slots (non-blocking — a transfer still in flight just
+        keeps its slot pending), re-prefill locally when a transfer
+        outlived its patience (prefill rank died / stream lost), and
+        drop orphaned images."""
+        now = time.monotonic()
+        pending_rids = set()
+        for i, s in enumerate(self.slots):
+            if s is None or s.pending is None:
+                continue
+            pending_rids.add(s.rid)
+            img = self._kvstream.pop_ready(s.rid) \
+                if self._kvstream is not None else None
+            if img is not None:
+                self._land_streamed(i, img)
+                continue
+            patience = max(1.0, s.slo_ms / 4e3)
+            if now - s.pending_since > patience:
+                a = s.pending
+                self.slots[i] = None
+                self._prefill_slot_paged(i, a, now)
+                self.stats["prefill_fallbacks"] += 1
+                if self._kvstream is not None:
+                    self._kvstream.discard(a.rid)
+        if self._kvstream is not None:
+            for rid in self._kvstream.ready_rids():
+                if rid not in pending_rids:
+                    self._kvstream.discard(rid)
+
+    def _land_streamed(self, slot: int, img) -> None:
+        """Insert a streamed prefill into the pool and activate the
+        slot: allocate the sequence's full block run, write the prompt
+        rows, publish them for prefix reuse."""
+        a = self.slots[slot].pending
+        now = time.monotonic()
+        bt = self.cfg.block_tokens
+        toks = self._clamped_tokens(a)
+        total = -(-(len(toks) + a.max_new_tokens) // bt)
+        blocks = self.pool.alloc(total)
+        if blocks is None:
+            raise RuntimeError(
+                f"KV pool exhausted landing streamed rid {a.rid}")
+        image = np.frombuffer(bytes(img.data),
+                              np.dtype(img.dtype)).reshape(img.shape)
+        nblk = image.shape[1]
+        self._insert_blocks(blocks[:nblk], image)
+        self._publish_prompt(toks, blocks)
+        row = np.full(self.cfg.table_width, self._sink, np.int32)
+        row[:total] = blocks
+        self._tables[slot] = row
+        remaining = self.slots[slot].remaining
+        self._last_tokens[slot] = img.first
+        self.slots[slot] = dataclasses.replace(
+            self.slots[slot], remaining=remaining - 1,
+            generated=[img.first], blocks=blocks, seq_len=img.cursor,
+            pending=None, pending_since=0.0)
+
+    # -- decode ----------------------------------------------------------
     def _decode_once(self) -> None:
         active = [i for i, s in enumerate(self.slots)
-                  if s is not None and s.remaining > 0]
+                  if s is not None and s.pending is None
+                  and s.remaining > 0]
         if not active:
             return
-        nxt, self._cache = self._decode_jit(
-            self.params, self._cache,
-            jnp.asarray(self._last_tokens[:, None]))
+        if self.cfg.paged:
+            bt = self.cfg.block_tokens
+            for i in active:
+                s = self.slots[i]
+                # COW guard: the write position may sit in a published
+                # tail (the first divergent write of a shared prefix).
+                if self._ensure_writable(s.blocks, s.seq_len // bt):
+                    self._tables[i][s.seq_len // bt] = \
+                        s.blocks[s.seq_len // bt]
+                self._cursors[i] = s.seq_len
+            nxt, self._cache = self._paged_jit(
+                self.params, self._cache,
+                jnp.asarray(self._last_tokens[:, None]),
+                jnp.asarray(self._tables), jnp.asarray(self._cursors))
+        else:
+            nxt, self._cache = self._decode_jit(
+                self.params, self._cache,
+                jnp.asarray(self._last_tokens[:, None]))
         nxt = np.asarray(nxt)
         for i in active:
             s = self.slots[i]
             tok = int(nxt[i])
             s.generated.append(tok)
             s.remaining -= 1
+            s.seq_len += 1
             self._last_tokens[i] = tok
             if self.cfg.eos_id >= 0 and tok == self.cfg.eos_id:
                 s.remaining = 0
@@ -273,7 +720,7 @@ class ReplicaExecutor:
     def _collect_completions(self) -> None:
         now = time.monotonic()
         for i, s in enumerate(self.slots):
-            if s is None or s.remaining > 0:
+            if s is None or s.pending is not None or s.remaining > 0:
                 continue
             rec = {"rid": s.rid, "replica": self.group,
                    "latency_ms": s.age_ms + (now - s.assigned_at) * 1e3,
@@ -284,7 +731,18 @@ class ReplicaExecutor:
                 # Every group member frees slots identically; only the
                 # leader reports, so completions appear exactly once.
                 self._unreported.append(rec)
-            self.slots[i] = None
+            self._release_slot(i)
+
+    def _release_slot(self, i: int) -> None:
+        s = self.slots[i]
+        if self.cfg.paged and s is not None:
+            for b in s.blocks:
+                self.pool.deref(b)
+            self._tables[i] = self._sink
+            self._cursors[i] = 0
+            if self._kvstream is not None:
+                self._kvstream.discard(s.rid)
+        self.slots[i] = None
 
     def _exchange_completions(self) -> list[dict]:
         from ..resilience import deadline_scope
@@ -359,6 +817,8 @@ class ReplicaExecutor:
         per_group = [per_rank[g * self.group_size]["rids"]
                      for g in range(self.num_groups)]
         self.batcher.rebuild(per_group)
+        if self.prefill_rank_list:
+            self._rebuild_kvstream()
         windows = getattr(self.statesync, "grow_windows", [])
         self.stats["grows"].append(
             {"join": join_id, "from": old_size, "to": new_size,
@@ -377,8 +837,11 @@ class ReplicaExecutor:
         if plan.stop:
             return False
         self._apply_plan(plan)
-        self._decode_once()
-        self._collect_completions()
+        if not self.is_prefill:
+            if self.cfg.paged and self.prefill_rank_list:
+                self._integrate_prefills()
+            self._decode_once()
+            self._collect_completions()
         completions = self._exchange_completions()
         self._account(completions)
         if self.statesync is not None:
@@ -442,9 +905,27 @@ class ReplicaExecutor:
         if self.statesync is not None:
             self.statesync.notify_world_changed()
         self._resync()
+        if self.prefill_rank_list:
+            self._rebuild_kvstream()
+        if not self.is_prefill:
+            self._repair_pending()
         self.stats["shrinks"].append(
             {"dead": sorted(dead), "from": old[1], "to": new_size,
              "step": self._step})
+
+    def _repair_pending(self) -> None:
+        """After a world rebuild, any still-pending streamed prefill may
+        have died with its prefill rank: re-prefill locally right away
+        (the plan already committed these admissions — they are never
+        dropped)."""
+        now = time.monotonic()
+        for i, s in enumerate(self.slots):
+            if s is None or s.pending is None:
+                continue
+            a = s.pending
+            self.slots[i] = None
+            self._prefill_slot_paged(i, a, now)
+            self.stats["prefill_fallbacks"] += 1
 
     def _resync(self) -> None:
         """Rebuild shared state from ground truth after a world rebuild.
@@ -473,12 +954,42 @@ class ReplicaExecutor:
                 self.admission.count("lost")
             self.stats["lost"] += len(lost)
 
-    # -- introspection ---------------------------------------------------
+    # -- introspection / teardown ----------------------------------------
     def inflight_rids(self) -> list[int]:
         return sorted(s.rid for s in self.slots if s is not None)
 
     def request_stop(self) -> None:
         self._stop_requested = True
+
+    def kv_stats(self) -> dict | None:
+        """The paged pool's residency/reuse numbers for reports and the
+        leak census (None in dense mode)."""
+        if self.pool is None:
+            return None
+        return {"pool_blocks": self.pool.num_blocks,
+                "block_tokens": self.pool.block_tokens,
+                "free": self.pool.free_count(),
+                "active": self.pool.active_count(),
+                "cached": self.pool.cached_count(),
+                "prefix_hits": self.pool._m_hits.value,
+                "prefix_misses": self.pool._m_misses.value,
+                "evictions": self.pool._m_evicted.value,
+                "cow_copies": self.pool._m_cow.value,
+                "max_concurrent_seqs": self.batcher.max_concurrent,
+                "prefill_streams": self.stats["prefill_streams"],
+                "prefill_fallbacks": self.stats["prefill_fallbacks"],
+                "prefill_skipped": self.stats["prefill_skipped"]}
+
+    def close(self) -> None:
+        """Release the serving resources this executor owns: the
+        kvstream mesh (drain threads + sockets) and the KV block pool
+        (hvdlife HVD702/704 — the pool must not outlive the executor
+        across elastic reinit cycles)."""
+        if self._kvstream is not None:
+            self._kvstream.close()
+            self._kvstream = None
+        if self.pool is not None:
+            self.pool.close()
 
 
 def serving_params_template(cfg: ServeConfig) -> dict:

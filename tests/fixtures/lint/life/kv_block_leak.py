@@ -1,0 +1,19 @@
+"""Seeded hvdlife fixture: HVD702/HVD704 — a serving-style executor
+that builds a paged KV-block pool per world epoch and never releases
+it: the pool's residency accounting (and the HBM rows its block ids
+index in the model cache) survives every reinit_world cycle."""
+from horovod_tpu.serving.kvpool import KVBlockPool
+
+
+class LeakyExecutor:
+    def __init__(self):
+        self.pool = KVBlockPool(32, 16)                       # HVD702
+
+    def close(self):
+        self.pool = None    # drops the handle, never pool.close()
+
+
+def reinit_world(rank, size):
+    """Epoch root: one leaked pool per elastic cycle (HVD704)."""
+    ex = LeakyExecutor()                                      # HVD704
+    return ex

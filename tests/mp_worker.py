@@ -2063,6 +2063,125 @@ def battery_serving(hvd, rank, size):
     hvd.barrier()
 
 
+def battery_serving_paged(hvd, rank, size):
+    """ISSUE 14 acceptance (4-rank): paged-KV continuous serving rides
+    the same chaos SIGKILL of rank 2 mid-serve as the dense battery.
+    The world shrinks 4->3 with block tables resynced from ground
+    truth, every survivor finishes every admitted request (zero failed
+    in-flight), repeated prompts hit the prefix cache, and after the
+    drain every survivor's pool passes the refcount-leak census
+    (active blocks == 0)."""
+    import random as _random
+    import time as _time
+
+    from horovod_tpu.serving import ReplicaExecutor, ServeConfig
+
+    ex = ReplicaExecutor(ServeConfig.from_env(
+        max_batch=4, token_budget=64, max_seq=64, slo_ms=120000.0,
+        paged=True, block_tokens=8))
+    assert ex.num_groups == size
+    assert ex.cfg.slots == 8 and ex.pool is not None
+    n_requests = 24
+    if rank == 0:
+        rng = _random.Random(7)
+        # A pool of 6 prompts offered 4x each: the repeated-prompt
+        # profile the prefix cache exists for.
+        prompts = [[rng.randrange(2, ex.model.cfg.vocab_size)
+                    for _ in range(rng.randint(2, 10))]
+                   for _ in range(6)]
+        for i in range(n_requests):
+            ex.stats["offered"] += 1
+            assert ex.queue.submit(prompts[i % 6], 12) is not None
+
+    t0 = _time.monotonic()
+    ex.serve_loop(stop_when=lambda: True)   # drain then stop
+    phase1_wall = _time.monotonic() - t0
+
+    # --- the kill happened, survivors absorbed it with paged KV intact
+    assert ex.size == size - 1, (ex.size, size)
+    assert ex.stats["shrinks"] and \
+        ex.stats["shrinks"][0]["dead"] == [2], ex.stats["shrinks"]
+    missing = ex.prefilled - set(ex.completed)
+    assert not missing, \
+        f"survivor {rank} failed admitted in-flight requests: {missing}"
+    kv = ex.kv_stats()
+    assert kv["active"] == 0, f"rank {rank} leaked KV blocks: {kv}"
+    print(f"serving_paged: rank {rank} kv census clean "
+          f"(hits={kv['prefix_hits']:g} cow={kv['cow_copies']:g})")
+    if rank == 0:
+        st = ex.stats
+        assert st["served"] + st["lost"] == n_requests, st
+        assert st["lost"] <= 8, st          # at most rank 2's slots
+        assert st["expired"] == 0, st
+        assert kv["prefix_hits"] > 0, kv    # repeated prompts hit
+        # Block-table resync: after the drain the front end's block
+        # mirror is empty again — reservations freed exactly once.
+        assert ex.batcher.inflight == {} and \
+            all(b == 0 for b in ex.batcher._blocks), \
+            (ex.batcher.inflight, ex.batcher._blocks)
+        fault_timeout = float(os.environ["HOROVOD_FAULT_TIMEOUT"])
+        assert phase1_wall < 10 * fault_timeout, phase1_wall
+        print(f"serving_paged: {st['served']}/{n_requests} served, "
+              f"{st['lost']} lost with rank 2, shrink at step "
+              f"{st['shrinks'][0]['step']} in {phase1_wall:.1f}s, "
+              f"max_concurrent={ex.batcher.max_concurrent}")
+    ex.close()
+    hvd.barrier()
+
+
+def battery_serving_disagg(hvd, rank, size):
+    """ISSUE 14 acceptance (2-rank, strict fingerprint): disaggregated
+    prefill/decode — rank 1 is a prefill-only rank streaming finished
+    KV blocks to the rank-0 decode replica over the kvstream mesh.
+    Every long prompt is prefilled OFF the decode rank (zero local
+    fallbacks), everything offered is served, and the strict-mode
+    collective fingerprint stays clean over the split-role step loop
+    (any divergence would abort the battery with a structured ERROR)."""
+    import random as _random
+
+    from horovod_tpu.serving import ReplicaExecutor, ServeConfig
+
+    ex = ReplicaExecutor(ServeConfig.from_env(
+        max_batch=4, token_budget=256, max_seq=64, slo_ms=120000.0,
+        paged=True, block_tokens=8, prefill_ranks=1))
+    assert ex.decode_size == 1 and ex.prefill_rank_list == [1]
+    assert ex.is_prefill == (rank == 1)
+    n_requests = 12
+    if rank == 0:
+        rng = _random.Random(5)
+        for _ in range(n_requests):
+            # Long prompts (3-5 blocks): the traffic whose prefill
+            # used to stall co-scheduled decode steps.
+            toks = [rng.randrange(2, ex.model.cfg.vocab_size)
+                    for _ in range(rng.randint(24, 40))]
+            ex.stats["offered"] += 1
+            assert ex.queue.submit(toks, 8) is not None
+
+    ex.serve_loop(stop_when=lambda: True)
+
+    if rank == 0:
+        st = ex.stats
+        kv = ex.kv_stats()
+        assert st["served"] == n_requests, st
+        assert kv["prefill_fallbacks"] == 0, kv
+        assert kv["active"] == 0, kv
+        assert ex.batcher.inflight == {}, ex.batcher.inflight
+        print(f"serving_disagg: {st['served']}/{n_requests} served via "
+              f"streamed prefill, zero local fallbacks")
+    else:
+        assert ex.stats["prefill_streams"] == n_requests, ex.stats
+        from horovod_tpu import telemetry
+        sent = telemetry.metrics().counter(
+            "horovod_serve_prefill_stream_bytes_total",
+            labels={"role": "sent"}).value
+        assert sent > 0, "prefill rank streamed no bytes"
+        print(f"serving_disagg: rank 1 streamed "
+              f"{ex.stats['prefill_streams']} prefills "
+              f"({sent:g} payload bytes)")
+    ex.close()
+    hvd.barrier()
+
+
 def _statesync_state(n=1 << 18):
     """Deterministic replicated training state: params/opt evolve by the
     (identical-on-every-rank) allreduce output, so donors' snapshots are
@@ -2552,6 +2671,8 @@ def battery_statesync_serve_joiner(port):
 BATTERIES = {
     "collectives": battery_collectives,
     "serving": battery_serving,
+    "serving_paged": battery_serving_paged,
+    "serving_disagg": battery_serving_disagg,
     "san": battery_san,
     "trace": battery_trace,
     "telemetry": battery_telemetry,
@@ -2726,12 +2847,14 @@ def main() -> int:
     if battery in ("resilience_kill", "resilience_retry",
                    "resilience_freeze"):
         os.environ["HOROVOD_FAULT_TOLERANCE"] = "1"
-    if battery == "serving":
+    if battery in ("serving", "serving_paged"):
         # ISSUE 9: data-parallel serving over the TCP plane with chaos
         # SIGKILL of rank 2 mid-serve (global collective index 11 = the
         # completion exchange of serve step 2, with ~16 requests
         # in-flight).  Fault tolerance on so survivors convert the dead
         # peer and shrink; metrics on so admission keys off live gauges.
+        # serving_paged (ISSUE 14) rides the identical chaos with the
+        # paged KV plane under it.
         os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
         os.environ["HOROVOD_FAULT_TOLERANCE"] = "1"
         os.environ["HOROVOD_FAULT_TIMEOUT"] = "5"
@@ -2739,6 +2862,14 @@ def main() -> int:
         os.environ["HOROVOD_CHAOS"] = "kill:rank=2,op=11,sig=9"
         os.environ["HOROVOD_FLIGHT_FILE"] = \
             f"/tmp/hvd_flight_{os.environ['HOROVOD_RENDEZVOUS_EPOCH']}.json"
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if battery == "serving_disagg":
+        # ISSUE 14 split-role loop under the STRICT fingerprint: a
+        # rank-divergent collective anywhere in the prefill/decode role
+        # split would surface as a structured ERROR within one cycle.
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+        os.environ["HOROVOD_METRICS"] = "on"
+        os.environ["HOROVOD_FINGERPRINT"] = "strict"
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if battery == "resilience_kill":
         os.environ["HOROVOD_FAULT_TIMEOUT"] = "5"

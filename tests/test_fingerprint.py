@@ -70,6 +70,21 @@ def test_descriptor_covers_op_name_dtype_dims_codec():
     assert describe(_req(0, "g", shape=(2, 3), codec=1)) != d
 
 
+def test_allgather_first_dim_is_rank_local_wildcard():
+    """Uneven-row allgather (allgather_object payloads, the serving
+    completion exchange) is the documented semantic: dim0 folds as a
+    wildcard so strict mode never flags it, while trailing-dim or op
+    drift still diverges."""
+    a = describe(_req(0, "done", shape=(204,),
+                      rtype=RequestType.ALLGATHER))
+    b = describe(_req(0, "done", shape=(5,),
+                      rtype=RequestType.ALLGATHER))
+    assert a == b == "ALLGATHER|done|FLOAT32|*|0/0"
+    assert describe(_req(0, "done", shape=(5, 2),
+                         rtype=RequestType.ALLGATHER)) != a
+    assert describe(_req(0, "done", shape=(204,))) != a   # ALLREDUCE
+
+
 def test_window_bounds_tail():
     t = _tracker(window=4)
     for i in range(10):

@@ -68,6 +68,18 @@ def test_fixture_epoch_leak_names_site_and_teardown_path():
     assert "shutdown/reinit_world" in msg      # the missing teardown
 
 
+def test_fixture_kv_block_pool_leak():
+    """ISSUE 14: the KV-block pool is a taxonomy channel — an executor
+    whose teardown drops the pool handle without close() leaks the
+    residency accounting (and the HBM rows its ids index) once per
+    elastic reinit cycle."""
+    out = analyze_paths([_fx("kv_block_leak.py")])
+    assert _ids(out) == [("HVD704", 10)]
+    msg = out.findings[0].message
+    assert "KVBlockPool" in msg
+    assert "init/reinit_world" in msg
+
+
 def test_fixture_blocked_no_wakeup():
     out = analyze_paths([_fx("blocked_no_wakeup.py")])
     assert _ids(out) == [("HVD705", 12)]

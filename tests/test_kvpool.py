@@ -1,0 +1,180 @@
+"""KV-block pool unit battery (ISSUE 14): free-list allocation with
+refcounts, FNV chain-hash prefix caching with collision safety,
+copy-on-write semantics and LRU eviction — the id-bookkeeping half of
+the paged serving plane (the tensor half is parity-tested in
+tests/test_transformer.py and end-to-end in test_serving.py)."""
+from __future__ import annotations
+
+import pytest
+
+from horovod_tpu.serving.kvpool import FNV_SEED, KVBlockPool, chain_hash
+from horovod_tpu.telemetry.registry import MetricsRegistry
+
+
+def _pool(blocks=8, bt=4):
+    return KVBlockPool(blocks, bt, registry=MetricsRegistry(0))
+
+
+# --- allocation / refcounts --------------------------------------------------
+def test_alloc_refcount_and_free_list_reuse():
+    p = _pool(4)
+    a = p.alloc(2)
+    assert sorted(a) == [0, 1] and p.free_count() == 2
+    assert p.active_count() == 2
+    p.ref(a[0])
+    assert p.refcount(a[0]) == 2
+    p.deref(a[0])
+    assert p.refcount(a[0]) == 1 and p.active_count() == 2
+    p.deref(a[0])
+    # Unpublished block at refcount 0 frees immediately and is reused.
+    assert p.free_count() == 3
+    b = p.alloc(3)
+    assert a[0] in b                      # free-list reuse
+    p.release_all()
+    assert p.free_count() == 4 and p.active_count() == 0
+
+
+def test_alloc_exhaustion_is_backpressure_not_error():
+    p = _pool(3)
+    assert p.alloc(4) is None             # over capacity: defer
+    got = p.alloc(3)
+    assert len(got) == 3
+    assert p.alloc(1) is None
+    p.deref(got[0])
+    assert p.alloc(1) == [got[0]]
+
+
+def test_ref_of_unowned_block_raises():
+    p = _pool(2)
+    with pytest.raises(ValueError):
+        p.ref(0)
+    with pytest.raises(ValueError):
+        p.deref(1)
+
+
+# --- prefix cache ------------------------------------------------------------
+def test_publish_lookup_chain_and_lru_park():
+    p = _pool(8, bt=4)
+    blocks = p.alloc(2)
+    k0 = p.publish(blocks[0], FNV_SEED, [1, 2, 3, 4])
+    p.publish(blocks[1], k0, [5, 6])      # partial tail, count-keyed
+    # Another sequence with the same prefix hits both links.
+    h0 = p.lookup(FNV_SEED, [1, 2, 3, 4])
+    assert h0 == blocks[0] and p.refcount(blocks[0]) == 2
+    h1 = p.lookup(k0, [5, 6])
+    assert h1 == blocks[1]
+    # Different tail tokens: miss (the chain key differs).
+    assert p.lookup(k0, [5, 7]) is None
+    assert p.lookup(k0, [5, 6, 7]) is None
+    # Deref to zero parks published blocks on the LRU, still hittable.
+    for b in blocks:
+        p.deref(b)
+        p.deref(b)
+    assert p.active_count() == 0 and p.cached_count() == 2
+    assert p.lookup(FNV_SEED, [1, 2, 3, 4]) == blocks[0]
+    assert p.active_count() == 1          # revived off the LRU
+
+
+def test_hash_collision_is_a_miss_not_corruption(monkeypatch):
+    p = _pool(4)
+    blocks = p.alloc(2)
+    # Force both publishes onto one chain key: the second keeps the
+    # incumbent mapping, and a lookup whose token ids differ from the
+    # stored ones must MISS instead of returning wrong-content blocks.
+    monkeypatch.setattr("horovod_tpu.serving.kvpool.chain_hash",
+                        lambda parent, tokens: 42)
+    p.publish(blocks[0], FNV_SEED, [1, 2])
+    p.publish(blocks[1], FNV_SEED, [3, 4])   # colliding key: kept out
+    assert p.lookup(FNV_SEED, [1, 2]) == blocks[0]
+    p.deref(blocks[0])
+    assert p.lookup(FNV_SEED, [3, 4]) is None
+    assert p.lookup(FNV_SEED, [9, 9]) is None
+
+
+def test_chain_hash_orders_and_links():
+    assert chain_hash(FNV_SEED, [1, 2]) != chain_hash(FNV_SEED, [2, 1])
+    k1 = chain_hash(FNV_SEED, [1, 2])
+    assert chain_hash(k1, [3]) != chain_hash(FNV_SEED, [3])
+
+
+# --- LRU eviction ------------------------------------------------------------
+def test_lru_eviction_oldest_first_under_pressure():
+    p = _pool(4, bt=4)
+    blocks = p.alloc(4)
+    keys = [FNV_SEED]
+    for i, b in enumerate(blocks):
+        keys.append(p.publish(b, keys[-1], [i]))
+        p.deref(b)
+    assert p.cached_count() == 4 and p.free_count() == 0
+    # Touch block 0 (a hit) so it becomes most-recently-used.
+    assert p.lookup(FNV_SEED, [0]) == blocks[0]
+    p.deref(blocks[0])
+    # Allocation under pressure evicts the LRU tail: blocks 1 then 2.
+    fresh = p.alloc(2)
+    assert fresh == [blocks[1], blocks[2]]
+    assert p._m_evicted.value == 2
+    # Block 0 survived (recently used); block 1's mapping is gone.
+    assert p.lookup(FNV_SEED, [0]) == blocks[0]
+    assert p.lookup(keys[1], [1]) is None
+
+
+# --- copy-on-write -----------------------------------------------------------
+def test_cow_private_block_is_noop():
+    p = _pool(4)
+    b = p.alloc(1)[0]
+    assert p.cow(b) == (b, False)
+
+
+def test_cow_on_shared_and_published_blocks():
+    p = _pool(4)
+    b = p.alloc(1)[0]
+    p.ref(b)                              # two holders
+    assert p.is_shared(b)
+    nb, copied = p.cow(b)
+    assert copied and nb != b
+    assert p.refcount(b) == 1 and p.refcount(nb) == 1
+    # Published ⇒ immutable even at refcount 1: the hash certifies the
+    # contents, so extending the tail must copy first.
+    p.publish(b, FNV_SEED, [7, 8])
+    nb2, copied2 = p.cow(b)
+    assert copied2 and nb2 not in (b,)
+    # The published original parked on the LRU, still a valid hit.
+    assert p.lookup(FNV_SEED, [7, 8]) == b
+
+
+def test_cow_exhaustion_names_the_headroom_contract():
+    p = _pool(1)
+    b = p.alloc(1)[0]
+    p.ref(b)
+    with pytest.raises(RuntimeError, match="headroom"):
+        p.cow(b)
+
+
+# --- telemetry + teardown ----------------------------------------------------
+def test_gauges_and_counters_track_states():
+    reg = MetricsRegistry(0)
+    p = KVBlockPool(4, 4, registry=reg)
+
+    def gauge(state):
+        return reg.gauge("horovod_serve_kv_blocks",
+                         labels={"state": state}).value
+
+    blocks = p.alloc(2)
+    assert (gauge("free"), gauge("active"), gauge("cached")) == (2, 2, 0)
+    p.publish(blocks[0], FNV_SEED, [1])
+    p.deref(blocks[0])
+    assert (gauge("free"), gauge("active"), gauge("cached")) == (2, 1, 1)
+    p.lookup(FNV_SEED, [1])
+    p.lookup(FNV_SEED, [2])
+    assert reg.counter("horovod_serve_prefix_hits_total").value == 1
+    assert reg.counter("horovod_serve_prefix_misses_total").value == 1
+    p.close()
+    assert (gauge("free"), gauge("active"), gauge("cached")) == (4, 0, 0)
+
+
+def test_close_is_idempotent_and_releases_everything():
+    p = _pool(4)
+    p.alloc(3)
+    p.close()
+    p.close()
+    assert p.free_count() == 4 and p.active_count() == 0

@@ -167,6 +167,72 @@ def test_batcher_rebuild_reports_lost():
     assert b._active == [1, 1]
 
 
+def test_batcher_aging_rescues_starved_big_prompt():
+    """ISSUE 14 starvation fix: an over-budget prompt requeued-at-head
+    every step used to be bypassed indefinitely by smaller admissions.
+    After HOROVOD_SERVE_MAX_DEFERRALS deferrals it turns urgent —
+    bypasses the token budget and reserves the step (barrier) — so it
+    lands as soon as a slot frees."""
+    q = RequestQueue(maxsize=256, registry=MetricsRegistry(0))
+    adm = _AdmitAll()
+    b = ContinuousBatcher(1, slots_per_replica=2, token_budget=10,
+                          max_deferrals=3)
+    huge = q.submit([9] * 40, 4)         # 40 prefill tokens >> budget
+    admitted_at = None
+    for step in range(12):
+        for _ in range(2):
+            q.submit([1] * 3, 2)         # relentless small-prompt stream
+        plan, _ = b.assemble(step, q, adm)
+        for a in plan.assign:            # everything finishes instantly
+            b.note_done(a.rid)
+        if any(a.rid == huge for a in plan.assign):
+            admitted_at = step
+            break
+    # Deferred steps 0..2 (budget), urgent at step 3: admitted there.
+    assert admitted_at is not None and admitted_at <= 4, admitted_at
+
+
+def test_batcher_urgent_barrier_reserves_the_step():
+    """While an urgent prompt still lacks a slot, nothing behind it is
+    admitted — smaller requests cannot keep stealing the capacity it
+    is waiting for."""
+    q = RequestQueue(maxsize=64, registry=MetricsRegistry(0))
+    adm = _AdmitAll()
+    b = ContinuousBatcher(1, slots_per_replica=1, token_budget=10,
+                          max_deferrals=0)   # urgent immediately
+    q.submit([9] * 40, 4)                    # needs the (occupied) slot
+    q.submit([1] * 2, 2)
+    blocker = q.submit([1] * 2, 2)
+    del blocker
+    # Occupy the only slot so even the urgent prompt cannot land.
+    b.inflight[99] = 0
+    b._active = [1]
+    plan, _ = b.assemble(0, q, adm)
+    assert plan.assign == []                 # barrier held everything
+    b.note_done(99)
+    plan, _ = b.assemble(1, q, adm)
+    assert [a.tokens[0] for a in plan.assign] == [9]   # urgent first
+
+
+def test_batcher_block_capacity_defers_admissions():
+    """Paged mode: the batcher mirrors each replica's block pool and
+    defers admissions whose worst-case reservation (prompt + max_new,
+    + 1 block COW headroom) would not fit — reserve-at-admission is
+    what makes mid-decode pool exhaustion impossible."""
+    q = RequestQueue(maxsize=64, registry=MetricsRegistry(0))
+    adm = _AdmitAll()
+    b = ContinuousBatcher(1, slots_per_replica=8, token_budget=1000,
+                          block_capacity=10, block_tokens=16)
+    for _ in range(4):
+        q.submit([1] * 16, 16)       # ceil(32/16)+1 = 3 blocks each
+    plan, _ = b.assemble(0, q, adm)
+    assert len(plan.assign) == 3 and b._blocks == [9]
+    assert q.depth() == 1            # 4th deferred: 9 + 3 > 10
+    b.note_done(plan.assign[0].rid)
+    plan2, _ = b.assemble(1, q, adm)
+    assert len(plan2.assign) == 1 and b._blocks == [9]
+
+
 # --- admission control ------------------------------------------------------
 def test_admission_verdicts():
     reg = MetricsRegistry(0)
@@ -359,6 +425,137 @@ def test_loadgen_smoke_cli(tmp_path):
     assert "loadgen: report written" in proc.stdout
 
 
+# --- paged KV end to end (single-rank worlds) -------------------------------
+def _solo_world():
+    import horovod_tpu as hvd
+    hvd.shutdown()
+    for var in ("HOROVOD_RANK", "HOROVOD_SIZE"):
+        os.environ.pop(var, None)
+    hvd.init()
+    return hvd
+
+
+class _Recorder:
+    """Capture every completed slot's generated token stream (the
+    completion record only carries counts)."""
+
+    def __init__(self):
+        self.streams = {}
+
+    def install(self, ex):
+        orig = ex._collect_completions
+
+        def wrapped():
+            for s in ex.slots:
+                if s is not None and s.pending is None \
+                        and s.remaining == 0:
+                    self.streams[s.rid] = list(s.generated)
+            orig()
+        ex._collect_completions = wrapped
+
+
+def _paged_cfg(**kw):
+    from horovod_tpu.serving import ServeConfig
+    base = dict(max_batch=2, token_budget=64, max_seq=64,
+                slo_ms=60000.0, block_tokens=8)
+    base.update(kw)
+    return ServeConfig.from_env(**base)
+
+
+def test_paged_serve_parity_prefix_hits_and_refcount_census():
+    """ISSUE 14 acceptance (tier-1 half): for an identical admitted
+    stream, paged decode produces token-for-token the dense output;
+    repeated prompts hit the prefix cache (refcount bumps instead of
+    re-prefill, COW on the first divergent write); and after the drain
+    the pool's active count is ZERO — the refcount-leak census."""
+    import random
+
+    from horovod_tpu.serving import ReplicaExecutor
+
+    streams = {}
+    for paged in (False, True):
+        hvd = _solo_world()
+        ex = ReplicaExecutor(_paged_cfg(paged=paged))
+        rec = _Recorder()
+        rec.install(ex)
+        rng = random.Random(7)
+        prompts = [[rng.randrange(2, 256)
+                    for _ in range(rng.randint(2, 12))]
+                   for _ in range(4)]
+        n = 12
+        for i in range(n):
+            ex.stats["offered"] += 1
+            assert ex.queue.submit(prompts[i % 4], 6) is not None
+        ex.serve_loop(stop_when=lambda: True)
+        assert ex.stats["served"] == n
+        if paged:
+            kv = ex.kv_stats()
+            assert kv["active"] == 0, kv          # refcount census
+            assert kv["prefix_hits"] > 0, kv      # repeated prompts hit
+            assert kv["cow_copies"] > 0, kv       # shared tails COWed
+            assert kv["prefill_skipped"] > 0, kv  # full hits skip prefill
+            assert kv["max_concurrent_seqs"] > ex.cfg.max_batch
+        streams[paged] = dict(rec.streams)
+        ex.close()
+        hvd.shutdown()
+    assert streams[False] == streams[True]        # bitwise token parity
+
+
+def test_paged_eviction_then_readmission_stays_correct():
+    """Cached prefix blocks evicted under pool pressure must not change
+    behavior: a re-admitted prompt misses, re-prefills fresh and
+    reproduces its original generation exactly."""
+    import random
+
+    from horovod_tpu.serving import ReplicaExecutor
+
+    hvd = _solo_world()
+    # Tiny pool: 2 in-flight sequences fit, but waves of distinct
+    # prompts force LRU eviction of the cached ones.
+    ex = ReplicaExecutor(_paged_cfg(paged=True, paged_slots=2,
+                                    pool_blocks=8))
+    rec = _Recorder()
+    rec.install(ex)
+    rng = random.Random(11)
+    prompts = [[rng.randrange(2, 256) for _ in range(9)]
+               for _ in range(4)]
+    rid_prompt = {}
+    for wave in (0, 1):
+        for p in prompts:
+            ex.stats["offered"] += 1
+            rid = ex.queue.submit(p, 6)
+            assert rid is not None
+            rid_prompt[rid] = tuple(p)
+        ex._stop_requested = False
+        ex.serve_loop(stop_when=lambda: True)
+    kv = ex.kv_stats()
+    assert ex.stats["served"] == 8
+    assert kv["evictions"] > 0, kv               # pressure really evicted
+    assert kv["active"] == 0, kv
+    # Re-admissions (same prompt, wave 2) reproduced wave-1 streams.
+    by_prompt = {}
+    for rid, stream in sorted(rec.streams.items()):
+        by_prompt.setdefault(rid_prompt[rid], []).append(stream)
+    for p, gens in by_prompt.items():
+        assert len(gens) == 2 and gens[0] == gens[1], p
+    ex.close()
+    hvd.shutdown()
+
+
+def test_loadgen_paged_report_carries_kv_section(tmp_path, monkeypatch):
+    monkeypatch.setenv("HOROVOD_SERVE_PAGED", "1")
+    report, _ = _run_loadgen_inproc(tmp_path, [
+        "--requests", "12", "--duration", "3", "--rate", "50",
+        "--max-new-tokens", "4", "--prompt-tokens", "6",
+        "--prompt-pool", "3"])
+    assert report["served"] == 12
+    kv = report["kv"]
+    assert kv is not None and kv["active"] == 0
+    assert kv["prefix_hits"] > 0                 # repeated-prompt pool
+    assert report["max_concurrent_seqs"] >= 1
+    assert report["config"]["paged"] is True
+
+
 # --- the 4-rank chaos acceptance battery ------------------------------------
 def test_serving_chaos_shrink_4rank():
     """ISSUE 9 acceptance: chaos SIGKILLs rank 2 mid-serve (global
@@ -371,3 +568,27 @@ def test_serving_chaos_shrink_4rank():
                          expected_rcs={2: -signal.SIGKILL})
     assert "shrink at step" in outputs[0], outputs[0]
     assert "shed at admission" in outputs[0], outputs[0]
+
+
+def test_serving_paged_chaos_shrink_4rank():
+    """ISSUE 14 acceptance: the paged-KV serving plane rides the same
+    4->3 chaos shrink — block tables resynced from ground truth, zero
+    failed admitted requests on survivors, prefix-cache hits under
+    repeated prompts, and every survivor's pool passes the
+    refcount-leak census after the drain."""
+    outputs = _run_world(4, "serving_paged", timeout=360.0,
+                         expected_rcs={2: -signal.SIGKILL})
+    assert "shrink at step" in outputs[0], outputs[0]
+    for r in (0, 1, 3):
+        assert "kv census clean" in outputs[r], outputs[r]
+
+
+def test_serving_disagg_prefill_decode_2rank():
+    """ISSUE 14 disaggregation: rank 1 prefill-only, rank 0 decode;
+    long prompts land on the decode replica via streamed KV blocks
+    (zero local fallbacks) under the STRICT collective fingerprint —
+    the split-role step loop provably never diverges on a
+    collective."""
+    outputs = _run_world(2, "serving_disagg", timeout=240.0)
+    assert "served via streamed prefill" in outputs[0], outputs[0]
+    assert "rank 1 streamed" in outputs[1], outputs[1]

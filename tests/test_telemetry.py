@@ -132,6 +132,16 @@ def test_prometheus_exposition_golden_file():
     reg.counter("horovod_statesync_bytes_total",
                 labels={"role": "joiner"}).inc(4096)
     reg.gauge("horovod_world_size", "Live world size").set(4)
+    for state, n in (("free", 24), ("active", 6), ("cached", 2)):
+        reg.gauge("horovod_serve_kv_blocks", "Paged KV blocks by state",
+                  labels={"state": state}).set(n)
+    reg.counter("horovod_serve_prefix_hits_total",
+                "Prompt blocks served from the prefix cache").inc(5)
+    reg.counter("horovod_serve_prefix_misses_total",
+                "Prompt blocks prefilled fresh").inc(3)
+    reg.counter("horovod_serve_prefill_stream_bytes_total",
+                "KV bytes streamed prefill->decode",
+                labels={"role": "sent"}).inc(8192)
     reg.counter("hvd_test_bytes_total", "Bytes moved",
                 labels={"peer": "1"}).inc(2048)
     reg.counter("hvd_test_bytes_total", labels={"peer": "2"}).inc(1024)

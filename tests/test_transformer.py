@@ -187,6 +187,118 @@ class TestIncrementalDecode:
                                        np.asarray(full1[0, 5 + j]),
                                        atol=2e-3, rtol=2e-3)
 
+    def test_paged_decode_matches_dense_decode_uneven_depths(self):
+        """ISSUE 14 parity: the paged block-pool decode path (scatter
+        writes through block tables + table-indexed gather) must stay
+        on the dense decode path's trajectory — same right-padded
+        shared prefill, each row at its own depth."""
+        import dataclasses
+
+        from horovod_tpu.models import transformer as tfm
+        cfg = gpt_tiny(dtype=jnp.float32, max_seq_len=64)
+        full_model = TransformerLM(cfg)
+        dmodel = TransformerLM(dataclasses.replace(cfg, decode=True))
+        pmodel = TransformerLM(dataclasses.replace(
+            cfg, decode=True, paged=True, kv_pool_blocks=16,
+            kv_block_tokens=8))
+        toks = jax.random.randint(jax.random.key(3), (2, 12), 0, 256)
+        variables = full_model.init(jax.random.key(0), toks)
+
+        lens = jnp.array([5, 9], jnp.int32)
+        padded = np.asarray(toks).copy()
+        padded[0, 5:] = 0
+        padded[1, 9:] = 0
+        dlogits, dcache = tfm.prefill(dmodel, variables,
+                                      jnp.asarray(padded), lengths=lens)
+        # Paged: disjoint block runs per row (8 tokens/block, 8 blocks
+        # of table width = 64 positions = max_seq_len, so the gathered
+        # attention length matches the dense path exactly).
+        tables = jnp.array([[0, 1, 2, 3, 4, 5, 6, 7],
+                            [8, 9, 10, 11, 12, 13, 14, 15]], jnp.int32)
+        _, mut = pmodel.apply(variables, jnp.zeros((2, 1), jnp.int32),
+                              block_tables=tables,
+                              cursors=jnp.zeros(2, jnp.int32),
+                              mutable=["cache"])
+        from flax.core import unfreeze
+        pcache = unfreeze(mut["cache"])
+        plogits, pcache = tfm.paged_apply(
+            pmodel, variables, pcache, jnp.asarray(padded), tables,
+            jnp.zeros(2, jnp.int32), lengths=lens)
+        np.testing.assert_allclose(np.asarray(plogits[0, :5]),
+                                   np.asarray(dlogits[0, :5]),
+                                   atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(plogits[1, :9]),
+                                   np.asarray(dlogits[1, :9]),
+                                   atol=1e-5, rtol=1e-5)
+        cur = np.array([5, 9], np.int32)
+        for _ in range(3):
+            step = jnp.asarray(np.stack([
+                np.asarray(toks)[0, cur[0]],
+                np.asarray(toks)[1, cur[1]]])[:, None])
+            dl, dcache = tfm.decode_step(dmodel, variables, dcache, step)
+            pl, pcache = tfm.paged_apply(pmodel, variables, pcache,
+                                         step, tables,
+                                         jnp.asarray(cur))
+            np.testing.assert_allclose(np.asarray(pl), np.asarray(dl),
+                                       atol=1e-5, rtol=1e-5)
+            cur += 1
+
+    def test_paged_cow_divergence_isolates_sequences(self):
+        """Two rows share a prompt's physical blocks (the prefix-cache
+        posture); before row 1 writes into the partial tail it gets a
+        private copy (paged_copy_block — the tensor half of the pool's
+        COW).  Both rows then decode DIFFERENT continuations and each
+        must match its own dense-path trajectory: the copy isolates
+        them, the shared full block stays intact."""
+        import dataclasses
+
+        from horovod_tpu.models import transformer as tfm
+        cfg = gpt_tiny(dtype=jnp.float32, max_seq_len=64)
+        full_model = TransformerLM(cfg)
+        dmodel = TransformerLM(dataclasses.replace(cfg, decode=True))
+        pmodel = TransformerLM(dataclasses.replace(
+            cfg, decode=True, paged=True, kv_pool_blocks=16,
+            kv_block_tokens=8))
+        prompt = jax.random.randint(jax.random.key(7), (1, 12), 0, 256)
+        both = jnp.concatenate([prompt, prompt])        # [2,12]
+        variables = full_model.init(jax.random.key(0), both)
+
+        # Dense reference: batch of two identical prompts, decoded with
+        # diverging continuations.
+        lens = jnp.array([12, 12], jnp.int32)
+        _, dcache = tfm.prefill(dmodel, variables, both, lengths=lens)
+
+        # Paged: prefill ONCE into blocks [0 (full), 1 (tail)], then
+        # share them — row 0 keeps [0, 1], row 1 COWs the tail to
+        # block 5 and keeps the full block shared.
+        tables0 = jnp.array([[0, 1, 2, 3, 15, 15, 15, 15],
+                             [0, 5, 6, 7, 15, 15, 15, 15]], jnp.int32)
+        _, mut = pmodel.apply(variables, jnp.zeros((2, 1), jnp.int32),
+                              block_tables=tables0,
+                              cursors=jnp.zeros(2, jnp.int32),
+                              mutable=["cache"])
+        from flax.core import unfreeze
+        pcache = unfreeze(mut["cache"])
+        # Prefill only row 0's blocks (row 1 masked out via lengths=0).
+        plogits, pcache = tfm.paged_apply(
+            pmodel, variables, pcache, both,
+            jnp.array([[0, 1, 2, 3, 15, 15, 15, 15]] * 2, jnp.int32),
+            jnp.zeros(2, jnp.int32), lengths=jnp.array([12, 0]))
+        # COW the partial tail (block 1 -> block 5) for row 1.
+        pcache = tfm.paged_copy_block(pcache, 1, 5)
+        cont = np.array([[3, 9, 4], [200, 17, 66]], np.int32)
+        cur = np.array([12, 12], np.int32)
+        for j in range(3):
+            step = jnp.asarray(cont[:, j][:, None])
+            dl, dcache = tfm.decode_step(dmodel, variables, dcache,
+                                         step)
+            pl, pcache = tfm.paged_apply(pmodel, variables, pcache,
+                                         step, tables0,
+                                         jnp.asarray(cur))
+            np.testing.assert_allclose(np.asarray(pl), np.asarray(dl),
+                                       atol=1e-5, rtol=1e-5)
+            cur += 1
+
     def test_decode_rejects_sequence_parallel(self):
         import dataclasses
 
