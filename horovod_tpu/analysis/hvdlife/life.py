@@ -95,6 +95,12 @@ _CHANNEL_CTORS = frozenset({
     "PeerMesh", "_PeerChannel", "ShmWorld", "MetricsExporter",
     "RendezvousServer", "ThreadingHTTPServer", "HTTPServer",
     "KVBlockPool", "KVStreamMesh",
+    # Rendezvous control plane (ISSUE 15): the WAL writer owns an fd +
+    # the group-commit fsync lane, the replicator owns the log-tail
+    # thread, the ControlPlane owns all three lease/tail/wal resources
+    # — each must have a close reachable from a teardown root or it
+    # leaks one fd + threads per elastic reinit cycle (HVD702/704).
+    "WalWriter", "Replicator", "ControlPlane",
 })
 
 _KIND_RULE = {
@@ -188,6 +194,23 @@ LIFECYCLE_ALLOWED: dict[str, str] = {
     "runner.run_api.run":
         "per-host remote-dispatch threads are joined inline by the "
         "same call (foreground fan-out, not background machinery)",
+    "resilience.chaos.ChaosEngine._fire_coord":
+        "the coordpause SIGCONT Timer is fire-and-forget by design: "
+        "it must deliver the resume even if the injecting rank's "
+        "engine (or the collective that fired the action) is torn "
+        "down first — cancelling it at teardown would leave the "
+        "rendezvous primary SIGSTOPped forever",
+    "runner.launch.start_rendezvous":
+        "ownership transfer by return value: the replica-set handles "
+        "are returned as a LIST to the launch path (launch_static / "
+        "launch_elastic), whose teardown stops every server in its "
+        "finally block — the list shape is what the lexical "
+        "returned-local transfer rule cannot see",
+    "runner.controlplane._main":
+        "the replica CLI's SIGTERM handler is process-lifetime: the "
+        "process IS the replica (the chaos coordkill/coordpause "
+        "target), and the handler's stop-event set is the orderly "
+        "shutdown path until exit",
 }
 
 

@@ -15,8 +15,8 @@ import json
 import sys
 import time
 
-from .machines import (MUTATIONS, GrowModel, PreemptModel, ShrinkModel,
-                       ToyTornModel)
+from .machines import (MUTATIONS, FailoverModel, GrowModel, PreemptModel,
+                       ShrinkModel, ToyTornModel)
 from .model import explore, render_trace
 
 __all__ = ["main"]
@@ -25,6 +25,7 @@ PROTOCOLS = {
     "grow": GrowModel,
     "preempt": PreemptModel,
     "shrink": ShrinkModel,
+    "failover": FailoverModel,
     "toy": ToyTornModel,
 }
 
@@ -82,7 +83,7 @@ def main(argv=None) -> int:
     if not args.check_tree or args.protocol != "all" or args.mutate:
         # "all" = the real protocols; the deliberately broken toy model
         # (golden-counterexample fixture) only runs when named.
-        names = ("grow", "preempt", "shrink") \
+        names = ("grow", "preempt", "shrink", "failover") \
             if args.protocol == "all" else (args.protocol,)
         if args.check_tree and args.protocol == "all" \
                 and not args.mutate:

@@ -36,9 +36,11 @@ __all__ = ["all_specs", "check_spec_conformance", "check_tree"]
 def all_specs():
     """The registered protocol specs (order is report order)."""
     from ...resilience.specs import shrink_spec
+    from ...runner.specs import failover_spec
     from ...statesync.specs import grow_spec, preempt_spec, stream_spec
 
-    return (grow_spec(), stream_spec(), preempt_spec(), shrink_spec())
+    return (grow_spec(), stream_spec(), preempt_spec(), shrink_spec(),
+            failover_spec())
 
 
 def _module_of(program, funckey: str):

@@ -27,16 +27,21 @@ the checker bites:
 - ``drop-torn-reject`` — the joiner commits a round even when donor
   stamps disagree (kills the ``stamps-unanimous`` guard);
 - ``early-ready-ack`` — the joiner posts ``ready`` before the bulk
-  image digest-verifies (kills the ``ready-after-verify`` guard).
+  image digest-verifies (kills the ``ready-after-verify`` guard);
+- ``accept-stale-lease`` — a rendezvous primary resumed after a lease
+  lapse keeps serving without re-reading the log (kills the
+  ``epoch-fence`` guard): the checker answers with a two-leaders +
+  lost-committed-write counterexample (FailoverModel).
 """
 from __future__ import annotations
 
 from .model import Model
 
-__all__ = ["GrowModel", "MUTATIONS", "PreemptModel", "ShrinkModel",
-           "ToyTornModel", "toy_spec"]
+__all__ = ["FailoverModel", "GrowModel", "MUTATIONS", "PreemptModel",
+           "ShrinkModel", "ToyTornModel", "toy_spec"]
 
-MUTATIONS = ("drop-torn-reject", "early-ready-ack")
+MUTATIONS = ("drop-torn-reject", "early-ready-ack",
+             "accept-stale-lease")
 
 _SEQ_CAP = 4
 
@@ -567,6 +572,153 @@ class ShrinkModel(Model):
             out.append(("world", ("sur.resync",),
                         st(ranks=nr, done=True)))
         return out
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous leader failover: N replicas + one client (runner/specs.py)
+# ---------------------------------------------------------------------------
+# Replica: (role, epoch)  role P=leading Z=paused S=tailing C=candidate
+#          D=dead; epoch = the reign the replica believes is current.
+# log: current epoch (the last leader record's epoch).
+# writes: per acked write (epoch_at_append, log_epoch_at_append) — a
+#         write is LOST when a later replay fences it (appended with an
+#         epoch older than the log's reigning epoch at append time).
+# client: (target replica, acked count).
+# faults: (kill, pause) budgets.
+class FailoverModel(Model):
+    name = "rendezvous-failover"
+
+    _WRITES = 2                    # client is done after 2 acked writes
+    _EPOCH_CAP = 6
+
+    def __init__(self, ranks: int = 3, mutations=(), *,
+                 faults: bool = True) -> None:
+        from ...runner.specs import failover_spec
+
+        self.n = max(2, int(ranks))
+        self.mutations = frozenset(mutations)
+        unknown = self.mutations - set(MUTATIONS)
+        if unknown:
+            raise ValueError(f"unknown mutation(s) {sorted(unknown)}; "
+                             f"known: {list(MUTATIONS)}")
+        self.spec = (failover_spec(),)
+        self._budget = (1, 1) if faults else (0, 0)
+
+    def initial(self):
+        replicas = (("P", 1),) + tuple(
+            ("S", 1) for _ in range(self.n - 1))
+        return (replicas, 1, (), (0, 0), self._budget)
+
+    def describe(self, state) -> str:
+        replicas, log_epoch, writes, client, faults = state
+        rs = " ".join(f"r{i}:{role}e{ep}"
+                      for i, (role, ep) in enumerate(replicas))
+        ws = " ".join(f"w{i}@e{we}/log{ce}"
+                      for i, (we, ce) in enumerate(writes))
+        return (f"log=e{log_epoch} [{rs}] client->r{client[0]} "
+                f"acked={client[1]}{f' [{ws}]' if ws else ''}")
+
+    def _leaders(self, replicas):
+        return [i for i, (role, _ep) in enumerate(replicas)
+                if role == "P"]
+
+    def invariants(self, state):
+        replicas, log_epoch, writes, client, faults = state
+        out = []
+        if len(self._leaders(replicas)) > 1:
+            out.append("two-leaders")
+        if any(we < ce for we, ce in writes):
+            out.append("committed-write-lost")
+        return out
+
+    def is_terminal(self, state) -> bool:
+        _replicas, _log, _writes, client, _faults = state
+        return client[1] >= self._WRITES
+
+    def resolved(self, state) -> bool:
+        # clients-converge: every reachable state must keep a path to
+        # all-writes-acked (the AG EF half of the property set).
+        return self.is_terminal(state)
+
+    def successors(self, state):
+        replicas, log_epoch, writes, client, faults = state
+        if self.is_terminal(state):
+            return []
+        target, acked = client
+        kill_left, pause_left = faults
+        out = []
+        leaders = self._leaders(replicas)
+
+        def st(replicas=replicas, log_epoch=log_epoch, writes=writes,
+               client=client, faults=faults):
+            return (replicas, log_epoch, writes, client, faults)
+
+        # -- replica faults + lease machinery ---------------------------
+        for i, (role, ep) in enumerate(replicas):
+            if role == "P":
+                if pause_left > 0:
+                    out.append(("net", ("pri.pause",),
+                                st(replicas=_repl(replicas, i,
+                                                  ("Z", ep)),
+                                   faults=(kill_left, pause_left - 1))))
+                if kill_left > 0:
+                    out.append(("net", ("pri.die",),
+                                st(replicas=_repl(replicas, i,
+                                                  ("D", ep)),
+                                   faults=(kill_left - 1, pause_left))))
+            elif role == "Z":
+                if "accept-stale-lease" in self.mutations:
+                    # MUTATED: resume serving without re-reading the
+                    # log — the stale reign survives a promotion.
+                    out.append((i, ("pri.resume-reclaim",),
+                                st(replicas=_repl(replicas, i,
+                                                  ("P", ep)))))
+                elif log_epoch > ep:
+                    out.append((i, ("pri.resume-fenced",),
+                                st(replicas=_repl(replicas, i,
+                                                  ("S", log_epoch)))))
+                else:
+                    e2 = min(log_epoch + 1, self._EPOCH_CAP)
+                    out.append((i, ("pri.resume-reclaim",),
+                                st(replicas=_repl(replicas, i,
+                                                  ("P", e2)),
+                                   log_epoch=e2)))
+            elif role == "S":
+                if not leaders:
+                    out.append((i, ("sb.lapse",),
+                                st(replicas=_repl(replicas, i,
+                                                  ("C", ep)))))
+            elif role == "C":
+                if ep < log_epoch:
+                    out.append((i, ("sb.lose",),
+                                st(replicas=_repl(replicas, i,
+                                                  ("S", log_epoch)))))
+                elif not leaders:
+                    e2 = min(log_epoch + 1, self._EPOCH_CAP)
+                    out.append((i, ("sb.promote",),
+                                st(replicas=_repl(replicas, i,
+                                                  ("P", e2)),
+                                   log_epoch=e2)))
+
+        # -- client ------------------------------------------------------
+        t_role, t_ep = replicas[target]
+        if t_role == "P":
+            out.append(("client", ("cli.write", "pri.commit"),
+                        st(writes=writes + ((t_ep, log_epoch),),
+                           client=(target, acked + 1))))
+        else:
+            nxt = (target + 1) % self.n
+            tids = ["cli.failover"]
+            if replicas[nxt][0] == "P":
+                tids.append("cli.converge")
+            out.append(("client", tuple(tids),
+                        st(client=(nxt, acked))))
+        return out
+
+    def actor_label(self, actor):
+        if actor == "client":
+            return "client"
+        return super().actor_label(actor)
 
 
 # ---------------------------------------------------------------------------

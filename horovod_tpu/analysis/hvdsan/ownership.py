@@ -165,6 +165,20 @@ LOCK_HOLD_ALLOWED: dict[str, str] = {
         "register_worker RPC under it happens once at worker start, "
         "bounded by the RPC connect timeout, before any listener can "
         "contend",
+    "runner.controlplane.ControlPlane._lock":
+        "the election critical section BY DESIGN: promotion/demotion "
+        "re-reads the durable WAL and appends the leader record under "
+        "it so role flips are serialized against the write fence; the "
+        "file I/O is local and bounded (no network inside the lock — "
+        "the urlopen the index fallback attributes here is the tail "
+        "thread's, which never takes this lock)",
+    "runner.network.RendezvousServer._httpd.kv_lock":
+        "KV commit ordering: the WAL enqueue (non-blocking put on the "
+        "group-commit lane) and the in-memory apply happen under one "
+        "hold so log order equals apply order; the fsync wait happens "
+        "on the commit event AFTER release, and the long-poll "
+        "Condition wait on kv_cond releases the lock by construction "
+        "(condition idiom)",
 }
 
 
@@ -204,6 +218,30 @@ THREAD_ROOTS: dict[str, tuple[str, str]] = {
         "snapshot over the dedicated sync mesh until BYE or the round "
         "deadline; reaped by StateSyncService._reap_donors at the next "
         "boundary/close"),
+    # Rendezvous control plane (ISSUE 15): replica-id-suffixed names
+    # the static Thread(target=, name=) scan cannot bind (f-strings).
+    "hvd-rdzv-wal-*": (
+        "runner.controlplane.WalWriter._run",
+        "group-commit fsync lane of the rendezvous WAL: drains queued "
+        "records, one fsync per batch, sets commit events; poisoned + "
+        "joined by WalWriter.close (reachable from "
+        "RendezvousServer.stop)"),
+    "hvd-rdzv-tail-*": (
+        "runner.controlplane.Replicator._run",
+        "standby log-tail replicator: long-polls the primary's "
+        "/.ctl/wal and mirrors records; stopped + joined by "
+        "Replicator.close"),
+    "hvd-rdzv-lease-*": (
+        "runner.controlplane.ControlPlane._lease_loop",
+        "lease monitor: renews the leader lease (primary) or watches "
+        "for lapse and runs the election (standby); stopped + joined "
+        "by ControlPlane.close"),
+    "hvd-chaos-cont": (
+        "resilience.chaos._sigcont",
+        "coordpause resume Timer: delivers SIGCONT to the paused "
+        "rendezvous primary after the configured pause; fire-and-"
+        "forget by design (the process under test may outlive the "
+        "engine)"),
 }
 
 

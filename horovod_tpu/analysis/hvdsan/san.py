@@ -54,6 +54,14 @@ _DEFAULT_WIRE_PRIMS = frozenset({
 _ENC_METHODS = ("encode", "to_bytes")
 _DEC_METHODS = ("decode", "from_bytes")
 
+# Optional-field prefixes that MUST sit behind a negotiated feature-bit
+# gate (`if features & FEATURE_X:`) on both codec sides — the
+# compile-time half of the versioned wire handshake: an optional field
+# encoded unconditionally breaks every peer that negotiated the bit
+# away.  Mirrors common/wire.py OPTIONAL_FIELD_FEATURES (tests assert
+# the two tables agree).
+_OPTIONAL_WIRE_PREFIXES = ("fp_", "tm_", "trace_")
+
 
 def collect_wire_method(program, mod, cls, node) -> None:
     """Extract the ordered primitive-call sequence of one encode/decode
@@ -67,8 +75,26 @@ def collect_wire_method(program, mod, cls, node) -> None:
     program.wire_codecs.append({
         "module": mod.label, "cls": cls.name, "path": mod.path,
         "method": node.name, "line": node.lineno, "side": side,
-        "tokens": tokens,
+        "tokens": tokens, "gated": _feature_gated_spans(node),
     })
+
+
+def _feature_gated_spans(node) -> tuple:
+    """Line spans of ``if`` bodies whose test consults the negotiated
+    ``features`` word — primitive calls inside them are feature-gated
+    (the HVD505 optional-field check)."""
+    spans = []
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.If):
+            continue
+        gated = any(
+            isinstance(t, (ast.Name, ast.Attribute)) and
+            "feature" in (t.id if isinstance(t, ast.Name)
+                          else t.attr).lower()
+            for t in ast.walk(sub.test))
+        if gated:
+            spans.append((sub.lineno, sub.end_lineno or sub.lineno))
+    return tuple(spans)
 
 
 def note_wire_class(program, mod, cls_node) -> None:
@@ -318,6 +344,23 @@ def check_wire_drift(analysis: Analysis) -> None:
                         f"'{prim}' not defined by both Encoder and "
                         f"Decoder in common/wire.py — the peer cannot "
                         f"decode what this side writes")
+            # Optional-field feature-bit gate (the compile-time half of
+            # the versioned HELLO handshake): every fp_*/tm_*/trace_*
+            # field must encode/decode inside an `if features & ...:`
+            # arm, or a peer that negotiated the bit away desyncs.
+            for prim, field, line in toks:
+                if not field or \
+                        not field.startswith(_OPTIONAL_WIRE_PREFIXES):
+                    continue
+                if not any(s <= line <= e for s, e in rec["gated"]):
+                    analysis._emit(
+                        "wire-schema-drift", "error", rec["path"], line,
+                        f"{cls}.{rec['method']} carries optional wire "
+                        f"field '{field}' outside a feature-bit gate "
+                        f"(`if features & FEATURE_...:`) — a peer that "
+                        f"negotiated the bit away cannot skip it; gate "
+                        f"the field on its OPTIONAL_FIELD_FEATURES bit "
+                        f"(common/wire.py)")
         n = min(len(et), len(dt))
         for i in range(n):
             ep, ef, eline = et[i]

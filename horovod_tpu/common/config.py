@@ -536,6 +536,29 @@ RENDEZVOUS_ADDR = register(
     "Rendezvous KV-store host (control plane over DCN).")
 RENDEZVOUS_PORT = register(
     "HOROVOD_GLOO_RENDEZVOUS_PORT", -1, int, "Rendezvous KV-store port.")
+RENDEZVOUS_REPLICAS = register(
+    "HOROVOD_RENDEZVOUS_REPLICAS", 0, int,
+    "Standby rendezvous replicas launched next to the primary (0 = the "
+    "single-server control plane); requires HOROVOD_RENDEZVOUS_WAL_DIR. "
+    "Standbys tail the primary's WAL and promote on lease lapse "
+    "(docs/controlplane.md).")
+RENDEZVOUS_LEASE_MS = register(
+    "HOROVOD_RENDEZVOUS_LEASE_MS", 3000.0, float,
+    "Rendezvous leader lease in milliseconds: the primary renews every "
+    "third of it, a standby promotes after ~2x of silence, and a "
+    "primary whose lease lapsed must re-verify the log (epoch fence) "
+    "before accepting another write.")
+RENDEZVOUS_WAL_DIR = register(
+    "HOROVOD_RENDEZVOUS_WAL_DIR", "", str,
+    "Directory of the rendezvous write-ahead log (shared by the "
+    "replica set).  Empty = no WAL: the KV is in-memory only and does "
+    "not survive coordinator death.")
+PROTO_COMPAT = register(
+    "HOROVOD_PROTO_COMPAT", 0, int,
+    "Advertise this wire protocol version (masking newer feature bits) "
+    "at every channel HELLO instead of the build's native version; 0 = "
+    "native.  The rolling-upgrade lever: peers negotiate the min "
+    "common schema per mesh.")
 CONTROLLER = register(
     "HOROVOD_CONTROLLER", "local", str,
     "Controller plane: local (in-process) | tcp (multi-process rendezvous).")

@@ -18,7 +18,8 @@ import enum
 from dataclasses import dataclass, field
 
 from .dtypes import DataType
-from .wire import Decoder, Encoder
+from .wire import (FEATURE_FINGERPRINT, FEATURE_TELEMETRY, FEATURE_TRACE,
+                   FEATURES_ALL, Decoder, Encoder)
 
 
 class RequestType(enum.IntEnum):
@@ -125,36 +126,51 @@ class RequestList:
     tm_sync_wait_ms: float = 0.0
     tm_queue_depth: int = 0
 
-    def to_bytes(self) -> bytes:
+    def to_bytes(self, features: int = FEATURES_ALL) -> bytes:
+        """`features` is the mesh-negotiated wire schema (HELLO
+        handshake): every optional field group is gated on its feature
+        bit, symmetrically with :meth:`from_bytes`, so mixed-version
+        worlds exchange only the min common schema."""
         enc = Encoder()
         enc.bool_(self.shutdown)
-        enc.uvarint(self.fp_seq)
-        enc.uvarint(self.fp_digest)
-        enc.uvarint_list(self.fp_tail_seqs)
-        enc.uvarint_list(self.fp_tail_digests)
-        enc.string_list(self.fp_tail_descs)
-        enc.uvarint(self.tm_cycles)
-        enc.f64(self.tm_cycle_ms)
-        enc.f64(self.tm_sync_wait_ms)
-        enc.uvarint(self.tm_queue_depth)
+        if features & FEATURE_FINGERPRINT:
+            enc.uvarint(self.fp_seq)
+            enc.uvarint(self.fp_digest)
+            enc.uvarint_list(self.fp_tail_seqs)
+            enc.uvarint_list(self.fp_tail_digests)
+            enc.string_list(self.fp_tail_descs)
+        if features & FEATURE_TELEMETRY:
+            enc.uvarint(self.tm_cycles)
+            enc.f64(self.tm_cycle_ms)
+            enc.f64(self.tm_sync_wait_ms)
+            enc.uvarint(self.tm_queue_depth)
         enc.uvarint(len(self.requests))
         for r in self.requests:
             r.encode(enc)
         return enc.getvalue()
 
     @classmethod
-    def from_bytes(cls, raw: bytes) -> "RequestList":
+    def from_bytes(cls, raw: bytes,
+                   features: int = FEATURES_ALL) -> "RequestList":
         dec = Decoder(raw)
         shutdown = dec.bool_()
-        fp_seq = dec.uvarint()
-        fp_digest = dec.uvarint()
-        fp_tail_seqs = dec.uvarint_list()
-        fp_tail_digests = dec.uvarint_list()
-        fp_tail_descs = dec.string_list()
-        tm_cycles = dec.uvarint()
-        tm_cycle_ms = dec.f64()
-        tm_sync_wait_ms = dec.f64()
-        tm_queue_depth = dec.uvarint()
+        fp_seq = fp_digest = 0
+        fp_tail_seqs: list[int] = []
+        fp_tail_digests: list[int] = []
+        fp_tail_descs: list[str] = []
+        tm_cycles = tm_queue_depth = 0
+        tm_cycle_ms = tm_sync_wait_ms = 0.0
+        if features & FEATURE_FINGERPRINT:
+            fp_seq = dec.uvarint()
+            fp_digest = dec.uvarint()
+            fp_tail_seqs = dec.uvarint_list()
+            fp_tail_digests = dec.uvarint_list()
+            fp_tail_descs = dec.string_list()
+        if features & FEATURE_TELEMETRY:
+            tm_cycles = dec.uvarint()
+            tm_cycle_ms = dec.f64()
+            tm_sync_wait_ms = dec.f64()
+            tm_queue_depth = dec.uvarint()
         n = dec.uvarint()
         return cls(requests=[Request.decode(dec) for _ in range(n)],
                    shutdown=shutdown, fp_seq=fp_seq, fp_digest=fp_digest,
@@ -199,7 +215,8 @@ class Response:
     trace_cycle: int = -1
     trace_seq: int = -1
 
-    def encode(self, enc: Encoder) -> None:
+    def encode(self, enc: Encoder,
+               features: int = FEATURES_ALL) -> None:
         (enc.uvarint(int(self.response_type))
             .string_list(self.tensor_names)
             .string(self.error_message)
@@ -212,13 +229,15 @@ class Response:
             .svarint(self.root_rank)
             .bool_(self.grouped)
             .uvarint(self.codec)
-            .uvarint(self.codec_block_size)
-            .svarint(self.trace_cycle)
-            .svarint(self.trace_seq))
+            .uvarint(self.codec_block_size))
+        if features & FEATURE_TRACE:
+            enc.svarint(self.trace_cycle)
+            enc.svarint(self.trace_seq)
 
     @classmethod
-    def decode(cls, dec: Decoder) -> "Response":
-        return cls(
+    def decode(cls, dec: Decoder,
+               features: int = FEATURES_ALL) -> "Response":
+        resp = cls(
             response_type=ResponseType(dec.uvarint()),
             tensor_names=dec.string_list(),
             error_message=dec.string(),
@@ -232,9 +251,11 @@ class Response:
             grouped=dec.bool_(),
             codec=dec.uvarint(),
             codec_block_size=dec.uvarint(),
-            trace_cycle=dec.svarint(),
-            trace_seq=dec.svarint(),
         )
+        if features & FEATURE_TRACE:
+            resp.trace_cycle = dec.svarint()
+            resp.trace_seq = dec.svarint()
+        return resp
 
     def trace_id(self) -> str | None:
         """Compact "cycle.seq" form used in Timeline span args and flow
@@ -269,7 +290,7 @@ class ResponseList:
     # cycle (compress/fused.py single-pass legs vs the reference chain).
     tuned_fused: int = -1
 
-    def to_bytes(self) -> bytes:
+    def to_bytes(self, features: int = FEATURES_ALL) -> bytes:
         enc = Encoder()
         enc.bool_(self.shutdown)
         enc.svarint(self.tuned_fusion_threshold)
@@ -280,11 +301,12 @@ class ResponseList:
         enc.svarint(self.tuned_fused)
         enc.uvarint(len(self.responses))
         for r in self.responses:
-            r.encode(enc)
+            r.encode(enc, features)
         return enc.getvalue()
 
     @classmethod
-    def from_bytes(cls, raw: bytes) -> "ResponseList":
+    def from_bytes(cls, raw: bytes,
+                   features: int = FEATURES_ALL) -> "ResponseList":
         dec = Decoder(raw)
         shutdown = dec.bool_()
         threshold = dec.svarint()
@@ -294,7 +316,8 @@ class ResponseList:
         streams = dec.svarint()
         fused = dec.svarint()
         n = dec.uvarint()
-        return cls(responses=[Response.decode(dec) for _ in range(n)],
+        return cls(responses=[Response.decode(dec, features)
+                              for _ in range(n)],
                    shutdown=shutdown,
                    tuned_fusion_threshold=threshold,
                    tuned_cycle_time_ms=cycle,

@@ -103,6 +103,35 @@ class TcpTransport(Transport):
         self.mesh = mesh
         self.rank = mesh.rank
         self.size = mesh.size
+        # Mesh-negotiated wire schema (HELLO handshake at formation):
+        # identical on every rank (min proto / AND of feature bits over
+        # the full mesh), so the coordinator's single encoded payload
+        # decodes on every peer and optional field groups stay
+        # symmetric in a mixed-version world.
+        from .wire import FEATURES_ALL
+        self.features = getattr(mesh, "negotiated_features",
+                                FEATURES_ALL)
+
+    def _mask_unnegotiated(self, request_list: RequestList):
+        """The coordinator's own RequestList never crosses the wire, so
+        its optional field groups survive even when the world
+        negotiated them away — while every peer's decode as zeros.  A
+        strict-mode fingerprint compare would then see rank 0 diverge
+        from everyone.  Mask the un-negotiated groups on the local
+        list too, so all ranks present the identical (absent)
+        schema."""
+        import dataclasses
+
+        from .wire import FEATURE_FINGERPRINT, FEATURE_TELEMETRY
+        kw = {}
+        if not self.features & FEATURE_FINGERPRINT:
+            kw.update(fp_seq=0, fp_digest=0, fp_tail_seqs=[],
+                      fp_tail_digests=[], fp_tail_descs=[])
+        if not self.features & FEATURE_TELEMETRY:
+            kw.update(tm_cycles=0, tm_cycle_ms=0.0,
+                      tm_sync_wait_ms=0.0, tm_queue_depth=0)
+        return dataclasses.replace(request_list, **kw) if kw \
+            else request_list
         # Coordinator-side: monotonic arrival time of each rank's last
         # gathered RequestList (telemetry straggler signal; the controller
         # reads it via getattr so LocalTransport needs no counterpart).
@@ -206,15 +235,15 @@ class TcpTransport(Transport):
             # negotiation tail when one rank lags.  The result stays
             # rank-indexed — arrival order never leaks downstream.
             lists: list[RequestList | None] = [None] * self.size
-            lists[0] = request_list
+            lists[0] = self._mask_unnegotiated(request_list)
             arrivals = {0: time.monotonic()}
             for peer, raw in self._drain_or_poison(
                     self.mesh.recv_in_arrival_order(range(1, self.size))):
                 arrivals[peer] = time.monotonic()
-                lists[peer] = RequestList.from_bytes(raw)
+                lists[peer] = RequestList.from_bytes(raw, self.features)
             self.last_gather_arrivals = arrivals
             return lists
-        self.mesh.send(0, request_list.to_bytes())
+        self.mesh.send(0, request_list.to_bytes(self.features))
         return None
 
     # -- ResponseList broadcast ------------------------------------------
@@ -222,7 +251,7 @@ class TcpTransport(Transport):
         if self.size == 1:
             return response_list
         if self.rank == 0:
-            payload = response_list.to_bytes()
+            payload = response_list.to_bytes(self.features)
             failure: RanksFailedError | None = None
             for peer in range(1, self.size):
                 try:
@@ -237,7 +266,7 @@ class TcpTransport(Transport):
             return response_list
         raw = self.mesh.recv(0)  # hvdlint: disable=unbounded-blocking-wait -- bounded inside the peer channel under fault tolerance; poison frames convert coordinator-detected failures
         check_poison(raw)
-        return ResponseList.from_bytes(raw)
+        return ResponseList.from_bytes(raw, self.features)
 
     def barrier(self) -> None:
         if self.size == 1:

@@ -14,6 +14,69 @@ from __future__ import annotations
 
 import struct
 
+# ---------------------------------------------------------------------------
+# Versioned wire handshake (HELLO{proto_version, feature_bits})
+# ---------------------------------------------------------------------------
+# Exchanged at every channel/mesh establishment (PeerMesh bootstrap, the
+# elastic RPC connect): both sides advertise the highest schema they
+# speak and every encode/decode thereafter is gated on the negotiated
+# min proto / AND of feature bits.  Every OPTIONAL control-plane field
+# group lives behind a feature bit (the hvdsan HVD505 optional-field
+# gate asserts this at lint time), so a world can roll from framework
+# version N to N+1 rank-by-rank: mixed-version peers simply negotiate
+# the old schema until the last rank upgrades.
+PROTO_VERSION = 2
+
+FEATURE_FINGERPRINT = 1 << 0   # RequestList fp_* (collective digests)
+FEATURE_TELEMETRY = 1 << 1     # RequestList tm_* (straggler snapshot)
+FEATURE_TRACE = 1 << 2         # Response trace_* (distributed tracing)
+
+FEATURES_ALL = (FEATURE_FINGERPRINT | FEATURE_TELEMETRY | FEATURE_TRACE)
+
+# Feature bits each protocol version may carry: proto 1 is the base
+# schema with every optional group absent; proto 2 is current.
+PROTO_FEATURE_SETS = {1: 0, 2: FEATURES_ALL}
+
+# Optional-field prefix -> gating feature bit.  The single source of
+# truth both message.py's conditional encode/decode and the HVD505
+# optional-field check key on (tests assert the analyzer's mirror of
+# the prefixes matches this table).
+OPTIONAL_FIELD_FEATURES = {
+    "fp_": FEATURE_FINGERPRINT,
+    "tm_": FEATURE_TELEMETRY,
+    "trace_": FEATURE_TRACE,
+}
+
+HELLO_MAGIC = b"HVDH"
+_HELLO = struct.Struct(">4sHHI")   # magic, proto, reserved, features
+HELLO_LEN = _HELLO.size
+
+
+def proto_features(proto: int) -> int:
+    """Feature bits a given protocol version may advertise."""
+    return PROTO_FEATURE_SETS.get(proto, FEATURES_ALL)
+
+
+def pack_hello(proto: int, features: int) -> bytes:
+    return _HELLO.pack(HELLO_MAGIC, proto, 0, features)
+
+
+def unpack_hello(raw) -> tuple[int, int]:
+    magic, proto, _reserved, features = _HELLO.unpack(bytes(raw))
+    if magic != HELLO_MAGIC:
+        raise ValueError(
+            "peer opened the channel without a HELLO frame (bad magic); "
+            "pre-handshake builds cannot join a versioned world")
+    return proto, features
+
+
+def negotiate(proto_a: int, features_a: int, proto_b: int,
+              features_b: int) -> tuple[int, int]:
+    """Min common schema of two HELLOs: lowest proto, intersected
+    feature bits, masked to what the chosen proto may carry."""
+    proto = min(proto_a, proto_b)
+    return proto, features_a & features_b & proto_features(proto)
+
 
 class Encoder:
     __slots__ = ("_parts",)

@@ -54,6 +54,9 @@ class ElasticDriver:
         self._running: set[tuple[str, int]] = set()
         self._results: dict[str, tuple[int, float]] = {}
         self._workers: dict[tuple[str, int], RpcClient] = {}
+        # Wire proto version each registered worker advertised (rolling-
+        # upgrade observability; see register_worker).
+        self._worker_protos: dict[tuple[str, int], int] = {}
 
         # Autoscale target (statesync/autoscale.py): caps the slots the
         # next round assigns.  None = no cap beyond max_np.
@@ -265,13 +268,36 @@ class ElasticDriver:
     # ------------------------------------------------------------------
     # RPC surface (called by workers through RpcServer)
     # ------------------------------------------------------------------
-    def register_worker(self, host: str, slot: int, port: int) -> None:
-        """Worker announces its notification service endpoint."""
+    def register_worker(self, host: str, slot: int, port: int,
+                        proto: int | None = None) -> None:
+        """Worker announces its notification service endpoint.  `proto`
+        is the wire protocol version the worker speaks (None = a
+        pre-handshake worker): the driver keeps the per-slot table so a
+        rolling upgrade is observable — a mixed-version world logs the
+        lagging slots, and :meth:`worker_protos` feeds the operator
+        view."""
         try:
-            self._workers[(host, slot)] = RpcClient(host, port, self._secret)
+            client = RpcClient(host, port, self._secret)
         except OSError as exc:
             logger.warning("cannot connect to worker %s[%d]: %s",
                            host, slot, exc)
+            return
+        self._workers[(host, slot)] = client
+        self._worker_protos[(host, slot)] = \
+            client.peer_proto if proto is None else int(proto)
+        versions = set(self._worker_protos.values())
+        if len(versions) > 1:
+            lagging = sorted(k for k, v in self._worker_protos.items()
+                             if v == min(versions))
+            logger.warning(
+                "elastic: mixed wire proto versions in the world "
+                "(%s); lagging slots: %s — rolling upgrade in "
+                "progress, collectives run at the min common schema",
+                sorted(versions), lagging)
+
+    def worker_protos(self) -> dict:
+        """{(host, slot): advertised wire proto} of registered workers."""
+        return dict(self._worker_protos)
 
     def record_ready(self, host: str, slot: int) -> None:
         self.registry.record_ready(host, slot)

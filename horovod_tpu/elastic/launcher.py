@@ -11,7 +11,6 @@ from collections import OrderedDict
 
 from ..common.logging import logger
 from ..runner.hosts import SlotInfo, parse_hosts
-from ..runner.network import RendezvousServer
 from ..runner import safe_shell_exec
 from .discovery import FixedHostDiscovery, HostDiscoveryScript
 from .driver import ElasticDriver
@@ -72,14 +71,16 @@ def launch_elastic(args, command: list[str], *,
         reset_limit=getattr(args, "reset_limit", None), secret=secret,
         verbose=bool(getattr(args, "verbose", False)))
 
-    rendezvous = RendezvousServer()
-    rendezvous.start()
+    addr = _driver_address(discovery,
+                           getattr(args, "network_interface", None))
+    from ..runner.launch import start_rendezvous
+    rendezvous_servers, addr_spec, rendezvous_port = \
+        start_rendezvous(addr)
+    rendezvous = rendezvous_servers[0]
     if payload is not None:
         from ..runner.elastic_run_worker import PAYLOAD_SCOPE
         rendezvous.put(PAYLOAD_SCOPE, "blob", payload)
     rpc = RpcServer(driver, secret)
-    addr = _driver_address(discovery,
-                           getattr(args, "network_interface", None))
 
     from ..runner.launch import args_to_env
     base_env = dict(os.environ)
@@ -103,8 +104,8 @@ def launch_elastic(args, command: list[str], *,
             "HOROVOD_ELASTIC": "1",
             "HOROVOD_HOSTNAME": slot.hostname,
             "HOROVOD_LOCAL_RANK": str(slot.local_rank),
-            "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
-            "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rendezvous.port),
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr_spec,
+            "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rendezvous_port),
             DRIVER_ADDR_ENV: addr,
             DRIVER_PORT_ENV: str(rpc.port),
             SECRET_ENV: secret,
@@ -219,4 +220,5 @@ def launch_elastic(args, command: list[str], *,
             return _done(1)
         return _done(0)
     finally:
-        rendezvous.stop()
+        for srv in rendezvous_servers:
+            srv.stop()

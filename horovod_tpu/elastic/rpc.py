@@ -14,8 +14,10 @@ import pickle
 import socket
 import threading
 
+from ..common import wire
 from ..common.logging import logger
-from ..runner.network import recv_msg, send_msg
+from ..runner.network import advertised_hello, recv_exact, recv_msg, \
+    send_msg
 
 _DIGEST = hashlib.sha256
 SECRET_ENV = "HOROVOD_SECRET_KEY"
@@ -74,6 +76,20 @@ class RpcServer:
     def _serve_conn(self, conn: socket.socket) -> None:
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
+            # Versioned handshake: the first bytes on every RPC
+            # connection are a HELLO exchange, so a driver at framework
+            # version N and a worker at N+1 agree on the min common
+            # schema before any pickled call crosses (the rolling-
+            # upgrade boundary lives exactly on this socket).
+            try:
+                peer_proto, peer_feats = wire.unpack_hello(
+                    recv_exact(conn, wire.HELLO_LEN))
+            except (ConnectionError, ValueError) as exc:
+                logger.warning("rpc: connection rejected at HELLO: %s",
+                               exc)
+                return
+            proto, feats = advertised_hello()
+            conn.sendall(wire.pack_hello(proto, feats))
             while True:
                 try:
                     method, args, kwargs = _unpack(self._secret,
@@ -113,6 +129,12 @@ class RpcClient:
     def __init__(self, addr: str, port: int, secret: str,
                  timeout: float = 30.0) -> None:
         self._sock = socket.create_connection((addr, port), timeout=timeout)
+        proto, feats = advertised_hello()
+        self._sock.sendall(wire.pack_hello(proto, feats))
+        self.peer_proto, peer_feats = wire.unpack_hello(
+            recv_exact(self._sock, wire.HELLO_LEN))
+        self.negotiated_proto, self.negotiated_features = wire.negotiate(
+            proto, feats, self.peer_proto, peer_feats)
         # Calls may legitimately block far longer than the connect timeout:
         # get_assignment waits server-side for a rendezvous round (up to the
         # driver's elastic_timeout).  Block until the server answers or the

@@ -60,8 +60,10 @@ class WorkerNotificationManager:
                                      secret)
             hostname = config.HOSTNAME.get() or "localhost"
             local_rank = max(config.LOCAL_RANK.get(), 0)
+            from ..runner.network import advertised_hello
             self._driver.call("register_worker", hostname, local_rank,
-                              self._service.port)
+                              self._service.port,
+                              proto=advertised_hello()[0])
             logger.debug("worker notification service on port %d",
                          self._service.port)
 

@@ -24,6 +24,15 @@ Response-level actions (fired by the background loop before dispatch;
   testable under the same deterministic harness as a kill.  Like every
   spec, ``rank=`` names the LAUNCH-TIME rank, so a survivor renumbered
   by an earlier shrink never inherits another rank's preemption.
+- ``coordkill:at=5[,rank=0]``          — SIGKILL the rendezvous
+  PRIMARY (pid resolved through the client's ``/.ctl/pid`` endpoint)
+  at the global collective index: the coordinator-death shape the
+  replicated control plane's standby promotion must absorb.  Fires
+  from launch rank 0 by default so an N-rank world kills once.
+- ``coordpause:at=5,ms=800[,rank=0]``  — SIGSTOP the rendezvous
+  primary and SIGCONT it ``ms`` later: the lease-lapse-then-return
+  split-brain shape — the resumed primary must fence itself on the
+  WAL's higher leader epoch instead of acking stale writes.
 
 Send-level actions (fired by ``PeerMesh`` at enqueue; ``send=`` is the
 per-(mesh-scope, peer) send index, ``mesh=`` a scope prefix like
@@ -41,19 +50,30 @@ fail/delay/drop/dup, so a retried op runs clean).
 """
 from __future__ import annotations
 
+import os
 import random
 import threading
 
 from ..common import config
 from ..common.logging import logger
 
+
+def _sigcont(pid: int) -> None:
+    """Resume a coordpause victim (fire-and-forget Timer body)."""
+    import signal
+    try:
+        os.kill(pid, signal.SIGCONT)
+    except OSError:
+        pass
+
 __all__ = ["ChaosAction", "ChaosEngine", "ChaosInjectedError", "active",
            "configure", "parse_spec"]
 
-_RESPONSE_KINDS = frozenset({"kill", "freeze", "fail", "preempt"})
+_RESPONSE_KINDS = frozenset({"kill", "freeze", "fail", "preempt",
+                             "coordkill", "coordpause"})
 _SEND_KINDS = frozenset({"delay", "drop", "dup"})
 _DEFAULT_COUNTS = {"fail": 1, "preempt": 1, "delay": 1, "drop": 1,
-                   "dup": 1}
+                   "dup": 1, "coordkill": 1, "coordpause": 1}
 
 
 class ChaosInjectedError(RuntimeError):
@@ -69,8 +89,14 @@ class ChaosAction:
         if kind not in _RESPONSE_KINDS | _SEND_KINDS:
             raise ValueError(f"unknown chaos action kind {kind!r}")
         self.kind = kind
-        self.rank = None if params.get("rank", "*") == "*" \
-            else int(params["rank"])
+        # coordkill/coordpause fire from ONE rank (default launch rank
+        # 0): the victim is the shared coordinator process, and N ranks
+        # each delivering the signal would consume N standby promotions.
+        raw_rank = params.get("rank",
+                              "0" if kind.startswith("coord") else "*")
+        self.rank = None if raw_rank == "*" else int(raw_rank)
+        if "at" in params:              # coord* spelling of the op index
+            params = dict(params, op=params["at"])
         self.op = int(params["op"]) if "op" in params else None
         self.name = params.get("name")
         self.peer = int(params["peer"]) if "peer" in params else None
@@ -154,6 +180,30 @@ def parse_spec(spec: str) -> list[ChaosAction]:
     return actions
 
 
+def _coordinator_pid() -> int | None:
+    """Resolve the rendezvous PRIMARY's pid through the seed list's
+    ``/.ctl/pid`` endpoint (same-host chaos harness contract: the
+    coordinator process must be signalable from this rank)."""
+    from ..common import config as _config
+    from ..runner.network import RendezvousClient
+
+    from urllib import request as urlrequest
+
+    addr = _config.RENDEZVOUS_ADDR.get()
+    port = _config.RENDEZVOUS_PORT.get()
+    if not addr:
+        return None
+    endpoint = RendezvousClient(addr, port, timeout=5.0).find_primary()
+    if endpoint is None:
+        return None
+    try:
+        with urlrequest.urlopen(
+                f"http://{endpoint}/.ctl/pid", timeout=2.0) as resp:
+            return int(resp.read())
+    except (OSError, ValueError):
+        return None
+
+
 class ChaosEngine:
     """Process-wide injector.  Survives core shutdown/re-init on purpose:
     consumed ``count``s persist, so a retried collective after a world
@@ -198,6 +248,8 @@ class ChaosEngine:
                 # NOT followed by an exit: the grace path owns the
                 # departure; without a grace handler the default
                 # disposition (or flight's chained handler) fires.
+            elif act.kind in ("coordkill", "coordpause"):
+                self._fire_coord(act, idx)
             elif act.kind == "freeze":
                 logger.warning("chaos: freezing rank %d at collective %d "
                                "for %.0f ms", self.rank, idx, act.ms)
@@ -208,6 +260,34 @@ class ChaosEngine:
                                idx, list(tensor_names))
                 verdict = "fail"
         return verdict
+
+    def _fire_coord(self, act: ChaosAction, idx: int) -> None:
+        """SIGKILL (coordkill) or SIGSTOP+delayed-SIGCONT (coordpause)
+        the rendezvous primary.  The victim pid is resolved through the
+        seed list at fire time, so the action targets whichever replica
+        CURRENTLY leads — a second firing after a failover exercises
+        the next promotion."""
+        import signal
+
+        pid = _coordinator_pid()
+        if pid is None:
+            logger.warning("chaos: %s at collective %d: no rendezvous "
+                           "primary reachable; skipping", act.kind, idx)
+            return
+        if act.kind == "coordkill":
+            logger.warning("chaos: SIGKILL rendezvous primary pid %d "
+                           "at collective %d", pid, idx)
+            os.kill(pid, signal.SIGKILL)
+            return
+        pause_s = (act.ms or 1000.0) / 1e3
+        logger.warning("chaos: SIGSTOP rendezvous primary pid %d at "
+                       "collective %d for %.0f ms (lease-lapse-then-"
+                       "return)", pid, idx, pause_s * 1e3)
+        os.kill(pid, signal.SIGSTOP)
+        timer = threading.Timer(pause_s, _sigcont, args=(pid,))
+        timer.daemon = True
+        timer.name = "hvd-chaos-cont"
+        timer.start()
 
     # -- send hook (PeerMesh enqueue path) -------------------------------
     def on_send(self, scope: str, peer: int) -> str | None:

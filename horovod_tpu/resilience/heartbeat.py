@@ -63,6 +63,10 @@ class HeartbeatMonitor:
         # peer -> (last observed value, local monotonic time it changed)
         self._last_progress: dict[int, tuple[str, float]] = {}
         self._started_at = 0.0
+        # True while the rendezvous KV itself is unreachable: peer
+        # staleness windows are paused (nobody can stamp), so a
+        # coordinator failover never reads as mass peer death.
+        self._kv_outage = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         from ..telemetry import metrics as _tm_metrics
@@ -174,9 +178,23 @@ class HeartbeatMonitor:
         except Exception:  # noqa: BLE001 - KV hiccup: next beat retries
             pass
 
+    def _note_kv_outage(self, now: float, was_down: bool) -> None:
+        """Restart every peer's staleness window at `now` (the liveness
+        table itself is down; one structured warning per outage)."""
+        if not was_down and not self._kv_outage:
+            logger.warning(
+                "resilience: rendezvous KV unreachable — heartbeat "
+                "staleness clock paused until an endpoint answers "
+                "(coordinator restart/failover window)")
+        self._kv_outage = True
+        for r, (value, _t) in list(self._last_progress.items()):
+            self._last_progress[r] = (value, now)
+
     def poll_once(self) -> None:
         """One detection pass (also called directly by tests)."""
         now = time.monotonic()
+        kv_was_down = self._kv_outage
+        self._kv_outage = False
         for r in range(self.size):
             # Suspect ranks keep being polled — heartbeat silence (or a
             # peer's confirmed mark) may upgrade them to confirmed.
@@ -205,7 +223,14 @@ class HeartbeatMonitor:
             try:
                 raw = self.kv.get(_HB_SCOPE, f"{self.epoch}:{r}")
             except Exception:  # noqa: BLE001
-                raw = None
+                # KV unreachable (coordinator death / failover window):
+                # nobody's stamp can advance, so observed silence says
+                # nothing about the PEER.  Pause the staleness clock —
+                # every peer's window restarts when the control plane
+                # answers again — instead of condemning the whole world
+                # for the coordinator's outage.
+                self._note_kv_outage(now, kv_was_down)
+                continue
             value = raw.decode(errors="replace") if raw is not None else ""
             if value.startswith("bye|"):
                 # Orderly departure (shutdown or epoch rebuild): not
@@ -226,6 +251,9 @@ class HeartbeatMonitor:
                        f"(> {self.fault_timeout:g}s)", kind="heartbeat")
                 if self._tm_on:
                     self._m_latency.observe(silence * 1e3)
+        if kv_was_down and not self._kv_outage:
+            logger.warning("resilience: rendezvous KV reachable again; "
+                           "heartbeat staleness clock resumed")
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):

@@ -47,6 +47,31 @@ def _retry_epoch(base: str, attempt: int) -> str:
     return f"{root}~r{attempt}"
 
 
+def _await_control_plane(deadline_s: float = 10.0) -> bool:
+    """Block (bounded) until some rendezvous endpoint answers its
+    ``/.ctl/role`` probe.  A retry that races a coordinator failover
+    window would otherwise burn its attempts on mesh formation
+    timeouts while the standby is still promoting; waiting here costs
+    one probe loop instead of a full rebuild cycle."""
+    from ..common import config as _config
+    from ..runner.network import RendezvousClient
+
+    addr = _config.RENDEZVOUS_ADDR.get()
+    port = _config.RENDEZVOUS_PORT.get()
+    if not addr:
+        return True                      # single-process world: no KV
+    client = RendezvousClient(addr, port, timeout=2.0)
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if client.find_primary() is not None:
+            return True
+        time.sleep(0.1)
+    logger.warning("resilience: no rendezvous primary answered within "
+                   "%.1fs; proceeding with the rebuild anyway",
+                   deadline_s)
+    return False
+
+
 def rebuild_world(attempt: int) -> None:
     """Tear the runtime down and re-form every channel under a fresh
     rendezvous epoch (mesh scopes, shm regions, heartbeat table all key
@@ -54,6 +79,7 @@ def rebuild_world(attempt: int) -> None:
     from .. import core
     base = os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", "0")
     core.shutdown()
+    _await_control_plane()
     os.environ["HOROVOD_RENDEZVOUS_EPOCH"] = _retry_epoch(base, attempt)
     core.init()
 

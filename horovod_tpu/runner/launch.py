@@ -20,6 +20,7 @@ import shlex
 import sys
 import threading
 
+from ..common.logging import logger
 from . import safe_shell_exec
 from .hosts import (get_host_assignments, parse_host_files, parse_hosts,
                     SlotInfo)
@@ -197,13 +198,44 @@ def rendezvous_env(addr: str, port: int,
                    start_timeout: float) -> dict[str, str]:
     """The env block every worker needs to reach the control plane —
     shared by the ssh and jsrun launch paths so the contract can't
-    drift between them."""
+    drift between them.  ``addr`` may be a single host or a comma-
+    separated ``host:port`` seed list (replicated control plane):
+    ``RendezvousClient`` parses both."""
     return {
         "HOROVOD_GLOO_RENDEZVOUS_ADDR": addr,
         "HOROVOD_GLOO_RENDEZVOUS_PORT": str(port),
         "HOROVOD_CONTROLLER": "tcp",
         "HOROVOD_GLOO_TIMEOUT_SECONDS": str(start_timeout),
     }
+
+
+def start_rendezvous(advertised_addr: str):
+    """Start the rendezvous control plane: one plain in-memory server
+    by default, or — under ``HOROVOD_RENDEZVOUS_REPLICAS`` > 0 with
+    ``HOROVOD_RENDEZVOUS_WAL_DIR`` set — a WAL-backed primary plus N
+    standby replicas that survive coordinator death (standby promotion
+    on lease lapse, docs/controlplane.md).  Returns ``(servers,
+    addr_spec, port)``; pass ``addr_spec`` (a seed list when
+    replicated) to :func:`rendezvous_env` and stop every server at
+    teardown."""
+    from ..common import config as _config
+
+    replicas = _config.RENDEZVOUS_REPLICAS.get()
+    wal_dir = _config.RENDEZVOUS_WAL_DIR.get()
+    if replicas > 0 and wal_dir:
+        from .controlplane import start_replica_set
+        servers, endpoints = start_replica_set(
+            replicas, wal_dir, host=advertised_addr)
+        return servers, ",".join(endpoints), servers[0].port
+    if replicas > 0:
+        logger.warning(
+            "HOROVOD_RENDEZVOUS_REPLICAS=%d needs "
+            "HOROVOD_RENDEZVOUS_WAL_DIR (the replica set shares the "
+            "durable log); starting a single un-replicated server",
+            replicas)
+    server = RendezvousServer()
+    port = server.start()
+    return [server], advertised_addr, port
 
 
 def _ssh_command(slot: SlotInfo, command: list[str], env: dict[str, str],
@@ -251,14 +283,13 @@ def launch_static(args, command: list[str]) -> int:
         hosts = parse_hosts(f"localhost:{np}")
     slots = get_host_assignments(hosts, np)
 
-    server = RendezvousServer()
-    port = server.start()
     rendezvous_addr = _advertised_address(
         hosts, getattr(args, "network_interface", None))
+    servers, addr_spec, port = start_rendezvous(rendezvous_addr)
 
     base_env = dict(os.environ)
     base_env.update(args_to_env(args))
-    base_env.update(rendezvous_env(rendezvous_addr, port,
+    base_env.update(rendezvous_env(addr_spec, port,
                                    args.start_timeout))
 
     exit_codes = [None] * len(slots)
@@ -303,7 +334,8 @@ def launch_static(args, command: list[str]) -> int:
         import signal
         for sig, h in prev_handlers.items():
             signal.signal(sig, h)
-        server.stop()
+        for srv in servers:
+            srv.stop()
     failures = [(s.rank, c) for s, c in zip(slots, exit_codes) if c != 0]
     if failures:
         sys.stderr.write(f"horovodrun-tpu: ranks failed: {failures}\n")

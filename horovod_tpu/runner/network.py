@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import queue
+import random
 import selectors
 import socket
 import struct
@@ -24,9 +25,12 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib import error as urlerror
+from urllib import parse as urlparse
 from urllib import request as urlrequest
 
+from ..common import config, wire
 from ..common.logging import logger
+from .controlplane import _FNV_OFFSET, ControlPlane, apply_record
 
 _LEN = struct.Struct(">I")
 
@@ -96,7 +100,7 @@ def recv_exact(sock: socket.socket, n: int) -> bytearray:
     view = memoryview(buf)
     got = 0
     while got < n:
-        r = sock.recv_into(view[got:], n - got)  # hvdlint: disable=unbounded-blocking-wait -- mesh-bootstrap rank-id exchange only; bounded upstream by the formation connect timeout
+        r = sock.recv_into(view[got:], n - got)  # hvdlint: disable=unbounded-blocking-wait,unbounded-serve-wait -- mesh-bootstrap rank-id/HELLO exchange only; dialed sockets carry the formation connect timeout as their socket timeout and the acceptor thread is joined under the same bound
         if r == 0:
             raise ConnectionError("socket closed mid-message")
         got += r
@@ -117,6 +121,37 @@ def free_port() -> int:
 # ---------------------------------------------------------------------------
 # Rendezvous KV store (HTTP, like the reference's RendezvousServer/HTTPStore)
 # ---------------------------------------------------------------------------
+def _kv_apply(httpd, kind: str, scope: str, key: str, value: bytes):
+    """Commit (WAL, when a control plane is attached) + apply one
+    mutating KV verb.  Enqueue and apply happen under the KV lock so
+    log order equals in-memory apply order; the fsync wait happens on
+    the returned event AFTER the lock is released (the caller acks the
+    client only once it is set).  Returns ``(commit_event|None,
+    claim_index|None)``."""
+    cp = httpd.controlplane
+    with httpd.kv_lock:
+        result = None
+        if kind == "claim":
+            claimant = value.decode()
+            ckey = f"{scope}/{key}"
+            assigned = httpd.claims.setdefault(ckey, {})
+            if claimant and claimant in assigned:
+                # Idempotent re-present: nothing new to commit.
+                return None, assigned[claimant]
+            result = httpd.counters.get(ckey, 0)
+            # The record carries the ASSIGNED index so replay never
+            # re-runs the counter (claim order in the log is free).
+            value = f"{claimant}|{result}".encode()
+        commit = cp.record(kind, scope, key, value) \
+            if cp is not None else None
+        state = {"kv": httpd.kv, "counters": httpd.counters,
+                 "claims": httpd.claims, "digest": httpd.kv_digest}
+        apply_record(state, kind, scope, key, value)
+        httpd.kv_digest = state["digest"]
+        httpd.kv_cond.notify_all()
+    return commit, result
+
+
 class _KVHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
@@ -124,34 +159,113 @@ class _KVHandler(BaseHTTPRequestHandler):
         pass
 
     def _split(self) -> tuple[str, str]:
-        parts = self.path.lstrip("/").split("/", 1)
+        parts = urlparse.urlsplit(self.path).path.lstrip("/") \
+            .split("/", 1)
         scope = parts[0] if parts else ""
         key = parts[1] if len(parts) > 1 else ""
         return scope, key
 
+    def _query(self) -> dict:
+        return urlparse.parse_qs(urlparse.urlsplit(self.path).query)
+
+    def _reply(self, code: int, body: bytes = b"",
+               headers=()) -> None:
+        self.send_response(code)
+        for name, val in headers:
+            self.send_header(name, val)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _gate(self) -> bool:
+        """Leader fence: with a control plane attached, only the
+        current primary answers KV verbs (reads included — clients must
+        never observe a stale standby mirror).  A refused request gets
+        409 + the best-known leader endpoint so clients converge."""
+        cp = self.server.controlplane
+        if cp is None:
+            return True
+        ok, hint = cp.check_write()
+        if ok:
+            return True
+        self._reply(409, headers=((("X-Hvd-Leader", hint),)
+                                  if hint else ()))
+        return False
+
+    def _commit_or_fail(self, commit) -> bool:
+        """Wait for the WAL group-commit fsync before acking; a write
+        that never reached disk answers 503 instead of lying."""
+        if commit is None or commit.wait(timeout=10.0):
+            return True
+        self._reply(503)
+        return False
+
     def do_PUT(self):
+        if not self._gate():
+            return
         scope, key = self._split()
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
-        with self.server.kv_lock:
-            self.server.kv.setdefault(scope, {})[key] = value
-        self.send_response(200)
-        self.send_header("Content-Length", "0")
-        self.end_headers()
+        commit, _ = _kv_apply(self.server, "put", scope, key, value)
+        if self._commit_or_fail(commit):
+            self._reply(200)
 
     def do_GET(self):
         scope, key = self._split()
+        if scope == ".ctl":
+            return self._ctl(key)
+        if not self._gate():
+            return
+        wait_q = self._query().get("wait", ["0"])[0]
+        try:
+            wait_s = max(0.0, min(float(wait_q) / 1e3, 60.0))
+        except ValueError:
+            wait_s = 0.0
+        deadline = time.monotonic() + wait_s
         with self.server.kv_lock:
             value = self.server.kv.get(scope, {}).get(key)
+            while value is None:
+                # Server-side long-poll (?wait=<ms>): a steady-state
+                # watcher costs one outstanding request instead of a
+                # 100 req/s busy-poll.  Bounded by the client's wait
+                # budget; wakeups ride every committed mutation.
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self.server.kv_cond.wait(timeout=remaining)
+                value = self.server.kv.get(scope, {}).get(key)
         if value is None:
-            self.send_response(404)
-            self.send_header("Content-Length", "0")
-            self.end_headers()
+            self._reply(404)
         else:
-            self.send_response(200)
-            self.send_header("Content-Length", str(len(value)))
-            self.end_headers()
-            self.wfile.write(value)
+            self._reply(200, value)
+
+    def _ctl(self, key: str) -> None:
+        """Introspection endpoints under ``/.ctl/``: replica role/epoch
+        (``role``), process id (``pid`` — the chaos ``coordkill:``
+        target), live KV digest (``digest``) and the raw log tail
+        (``wal?from=<offset>``) standbys replicate from."""
+        cp = self.server.controlplane
+        if key == "pid":
+            return self._reply(200, str(os.getpid()).encode())
+        if key == "role":
+            desc = cp.describe() if cp is not None else "primary|0|"
+            return self._reply(200, desc.encode())
+        if key == "digest":
+            with self.server.kv_lock:
+                digest = self.server.kv_digest
+            return self._reply(200, str(digest).encode())
+        if key.startswith("wal"):
+            if cp is None:
+                return self._reply(404)
+            try:
+                offset = int(self._query().get("from", ["0"])[0])
+            except ValueError:
+                offset = 0
+            raw, end = cp.wal_bytes_from(offset)
+            return self._reply(200, raw,
+                               headers=(("X-Hvd-Wal-End", str(end)),))
+        self._reply(404)
 
     def do_POST(self):
         """Atomic fetch-and-increment counter per (scope, key) — used for
@@ -159,53 +273,62 @@ class _KVHandler(BaseHTTPRequestHandler):
         task-registration counter, spark/runner.py:47-426). A non-empty
         body names the logical claimant: re-presenting the same body
         returns the original index (idempotent under task retries)."""
+        if not self._gate():
+            return
         scope, key = self._split()
         length = int(self.headers.get("Content-Length", 0))
-        claimant = self.rfile.read(length).decode()
-        ckey = f"{scope}/{key}"
-        with self.server.kv_lock:
-            assigned = self.server.claims.setdefault(ckey, {})
-            if claimant and claimant in assigned:
-                n = assigned[claimant]
-            else:
-                n = self.server.counters.get(ckey, 0)
-                self.server.counters[ckey] = n + 1
-                if claimant:
-                    assigned[claimant] = n
-        body = str(n).encode()
-        self.send_response(200)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        claimant = self.rfile.read(length)
+        commit, n = _kv_apply(self.server, "claim", scope, key, claimant)
+        if self._commit_or_fail(commit):
+            self._reply(200, str(n).encode())
 
     def do_DELETE(self):
+        if not self._gate():
+            return
         scope, key = self._split()
-        with self.server.kv_lock:
-            if key:
-                self.server.kv.get(scope, {}).pop(key, None)
-            else:
-                self.server.kv.pop(scope, None)
-        self.send_response(200)
-        self.send_header("Content-Length", "0")
-        self.end_headers()
+        commit, _ = _kv_apply(self.server, "delete", scope, key, b"")
+        if self._commit_or_fail(commit):
+            self._reply(200)
 
 
 class RendezvousServer:
-    """Threaded HTTP KV store (reference: runner/http/http_server.py)."""
+    """Threaded HTTP KV store (reference: runner/http/http_server.py).
 
-    def __init__(self, port: int = 0) -> None:
+    With ``wal_dir`` (or ``HOROVOD_RENDEZVOUS_WAL_DIR``) set, a
+    :class:`~.controlplane.ControlPlane` is attached: every mutating
+    verb is WAL-committed before it is acked, standby replicas tail the
+    log and promote on lease lapse, and the handler fences every verb
+    on the current leadership (docs/controlplane.md)."""
+
+    def __init__(self, port: int = 0, wal_dir: str | None = None,
+                 replica_id: int = 0, endpoints=None,
+                 lease_ms: float | None = None,
+                 standby: bool = False) -> None:
         self._httpd = ThreadingHTTPServer(("", port), _KVHandler)
         self._httpd.kv = {}
         self._httpd.counters = {}
         self._httpd.claims = {}
+        self._httpd.kv_digest = _FNV_OFFSET
         self._httpd.kv_lock = threading.Lock()
+        self._httpd.kv_cond = threading.Condition(self._httpd.kv_lock)
+        wal_dir = wal_dir or (config.RENDEZVOUS_WAL_DIR.get() or None)
+        self._httpd.controlplane = None if wal_dir is None else \
+            ControlPlane(self, wal_dir, replica_id=replica_id,
+                         endpoints=endpoints, lease_ms=lease_ms,
+                         standby=standby)
         self._thread: threading.Thread | None = None
 
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
 
+    @property
+    def controlplane(self) -> ControlPlane | None:
+        return self._httpd.controlplane
+
     def start(self) -> int:
+        if self._httpd.controlplane is not None:
+            self._httpd.controlplane.start()
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True,
                                         name="hvd-rendezvous")
@@ -213,14 +336,23 @@ class RendezvousServer:
         return self.port
 
     def put(self, scope: str, key: str, value: bytes) -> None:
-        with self._httpd.kv_lock:
-            self._httpd.kv.setdefault(scope, {})[key] = value
+        commit, _ = _kv_apply(self._httpd, "put", scope, key, value)
+        if commit is not None:
+            commit.wait(timeout=10.0)
 
     def get(self, scope: str, key: str) -> bytes | None:
         with self._httpd.kv_lock:
             return self._httpd.kv.get(scope, {}).get(key)
 
+    def kv_digest(self) -> int:
+        """Rolling FNV digest of every applied mutation (matches the
+        digest a WAL replay of the same history computes)."""
+        with self._httpd.kv_lock:
+            return self._httpd.kv_digest
+
     def stop(self) -> None:
+        if self._httpd.controlplane is not None:
+            self._httpd.controlplane.close()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
@@ -230,60 +362,224 @@ class RendezvousServer:
             self._thread = None
 
 
-class RendezvousClient:
-    """HTTP KV client with blocking get (reference: gloo/http_store.cc wait)."""
+# Long-poll chunk a single wait() request asks the server to hold for;
+# short enough that endpoint failover is never stalled behind one
+# outstanding request for long.
+_LONG_POLL_CHUNK_S = 5.0
+# Jittered exponential retry backoff between endpoint attempts.
+_BACKOFF_FLOOR_S = 0.01
+_BACKOFF_CAP_S = 0.25
+# Per-attempt HTTP timeout: one stalled endpoint (SIGSTOP'd primary, a
+# half-open socket) must never eat the whole retry deadline — the next
+# seed gets its turn after this bound.
+_ATTEMPT_TIMEOUT_S = 5.0
 
-    def __init__(self, addr: str, port: int, timeout: float = 30.0) -> None:
-        self._base = f"http://{addr}:{port}"
+
+class RendezvousClient:
+    """HTTP KV client with blocking get (reference: gloo/http_store.cc
+    wait) and multi-endpoint failover: ``addr`` may be a single host
+    (paired with ``port``) or a comma-separated ``host:port`` seed list
+    (every replica of a fault-tolerant control plane).  Idempotent
+    verbs — get/wait/delete/put/claim-with-``task_key`` — retry across
+    endpoints with jittered exponential backoff inside one deadline,
+    riding out a coordinator restart or failover window; a bare claim
+    (no ``task_key``) still fails fast, since a retry could double-
+    allocate its index."""
+
+    def __init__(self, addr: str, port: int | None = None,
+                 timeout: float = 30.0, endpoints=None) -> None:
+        if endpoints is not None:
+            self._endpoints = list(endpoints)
+        else:
+            self._endpoints = self.parse_endpoints(addr, port)
+        self._active = 0
         self.timeout = timeout
 
+    @staticmethod
+    def parse_endpoints(addr: str, port: int | None) -> list[str]:
+        """``"h1:p1,h2:p2"`` (seed list) or ``("host", port)``."""
+        eps = []
+        for part in str(addr).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" not in part and port is None:
+                raise ValueError(
+                    f"rendezvous endpoint {part!r} has no port and no "
+                    f"default port was given")
+            eps.append(part if ":" in part else f"{part}:{port}")
+        if not eps:
+            raise ValueError("rendezvous client needs at least one "
+                             "endpoint")
+        return eps
+
+    @property
+    def endpoint(self) -> str:
+        return self._endpoints[self._active]
+
+    @property
+    def _base(self) -> str:
+        return f"http://{self.endpoint}"
+
+    def _failover(self, failed: str, why, hint: str = "") -> None:
+        """Move to the hinted leader (409 redirect) or the next seed;
+        one structured warning names the endpoint per transition."""
+        if hint:
+            if hint not in self._endpoints:
+                self._endpoints.append(hint)
+            nxt = self._endpoints.index(hint)
+        else:
+            nxt = (self._active + 1) % len(self._endpoints)
+        if nxt != self._active:
+            logger.warning(
+                "rendezvous: endpoint %s unavailable (%s); failing "
+                "over to %s", failed, why, self._endpoints[nxt])
+        self._active = nxt
+
+    def _call(self, method: str, scope: str, key: str,
+              data: bytes | None = None, query: str = "",
+              idempotent: bool = True,
+              deadline: float | None = None,
+              attempt_timeout: float | None = None) -> bytes | None:
+        """One verb with bounded endpoint failover.  Returns the body,
+        or None on 404.  Non-idempotent calls never retry a transport
+        error (the request may have committed server-side); 409 leader
+        redirects are always safe to follow — a refused request was
+        never applied."""
+        if deadline is None:
+            deadline = time.monotonic() + self.timeout
+        if attempt_timeout is None:
+            attempt_timeout = min(self.timeout, _ATTEMPT_TIMEOUT_S)
+        attempt = 0
+        last_exc: Exception | None = None
+        while True:
+            endpoint = self.endpoint
+            req = urlrequest.Request(
+                f"http://{endpoint}/{scope}/{key}{query}",
+                data=data, method=method)
+            try:
+                with urlrequest.urlopen(
+                        req, timeout=attempt_timeout) as resp:
+                    return resp.read()
+            except urlerror.HTTPError as e:
+                if e.code == 404:
+                    return None
+                if e.code not in (409, 503):
+                    raise
+                last_exc = e
+                self._failover(endpoint, f"HTTP {e.code}",
+                               e.headers.get("X-Hvd-Leader", ""))
+            except (urlerror.URLError, ConnectionError, TimeoutError,
+                    OSError) as e:
+                if not idempotent:
+                    raise
+                last_exc = e
+                reason = getattr(e, "reason", e)
+                self._failover(endpoint, reason)
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rendezvous {method} {scope}/{key} failed against "
+                    f"every endpoint {self._endpoints} within the "
+                    f"deadline") from last_exc
+            delay = min(_BACKOFF_FLOOR_S * (2 ** attempt),
+                        _BACKOFF_CAP_S)
+            time.sleep(delay * random.uniform(0.5, 1.0))
+            attempt += 1
+
     def put(self, scope: str, key: str, value: bytes) -> None:
-        req = urlrequest.Request(f"{self._base}/{scope}/{key}", data=value,
-                                 method="PUT")
-        with urlrequest.urlopen(req, timeout=self.timeout):
-            pass
+        # A put is a blind last-write-wins set: retrying a possibly-
+        # committed put re-applies the same value (idempotent).
+        self._call("PUT", scope, key, data=value)
 
     def claim(self, scope: str, key: str, task_key: str = "") -> int:
         """Atomic fetch-and-increment of the (scope, key) counter.
-        A non-empty ``task_key`` makes the claim idempotent: retries with
-        the same key get the originally assigned index back."""
-        req = urlrequest.Request(f"{self._base}/{scope}/{key}",
-                                 data=task_key.encode(), method="POST")
-        with urlrequest.urlopen(req, timeout=self.timeout) as resp:
-            return int(resp.read())
+        A non-empty ``task_key`` makes the claim idempotent: retries
+        with the same key get the originally assigned index back (and
+        may therefore safely ride endpoint failover)."""
+        raw = self._call("POST", scope, key, data=task_key.encode(),
+                         idempotent=bool(task_key))
+        return int(raw)
 
     def get(self, scope: str, key: str) -> bytes | None:
-        try:
-            req = urlrequest.Request(f"{self._base}/{scope}/{key}",
-                                     method="GET")
-            with urlrequest.urlopen(req, timeout=self.timeout) as resp:
-                return resp.read()
-        except urlerror.HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+        return self._call("GET", scope, key)
 
     def delete(self, scope: str, key: str = "") -> None:
         """Delete one key (or a whole scope when ``key`` is empty) —
         statesync consumes its join/ready/donation marks so a later
         epoch's watcher never replays a resolved event."""
-        req = urlrequest.Request(f"{self._base}/{scope}/{key}",
-                                 method="DELETE")
-        with urlrequest.urlopen(req, timeout=self.timeout):
-            pass
+        self._call("DELETE", scope, key)
+
+    def probe(self) -> str | None:
+        """The active endpoint's ``/.ctl/role`` descriptor, or None
+        when no endpoint answers (control-plane health check)."""
+        try:
+            raw = self._call("GET", ".ctl", "role")
+        except (TimeoutError, urlerror.URLError, OSError):
+            return None
+        return raw.decode() if raw is not None else None
+
+    def find_primary(self) -> str | None:
+        """Probe every seed DIRECTLY (each replica answers ``/.ctl``
+        for itself) and return the endpoint currently acting as
+        primary, retargeting the client at it.  None while no replica
+        leads (mid-election)."""
+        for i, endpoint in enumerate(list(self._endpoints)):
+            try:
+                with urlrequest.urlopen(
+                        f"http://{endpoint}/.ctl/role",
+                        timeout=2.0) as resp:
+                    role = resp.read().decode()
+            except OSError:
+                continue
+            if role.startswith("primary"):
+                self._active = i
+                return endpoint
+        return None
 
     def wait(self, scope: str, key: str,
              timeout: float | None = None) -> bytes:
-        deadline = time.monotonic() + (timeout or self.timeout)
+        """Block until the key exists.  Each request long-polls
+        server-side (``?wait=<ms>``) so a steady-state watcher keeps
+        ONE outstanding request instead of busy-polling at 100 req/s;
+        between failed attempts the retry backs off with jitter
+        (10 ms -> 250 ms cap)."""
+        total = timeout or self.timeout
+        deadline = time.monotonic() + total
+        delay = _BACKOFF_FLOOR_S
         while True:
-            value = self.get(scope, key)
-            if value is not None:
-                return value
-            if time.monotonic() > deadline:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 raise TimeoutError(
                     f"Rendezvous key {scope}/{key} not available after "
-                    f"{timeout or self.timeout}s")
-            time.sleep(0.01)
+                    f"{total}s")
+            chunk_ms = int(min(remaining, _LONG_POLL_CHUNK_S) * 1e3)
+            try:
+                # The server legitimately holds the request for the
+                # whole chunk: the per-attempt bound must exceed it.
+                value = self._call("GET", scope, key,
+                                   query=f"?wait={chunk_ms}",
+                                   deadline=deadline,
+                                   attempt_timeout=chunk_ms / 1e3 + 5.0)
+            except TimeoutError:
+                raise TimeoutError(
+                    f"Rendezvous key {scope}/{key} not available after "
+                    f"{total}s (endpoints {self._endpoints})") from None
+            if value is not None:
+                return value
+            time.sleep(delay * random.uniform(0.5, 1.0))
+            delay = min(delay * 2, _BACKOFF_CAP_S)
+
+
+def advertised_hello() -> tuple[int, int]:
+    """The wire proto version + feature bits this process offers at
+    channel establishment.  ``HOROVOD_PROTO_COMPAT=<N>`` pins the
+    advertisement to version N (masking newer feature bits) so a world
+    can roll from framework version N to N+1 rank-by-rank: the still-
+    old ranks negotiate every peer down to the min common schema."""
+    compat = config.PROTO_COMPAT.get()
+    proto = wire.PROTO_VERSION if compat <= 0 \
+        else min(compat, wire.PROTO_VERSION)
+    return proto, wire.proto_features(proto)
 
 
 # ---------------------------------------------------------------------------
@@ -549,6 +845,16 @@ class PeerMesh:
             "Outbound frames queued on a peer's persistent sender lane "
             "at enqueue time", labels={"mesh": scope}) if self._tm_on \
             else None
+        # Versioned wire handshake (HELLO{proto_version, feature_bits},
+        # exchanged on every pair socket at formation): the mesh-wide
+        # negotiated schema is the min proto / AND of feature bits over
+        # every peer — identical on all ranks by construction, so one
+        # encode per broadcast serves the whole world and optional
+        # field groups (fp_*/tm_*/trace_*) are gated symmetrically.
+        self.proto_version, self.features = advertised_hello()
+        self.peer_protos: dict[int, int] = {}
+        self.negotiated_proto = self.proto_version
+        self.negotiated_features = self.features
         if size == 1:
             return
 
@@ -574,10 +880,16 @@ class PeerMesh:
                 except OSError:
                     pass
 
+        hello = wire.pack_hello(self.proto_version, self.features)
+        peer_hellos: dict[int, tuple[int, int]] = {}
+
         def _accept():
             for _ in range(expected_inbound):
                 conn, _ = listener.accept()
                 peer = int.from_bytes(recv_exact(conn, 4), "big")
+                peer_hellos[peer] = wire.unpack_hello(
+                    recv_exact(conn, wire.HELLO_LEN))
+                conn.sendall(hello)
                 _tune(conn)
                 accepted[peer] = conn
 
@@ -599,7 +911,9 @@ class PeerMesh:
                         raise
                     time.sleep(0.05)
             _tune(sock)
-            sock.sendall(self.rank.to_bytes(4, "big"))
+            sock.sendall(self.rank.to_bytes(4, "big") + hello)
+            peer_hellos[peer] = wire.unpack_hello(
+                recv_exact(sock, wire.HELLO_LEN))
             self._socks[peer] = sock
 
         acceptor.join(timeout)
@@ -609,10 +923,32 @@ class PeerMesh:
                 f"inbound peers connected")
         self._socks.update(accepted)
         listener.close()
+        self._negotiate_wire(peer_hellos)
         for peer, sock in self._socks.items():
             self._channels[peer] = _PeerChannel(sock, peer,
                                                 self._count_sent,
                                                 resilience=self._resilience)
+
+    def _negotiate_wire(self, peer_hellos: dict) -> None:
+        """Fold every peer's HELLO into the mesh-wide negotiated wire
+        schema and export the per-peer proto gauge.  The fold is
+        order-free (min / AND), so every rank lands on the identical
+        (proto, features) pair without an extra exchange."""
+        proto, feats = self.proto_version, self.features
+        for peer_proto, peer_feats in peer_hellos.values():
+            proto, feats = wire.negotiate(proto, feats, peer_proto,
+                                          peer_feats)
+        self.negotiated_proto = proto
+        self.negotiated_features = feats
+        self.peer_protos = {p: h[0] for p, h in peer_hellos.items()}
+        if self._tm_on:
+            for peer, (peer_proto, _pf) in sorted(peer_hellos.items()):
+                self._tm.gauge(
+                    "horovod_wire_proto_version",
+                    "Wire protocol version the peer advertised at "
+                    "channel establishment",
+                    labels={"mesh": self.scope,
+                            "peer": str(peer)}).set(peer_proto)
 
     @staticmethod
     def _advertised_host() -> str:

@@ -1,0 +1,142 @@
+"""Protocol spec for the rendezvous-failover election (hvdmc).
+
+Co-located with ``controlplane.py``: the durable replicated rendezvous
+elects its leader through the write-ahead log itself — the primary
+renews ``lease`` records, standbys tail the log and, on lease lapse,
+append a ``leader`` record at ``epoch + 1``; the FIRST leader record at
+a given epoch wins and everyone else demotes.  A primary whose lease
+lapsed (SIGSTOP / partition — the ``coordpause:`` chaos shape) must
+re-read the log before accepting another write: a higher-epoch leader
+record fences it out.  Clients hold a multi-endpoint seed list and
+converge on whichever replica currently leads via connection-refused
+rotation and 409 leader hints.
+
+Checked properties (``analysis/hvdmc/machines.py`` FailoverModel):
+
+- **two-leaders** — no two replicas ever serve as primary at once
+  (per-epoch leadership is unique by first-leader-record arbitration);
+- **committed-write-lost** — no write acked to a client is dropped by
+  a later promotion's replay (epoch fencing at the log);
+- **clients-converge** — from every reachable state the client can
+  still reach a state where all its writes are acked (AG EF).
+
+The seeded ``accept-stale-lease`` mutation (``--mutate``) lets a
+resumed primary skip the log re-verification — the checker answers
+with a two-leaders (and lost-write) counterexample trace.
+"""
+from __future__ import annotations
+
+from ..analysis.hvdmc.spec import ProtocolSpec, Transition, Verb
+
+__all__ = ["failover_spec"]
+
+_CP = "runner.controlplane.ControlPlane"
+_NET = "runner.network"
+
+
+def failover_spec() -> ProtocolSpec:
+    transitions = (
+        Transition("pri.renew", "primary", "leading", "leading",
+                   "kv:LEASE",
+                   binds=(f"{_CP}._renew_lease",),
+                   requires_calls=("append",),
+                   doc="lease record every third of "
+                       "HOROVOD_RENDEZVOUS_LEASE_MS"),
+        Transition("pri.commit", "primary", "leading", "leading",
+                   "kv:PUT", guard="lease-valid",
+                   binds=(f"{_CP}.check_write", f"{_NET}._kv_apply"),
+                   requires_calls=("record", "apply_record"),
+                   doc="WAL-commit + apply one mutating KV verb; acked "
+                       "only after the group-commit fsync"),
+        Transition("pri.pause", "primary", "leading", "paused",
+                   "fault:pause",
+                   doc="SIGSTOP / GC pause / partition: the lease "
+                       "keeps ticking while the process does not"),
+        Transition("pri.die", "primary", "leading", "dead",
+                   "fault:kill"),
+        Transition("pri.resume-fenced", "primary", "paused", "fenced",
+                   "internal:reverify", guard="epoch-fence",
+                   binds=(f"{_CP}._reverify_lease",),
+                   requires_calls=("replay_state", "_demote"),
+                   doc="a higher-epoch leader record in the log fences "
+                       "the resumed primary out: demote, 409 + hint"),
+        Transition("pri.resume-reclaim", "primary", "paused", "leading",
+                   "internal:reverify", guard="epoch-fence",
+                   binds=(f"{_CP}._reverify_lease",),
+                   requires_calls=("replay_state",),
+                   doc="lease lapsed but uncontested: self-succeed "
+                       "under a fresh epoch so racing candidates are "
+                       "fenced"),
+        Transition("sb.tail", "standby", "tailing", "tailing",
+                   "kv:LEASE",
+                   binds=(f"{_CP}._tail_once",
+                          "runner.controlplane.Replicator._run"),
+                   requires_calls=("urlopen",),
+                   doc="log-tail replication doubles as lease "
+                       "observation"),
+        Transition("sb.lapse", "standby", "tailing", "candidate",
+                   "internal:lease-lapse", guard="lapse-after-silence",
+                   binds=(f"{_CP}._lease_loop",),
+                   doc="no leader sign for ~2x the lease (staggered by "
+                       "replica id)"),
+        Transition("sb.promote", "standby", "candidate", "promoted",
+                   "kv:LEADER", guard="first-leader-wins",
+                   binds=(f"{_CP}._try_promote",),
+                   requires_calls=("replay_state", "_election_winner"),
+                   doc="append leader@epoch+1, re-read the log, first "
+                       "record at the new epoch wins; replay the WAL "
+                       "into the serving state"),
+        Transition("sb.lose", "standby", "candidate", "tailing",
+                   "internal:lost-election",
+                   binds=(f"{_CP}._election_winner",),
+                   doc="a peer's leader record landed first: adopt its "
+                       "epoch, keep tailing"),
+        Transition("cli.write", "client", "connected", "connected",
+                   "kv:PUT",
+                   binds=(f"{_NET}.RendezvousClient._call",),
+                   doc="idempotent verbs retry across endpoints inside "
+                       "one deadline; bare claims fail fast"),
+        Transition("cli.failover", "client", "connected", "retrying",
+                   "internal:endpoint-failover",
+                   binds=(f"{_NET}.RendezvousClient._failover",),
+                   doc="connection refused / 409: rotate to the next "
+                       "seed or follow the X-Hvd-Leader hint"),
+        Transition("cli.converge", "client", "retrying", "connected",
+                   "internal:leader-found",
+                   binds=(f"{_NET}.RendezvousClient.find_primary",),
+                   requires_calls=("urlopen",)),
+    )
+    return ProtocolSpec(
+        name="rendezvous-failover",
+        doc="durable replicated rendezvous leader election "
+            "(docs/controlplane.md)",
+        roles=("primary", "standby", "client"),
+        states={"primary": ("leading", "paused", "fenced", "dead"),
+                "standby": ("tailing", "candidate", "promoted"),
+                "client": ("connected", "retrying")},
+        verbs=(
+            Verb("LEASE", "kv", "lease",
+                 doc="leader liveness record, wall-clock expiry in the "
+                     "value"),
+            Verb("LEADER", "kv", "leader",
+                 doc="election record: epoch-fenced, first-at-epoch "
+                     "wins"),
+            Verb("PUT", "kv", "put", doc="client KV set, WAL-committed"),
+            Verb("CLAIM", "kv", "claim",
+                 doc="fetch-and-increment; the record carries the "
+                     "assigned index so replay is order-free"),
+            Verb("DELETE", "kv", "delete"),
+        ),
+        transitions=transitions,
+        anchor_modules=("runner.controlplane",),
+        properties={
+            "two-leaders":
+                "no two replicas serve as primary at once — per-epoch "
+                "leadership is unique (first leader record arbitrates)",
+            "committed-write-lost":
+                "every write acked to a client survives any later "
+                "promotion's WAL replay (epoch fencing)",
+            "clients-converge":
+                "from every reachable state the client can still "
+                "reach all-writes-acked (AG EF resolution)",
+        })

@@ -252,9 +252,26 @@ class StateSyncService:
     # -- watcher ---------------------------------------------------------
     def _watch_loop(self) -> None:
         poll = config.STATESYNC_POLL_SECONDS.get()
+        kv_healthy = True
         while not self._stop.wait(poll):
             try:
                 self._watch_once()
+                if not kv_healthy:
+                    kv_healthy = True
+                    logger.warning(
+                        "statesync: rendezvous KV reachable again "
+                        "(endpoint %s); watcher resumed",
+                        getattr(self._kv, "endpoint", "?"))
+            except TimeoutError as exc:
+                # Coordinator restart/failover window: the client's
+                # bounded retry already rotated endpoints — keep the
+                # watcher alive and name the outage once instead of
+                # silently dropping membership events.
+                if kv_healthy:
+                    kv_healthy = False
+                    logger.warning(
+                        "statesync: rendezvous KV unreachable (%s); "
+                        "watcher idling until an endpoint answers", exc)
             except Exception:  # noqa: BLE001 - never kill the watcher
                 logger.debug("statesync: watcher poll failed",
                              exc_info=True)

@@ -1,0 +1,22 @@
+"""Seeded hvdlife fixture: HVD702/HVD704 — a rendezvous replica that
+opens the WAL group-commit lane and the log-tail replicator per world
+epoch and never releases either: the WAL fd + fsync thread and the
+tail thread survive every reinit_world cycle (one leaked fd + two
+threads per elastic transition)."""
+from horovod_tpu.runner.controlplane import Replicator, WalWriter
+
+
+class LeakyReplica:
+    def __init__(self, path):
+        self.wal = WalWriter(path)                            # HVD702
+        self.tail = Replicator(self)                          # HVD702
+
+    def close(self):
+        self.wal = None     # drops both handles, never .close()
+        self.tail = None
+
+
+def reinit_world(rank, size):
+    """Epoch root: one leaked WAL lane + replicator per cycle."""
+    replica = LeakyReplica(f"/tmp/wal-{rank}")                # HVD704
+    return replica

@@ -1,0 +1,51 @@
+"""Seeded HVD505 (optional-field gate): an fp_* optional wire field
+encoded/decoded OUTSIDE a feature-bit gate — the rolling-upgrade
+hazard: a peer that negotiated FEATURE_FINGERPRINT away cannot skip
+the field, so every frame after it decodes garbage."""
+
+
+class UngatedRequestList:
+    """Symmetric codec (no sequence drift) with the optional field
+    unconditionally on the wire on both sides."""
+
+    def __init__(self, shutdown=False, fp_seq=0, count=0):
+        self.shutdown = shutdown
+        self.fp_seq = fp_seq
+        self.count = count
+
+    def to_bytes(self, enc, features=0):
+        (enc.bool_(self.shutdown)
+            .uvarint(self.fp_seq)       # HVD505: not behind a feature bit
+            .uvarint(self.count))
+
+    @classmethod
+    def from_bytes(cls, dec, features=0):
+        return cls(shutdown=dec.bool_(),
+                   fp_seq=dec.uvarint(),   # HVD505: symmetric, same bug
+                   count=dec.uvarint())
+
+
+class GatedRequestList:
+    """The sanctioned form: both sides gate the group identically."""
+
+    FEATURE_FINGERPRINT = 1
+
+    def __init__(self, shutdown=False, fp_seq=0, count=0):
+        self.shutdown = shutdown
+        self.fp_seq = fp_seq
+        self.count = count
+
+    def to_bytes(self, enc, features=0):
+        enc.bool_(self.shutdown)
+        if features & self.FEATURE_FINGERPRINT:
+            enc.uvarint(self.fp_seq)
+        enc.uvarint(self.count)
+
+    @classmethod
+    def from_bytes(cls, dec, features=0):
+        shutdown = dec.bool_()
+        fp_seq = 0
+        if features & cls.FEATURE_FINGERPRINT:
+            fp_seq = dec.uvarint()
+        return cls(shutdown=shutdown, fp_seq=fp_seq,
+                   count=dec.uvarint())

@@ -2236,6 +2236,53 @@ def _statesync_digest_check(hvd, state):
     return digest
 
 
+def battery_rolling(hvd, rank, size):
+    """ISSUE 15 rolling-upgrade battery: rank 1 advertises wire proto 1
+    (the still-old framework version; HOROVOD_PROTO_COMPAT set in main
+    before init) — the world negotiates the min common schema at every
+    mesh HELLO and completes training steps with zero failed steps and
+    zero fingerprint divergence under strict mode; then the lagging
+    rank "upgrades" (compat lifted) and the whole world rejoins under a
+    fresh epoch, negotiating the native schema again."""
+    from horovod_tpu import core as _core
+    from horovod_tpu.common import wire as _wire
+    from horovod_tpu.runner.network import PeerMesh as _PeerMesh
+
+    def _meshes():
+        return [r for r in _core.global_state().resources
+                if isinstance(r, _PeerMesh)]
+
+    def _steps(tag):
+        t = np.ones(256, np.float32) * (rank + 1)
+        want = np.ones(256, np.float32) * (size * (size + 1) / 2)
+        for i in range(4):
+            out = hvd.allreduce(t, op=hvd.Sum, name=f"{tag}{i}")
+            np.testing.assert_allclose(np.asarray(out), want)
+
+    meshes = _meshes()
+    assert meshes, "no TCP meshes formed"
+    for m in meshes:
+        assert m.negotiated_proto == 1, m.negotiated_proto
+        assert m.negotiated_features == 0, m.negotiated_features
+        assert m.peer_protos, m.peer_protos
+    _steps("rollold")
+
+    # The old rank upgrades: drain, lift the compat pin, rejoin at N+1.
+    hvd.shutdown()
+    os.environ.pop("HOROVOD_PROTO_COMPAT", None)
+    os.environ["HOROVOD_RENDEZVOUS_EPOCH"] = \
+        os.environ.get("HOROVOD_RENDEZVOUS_EPOCH", "0") + "~u1"
+    hvd.init()
+    meshes = _meshes()
+    assert meshes
+    for m in meshes:
+        assert m.negotiated_proto == _wire.PROTO_VERSION
+        assert m.negotiated_features == _wire.FEATURES_ALL
+    _steps("rollnew")
+    print(f"ROLLING_OK rank={rank} proto "
+          f"1->{_wire.PROTO_VERSION}", flush=True)
+
+
 def battery_statesync_grow(hvd, rank, size):
     """ISSUE 10 acceptance (4-rank, rides 4->3->4): chaos SIGKILLs rank
     2 mid-training; survivors shrink with zero failed steps after the
@@ -2707,6 +2754,7 @@ BATTERIES = {
         battery_tensorflow(hvd, rank, size),
         battery_tf_grid(hvd, rank, size),
         battery_tf_function(hvd, rank, size)],
+    "rolling": battery_rolling,
     "hierarchical": battery_hierarchical,
     "shm": battery_shm,
     "compress": battery_compress,
@@ -2747,7 +2795,10 @@ def main() -> int:
     battery = sys.argv[4] if len(sys.argv) > 4 else "collectives"
     os.environ["HOROVOD_RANK"] = str(rank)
     os.environ["HOROVOD_SIZE"] = str(size)
-    os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = "127.0.0.1"
+    # A replicated-control-plane harness passes a multi-endpoint seed
+    # list through the env; plain worlds get the localhost default
+    # (test_multiprocess._run_world pops any stale inherited value).
+    os.environ.setdefault("HOROVOD_GLOO_RENDEZVOUS_ADDR", "127.0.0.1")
     os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(port)
     # Generous under CI load: a peer may still be importing torch/tf when
     # this rank reaches rendezvous.
@@ -2755,6 +2806,16 @@ def main() -> int:
     if battery == "stall":
         os.environ["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "1"
         os.environ["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = "3"
+    if battery == "rolling":
+        # Rank 1 is the still-old framework version: it advertises wire
+        # proto 1, so every mesh negotiates the base schema until the
+        # battery lifts the pin mid-run (the rolling upgrade).  Strict
+        # fingerprinting turns any schema asymmetry into a structured
+        # divergence ERROR within one cycle.
+        if rank == 1:
+            os.environ["HOROVOD_PROTO_COMPAT"] = "1"
+        os.environ.setdefault("HOROVOD_FINGERPRINT", "strict")
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
     if battery == "flow":
         # Strict mode: divergence surfaces within one forced
         # negotiation heartbeat even in cache steady state.

@@ -80,6 +80,19 @@ def test_fixture_kv_block_pool_leak():
     assert "init/reinit_world" in msg
 
 
+def test_fixture_wal_and_replicator_leak():
+    """ISSUE 15: the rendezvous WAL writer and log-tail replicator are
+    taxonomy channels — a replica whose teardown drops the handles
+    without close() leaks the WAL fd + fsync lane and the tail thread
+    once per elastic reinit cycle."""
+    out = analyze_paths([_fx("wal_leak.py")])
+    ids = _ids(out)
+    assert ("HVD702", 11) in ids or ("HVD704", 11) in ids, ids
+    assert ("HVD702", 12) in ids or ("HVD704", 12) in ids, ids
+    msgs = " | ".join(f.message for f in out.findings)
+    assert "WalWriter" in msgs and "Replicator" in msgs
+
+
 def test_fixture_blocked_no_wakeup():
     out = analyze_paths([_fx("blocked_no_wakeup.py")])
     assert _ids(out) == [("HVD705", 12)]

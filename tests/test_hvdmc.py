@@ -42,7 +42,7 @@ def test_all_specs_validate():
     specs = all_specs()
     assert {sp.name for sp in specs} == {
         "statesync-grow", "statesync-stream", "statesync-preempt",
-        "resilience-shrink"}
+        "resilience-shrink", "rendezvous-failover"}
     for sp in specs + (toy_spec(),):
         assert sp.validate() == [], sp.name
         # Every transition id is unique across the registry too.
@@ -148,7 +148,52 @@ def test_mutation_early_ready_ack_caught_with_trace():
 def test_unknown_mutation_rejected():
     with pytest.raises(ValueError):
         GrowModel(3, mutations=("no-such-guard",))
-    assert set(MUTATIONS) == {"drop-torn-reject", "early-ready-ack"}
+    assert set(MUTATIONS) == {"drop-torn-reject", "early-ready-ack",
+                              "accept-stale-lease"}
+
+
+# --- rendezvous failover (ISSUE 15) -----------------------------------------
+def test_failover_model_clean_at_head():
+    """The election protocol at head: leader death and the
+    lease-lapse-then-return pause both explore to a fixpoint with no
+    two-leaders state, no lost committed write, and every state able
+    to reach all-writes-acked (clients converge, AG EF)."""
+    from horovod_tpu.analysis.hvdmc.machines import FailoverModel
+
+    r = explore(FailoverModel(3))
+    assert r.fixpoint and r.violations == []
+    assert r.states > 300, r.states
+    assert {"pri.pause", "pri.die", "pri.resume-fenced",
+            "pri.resume-reclaim", "sb.lapse", "sb.promote", "sb.lose",
+            "cli.write", "cli.failover", "cli.converge",
+            "pri.commit"} <= r.fired
+
+
+def test_mutation_accept_stale_lease_caught_with_trace():
+    """ISSUE 15 acceptance: dropping the epoch-fence re-verification
+    (a resumed primary keeps serving on its stale lease) produces the
+    two-leaders counterexample AND a committed write the promotion's
+    replay drops — each with a trace bound to the control-plane code
+    sites."""
+    from horovod_tpu.analysis.hvdmc.machines import FailoverModel
+
+    m = FailoverModel(3, mutations=("accept-stale-lease",))
+    r = explore(m)
+    assert r.fixpoint
+    props = {v.prop for v in r.violations}
+    assert "two-leaders" in props, props
+    assert "committed-write-lost" in props, props
+    v = next(v for v in r.violations if v.prop == "two-leaders")
+    trace = render_trace(m, v)
+    assert "sb.promote" in trace
+    assert "pri.resume-reclaim" in trace
+    assert "runner.controlplane.ControlPlane._try_promote" in trace
+    assert "runner.controlplane.ControlPlane._reverify_lease" in trace
+    lost = next(v for v in r.violations
+                if v.prop == "committed-write-lost")
+    lost_trace = render_trace(m, lost)
+    assert "cli.write" in lost_trace and "pri.commit" in lost_trace
+    assert "runner.network._kv_apply" in lost_trace
 
 
 # --- golden counterexample --------------------------------------------------
@@ -297,7 +342,7 @@ def test_cli_default_explores_all_protocols_clean():
     payload = json.loads(proc.stdout)
     protos = payload["protocols"]
     assert set(protos) == {"statesync-grow", "statesync-preempt",
-                           "resilience-shrink"}
+                           "resilience-shrink", "rendezvous-failover"}
     for name, rec in protos.items():
         assert rec["fixpoint"] and rec["violations"] == [], name
         assert rec["states"] > 0

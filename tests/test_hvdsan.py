@@ -89,6 +89,20 @@ def test_fixture_wire_drift_hvd505():
     assert any("swapped" in m for m in msgs)
 
 
+def test_fixture_ungated_optional_field_hvd505():
+    """ISSUE 15 satellite: every optional wire field (fp_*/tm_*/
+    trace_*) must sit behind a feature-bit gate on BOTH codec sides —
+    the compile-time half of the versioned HELLO handshake.  The
+    fixture's ungated class is flagged once per side; the gated class
+    next to it is clean."""
+    a = _fixture("ungated_optional_field.py")
+    assert _slugs(a) == ["wire-schema-drift"] * 2
+    msgs = [f.message for f in a.findings]
+    assert all("feature-bit gate" in m and "fp_seq" in m for m in msgs)
+    assert {f.message.split(".")[0].rsplit(" ", 1)[-1]
+            for f in a.findings} == {"UngatedRequestList"}
+
+
 def test_fixture_state_frame_drift_hvd505():
     """ISSUE 11 satellite: HVD505 extended over the statesync
     STATE_MAGIC frame codec — the seeded fixture drifts every check

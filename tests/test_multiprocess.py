@@ -25,6 +25,10 @@ def _run_world(size: int, battery: str, timeout: float = 90.0,
     env = dict(os.environ)
     env.pop("HOROVOD_RANK", None)
     env.pop("HOROVOD_SIZE", None)
+    # A stale seed list inherited from the outer environment would point
+    # workers at a dead control plane; mp_worker defaults to localhost
+    # and replicated harnesses pass their seed list via extra_env.
+    env.pop("HOROVOD_GLOO_RENDEZVOUS_ADDR", None)
     env["HOROVOD_RENDEZVOUS_EPOCH"] = f"{battery}{size}"
     env.update(extra_env or {})
     procs = [

@@ -132,6 +132,19 @@ def test_prometheus_exposition_golden_file():
     reg.counter("horovod_statesync_bytes_total",
                 labels={"role": "joiner"}).inc(4096)
     reg.gauge("horovod_world_size", "Live world size").set(4)
+    # Rendezvous control plane (ISSUE 15): per-replica role, promotion
+    # counter, and the per-peer wire proto gauge of the HELLO handshake.
+    reg.gauge("horovod_rendezvous_role",
+              "1 while this replica is the rendezvous primary, 0 as "
+              "standby", labels={"replica": "0"}).set(1)
+    reg.gauge("horovod_rendezvous_role",
+              labels={"replica": "1"}).set(0)
+    reg.counter("horovod_rendezvous_failovers_total",
+                "Leader promotions this replica performed").inc()
+    reg.gauge("horovod_wire_proto_version",
+              "Wire protocol version the peer advertised at channel "
+              "establishment",
+              labels={"mesh": "ctrl0", "peer": "1"}).set(2)
     for state, n in (("free", 24), ("active", 6), ("cached", 2)):
         reg.gauge("horovod_serve_kv_blocks", "Paged KV blocks by state",
                   labels={"state": state}).set(n)
