@@ -66,9 +66,21 @@ _PROBE_CODE = (
 )
 
 
-def _probe_backend(timeout: float) -> tuple[int, str, str] | None:
+def _probe_backend_status(timeout: float) \
+        -> tuple[str, tuple[int, str, str] | None]:
     """Probe jax backend init in a subprocess (a wedged axon tunnel hangs
-    jax.devices() forever — never probe in-process first)."""
+    jax.devices() forever — never probe in-process first).
+
+    Returns ``(status, result)`` where status is:
+
+    - ``"ok"``: backend is up, result is (device_count, platform, kind);
+    - ``"absent"``: the probe ran to its timeout — a wedged/blackholed
+      tunnel, i.e. the accelerator genuinely is not reachable right now;
+    - ``"crash"``: the probe PROCESS died (rc != 0) or printed garbage —
+      a transient init crash (tunnel reset mid-handshake, plugin race),
+      NOT evidence the accelerator is gone.  BENCH_r01-05 burned whole
+      round windows treating these as terminal; they are retryable.
+    """
     # Probe with the IDENTICAL environment the in-process run will use —
     # popping JAX_PLATFORMS here would let the probe see a TPU the real
     # run (honoring the env) never touches, mislabeling the result.
@@ -78,18 +90,22 @@ def _probe_backend(timeout: float) -> tuple[int, str, str] | None:
             capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
         print("bench: backend probe timed out", file=sys.stderr)
-        return None
+        return "absent", None
     if out.returncode != 0:
-        print(f"bench: backend probe failed:\n{out.stderr[-2000:]}",
-              file=sys.stderr)
-        return None
+        print(f"bench: backend probe crashed (rc={out.returncode}):\n"
+              f"{out.stderr[-2000:]}", file=sys.stderr)
+        return "crash", None
     try:
         n, platform, kind = out.stdout.strip().rsplit("\n", 1)[-1].split("|")
-        return int(n), platform, kind
+        return "ok", (int(n), platform, kind)
     except ValueError:
         print(f"bench: unparseable probe output: {out.stdout!r}",
               file=sys.stderr)
-        return None
+        return "crash", None
+
+
+def _probe_backend(timeout: float) -> tuple[int, str, str] | None:
+    return _probe_backend_status(timeout)[1]
 
 
 def _init_backend(retries: int = 2, timeout: float = 150.0) -> dict:
@@ -216,17 +232,34 @@ def _probe_state_path() -> str:
 
 
 def _load_probe_state(window: float) -> dict:
-    """Checkpointed watcher state: {"window_start", "attempts"}.  A state
-    older than the window belongs to a previous round — start fresh."""
+    """Checkpointed watcher state: {"window_start", "attempts",
+    "active_s", "last_seen"}.
+
+    The window is measured in ACTIVE watching seconds (``active_s``),
+    not wall time: a tunnel outage that also kills the bench process
+    for hours must not burn the round's budget while nobody was
+    watching (BENCH_r01-05 recorded cpu-fallback rounds exactly this
+    way).  A resumed watcher therefore continues the same window no
+    matter how long it was dead; only a state whose budget is already
+    spent belongs to a finished round and starts fresh."""
     try:
         with open(_probe_state_path()) as f:
-            state = json.load(f)
-        if time.time() - float(state["window_start"]) <= window:
-            return {"window_start": float(state["window_start"]),
-                    "attempts": int(state.get("attempts", 0))}
+            raw = json.load(f)
+        ws = float(raw["window_start"])
+        state = {"window_start": ws,
+                 "attempts": int(raw.get("attempts", 0)),
+                 # Old-format states (pre active-time windows) carry no
+                 # active_s: resume with a zero budget spent rather
+                 # than discarding the round.
+                 "active_s": float(raw.get("active_s", 0.0)),
+                 "last_seen": float(raw.get("last_seen", ws))}
+        if state["active_s"] < window:
+            return state
     except (OSError, ValueError, KeyError, TypeError):
         pass
-    return {"window_start": time.time(), "attempts": 0}
+    now = time.time()
+    return {"window_start": now, "attempts": 0, "active_s": 0.0,
+            "last_seen": now}
 
 
 def _save_probe_state(state: dict) -> None:
@@ -260,13 +293,25 @@ def _orchestrate(args) -> int:
     watcher's state file (HOROVOD_BENCH_STATE_FILE) survives process
     death — a re-invoked bench RESUMES the same window instead of
     restarting the schedule, so the round keeps watching for the tunnel
-    to recover for as long as the driver keeps asking.  Each probe runs
+    to recover for as long as the driver keeps asking.
+
+    Two BENCH_r01-05 regressions fixed here: (1) a probe CRASH (the
+    subprocess exits rc!=0 — a tunnel reset mid-handshake, a plugin
+    race) is classified as RETRYABLE and retried on a short capped
+    backoff (5 s doubling, capped at the probe interval) instead of
+    being treated like "no accelerator" and burning a full interval
+    per crash; (2) the window is measured in ACTIVE watching seconds,
+    not wall time — a multi-hour tunnel outage that also kills the
+    bench process contributes at most one sleep's worth of budget per
+    gap, so the resumed watcher still has its round budget and the
+    next round records a real payload.  Each probe runs
     in the PARENT with a short timeout (a wedged tunnel costs 90 s, not
     a full inner spawn) and the inner run still fail-fasts via
     HVD_BENCH_REQUIRE_ACCEL if the tunnel dies between probe and run.
     A successful capture clears the checkpoint (the next round starts a
-    fresh window); a CPU fallback leaves it (the window is still open
-    for a retry of the same round).
+    fresh window); a CPU fallback leaves it — a re-run resumes any
+    remaining probe budget, and a spent budget marks the round finished
+    so the NEXT invocation starts a fresh window.
 
     HOROVOD_BENCH_PROBE_ATTEMPTS still caps the TOTAL probes per window
     when set, and a CPU-pinned environment (JAX_PLATFORMS=cpu) skips
@@ -296,17 +341,41 @@ def _orchestrate(args) -> int:
               "accelerator probe window", file=sys.stderr)
 
     state = _load_probe_state(window)
-    deadline = state["window_start"] + window
+    crash_streak = 0
+
+    def _tick(cap: float) -> None:
+        """Advance the active-time budget: wall time since the last
+        checkpoint counts while the watcher was provably alive, but a
+        process-death gap (a tunnel outage that killed the driver too)
+        contributes at most ``cap`` — the round survives the gap
+        instead of expiring during it."""
+        now = time.time()
+        state["active_s"] += min(max(now - state["last_seen"], 0.0), cap)
+        state["last_seen"] = now
+
     while not cpu_pinned:
+        _tick(2.0 * interval)
+        if state["active_s"] >= window:
+            print(f"bench: round window exhausted "
+                  f"({state['active_s']:.0f}s watched of {window:.0f}s)",
+                  file=sys.stderr)
+            # Checkpoint the spent budget: it marks this round finished,
+            # so the NEXT invocation starts a fresh window.
+            _save_probe_state(state)
+            break
         state["attempts"] += 1
         _save_probe_state(state)
-        if _probe_backend(timeout=90.0) is not None:
+        status, _probed = _probe_backend_status(timeout=90.0)
+        _tick(120.0)   # the probe itself ran in-process (<= 90 s)
+        if status == "ok":
+            crash_streak = 0
             # Attempt runs fail fast on probe failure
             # (HVD_BENCH_REQUIRE_ACCEL) instead of silently completing a
             # CPU benchmark the watcher would discard; CPU execution
             # happens only in the final explicit fallback below.
             rc, payload, err, oom = _spawn_inner(
                 args, {"HVD_BENCH_REQUIRE_ACCEL": "1"}, timeout=900.0)
+            _tick(1200.0)   # the attempt ran in-process (<= 900 s)
             if rc == 0 and payload and \
                     not str(payload.get("metric", "")
                             ).endswith("_failed") and \
@@ -314,6 +383,7 @@ def _orchestrate(args) -> int:
                 payload["attempts"] = state["attempts"]
                 payload["probe_window_s"] = round(
                     time.time() - state["window_start"], 1)
+                payload["probe_active_s"] = round(state["active_s"], 1)
                 _clear_probe_state()
                 _emit(payload)
                 return 0
@@ -338,22 +408,33 @@ def _orchestrate(args) -> int:
                                  f"{err[-300:]}"),
                        "attempts": state["attempts"]})
                 return 0
+            delay = interval
+        elif status == "crash":
+            # A transient probe crash is NOT "no accelerator": retry on
+            # a short capped backoff instead of burning a full probe
+            # interval per crash (the BENCH_r01-05 failure shape).
+            crash_streak += 1
+            delay = min(5.0 * (2.0 ** (crash_streak - 1)), interval)
+            print(f"bench: probe {state['attempts']}: transient probe "
+                  f"crash (#{crash_streak} in a row); retrying in "
+                  f"{delay:.0f}s", file=sys.stderr)
         else:
+            crash_streak = 0
+            delay = interval
             print(f"bench: probe {state['attempts']}: no accelerator "
-                  f"({max(deadline - time.time(), 0):.0f}s left in the "
-                  f"round window)", file=sys.stderr)
+                  f"({max(window - state['active_s'], 0):.0f}s of probe "
+                  f"budget left in the round window)", file=sys.stderr)
+        _save_probe_state(state)
         if attempts_cap is not None and state["attempts"] >= attempts_cap:
             print(f"bench: HOROVOD_BENCH_PROBE_ATTEMPTS cap "
                   f"({attempts_cap}) reached", file=sys.stderr)
             break
-        if time.time() + interval > deadline:
-            print("bench: round window exhausted", file=sys.stderr)
-            break
-        time.sleep(min(interval, max(deadline - time.time(), 0.0)))
+        time.sleep(min(delay, max(window - state["active_s"], 0.0)))
 
     print("bench: accelerator unavailable; falling back to CPU "
-          "(watcher state is kept — a re-run inside the window resumes "
-          "the probe schedule)", file=sys.stderr)
+          "(watcher state is kept — a re-run resumes any remaining "
+          "probe budget; a spent window starts the next round fresh)",
+          file=sys.stderr)
     rc, payload, err, _ = _spawn_inner(args, {"JAX_PLATFORMS": "cpu"},
                                        timeout=900.0)
     if rc == 0 and payload:

@@ -236,6 +236,19 @@ THREAD_ROOTS: dict[str, tuple[str, str]] = {
         "lease monitor: renews the leader lease (primary) or watches "
         "for lapse and runs the election (standby); stopped + joined "
         "by ControlPlane.close"),
+    # fleetsim harness (ISSUE 16): vid-suffixed virtual-rank bodies the
+    # static Thread(target=, name=) scan cannot bind (f-string names).
+    "hvd-fleet-vrank-*": (
+        "fleetsim.vrank.VirtualRank._run",
+        "one virtual rank's protocol loop: real heartbeat monitor + "
+        "chaos matching + loopback boundary exchange per step; joined "
+        "by FleetSim.run against the episode deadline (abort wakes "
+        "stragglers via LoopbackFabric.abort)"),
+    "hvd-fleet-ctlwatch": (
+        "fleetsim.harness._CtlRoleProber._run",
+        "episode-long sampler of every rendezvous replica's /.ctl/role "
+        "(the console's failover timeline); stopped + joined by "
+        "_CtlRoleProber.close from FleetSim.close"),
     "hvd-chaos-cont": (
         "resilience.chaos._sigcont",
         "coordpause resume Timer: delivers SIGCONT to the paused "

@@ -401,6 +401,69 @@ AUTOSCALE_HYSTERESIS_ROUNDS = register(
     "decision fires (and the cooldown after each decision), so one "
     "burst never flaps the world size.")
 
+# --- Fleet-scale harness (fleetsim/ subsystem; docs/fleetsim.md) ------------
+FLEETSIM_RANKS = register(
+    "HOROVOD_FLEETSIM_RANKS", 32, int,
+    "Virtual ranks the fleetsim harness runs inside one process: each "
+    "executes the real control-plane client, heartbeat monitor, and "
+    "membership boundary exchange (compute is stubbed).")
+FLEETSIM_STEPS = register(
+    "HOROVOD_FLEETSIM_STEPS", 12, int,
+    "Boundary exchanges (virtual training steps) one fleetsim episode "
+    "runs before the orderly fleet-wide stop.")
+FLEETSIM_STEP_MS = register(
+    "HOROVOD_FLEETSIM_STEP_MS", 5.0, float,
+    "Stubbed per-step compute delay of every virtual rank, ms (the "
+    "model-compute stand-in between membership boundaries).")
+FLEETSIM_HOST_GROUP = register(
+    "HOROVOD_FLEETSIM_HOST_GROUP", 16, int,
+    "Virtual ranks per simulated host: one host group shares a "
+    "rendezvous client, batches its heartbeat stamps into a single "
+    "PUT /.batch/ per window, and refreshes liveness from one scope "
+    "dump instead of size-many gets.")
+FLEETSIM_HEARTBEAT_S = register(
+    "HOROVOD_FLEETSIM_HEARTBEAT_S", 1.0, float,
+    "Heartbeat publish/poll interval of every virtual rank's monitor.")
+FLEETSIM_FAULT_TIMEOUT_S = register(
+    "HOROVOD_FLEETSIM_FAULT_TIMEOUT_S", 20.0, float,
+    "Heartbeat staleness window before a virtual rank declares a peer "
+    "failed (must exceed the control-plane failover window under "
+    "coordkill chaos, or the whole fleet condemns itself).")
+FLEETSIM_STRAGGLER_RANK = register(
+    "HOROVOD_FLEETSIM_STRAGGLER_RANK", -1, int,
+    "Launch id of one virtual rank made to drag every step "
+    "(HOROVOD_FLEETSIM_STRAGGLER_MS extra delay); -1 disables.  "
+    "Exercises the coordinator straggler-attribution path at fleet "
+    "scale.")
+FLEETSIM_STRAGGLER_MS = register(
+    "HOROVOD_FLEETSIM_STRAGGLER_MS", 40.0, float,
+    "Extra per-step delay of the designated straggler virtual rank.")
+FLEETSIM_STEP_TIMEOUT_S = register(
+    "HOROVOD_FLEETSIM_STEP_TIMEOUT_S", 60.0, float,
+    "Bound on one boundary exchange: a virtual rank that cannot "
+    "complete the membership allgather inside it counts a failed step "
+    "and leaves the fleet (desync backstop, never silent hang).")
+FLEETSIM_DUMP_DIR = register(
+    "HOROVOD_FLEETSIM_DUMP_DIR", "", str,
+    "Directory the episode's rank-stamped evidence lands in (flight "
+    "ring, metrics snapshot, control-plane role probes, episode "
+    "summary) — the operator console replays an episode from it.  "
+    "Empty disables dumping.")
+FLEETSIM_AUTOSCALE = register(
+    "HOROVOD_FLEETSIM_AUTOSCALE", False, _parse_bool,
+    "Drive the real autoscale policy from the harness's synthetic "
+    "serving load: up-decisions admit joiner virtual ranks, "
+    "down-decisions preempt the highest launch id (exercises "
+    "autoscale oscillation against the live control plane).")
+
+# --- Operator console (console/ subsystem; docs/observability.md) -----------
+CONSOLE_REFRESH_S = register(
+    "HOROVOD_CONSOLE_REFRESH_S", 2.0, float,
+    "Delay between live-mode console frames (scrape mode).")
+CONSOLE_TOPK = register(
+    "HOROVOD_CONSOLE_TOPK", 8, int,
+    "Rows per console section (top-K ranks, last-K membership events).")
+
 # --- Inference serving (serving/ subsystem; docs/serving.md) ----------------
 SERVE_MAX_BATCH = register(
     "HOROVOD_SERVE_MAX_BATCH", 8, int,

@@ -1,0 +1,16 @@
+"""console — the live/post-hoc operator view of a fleet.
+
+``python -m horovod_tpu.console --dumps DIR`` replays a finished (or
+crashed) episode from its rank-stamped evidence — flight rings, metrics
+snapshots, ``/.ctl`` role probes, fleetsim summaries — and ``--scrape``
+/ ``--ctl`` fuse the same view live from each rank's Prometheus
+exporter and the rendezvous replicas' control endpoints.  One fused
+screen answers the first three incident questions: who is primary, who
+left the fleet and why, and where the time is going (straggler +
+rendezvous-KV verb latency).  See docs/observability.md.
+"""
+from .render import render, summary_lines
+from .sources import Episode, live_snapshot, load_dump_dir
+
+__all__ = ["Episode", "live_snapshot", "load_dump_dir", "render",
+           "summary_lines"]

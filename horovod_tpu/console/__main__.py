@@ -1,0 +1,86 @@
+"""CLI: ``python -m horovod_tpu.console``.
+
+Post-hoc:  ``--dumps DIR`` renders a recorded episode once.
+Live:      ``--scrape host:port,...`` (metrics exporters) and/or
+           ``--ctl host:port,...`` (rendezvous replicas) render one
+           scrape pass; add ``--watch`` to refresh every
+           HOROVOD_CONSOLE_REFRESH_S seconds until interrupted.
+``--summary`` prints the compact golden-testable lines instead of the
+full view (what tests/test_console.py pins).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ..common import config
+from .render import render, summary_lines
+from .sources import live_snapshot, load_dump_dir
+
+
+def _split(raw: str) -> list[str]:
+    return [part.strip() for part in raw.split(",") if part.strip()]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.console",
+        description="operator console: fused fleet view from dumps or "
+                    "live scrapes")
+    parser.add_argument("--dumps", default="",
+                        help="directory of rank-stamped episode dumps "
+                             "(post-hoc mode)")
+    parser.add_argument("--scrape", default="",
+                        help="comma-separated metrics-exporter "
+                             "endpoints (live mode)")
+    parser.add_argument("--ctl", default="",
+                        help="comma-separated rendezvous replica "
+                             "endpoints for /.ctl/role probes")
+    parser.add_argument("--watch", action="store_true",
+                        help="live mode: refresh until interrupted")
+    parser.add_argument("--refresh", type=float,
+                        default=config.CONSOLE_REFRESH_S.get(),
+                        help="watch refresh period in seconds")
+    parser.add_argument("--topk", type=int,
+                        default=config.CONSOLE_TOPK.get(),
+                        help="rows per truncated section")
+    parser.add_argument("--summary", action="store_true",
+                        help="print the compact episode summary only")
+    args = parser.parse_args(argv)
+
+    scrape = _split(args.scrape)
+    ctl = _split(args.ctl)
+    if not args.dumps and not scrape and not ctl:
+        parser.error("one of --dumps or --scrape/--ctl is required")
+    if args.dumps and args.watch:
+        parser.error("--watch is for live mode; --dumps renders once")
+
+    def _load():
+        if args.dumps:
+            return load_dump_dir(args.dumps)
+        return live_snapshot(scrape, ctl)
+
+    def _show(ep) -> None:
+        if args.summary:
+            print("\n".join(summary_lines(ep)))
+        else:
+            print(render(ep, topk=args.topk), end="")
+
+    episode = _load()
+    if not args.watch:
+        _show(episode)
+        return 0 if not episode.empty else 1
+    try:
+        while True:
+            # ANSI home+clear keeps the view in place like `watch(1)`.
+            sys.stdout.write("\x1b[H\x1b[2J")
+            _show(episode)
+            time.sleep(max(args.refresh, 0.2))
+            episode = _load()
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

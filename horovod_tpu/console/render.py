@@ -1,0 +1,319 @@
+"""Deterministic text rendering of one episode's evidence.
+
+The renderer is a pure function of the :class:`~.sources.Episode` —
+same files in, same characters out — so the golden-fixture test
+(tests/test_console.py) can assert the full summary byte-for-byte.
+Sections degrade independently: evidence a given episode never produced
+(no autoscaler, no chaos, no control-plane probes) renders as an
+explicit ``none`` line rather than vanishing, so an operator can tell
+"feature idle" from "dump missing".
+"""
+from __future__ import annotations
+
+from .sources import Episode
+
+__all__ = ["render", "summary_lines"]
+
+# Flight kinds that narrate the membership story, in the order the
+# fleetsim harness emits them (vrank.py / harness.py).
+_MEMBERSHIP_KINDS = (
+    "fleet-start", "join-announce", "join-entered", "preempt-notice",
+    "departed", "fleet-vkill", "fleet-desync", "fleet-step-fail",
+    "grow", "shrink", "autoscale", "fleet-end",
+)
+
+
+def _fmt(value) -> str:
+    if isinstance(value, float):
+        return f"{value:.1f}"
+    return str(value)
+
+
+def _summary(ep: Episode) -> dict | None:
+    """The lowest-rank fleetsim summary (normally there is one)."""
+    best = None
+    for payload in ep.summaries:
+        rank = payload.get("rank", 0)
+        if best is None or rank < best[0]:
+            best = (rank, payload["fleetsim_summary"])
+    return best[1] if best else None
+
+
+def _metric_entries(ep: Episode, name: str) -> list[dict]:
+    out = []
+    for snap in ep.metrics:
+        for entry in snap.get("metrics", ()):
+            if entry.get("name") == name:
+                out.append(entry)
+    return out
+
+
+def _counter_by_label(ep: Episode, name: str, label: str) -> dict:
+    folded: dict[str, float] = {}
+    for entry in _metric_entries(ep, name):
+        key = entry.get("labels", {}).get(label, "")
+        folded[key] = folded.get(key, 0.0) + float(entry.get("value", 0))
+    return folded
+
+
+def _counter_total(ep: Episode, name: str) -> float:
+    return sum(float(e.get("value", 0))
+               for e in _metric_entries(ep, name))
+
+
+def _membership_events(ep: Episode) -> list[tuple[float, int, dict]]:
+    """Merge membership-narrative flight events across ranks, on each
+    dump's own relative clock (monotonic clocks don't compare across
+    processes)."""
+    merged = []
+    for dump in ep.flights:
+        events = dump.get("events", ())
+        if not events:
+            continue
+        t0 = min(e.get("ts", 0.0) for e in events)
+        rank = dump.get("rank", 0)
+        for e in events:
+            if e.get("kind") in _MEMBERSHIP_KINDS:
+                merged.append((round(e.get("ts", 0.0) - t0, 3), rank, e))
+    merged.sort(key=lambda item: (item[0], item[1],
+                                  item[2].get("kind", ""),
+                                  item[2].get("name", "")))
+    return merged
+
+
+def _role_timeline(ep: Episode) -> tuple[list[dict], list[str]]:
+    """(all probes time-ordered, distinct primaries first-seen)."""
+    probes = []
+    for dump in ep.ctl_roles:
+        probes.extend(dump.get("probes", ()))
+    probes.sort(key=lambda p: (p.get("t", 0.0), p.get("endpoint", "")))
+    primaries = []
+    for p in probes:
+        if str(p.get("role", "")).startswith("primary") \
+                and p.get("endpoint") not in primaries:
+            primaries.append(p["endpoint"])
+    return probes, primaries
+
+
+def _transitions(probes: list[dict]) -> list[str]:
+    """Role-change edges per endpoint (the promotion/demotion story)."""
+    last: dict[str, str] = {}
+    edges = []
+    for p in probes:
+        endpoint = p.get("endpoint", "?")
+        role = str(p.get("role", "?")).split("|")[0]
+        if last.get(endpoint) not in (None, role):
+            edges.append(f"t={_fmt(p.get('t', 0.0))}s {endpoint}: "
+                         f"{last[endpoint]} -> {role}")
+        last[endpoint] = role
+    return edges
+
+
+# ---------------------------------------------------------------------------
+# Sections
+# ---------------------------------------------------------------------------
+def _sec_fleet(ep: Episode, lines: list[str]) -> None:
+    s = _summary(ep)
+    lines.append("== fleet ==")
+    if s is None:
+        world = _metric_entries(ep, "horovod_fleetsim_world_size")
+        steps = _counter_total(ep, "horovod_fleetsim_steps_total")
+        if world or steps:
+            size = max((e.get("value", 0) for e in world), default=0)
+            lines.append(f"world={int(size)} rank_steps={int(steps)} "
+                         "(no summary dump)")
+        else:
+            lines.append("no fleet summary")
+        return
+    lines.append(f"ranks={s['ranks']} steps={s['steps']} "
+                 f"rank_steps={s['total_rank_steps']} "
+                 f"failed_steps={s['failed_steps']}")
+    outcomes = " ".join(f"{k}={v}"
+                        for k, v in sorted(s["outcomes"].items()))
+    lines.append(f"outcomes: {outcomes or 'none'}")
+    world = s.get("final_world", [])
+    shown = ",".join(map(str, world[:16]))
+    more = f" (+{len(world) - 16} more)" if len(world) > 16 else ""
+    lines.append(f"final_world[{len(world)}]: {shown}{more}")
+
+
+def _sec_controlplane(ep: Episode, lines: list[str], topk: int) -> None:
+    lines.append("== control plane ==")
+    probes, primaries = _role_timeline(ep)
+    if not probes:
+        lines.append("role probes: none")
+    else:
+        lines.append(f"role probes: {len(probes)}  "
+                     f"primaries: {','.join(primaries) or 'none'}  "
+                     f"failovers: {max(len(primaries) - 1, 0)}")
+        for edge in _transitions(probes)[:topk]:
+            lines.append(f"  {edge}")
+    batches = _counter_total(
+        ep, "horovod_rendezvous_wal_commit_batches_total")
+    records = _counter_total(ep, "horovod_rendezvous_wal_records_total")
+    if records:
+        ratio = records / batches if batches else 0.0
+        lines.append(f"wal: records={int(records)} "
+                     f"fsync_batches={int(batches)} "
+                     f"coalescing=x{ratio:.1f}")
+    else:
+        lines.append("wal: no counters (server ran out of process)")
+
+
+def _sec_membership(ep: Episode, lines: list[str], topk: int) -> None:
+    lines.append("== membership ==")
+    s = _summary(ep)
+    if s is not None:
+        departures = " ".join(f"{k}={v}" for k, v
+                              in sorted(s["departures"].items()))
+        lines.append(f"transitions={s['transitions']} "
+                     f"joins={s['joins']} "
+                     f"departures: {departures or 'none'}")
+    events = _membership_events(ep)
+    if not events:
+        lines.append("flight events: none")
+        return
+    shown = events if len(events) <= 2 * topk \
+        else events[:topk] + events[-topk:]
+    for t, rank, e in shown:
+        detail = f" {e['detail']}" if e.get("detail") else ""
+        lines.append(f"  [r{rank} +{t:.3f}s] {e['kind']} "
+                     f"{e.get('name', '')}{detail}")
+    if len(events) > len(shown):
+        lines.append(f"  ... {len(events) - len(shown)} more events")
+
+
+def _sec_straggler(ep: Episode, lines: list[str]) -> None:
+    lines.append("== straggler ==")
+    s = _summary(ep)
+    rank = lag = None
+    if s is not None:
+        rank, lag = s.get("straggler_rank"), s.get("straggler_lag_ms")
+    else:
+        for e in _metric_entries(ep, "horovod_controller_straggler_rank"):
+            rank = int(e.get("value", -1))
+        for e in _metric_entries(
+                ep, "horovod_controller_straggler_lag_ms"):
+            lag = e.get("value")
+    if rank is None or rank < 0:
+        lines.append("none flagged")
+        return
+    lines.append(f"rank={rank} lag_ms={_fmt(lag or 0.0)}")
+    stats = {e["labels"].get("stat", ""): e.get("value", 0.0)
+             for e in _metric_entries(
+                 ep, "horovod_controller_negotiation_lag_ms")}
+    if stats:
+        lines.append("negotiation lag: "
+                     + " ".join(f"{k}={_fmt(v)}"
+                                for k, v in sorted(stats.items())))
+
+
+def _sec_autoscale(ep: Episode, lines: list[str], topk: int) -> None:
+    lines.append("== autoscale ==")
+    s = _summary(ep)
+    decisions = (s or {}).get("autoscale_decisions") or []
+    if decisions:
+        for d in decisions[:topk]:
+            lines.append(f"  {d}")
+        if len(decisions) > topk:
+            lines.append(f"  ... {len(decisions) - topk} more")
+        return
+    by_dir = _counter_by_label(ep, "horovod_autoscale_decisions_total",
+                               "direction")
+    if by_dir:
+        lines.append("decisions: "
+                     + " ".join(f"{k}={int(v)}"
+                                for k, v in sorted(by_dir.items())))
+    else:
+        lines.append("no decisions")
+
+
+def _sec_kv(ep: Episode, lines: list[str]) -> None:
+    lines.append("== rendezvous kv latency (ms) ==")
+    s = _summary(ep)
+    if s is not None and s.get("kv_latency_ms"):
+        table = s["kv_latency_ms"]
+    else:
+        table = {}
+        for e in _metric_entries(ep,
+                                 "horovod_rendezvous_kv_latency_ms"):
+            verb = e.get("labels", {}).get("verb", "?")
+            table[verb] = {"count": e.get("count", 0),
+                           "p50": e.get("p50", 0.0),
+                           "p99": e.get("p99", 0.0)}
+    if not table:
+        lines.append("no kv traffic observed")
+        return
+    lines.append(f"  {'verb':<10} {'count':>7} {'p50':>9} {'p99':>9}")
+    for verb in sorted(table):
+        row = table[verb]
+        lines.append(f"  {verb:<10} {row['count']:>7} "
+                     f"{row['p50']:>9.1f} {row['p99']:>9.1f}")
+
+
+def _sec_admission(ep: Episode, lines: list[str]) -> None:
+    lines.append("== admission ==")
+    outcomes = _counter_by_label(ep, "horovod_serve_requests_total",
+                                 "outcome")
+    if not outcomes:
+        lines.append("no admission traffic")
+        return
+    lines.append(" ".join(f"{k}={int(v)}"
+                          for k, v in sorted(outcomes.items())))
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+def render(ep: Episode, topk: int = 8) -> str:
+    """The full console view; one deterministic string."""
+    if ep.empty:
+        return (f"horovod_tpu console: no episode evidence in "
+                f"{ep.source!r}\n(expected flight/metrics/ctl_roles/"
+                "summary dumps, or reachable scrape endpoints)\n")
+    lines = [f"horovod_tpu operator console — {ep.source}",
+             f"dumps: flight={len(ep.flights)} "
+             f"metrics={len(ep.metrics)} ctl={len(ep.ctl_roles)} "
+             f"summary={len(ep.summaries)} "
+             f"skipped={len(ep.skipped)}"]
+    _sec_fleet(ep, lines)
+    _sec_controlplane(ep, lines, topk)
+    _sec_membership(ep, lines, topk)
+    _sec_straggler(ep, lines)
+    _sec_autoscale(ep, lines, topk)
+    _sec_kv(ep, lines)
+    _sec_admission(ep, lines)
+    return "\n".join(lines) + "\n"
+
+
+def summary_lines(ep: Episode) -> list[str]:
+    """The compact golden-testable episode summary: what happened, in
+    order, with the numbers that decide pass/fail."""
+    if ep.empty:
+        return ["empty episode"]
+    out = []
+    s = _summary(ep)
+    if s is not None:
+        out.append(f"fleet ranks={s['ranks']} steps={s['steps']} "
+                   f"rank_steps={s['total_rank_steps']} "
+                   f"failed={s['failed_steps']}")
+        out.append("outcomes "
+                   + " ".join(f"{k}={v}" for k, v
+                              in sorted(s["outcomes"].items())))
+        departures = " ".join(f"{k}={v}" for k, v
+                              in sorted(s["departures"].items()))
+        out.append(f"membership transitions={s['transitions']} "
+                   f"joins={s['joins']} "
+                   f"departures {departures or 'none'}")
+        out.append(f"straggler rank={s['straggler_rank']}")
+    primaries = _role_timeline(ep)[1]
+    out.append(f"controlplane primaries={len(primaries)} "
+               f"failovers={max(len(primaries) - 1, 0)}")
+    events = _membership_events(ep)
+    kinds: dict[str, int] = {}
+    for _t, _r, e in events:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    out.append("events "
+               + (" ".join(f"{k}={v}" for k, v in sorted(kinds.items()))
+                  or "none"))
+    return out

@@ -1,0 +1,199 @@
+"""Evidence loaders for the operator console.
+
+Two modes feed the same renderer:
+
+- **Post-hoc**: a directory of rank-stamped dumps from a finished (or
+  crashed) episode — flight-recorder rings (``flight.r*.json``),
+  telemetry snapshots (``metrics.r*.json``), ``/.ctl`` role-probe
+  timelines (``ctl_roles.r*.json``) and fleetsim summaries
+  (``summary.r*.json``).  Files are classified by PAYLOAD SHAPE, not
+  filename, so dumps renamed by collection tooling still load.
+- **Live**: Prometheus text scraped from each rank's metrics exporter
+  (telemetry/exporter.py) plus the rendezvous replicas' ``/.ctl/role``
+  keys, re-assembled into the same snapshot schema the post-hoc dumps
+  use.
+
+Everything here is best-effort: an unreadable file or unreachable
+endpoint degrades to an absent section, never an exception — the
+console is the tool you reach for when the fleet is already broken.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from urllib import request as urlrequest
+
+__all__ = ["Episode", "load_dump_dir", "live_snapshot",
+           "parse_prometheus", "probe_ctl", "scrape_metrics"]
+
+
+@dataclasses.dataclass
+class Episode:
+    """One episode's evidence, whatever subset of it was found."""
+    source: str
+    flights: list = dataclasses.field(default_factory=list)
+    metrics: list = dataclasses.field(default_factory=list)
+    ctl_roles: list = dataclasses.field(default_factory=list)
+    summaries: list = dataclasses.field(default_factory=list)
+    skipped: list = dataclasses.field(default_factory=list)
+
+    @property
+    def empty(self) -> bool:
+        return not (self.flights or self.metrics or self.ctl_roles
+                    or self.summaries)
+
+
+def _classify(payload) -> str | None:
+    """Dump kind by shape (see module docstring)."""
+    if not isinstance(payload, dict):
+        return None
+    if "fleetsim_summary" in payload:
+        return "summary"
+    if "events" in payload and "reason" in payload:
+        return "flight"
+    if "probes" in payload:
+        return "ctl"
+    if "metrics" in payload and "rank" in payload:
+        return "metrics"
+    return None
+
+
+def load_dump_dir(path: str) -> Episode:
+    """Load every classifiable ``*.json`` under ``path`` (one level)."""
+    ep = Episode(source=path)
+    try:
+        names = sorted(os.listdir(path))
+    except OSError:
+        return ep
+    buckets = {"flight": ep.flights, "metrics": ep.metrics,
+               "ctl": ep.ctl_roles, "summary": ep.summaries}
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        full = os.path.join(path, name)
+        try:
+            with open(full) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            ep.skipped.append(name)
+            continue
+        kind = _classify(payload)
+        if kind is None:
+            ep.skipped.append(name)
+            continue
+        payload.setdefault("_file", name)
+        buckets[kind].append(payload)
+    return ep
+
+
+# ---------------------------------------------------------------------------
+# Live scrape
+# ---------------------------------------------------------------------------
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def parse_prometheus(text: str) -> list[dict]:
+    """Prometheus text format -> ``[{"name", "labels", "value"}]``.
+    Unparsable lines are skipped (scrape-side truncation happens)."""
+    samples = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        labels = dict(_LABEL_RE.findall(raw_labels or ""))
+        samples.append({"name": name, "labels": labels, "value": value})
+    return samples
+
+
+def samples_to_snapshot(samples: list[dict], rank: int) -> dict:
+    """Re-assemble flat scrape samples into the ``dump_json`` snapshot
+    schema (telemetry/exporter.py): counters/gauges keep their value,
+    histogram series (``_count``/``_sum``/``quantile=``) fold back into
+    one entry with count/sum/p50/p99."""
+    plain: list[dict] = []
+    hists: dict[tuple, dict] = {}
+
+    def _hist(base: str, labels: dict) -> dict:
+        key = (base, tuple(sorted(labels.items())))
+        return hists.setdefault(
+            key, {"name": base, "labels": dict(labels),
+                  "type": "histogram", "count": 0, "sum": 0.0,
+                  "p50": 0.0, "p99": 0.0})
+
+    for s in samples:
+        name, labels, value = s["name"], dict(s["labels"]), s["value"]
+        q = labels.pop("quantile", None)
+        if q is not None:
+            h = _hist(name, labels)
+            if q == "0.5":
+                h["p50"] = value
+            elif q == "0.99":
+                h["p99"] = value
+            continue
+        if name.endswith("_bucket") and "le" in labels:
+            continue    # quantiles carry what the console renders
+        if name.endswith("_count"):
+            _hist(name[:-len("_count")], labels)["count"] = int(value)
+            continue
+        if name.endswith("_sum"):
+            _hist(name[:-len("_sum")], labels)["sum"] = value
+            continue
+        kind = "counter" if name.endswith("_total") else "gauge"
+        plain.append({"name": name, "labels": labels, "type": kind,
+                      "value": value})
+    return {"rank": rank, "metrics": plain + list(hists.values())}
+
+
+def scrape_metrics(endpoint: str, timeout: float = 2.0) -> list[dict]:
+    """GET ``/metrics`` from one exporter; [] when unreachable."""
+    try:
+        with urlrequest.urlopen(f"http://{endpoint}/metrics",
+                                timeout=timeout) as resp:
+            return parse_prometheus(resp.read().decode(errors="replace"))
+    except OSError:
+        return []
+
+
+def probe_ctl(endpoint: str, key: str = "role",
+              timeout: float = 1.0) -> str:
+    """GET one ``/.ctl/<key>`` from a rendezvous replica."""
+    try:
+        with urlrequest.urlopen(f"http://{endpoint}/.ctl/{key}",
+                                timeout=timeout) as resp:
+            return resp.read().decode(errors="replace")
+    except OSError:
+        return "unreachable"
+
+
+def live_snapshot(metric_endpoints: list[str],
+                  ctl_endpoints: list[str]) -> Episode:
+    """One live scrape pass across the fleet, shaped like a dump dir."""
+    ep = Episode(source="live:" + ",".join(metric_endpoints
+                                           + ctl_endpoints))
+    for i, endpoint in enumerate(metric_endpoints):
+        samples = scrape_metrics(endpoint)
+        if samples:
+            snap = samples_to_snapshot(samples, rank=i)
+            snap["_endpoint"] = endpoint
+            ep.metrics.append(snap)
+        else:
+            ep.skipped.append(endpoint)
+    if ctl_endpoints:
+        probes = [{"t": 0.0, "endpoint": endpoint,
+                   "role": probe_ctl(endpoint)}
+                  for endpoint in ctl_endpoints]
+        ep.ctl_roles.append({"probes": probes,
+                             "endpoints": list(ctl_endpoints)})
+    return ep

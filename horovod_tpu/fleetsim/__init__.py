@@ -1,0 +1,25 @@
+"""fleetsim — rank-virtualized O(500) scale harness (ROADMAP item 5).
+
+Runs hundreds of *protocol-only* ranks inside one process: each virtual
+rank executes the REAL control-plane client (through a host-group
+batching session), the REAL heartbeat monitor, the membership boundary
+fold, and deterministic chaos matching — with model compute stubbed to
+a configurable delay and the tensor data plane replaced by an
+in-process loopback allgather.  Coordinator WAL throughput, failover
+storms, liveness fan-out, autoscale oscillation, and straggler
+attribution are thereby exercised at fleet scale in CI seconds.
+
+Entry points: ``python -m horovod_tpu.fleetsim`` runs one episode from
+the HOROVOD_FLEETSIM_* environment; tests drive :class:`FleetSim`
+directly.  The episode's rank-stamped evidence (flight ring, metrics
+snapshot, ``/.ctl`` role probes, summary) replays in the operator
+console (``python -m horovod_tpu.console``).  See docs/fleetsim.md.
+"""
+from .harness import FleetConfig, FleetReport, FleetSim
+from .kvproxy import HostGroupKV, HostGroupSession
+from .loopback import FleetDesyncError, LoopbackFabric
+from .vrank import VirtualChaosEngine, VirtualRank
+
+__all__ = ["FleetConfig", "FleetDesyncError", "FleetReport", "FleetSim",
+           "HostGroupKV", "HostGroupSession", "LoopbackFabric",
+           "VirtualChaosEngine", "VirtualRank"]

@@ -1,0 +1,28 @@
+"""CLI: run one fleetsim episode from the environment.
+
+``python -m horovod_tpu.fleetsim`` builds a :class:`FleetSim` from the
+HOROVOD_FLEETSIM_* knobs (rendezvous endpoints from
+HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT, chaos from HOROVOD_CHAOS), runs the
+episode, prints one ``FLEETSIM_SUMMARY <json>`` line, and exits 0 when
+every step succeeded — the mp_worker batteries and ad-hoc load
+generation both ride this entry point.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+from .harness import FleetConfig, FleetSim
+
+
+def main(argv=None) -> int:
+    cfg = FleetConfig.from_env()
+    fleet = FleetSim(cfg)
+    report = fleet.run()
+    print("FLEETSIM_SUMMARY " + json.dumps(report.to_dict(),
+                                           sort_keys=True))
+    return 0 if report.failed_steps == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,573 @@
+"""The fleet harness: config, shared services, episode lifecycle.
+
+:class:`FleetSim` owns everything the virtual ranks share — the
+loopback fabric, one rendezvous client + stamp batcher per simulated
+host group, the coordinator-side straggler aggregator, the real
+admission controller fed a scripted synthetic load, the real autoscale
+policy (up-decisions admit joiner virtual ranks over the live KV join
+path, down-decisions drain the highest launch id), and a control-plane
+role prober that snapshots every replica's ``/.ctl/role`` through the
+episode so the operator console can replay failovers and promotions.
+
+``run()`` drives one episode: start N virtual ranks, let them step to
+``HOROVOD_FLEETSIM_STEPS`` boundaries under whatever chaos
+``HOROVOD_CHAOS`` specifies, then join everything and (with
+``HOROVOD_FLEETSIM_DUMP_DIR`` set) write the rank-stamped evidence the
+console renders post-hoc: the flight ring, the metrics snapshot, the
+role-probe timeline, and a machine-readable episode summary.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from urllib import request as urlrequest
+
+from ..common import config
+from ..common.logging import logger
+from ..runner.network import RendezvousClient
+from ..serving.admission import AdmissionController
+from ..statesync.autoscale import (AutoscaleController, AutoscalePolicy,
+                                   registry_source)
+from ..telemetry import flight as flight_mod
+from ..telemetry import metrics as _tm_metrics
+from ..telemetry.exporter import dump_json
+from ..telemetry.registry import NULL_REGISTRY
+from ..telemetry.straggler import StragglerAggregator
+from .kvproxy import HostGroupKV, HostGroupSession
+from .loopback import LoopbackFabric
+from .vrank import JOIN_SCOPE, VirtualRank
+
+__all__ = ["FleetConfig", "FleetReport", "FleetSim"]
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """One episode's knobs (defaults from the HOROVOD_FLEETSIM_*
+    registry — see docs/fleetsim.md for the table)."""
+
+    ranks: int = 32
+    steps: int = 12
+    step_ms: float = 5.0
+    host_group: int = 16
+    heartbeat_s: float = 1.0
+    fault_timeout_s: float = 20.0
+    straggler_vid: int = -1
+    straggler_ms: float = 40.0
+    step_timeout_s: float = 60.0
+    dump_dir: str = ""
+    autoscale: bool = False
+    epoch: str = "fleet"
+    endpoints: str = ""
+
+    @classmethod
+    def from_env(cls) -> "FleetConfig":
+        addr = config.RENDEZVOUS_ADDR.get()
+        port = config.RENDEZVOUS_PORT.get()
+        endpoints = ",".join(
+            RendezvousClient.parse_endpoints(addr, port)) if addr else ""
+        return cls(
+            ranks=config.FLEETSIM_RANKS.get(),
+            steps=config.FLEETSIM_STEPS.get(),
+            step_ms=config.FLEETSIM_STEP_MS.get(),
+            host_group=config.FLEETSIM_HOST_GROUP.get(),
+            heartbeat_s=config.FLEETSIM_HEARTBEAT_S.get(),
+            fault_timeout_s=config.FLEETSIM_FAULT_TIMEOUT_S.get(),
+            straggler_vid=config.FLEETSIM_STRAGGLER_RANK.get(),
+            straggler_ms=config.FLEETSIM_STRAGGLER_MS.get(),
+            step_timeout_s=config.FLEETSIM_STEP_TIMEOUT_S.get(),
+            dump_dir=config.FLEETSIM_DUMP_DIR.get(),
+            autoscale=config.FLEETSIM_AUTOSCALE.get(),
+            epoch=config.RENDEZVOUS_EPOCH.get() or "fleet",
+            endpoints=endpoints)
+
+
+@dataclasses.dataclass
+class FleetReport:
+    """What one episode did (the battery's assertion surface)."""
+
+    ranks: int = 0
+    steps: int = 0
+    total_rank_steps: int = 0
+    failed_steps: int = 0
+    departures: dict = dataclasses.field(default_factory=dict)
+    joins: int = 0
+    transitions: int = 0
+    final_world: list = dataclasses.field(default_factory=list)
+    outcomes: dict = dataclasses.field(default_factory=dict)
+    straggler_rank: int = -1
+    straggler_lag_ms: float = 0.0
+    autoscale_decisions: list = dataclasses.field(default_factory=list)
+    kv_latency_ms: dict = dataclasses.field(default_factory=dict)
+    wal: dict = dataclasses.field(default_factory=dict)
+    role_probes: int = 0
+    primaries_seen: list = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class _CtlRoleProber:
+    """Background sampler of every replica's ``/.ctl/role``: the
+    failover/promotion timeline the console renders."""
+
+    def __init__(self, endpoints: list[str],
+                 interval_s: float = 0.25) -> None:
+        self.endpoints = list(endpoints)
+        self.interval_s = interval_s
+        self.probes: list[dict] = []
+        self._t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if not self.endpoints:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="hvd-fleet-ctlwatch")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample()
+
+    def sample(self) -> None:
+        if len(self.probes) >= 20000:   # bounded evidence
+            return
+        t = time.monotonic() - self._t0
+        for ep in self.endpoints:
+            try:
+                with urlrequest.urlopen(
+                        f"http://{ep}/.ctl/role", timeout=1.0) as resp:
+                    role = resp.read().decode(errors="replace")
+            except OSError:
+                role = "unreachable"
+            self.probes.append({"t": round(t, 3), "endpoint": ep,
+                                "role": role})
+
+    def close(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            # Reap the sampler (hvdlife HVD701): the stop event is its
+            # wakeup; one in-flight probe is bounded by its 1s timeout.
+            t.join(timeout=self.interval_s + 5.0)
+        self._thread = None
+
+    def primaries(self) -> list[str]:
+        """Distinct endpoints observed as primary, in first-seen order."""
+        seen: list[str] = []
+        for p in self.probes:
+            if p["role"].startswith("primary") \
+                    and p["endpoint"] not in seen:
+                seen.append(p["endpoint"])
+        return seen
+
+
+class _SyntheticRequest:
+    __slots__ = ("deadline", "max_new_tokens")
+
+    def __init__(self, deadline: float, max_new_tokens: int = 16) -> None:
+        self.deadline = deadline
+        self.max_new_tokens = max_new_tokens
+
+
+class _FleetDriver:
+    """The autoscale controller's ``set_target_np`` surface, mapped to
+    virtual membership: up admits a joiner, down drains the highest
+    launch id."""
+
+    def __init__(self, fleet: "FleetSim") -> None:
+        self.fleet = fleet
+
+    def world_size(self) -> int:
+        return len(self.fleet.fabric.members())
+
+    def set_target_np(self, target: int) -> None:
+        self.fleet.apply_target(target)
+
+
+class FleetSim:
+    """One rank-virtualized fleet episode inside this process."""
+
+    def __init__(self, cfg: FleetConfig, *, server=None) -> None:
+        self.cfg = cfg
+        # In-proc fallback: a test may hand the RendezvousServer itself
+        # (no HTTP hop) — but the default is the REAL client stack.
+        self._server = server
+        self.aborted = threading.Event()
+        self.chaos_spec = config.CHAOS.get().strip()
+        self.flight = flight_mod.recorder()
+        self.tm = _tm_metrics()
+        self.fabric = LoopbackFabric(range(cfg.ranks), cfg.epoch)
+        self._epoch_lock = threading.Lock()
+        self._epoch_counter = 0
+        self._epoch_next: dict[str, str] = {}
+        self._sessions: dict[int, HostGroupSession] = {}
+        self._next_vid = cfg.ranks
+        self._pending_joiners: list[int] = []
+        self._granted: set[int] = set()
+        self.vranks: dict[int, VirtualRank] = {}
+        self._state_lock = threading.Lock()
+        self.report = FleetReport(ranks=cfg.ranks, steps=cfg.steps)
+        self._prober = _CtlRoleProber(
+            cfg.endpoints.split(",") if cfg.endpoints else [])
+        # Coordinator-side services (driven by whichever virtual rank
+        # currently leads — the leader calls in, the harness owns them).
+        self._straggler: StragglerAggregator | None = None
+        self._straggler_size = 0
+        # Attribution latched by LAUNCH id: the aggregator names a
+        # world index, and a membership transition both shifts indices
+        # and rebuilds the window — translate and latch at observation
+        # time so the finding survives the shrink.
+        self._straggler_vid = -1
+        self._straggler_lag_ms = 0.0
+        self._admission = AdmissionController(
+            registry=self.tm if self.tm.enabled else None)
+        self._autoscale: AutoscaleController | None = None
+        if cfg.autoscale:
+            policy = AutoscalePolicy(
+                max(2, cfg.ranks // 2), cfg.ranks + 4,
+                hysteresis_rounds=2,
+                down_lag_ms=max(1.0, cfg.straggler_ms / 2.0))
+            self._autoscale = AutoscaleController(
+                _FleetDriver(self), registry_source(self.tm),
+                policy, interval=3600.0)
+        # Fleet-level metrics (shared registry: every virtual rank's
+        # steps land here — the load-generator contract).
+        tm = self.tm
+        self._m_steps = tm.counter(
+            "horovod_fleetsim_steps_total",
+            "Virtual-rank steps completed across the fleet")
+        self._m_failed = tm.counter(
+            "horovod_fleetsim_failed_steps_total",
+            "Virtual-rank steps that failed (chaos fail verdicts, "
+            "boundary desyncs)")
+        self._m_world = tm.gauge(
+            "horovod_fleetsim_world_size",
+            "Live virtual ranks in the fleet")
+        self._m_transitions = tm.counter(
+            "horovod_fleetsim_transitions_total",
+            "Membership epoch transitions folded at fleet boundaries")
+        self._m_departures = {
+            kind: tm.counter(
+                "horovod_fleetsim_departures_total",
+                "Virtual ranks that left the fleet, by cause",
+                labels={"kind": kind})
+            for kind in ("preempt", "kill", "desync", "error")}
+
+    # -- shared-service plumbing (called by virtual ranks) ---------------
+    def session_for(self, vid: int) -> HostGroupSession:
+        group = vid // max(1, self.cfg.host_group)
+        with self._state_lock:
+            sess = self._sessions.get(group)
+            if sess is None:
+                client = self._make_client()
+                sess = HostGroupSession(
+                    client, self.cfg.host_group,
+                    flush_age_s=min(0.25, self.cfg.heartbeat_s / 4.0),
+                    snapshot_ttl_s=min(0.5, self.cfg.heartbeat_s / 2.0),
+                    registry=self.tm)
+                self._sessions[group] = sess
+            return sess
+
+    def _make_client(self):
+        if self._server is not None:
+            return _InProcClient(self._server)
+        return RendezvousClient(self.cfg.endpoints, timeout=30.0)
+
+    def kv_for(self, vid: int) -> HostGroupKV:
+        return HostGroupKV(self.session_for(vid))
+
+    def monitor_registry(self, vid: int, world: list[int]):
+        """Real registry only for the fleet leader's monitor: one full
+        per-peer liveness gauge family per process, not 500."""
+        return self.tm if world and vid == world[0] else NULL_REGISTRY
+
+    def next_epoch(self, from_epoch: str) -> str:
+        """Deterministic epoch tag for the transition folded FROM
+        ``from_epoch`` — every survivor of the same boundary computes
+        the same fold, so the first caller names it and the rest look
+        it up."""
+        with self._epoch_lock:
+            nxt = self._epoch_next.get(from_epoch)
+            if nxt is None:
+                self._epoch_counter += 1
+                nxt = f"{self.cfg.epoch}~t{self._epoch_counter}"
+                self._epoch_next[from_epoch] = nxt
+            return nxt
+
+    def scan_joiners(self, world: list[int]) -> tuple:
+        """Leader-side: pending ``fleetjoin/join:*`` announcements not
+        yet granted (one scope dump per boundary)."""
+        try:
+            pending = self.kv_for(world[0]).get_scope(JOIN_SCOPE)
+        except Exception:  # noqa: BLE001 - failover window: retry next
+            return ()
+        admits = []
+        with self._state_lock:
+            for key in pending:
+                if not key.startswith("join:"):
+                    continue
+                vid = int(key.split(":", 1)[1])
+                if vid not in world and vid not in self._granted:
+                    self._granted.add(vid)
+                    admits.append(vid)
+        return tuple(sorted(admits))
+
+    # -- counters / notes -------------------------------------------------
+    def note_step(self) -> None:
+        self._m_steps.inc()
+
+    def note_departure(self, vid: int, kind: str) -> None:
+        self._m_departures.get(kind, self._m_departures["error"]).inc()
+        with self._state_lock:
+            self.report.departures[kind] = \
+                self.report.departures.get(kind, 0) + 1
+
+    def note_transition(self, old_epoch: str, new_epoch: str,
+                        old_world, new_world, *, departing, vanished,
+                        admits, gstep: int) -> None:
+        self._m_transitions.inc()
+        self._m_world.set(len(new_world))
+        with self._state_lock:
+            self.report.transitions += 1
+            self.report.joins += len(admits)
+        if self.flight.enabled:
+            kind = "grow" if admits else "shrink"
+            self.flight.record(
+                kind, new_epoch,
+                detail=f"gstep={gstep} {len(old_world)}->"
+                       f"{len(new_world)} departing="
+                       f"{sorted(departing)} vanished="
+                       f"{sorted(vanished)} admits={list(admits)}")
+        logger.warning(
+            "fleetsim: boundary transition %s -> %s (%d -> %d ranks, "
+            "departing=%s vanished=%s admits=%s)", old_epoch, new_epoch,
+            len(old_world), len(new_world), sorted(departing),
+            sorted(vanished), list(admits))
+
+    # -- leader duties (once per boundary, by the folding leader) --------
+    def leader_duties(self, world, views, arrivals, gstep: int) -> None:
+        # 1. straggler attribution from REAL boundary arrival skew
+        size = len(world)
+        if self._straggler is None or self._straggler_size != size:
+            self._straggler = StragglerAggregator(
+                size, self.tm, window=4)
+            self._straggler_size = size
+        index = {vid: i for i, vid in enumerate(world)}
+        self._straggler.observe_tensor(
+            {index[vid]: t for vid, t in arrivals.items()
+             if vid in index})
+        flagged = self._straggler.last_straggler
+        if 0 <= flagged < len(world):
+            self._straggler_vid = world[flagged]
+            self._straggler_lag_ms = self._straggler.last_skew_ms
+        # 2. synthetic serving load through the REAL admission path
+        queue_depth, slack_s = self._load_pattern(gstep)
+        now = time.monotonic()
+        for _ in range(4):
+            req = _SyntheticRequest(deadline=now + slack_s)
+            ok, _outcome = self._admission.admit(
+                req, queue_depth, now=now)
+            if ok:
+                self._admission.count("served")
+                self._admission.observe_step_ms(self.cfg.step_ms)
+        # 3. autoscale tick against the live gauges
+        if self._autoscale is not None:
+            try:
+                self._autoscale.tick()
+            except Exception:  # noqa: BLE001 - policy must not kill fold
+                logger.debug("fleetsim: autoscale tick failed",
+                             exc_info=True)
+
+    def _load_pattern(self, gstep: int) -> tuple[float, float]:
+        """Scripted offered load: an overloaded first third (deep queue
+        → sheds → scale-up pressure), then a calm tail where only the
+        straggler signal remains (scale-down pressure) — the
+        oscillation shape of ROADMAP item 5."""
+        third = max(1, self.cfg.steps // 3)
+        if gstep < third:
+            return (self._admission.queue_depth_limit * 0.95, 0.001)
+        return (0.0, 30.0)
+
+    # -- autoscale application -------------------------------------------
+    def apply_target(self, target: int) -> None:
+        live = sorted(self.fabric.members())
+        if target > len(live):
+            for _ in range(target - len(live)):
+                self.spawn_joiner()
+        elif target < len(live) and len(live) > 1:
+            for vid in live[len(live) - target:][::-1]:
+                vr = self.vranks.get(vid)
+                if vr is not None and not vr.pending_depart:
+                    vr.pending_depart = True
+                    logger.warning("fleetsim: autoscale draining v%d",
+                                   vid)
+
+    def spawn_joiner(self) -> int:
+        with self._state_lock:
+            vid = self._next_vid
+            self._next_vid += 1
+        vr = VirtualRank(self, vid, joiner=True)
+        self.vranks[vid] = vr
+        vr.start()
+        return vid
+
+    # -- episode lifecycle ------------------------------------------------
+    def run(self, timeout_s: float | None = None) -> FleetReport:
+        cfg = self.cfg
+        if timeout_s is None:
+            timeout_s = cfg.steps * (cfg.step_ms / 1e3 + 0.5) \
+                + cfg.step_timeout_s + 30.0
+        if self.flight.enabled:
+            self.flight.set_metadata(fleetsim_ranks=cfg.ranks,
+                                     fleetsim_steps=cfg.steps)
+            self.flight.record("fleet-start", cfg.epoch,
+                               detail=f"ranks={cfg.ranks} "
+                                      f"steps={cfg.steps}")
+        self._m_world.set(cfg.ranks)
+        self._prober.start()
+        for vid in range(cfg.ranks):
+            self.vranks[vid] = VirtualRank(self, vid)
+        for vr in self.vranks.values():
+            vr.start()
+        deadline = time.monotonic() + timeout_s
+        for vr in list(self.vranks.values()):
+            if not vr.join_thread(max(0.1, deadline - time.monotonic())):
+                logger.warning("fleetsim: v%d still running at episode "
+                               "deadline; aborting fleet", vr.vid)
+                self.abort()
+                break
+        # Joiners spawned mid-run (autoscale) may still be draining.
+        for vr in list(self.vranks.values()):
+            if not vr.join_thread(max(0.1, deadline - time.monotonic())):
+                self.abort()
+                vr.join_thread(5.0)
+        self.close()
+        return self.report
+
+    def abort(self) -> None:
+        self.aborted.set()
+        self.fabric.abort()
+
+    def close(self) -> None:
+        self.aborted.set()
+        # Wake any vrank still blocked in the boundary exchange (the
+        # abort flag is its only exit) and reap the threads — close()
+        # must release every vrank even when run() never joined them
+        # (exception paths, driver-initiated teardown).
+        self.fabric.abort()
+        for vr in list(self.vranks.values()):
+            vr.close(5.0)
+            if not vr.join_thread(0.0):
+                logger.warning("fleetsim: v%d leaked past teardown",
+                               vr.vid)
+        self._prober.close()
+        if self._autoscale is not None:
+            self._autoscale.stop()
+        for sess in self._sessions.values():
+            try:
+                sess.flush()
+            except Exception:  # noqa: BLE001 - KV gone at teardown
+                pass
+        self._finalize_report()
+        if self.cfg.dump_dir:
+            self.dump_evidence(self.cfg.dump_dir)
+
+    def _finalize_report(self) -> None:
+        rep = self.report
+        rep.total_rank_steps = sum(v.steps_done
+                                   for v in self.vranks.values())
+        rep.failed_steps = sum(v.failed_steps
+                               for v in self.vranks.values())
+        if rep.failed_steps:
+            self._m_failed.inc(rep.failed_steps)
+        rep.final_world = sorted(self.fabric.members())
+        outcomes: dict[str, int] = {}
+        for v in self.vranks.values():
+            outcomes[v.outcome] = outcomes.get(v.outcome, 0) + 1
+        rep.outcomes = outcomes
+        rep.straggler_rank = self._straggler_vid
+        rep.straggler_lag_ms = round(self._straggler_lag_ms, 3)
+        if self._autoscale is not None:
+            rep.autoscale_decisions = [
+                {"direction": d.direction, "target": d.target}
+                for d in self._autoscale.decisions]
+        if self.tm.enabled:
+            for entry in self.tm.snapshot()["metrics"]:
+                name = entry.get("name", "")
+                if name == "horovod_rendezvous_kv_latency_ms":
+                    verb = entry.get("labels", {}).get("verb", "?")
+                    rep.kv_latency_ms[verb] = {
+                        "count": entry.get("count", 0),
+                        "p50": round(entry.get("p50", 0.0), 3),
+                        "p99": round(entry.get("p99", 0.0), 3)}
+                elif name.startswith("horovod_rendezvous_wal_"):
+                    rep.wal[name] = entry.get("value", 0)
+        rep.role_probes = len(self._prober.probes)
+        rep.primaries_seen = self._prober.primaries()
+
+    def dump_evidence(self, dump_dir: str) -> None:
+        """Write the episode's rank-stamped evidence for the console."""
+        os.makedirs(dump_dir, exist_ok=True)
+        rank = int(os.environ.get("HOROVOD_RANK", "0") or "0")
+        if self.flight.enabled:
+            self.flight.dump(reason="fleetsim episode end")
+        if self.tm.enabled:
+            dump_json(self.tm,
+                      os.path.join(dump_dir, "metrics.r{rank}.json"),
+                      rank)
+        if self._prober.probes or self._prober.endpoints:
+            path = os.path.join(dump_dir, f"ctl_roles.r{rank}.json")
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"probes": self._prober.probes,
+                           "endpoints": self._prober.endpoints}, f,
+                          indent=1)
+            os.replace(tmp, path)
+        path = os.path.join(dump_dir, f"summary.r{rank}.json")
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"fleetsim_summary": self.report.to_dict()}, f,
+                      indent=1)
+        os.replace(tmp, path)
+
+
+class _InProcClient:
+    """RendezvousClient verb surface over an in-process
+    RendezvousServer (unit tests without an HTTP hop)."""
+
+    def __init__(self, server) -> None:
+        self._server = server
+
+    def put(self, scope, key, value):
+        self._server.put(scope, key, value)
+
+    def put_many(self, records):
+        self._server.put_many(records)
+
+    def get(self, scope, key):
+        return self._server.get(scope, key)
+
+    def get_scope(self, scope):
+        return self._server.get_scope(scope)
+
+    def delete(self, scope, key=""):
+        from ..runner.network import _kv_apply
+        _kv_apply(self._server._httpd, "delete", scope, key, b"")
+
+    def wait(self, scope, key, timeout=None):
+        deadline = time.monotonic() + (timeout or 30.0)
+        while True:
+            value = self._server.get(scope, key)
+            if value is not None:
+                return value
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"{scope}/{key} not available")
+            time.sleep(0.02)
+
+    def claim(self, scope, key, task_key=""):
+        raise NotImplementedError

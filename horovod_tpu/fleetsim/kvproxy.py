@@ -1,0 +1,174 @@
+"""Host-group KV adapter: the fleetsim fan-in batching layer.
+
+500 virtual ranks stamping ``hb/<epoch>:<rank>`` individually would
+serialize 500 HTTP puts per heartbeat window through one coordinator —
+exactly the fan-in a real 500-worker pod amortizes at the HOST level
+(one physical host carries N workers and one control-plane session).
+:class:`HostGroupKV` reproduces that topology: every simulated host
+group shares one :class:`~..runner.network.RendezvousClient`, and
+
+- **writes**: periodic heartbeat stamps are buffered per group and
+  flushed as ONE ``PUT /.batch/`` (``RendezvousClient.put_many``) when
+  the group's live members have all stamped or the oldest buffered
+  stamp exceeds the flush age — the server applies the batch under a
+  single lock hold, so the WAL group-commits it in one fsync lane pass
+  (asserted by the coalesce counters in
+  ``horovod_rendezvous_wal_*_total``).  Urgent liveness signals —
+  ``bye|`` departure stamps and ``dead/`` marks — bypass the buffer:
+  coalescing must never delay failure evidence.
+- **reads**: the ``hb``/``dead`` liveness tables are served from one
+  TTL-cached scope dump per group (``RendezvousClient.get_scope``)
+  instead of ``size``-many gets per monitor poll.  A failed refresh
+  poisons the snapshot so every reader in the group observes the KV
+  outage (heartbeat monitors pause their staleness clocks), matching
+  what per-rank clients would all see.
+
+Everything else (membership scopes, waits, deletes) passes straight
+through to the shared client.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["HostGroupKV", "HostGroupSession"]
+
+# Liveness scopes served from the cached snapshot / batched on write.
+_SNAPSHOT_SCOPES = ("hb", "dead")
+
+
+class HostGroupSession:
+    """Shared per-host-group state: one rendezvous client, one stamp
+    buffer, one snapshot cache."""
+
+    def __init__(self, client, group_size: int,
+                 flush_age_s: float = 0.25,
+                 snapshot_ttl_s: float = 0.5,
+                 registry=None) -> None:
+        self.client = client
+        self.group_size = max(1, int(group_size))
+        self.flush_age_s = float(flush_age_s)
+        self.snapshot_ttl_s = float(snapshot_ttl_s)
+        self._lock = threading.Lock()
+        # (scope, key) -> value: a later stamp overwrites the buffered
+        # one, so the buffer is bounded by the group's key universe.
+        self._buffer: dict[tuple[str, str], bytes] = {}
+        self._buffer_since: float | None = None
+        # scope -> (fetched_monotonic, dict | None, error | None)
+        self._snap: dict[str, tuple[float, dict | None, Exception | None]] \
+            = {}
+        self._refreshing: set[str] = set()
+        if registry is None:
+            from ..telemetry import metrics
+            registry = metrics()
+        self._m_stamps = registry.counter(
+            "horovod_fleetsim_hb_stamps_total",
+            "Heartbeat stamps produced by this process's virtual ranks")
+        self._m_flushes = registry.counter(
+            "horovod_fleetsim_hb_flushes_total",
+            "Batched put_many flushes carrying those stamps (the "
+            "host-group fan-in coalescing ratio)")
+
+    # -- write path ------------------------------------------------------
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        # Only periodic hb stamps coalesce.  Urgent liveness signals —
+        # bye| departure stamps, dead/ marks — and every membership
+        # record go straight through.
+        if scope == "hb" and not bytes(value).startswith(b"bye|"):
+            self._buffer_put(scope, key, value)
+            return
+        self.client.put(scope, key, value)
+
+    def _buffer_put(self, scope: str, key: str, value: bytes) -> None:
+        now = time.monotonic()
+        flush: list | None = None
+        with self._lock:
+            self._buffer[(scope, key)] = bytes(value)
+            self._m_stamps.inc()
+            if self._buffer_since is None:
+                self._buffer_since = now
+            full = len(self._buffer) >= self.group_size
+            aged = now - self._buffer_since >= self.flush_age_s
+            if full or aged:
+                flush = [(s, k, v)
+                         for (s, k), v in self._buffer.items()]
+                self._buffer.clear()
+                self._buffer_since = None
+        if flush:
+            # HTTP outside the lock: a slow coordinator must not stall
+            # the other monitors' stamping.
+            self.client.put_many(flush)
+            self._m_flushes.inc()
+
+    def flush(self) -> None:
+        """Drain whatever is buffered now (teardown, tests)."""
+        with self._lock:
+            flush = [(s, k, v) for (s, k), v in self._buffer.items()]
+            self._buffer.clear()
+            self._buffer_since = None
+        if flush:
+            self.client.put_many(flush)
+            self._m_flushes.inc()
+
+    # -- read path -------------------------------------------------------
+    def snapshot_get(self, scope: str, key: str) -> bytes | None:
+        now = time.monotonic()
+        refresh = False
+        with self._lock:
+            entry = self._snap.get(scope)
+            stale = entry is None \
+                or now - entry[0] >= self.snapshot_ttl_s
+            if stale and scope not in self._refreshing:
+                self._refreshing.add(scope)
+                refresh = True
+        if refresh:
+            # One refresher per scope; HTTP outside the lock.  A failed
+            # refresh poisons the snapshot so EVERY reader in the group
+            # observes the outage (monitors pause staleness clocks).
+            try:
+                snap, snap_err = self.client.get_scope(scope), None
+            except Exception as exc:  # noqa: BLE001 - poisoned below
+                snap, snap_err = None, exc
+            with self._lock:
+                self._snap[scope] = (time.monotonic(), snap, snap_err)
+                self._refreshing.discard(scope)
+        with self._lock:
+            entry = self._snap.get(scope)
+        if entry is None:
+            # Another thread's FIRST refresh is still in flight: a
+            # direct get beats fabricating an empty liveness view.
+            return self.client.get(scope, key)
+        _fetched, data, err = entry
+        if err is not None:
+            raise ConnectionError(
+                f"host-group snapshot of {scope!r} failed") from err
+        return (data or {}).get(key)
+
+
+class HostGroupKV:
+    """The per-virtual-rank KV facade handed to the real
+    :class:`~..resilience.heartbeat.HeartbeatMonitor` (duck-typed to
+    RendezvousClient's verb surface)."""
+
+    def __init__(self, session: HostGroupSession) -> None:
+        self._s = session
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        self._s.put(scope, key, value)
+
+    def get(self, scope: str, key: str) -> bytes | None:
+        if scope in _SNAPSHOT_SCOPES:
+            return self._s.snapshot_get(scope, key)
+        return self._s.client.get(scope, key)
+
+    def get_scope(self, scope: str) -> dict[str, bytes]:
+        return self._s.client.get_scope(scope)
+
+    def wait(self, scope: str, key: str, timeout: float | None = None):
+        return self._s.client.wait(scope, key, timeout)
+
+    def delete(self, scope: str, key: str = "") -> None:
+        self._s.client.delete(scope, key)
+
+    def claim(self, scope: str, key: str, task_key: str = "") -> int:
+        return self._s.client.claim(scope, key, task_key)

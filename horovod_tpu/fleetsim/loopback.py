@@ -1,0 +1,152 @@
+"""Loopback data-plane shim for the fleetsim harness.
+
+The virtual fleet replaces the tensor data plane with ONE in-process
+barrier-allgather per step: every live virtual rank deposits its
+membership-boundary flags for ``(epoch, seq)`` and blocks until every
+other live member has too (the fleet-scale analogue of the statesync
+boundary allgather, statesync/service.py).  Per-rank arrival times are
+captured on deposit, so the coordinator-side straggler aggregator sees
+exactly the skew signal a real negotiation would produce.
+
+Membership is epoch-versioned: a transition (grow/shrink) swaps the
+member set and the epoch tag under the same condition variable, and a
+virtual rank that died without announcing (chaos ``kill``) is removed
+with :meth:`LoopbackFabric.remove` so in-flight exchanges complete
+without its slot instead of hanging — the survivors observe the missing
+slot and fold it as a hard failure, just as socket death converts to a
+structured error on the real transport.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["FleetDesyncError", "LoopbackFabric"]
+
+# Completed rounds kept per epoch for late readers; older rounds are
+# pruned on entry so a long episode never accumulates per-seq dicts.
+_ROUND_KEEP = 8
+
+
+class FleetDesyncError(RuntimeError):
+    """A boundary exchange did not complete inside the step timeout."""
+
+
+class LoopbackFabric:
+    """Epoch-versioned barrier-allgather over one condition variable."""
+
+    def __init__(self, members, epoch: str) -> None:
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._members = set(members)
+        self._epoch = epoch
+        self._aborted = False
+        # (epoch, seq) -> {"slots": {vid: payload}, "arrivals": {vid: t}}
+        self._rounds: dict[tuple[str, int], dict] = {}
+
+    def abort(self) -> None:
+        """Wake every waiter with a desync error (harness teardown)."""
+        with self._lock:
+            self._aborted = True
+            self._cond.notify_all()
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def epoch(self) -> str:
+        return self._epoch
+
+    def members(self) -> frozenset[int]:
+        with self._lock:
+            return frozenset(self._members)
+
+    # -- membership ------------------------------------------------------
+    def transition(self, new_epoch: str, new_members) -> None:
+        """Swap the live member set at a boundary.  Idempotent: every
+        survivor folds the same flags and calls this with the same
+        arguments; the first caller applies it, the rest verify."""
+        with self._lock:
+            if self._epoch == new_epoch:
+                if set(new_members) != self._members:
+                    raise FleetDesyncError(
+                        f"divergent transition to {new_epoch!r}: "
+                        f"{sorted(new_members)} vs "
+                        f"{sorted(self._members)}")
+                return
+            self._epoch = new_epoch
+            self._members = set(new_members)
+            self._rounds = {k: v for k, v in self._rounds.items()
+                            if k[0] == new_epoch}
+            self._cond.notify_all()
+
+    def remove(self, vid: int) -> None:
+        """Drop a member that died without a boundary announcement (the
+        chaos ``kill`` shape): waiters re-evaluate and complete without
+        its slot."""
+        with self._lock:
+            self._members.discard(vid)
+            self._cond.notify_all()
+
+    def await_epoch(self, epoch: str, timeout: float) -> None:
+        """Block until the fleet has transitioned to ``epoch`` — the
+        joiner's entry gate (incumbents apply the transition at their
+        admission boundary)."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self._epoch != epoch:
+                if self._aborted:
+                    raise FleetDesyncError("fleet aborted")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    raise FleetDesyncError(
+                        f"fleet never reached epoch {epoch!r} within "
+                        f"{timeout:g}s (at {self._epoch!r})")
+
+    # -- the exchange ----------------------------------------------------
+    def exchange(self, epoch: str, seq: int, vid: int, payload,
+                 timeout: float) -> tuple[dict, dict]:
+        """Deposit this rank's boundary flags and block until every
+        live member of ``epoch`` has deposited theirs.  Returns
+        ``(views, arrivals)``: vid -> payload and vid -> monotonic
+        deposit time.  A member that vanished mid-round simply has no
+        slot in ``views`` — the callers fold that as a hard failure."""
+        deadline = time.monotonic() + timeout
+        key = (epoch, seq)
+        with self._lock:
+            if epoch != self._epoch:
+                raise FleetDesyncError(
+                    f"v{vid} exchanging on stale epoch {epoch!r} "
+                    f"(fleet at {self._epoch!r})")
+            for old in [k for k in self._rounds
+                        if k[0] == epoch and k[1] < seq - _ROUND_KEEP]:
+                del self._rounds[old]
+            rd = self._rounds.setdefault(
+                key, {"slots": {}, "arrivals": {}})
+            rd["slots"][vid] = payload
+            rd["arrivals"][vid] = time.monotonic()
+            self._cond.notify_all()
+            while True:
+                if self._aborted:
+                    raise FleetDesyncError("fleet aborted")
+                if rd.get("done"):
+                    # Completed while we slept — possibly already folded
+                    # and transitioned by a faster member; the frozen
+                    # round is still the right view for this seq.
+                    return dict(rd["slots"]), dict(rd["arrivals"])
+                if epoch != self._epoch:
+                    # The fleet transitioned under us before this round
+                    # ever completed (we deposited into a stale seq).
+                    raise FleetDesyncError(
+                        f"v{vid} overtaken by transition to "
+                        f"{self._epoch!r} during seq {seq}")
+                waiting_on = self._members - set(rd["slots"])
+                if not waiting_on:
+                    rd["done"] = True
+                    self._cond.notify_all()
+                    return dict(rd["slots"]), dict(rd["arrivals"])
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    raise FleetDesyncError(
+                        f"v{vid} boundary {epoch!r}/{seq} incomplete "
+                        f"after {timeout:g}s: waiting on "
+                        f"{sorted(waiting_on)[:8]} "
+                        f"({len(waiting_on)} total)")

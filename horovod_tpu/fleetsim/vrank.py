@@ -1,0 +1,301 @@
+"""One virtual rank: the real protocol stack over a stubbed step.
+
+A :class:`VirtualRank` is a thread that executes, per step, exactly
+what a real worker's control path executes — a live
+:class:`~..resilience.heartbeat.HeartbeatMonitor` stamping and polling
+the rendezvous liveness table through its host group's shared client,
+deterministic chaos matching against the launch id, and the
+membership boundary exchange (the statesync flag fold) — with model
+compute replaced by ``HOROVOD_FLEETSIM_STEP_MS`` of sleep and the
+tensor data plane by the loopback fabric.
+
+Chaos composes unchanged: each virtual rank owns a
+:class:`VirtualChaosEngine` whose ``rank`` is the LAUNCH id, so the
+existing grammar (``kill:rank=37,op=5``, ``preempt:rank=12,op=9``)
+addresses individual virtual ranks.  ``kill``/``preempt`` are
+virtualized — they end or drain ONE virtual rank instead of the host
+process carrying hundreds — while ``coordkill``/``coordpause`` keep
+their real semantics (a signal at the external coordinator process)
+and ``freeze``/``fail`` act inline as always.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..common.logging import logger
+from ..resilience.chaos import ChaosAction, ChaosEngine
+from ..resilience.heartbeat import HeartbeatMonitor
+from .loopback import FleetDesyncError
+
+__all__ = ["VirtualChaosEngine", "VirtualRank"]
+
+# KV scope carrying join announcements and admission grants.
+JOIN_SCOPE = "fleetjoin"
+
+
+class VirtualChaosEngine(ChaosEngine):
+    """Chaos engine whose self-directed verdicts are virtual: ``kill``
+    and ``preempt`` latch a verdict for the owning virtual rank instead
+    of signalling the host process.  Everything else (coord*, freeze,
+    fail) inherits the real behavior."""
+
+    def __init__(self, spec: str, rank: int) -> None:
+        super().__init__(spec, rank)
+        self._pending: str | None = None
+
+    def _fire_kill(self, act: ChaosAction, idx: int) -> None:
+        logger.warning("fleetsim: chaos kill of v%d at step %d "
+                       "(virtualized)", self.rank, idx)
+        self._pending = "kill"
+
+    def _fire_preempt(self, act: ChaosAction, idx: int) -> None:
+        logger.warning("fleetsim: chaos preempt of v%d at step %d "
+                       "(virtualized SIGTERM)", self.rank, idx)
+        if self._pending != "kill":
+            self._pending = "preempt"
+
+    def take_pending(self) -> str | None:
+        verdict, self._pending = self._pending, None
+        return verdict
+
+
+class VirtualRank:
+    """Protocol-only worker: real control plane, stubbed compute."""
+
+    def __init__(self, fleet, vid: int, *, joiner: bool = False) -> None:
+        self.fleet = fleet
+        self.cfg = fleet.cfg
+        self.vid = vid
+        self.joiner = joiner
+        self.session = fleet.session_for(vid)
+        self.kv = fleet.kv_for(vid)
+        self.engine: VirtualChaosEngine | None = \
+            VirtualChaosEngine(fleet.chaos_spec, vid) \
+            if fleet.chaos_spec else None
+        # Set by the boundary fold (or by the autoscale driver asking
+        # this rank to drain): announce departure at the next boundary.
+        self.pending_depart = False
+        # Episode state (single-writer: this thread).
+        self.epoch = fleet.fabric.epoch
+        self.world: list[int] = sorted(fleet.fabric.members())
+        self.seq = 0
+        self.gstep = 0
+        self.steps_done = 0
+        self.failed_steps = 0
+        self.outcome = "running"
+        self.monitor: HeartbeatMonitor | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"hvd-fleet-vrank-{self.vid}")
+        self._thread.start()
+
+    def join_thread(self, timeout: float) -> bool:
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            return not t.is_alive()
+        return True
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Teardown: wake the loop (fleet abort is the only exit for a
+        rank blocked in the boundary exchange) and reap the thread."""
+        self.fleet.aborted.set()
+        self.fleet.fabric.abort()
+        self.join_thread(timeout)
+
+    # -- helpers ---------------------------------------------------------
+    @property
+    def world_rank(self) -> int:
+        return self.world.index(self.vid)
+
+    def _start_monitor(self) -> None:
+        self.monitor = HeartbeatMonitor(
+            self.world_rank, len(self.world), self.kv,
+            epoch=self.epoch,
+            fault_timeout=self.cfg.fault_timeout_s,
+            interval=self.cfg.heartbeat_s,
+            registry=self.fleet.monitor_registry(self.vid, self.world))
+        self.monitor.start()
+
+    def _stop_monitor(self, silent: bool = False) -> None:
+        if self.monitor is not None:
+            self.monitor.stop(silent=silent)
+            self.monitor = None
+
+    def _flight(self, kind: str, detail: str = "") -> None:
+        rec = self.fleet.flight
+        if rec.enabled:
+            rec.record(kind, f"v{self.vid}", detail=detail)
+
+    # -- thread body -----------------------------------------------------
+    def _run(self) -> None:
+        try:
+            if self.joiner:
+                if not self._join_fleet():
+                    return
+            else:
+                self._start_monitor()
+            self._loop()
+        except FleetDesyncError as exc:
+            if self.fleet.aborted.is_set():
+                self.outcome = "aborted"
+                self._stop_monitor()
+                return
+            self.failed_steps += 1
+            self.outcome = "desync"
+            self._flight("fleet-desync", detail=str(exc))
+            logger.warning("fleetsim: v%d desynced: %s", self.vid, exc)
+            self.fleet.fabric.remove(self.vid)
+            self._stop_monitor()
+            self.fleet.note_departure(self.vid, "desync")
+        except Exception:  # noqa: BLE001 - one vrank never kills the host
+            self.failed_steps += 1
+            self.outcome = "error"
+            logger.warning("fleetsim: v%d crashed", self.vid,
+                           exc_info=True)
+            self.fleet.fabric.remove(self.vid)
+            self._stop_monitor()
+            self.fleet.note_departure(self.vid, "error")
+
+    def _join_fleet(self) -> bool:
+        """Announce over the REAL KV path and wait for the leader's
+        admission grant (``fleetjoin/go:<vid>``), then enter the fleet
+        at the granted epoch."""
+        self.kv.put(JOIN_SCOPE, f"join:{self.vid}", b"waiting")
+        self._flight("join-announce")
+        deadline = time.monotonic() + self.cfg.step_timeout_s * 2
+        grant = None
+        while grant is None:
+            if self.fleet.aborted.is_set() \
+                    or time.monotonic() > deadline:
+                self.outcome = "join-abandoned"
+                return False
+            try:
+                grant = self.kv.wait(JOIN_SCOPE, f"go:{self.vid}",
+                                     timeout=1.0)
+            except TimeoutError:
+                continue
+        epoch, gstep, world = grant.decode().split("|")
+        self.fleet.fabric.await_epoch(epoch, self.cfg.step_timeout_s)
+        self.epoch = epoch
+        self.gstep = int(gstep)
+        self.world = [int(v) for v in world.split(",")]
+        self.seq = 0
+        self._start_monitor()
+        self._flight("join-entered", detail=f"epoch={epoch}")
+        return True
+
+    def _loop(self) -> None:
+        cfg = self.cfg
+        while not self.fleet.aborted.is_set():
+            # 1. chaos (the per-step response hook, names carry the
+            #    global step so name= matchers compose too)
+            if self.engine is not None:
+                verdict = self.engine.on_response(
+                    (f"fleet.step.{self.gstep}",))
+                pending = self.engine.take_pending()
+                if pending == "kill":
+                    # Silent death: no bye stamp, no boundary flag —
+                    # peers see a missing slot now and heartbeat
+                    # silence later.
+                    self.outcome = "killed"
+                    self._flight("fleet-vkill")
+                    self.fleet.fabric.remove(self.vid)
+                    self._stop_monitor(silent=True)
+                    self.fleet.note_departure(self.vid, "kill")
+                    return
+                if pending == "preempt":
+                    self.pending_depart = True
+                    self._flight("preempt-notice")
+                if verdict == "fail":
+                    self.failed_steps += 1
+                    self._flight("fleet-step-fail",
+                                 detail=f"gstep={self.gstep}")
+            # 2. stubbed compute
+            delay_ms = cfg.step_ms
+            if self.vid == cfg.straggler_vid:
+                delay_ms += cfg.straggler_ms
+            if delay_ms > 0:
+                time.sleep(delay_ms / 1e3)
+            # 3. boundary exchange (the loopback data plane)
+            leader = self.vid == self.world[0]
+            flags = {
+                "vid": self.vid,
+                "depart": self.vid if self.pending_depart else -1,
+                "gstep": self.gstep,
+                "admit": self.fleet.scan_joiners(self.world)
+                if leader else (),
+            }
+            views, arrivals = self.fleet.fabric.exchange(
+                self.epoch, self.seq, self.vid, flags,
+                cfg.step_timeout_s)
+            self.steps_done += 1
+            self.fleet.note_step()
+            if not self._fold(views, arrivals):
+                return
+        self.outcome = self.outcome if self.outcome != "running" \
+            else "aborted"
+
+    def _fold(self, views: dict, arrivals: dict) -> bool:
+        """Fold one boundary's flags exactly once per rank; returns
+        False when this rank leaves the loop (departure or fleet
+        end)."""
+        cfg = self.cfg
+        present = set(views)
+        vanished = set(self.world) - present
+        departing = {f["depart"] for f in views.values()
+                     if f["depart"] >= 0}
+        survivors = [v for v in self.world
+                     if v in present and v not in departing]
+        gstep = max(f["gstep"] for f in views.values())
+        leader_flags = views.get(min(present), {})
+        admits = tuple(leader_flags.get("admit", ())) \
+            if not (vanished or departing) else ()
+        if self.vid == min(survivors or sorted(present)):
+            self.fleet.leader_duties(self.world, views, arrivals,
+                                     gstep)
+        self.gstep = gstep + 1
+        # Fleet end: everyone folds the same gstep, everyone leaves.
+        if self.gstep >= cfg.steps:
+            self.outcome = "finished"
+            self._flight("fleet-end", detail=f"gstep={self.gstep}")
+            self._stop_monitor()
+            return False
+        if self.vid in departing:
+            self.outcome = "preempted"
+            self._flight("departed",
+                         detail=f"gstep={self.gstep} orderly")
+            self._stop_monitor()
+            self.fleet.note_departure(self.vid, "preempt")
+            return False
+        if vanished or departing or admits:
+            new_world = survivors + [v for v in admits
+                                     if v not in survivors]
+            new_world.sort()
+            new_epoch = self.fleet.next_epoch(self.epoch)
+            self.fleet.fabric.transition(new_epoch, new_world)
+            if self.vid == new_world[0]:
+                self.fleet.note_transition(
+                    self.epoch, new_epoch, self.world, new_world,
+                    departing=departing, vanished=vanished,
+                    admits=admits, gstep=self.gstep)
+                for a in admits:
+                    grant = f"{new_epoch}|{self.gstep}|" \
+                            f"{','.join(map(str, new_world))}"
+                    self.kv.put(JOIN_SCOPE, f"go:{a}", grant.encode())
+                    self.kv.delete(JOIN_SCOPE, f"join:{a}")
+            # Epoch rebuild: the old epoch's monitor says goodbye, the
+            # new epoch's monitor starts from a clean liveness table.
+            self._stop_monitor()
+            self.epoch = new_epoch
+            self.world = new_world
+            self.seq = 0
+            self._start_monitor()
+            return True
+        self.seq += 1
+        return True

@@ -229,25 +229,9 @@ class ChaosEngine:
             if not act.matches_response(self.rank, idx, tensor_names):
                 continue
             if act.kind == "kill":
-                logger.warning("chaos: killing rank %d at collective %d "
-                               "(%s)", self.rank, idx,
-                               f"signal {act.sig}" if act.sig is not None
-                               else f"exit {act.exit_code}")
-                import os
-                if act.sig is not None:
-                    import time
-                    os.kill(os.getpid(), act.sig)
-                    time.sleep(5.0)   # SIGKILL lands before this expires
-                os._exit(act.exit_code)
+                self._fire_kill(act, idx)
             elif act.kind == "preempt":
-                logger.warning("chaos: preempting rank %d at collective "
-                               "%d (SIGTERM)", self.rank, idx)
-                import os
-                import signal
-                os.kill(os.getpid(), signal.SIGTERM)
-                # NOT followed by an exit: the grace path owns the
-                # departure; without a grace handler the default
-                # disposition (or flight's chained handler) fires.
+                self._fire_preempt(act, idx)
             elif act.kind in ("coordkill", "coordpause"):
                 self._fire_coord(act, idx)
             elif act.kind == "freeze":
@@ -260,6 +244,32 @@ class ChaosEngine:
                                idx, list(tensor_names))
                 verdict = "fail"
         return verdict
+
+    def _fire_kill(self, act: ChaosAction, idx: int) -> None:
+        """Deliver a kill to THIS process.  A seam on purpose: fleetsim's
+        virtual engine overrides it to end one virtual rank instead of
+        the host process that carries 500 of them."""
+        logger.warning("chaos: killing rank %d at collective %d "
+                       "(%s)", self.rank, idx,
+                       f"signal {act.sig}" if act.sig is not None
+                       else f"exit {act.exit_code}")
+        import os
+        if act.sig is not None:
+            import time
+            os.kill(os.getpid(), act.sig)
+            time.sleep(5.0)   # SIGKILL lands before this expires
+        os._exit(act.exit_code)
+
+    def _fire_preempt(self, act: ChaosAction, idx: int) -> None:
+        """SIGTERM to self (virtualized by fleetsim the same way)."""
+        logger.warning("chaos: preempting rank %d at collective "
+                       "%d (SIGTERM)", self.rank, idx)
+        import os
+        import signal
+        os.kill(os.getpid(), signal.SIGTERM)
+        # NOT followed by an exit: the grace path owns the
+        # departure; without a grace handler the default
+        # disposition (or flight's chained handler) fires.
 
     def _fire_coord(self, act: ChaosAction, idx: int) -> None:
         """SIGKILL (coordkill) or SIGSTOP+delayed-SIGCONT (coordpause)

@@ -44,7 +44,8 @@ class HeartbeatMonitor:
 
     def __init__(self, rank: int, size: int, kv, epoch: str,
                  fault_timeout: float = 30.0,
-                 interval: float | None = None) -> None:
+                 interval: float | None = None,
+                 registry=None) -> None:
         self.rank = rank
         self.size = size
         self.kv = kv
@@ -69,8 +70,13 @@ class HeartbeatMonitor:
         self._kv_outage = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        from ..telemetry import metrics as _tm_metrics
-        tm = _tm_metrics()
+        # `registry` overrides the process registry — fleetsim passes a
+        # NullRegistry to non-leader virtual ranks so 500 monitors do
+        # not mint 500×499 per-peer liveness gauges in one process.
+        if registry is None:
+            from ..telemetry import metrics as _tm_metrics
+            registry = _tm_metrics()
+        tm = registry
         self._tm_on = tm.enabled
         self._m_liveness = {}
         if self._tm_on:
@@ -103,7 +109,7 @@ class HeartbeatMonitor:
                                         name="hvd-heartbeat")
         self._thread.start()
 
-    def stop(self) -> None:
+    def stop(self, silent: bool = False) -> None:
         self._stop.set()
         t = self._thread
         if t is not None:
@@ -112,6 +118,11 @@ class HeartbeatMonitor:
                 logger.warning("resilience: heartbeat monitor thread did "
                                "not stop within grace (rank=%d)", self.rank)
         self._thread = None
+        if silent:
+            # A simulated hard kill (fleetsim chaos `kill`): the rank
+            # must fall silent WITHOUT a goodbye — peers are supposed to
+            # detect the death from heartbeat staleness.
+            return
         # Orderly departure stamp: peers still watching THIS epoch (e.g.
         # mid-retry, about to rebuild under a new one) must not read the
         # coming heartbeat silence as death — a rank that leaves the

@@ -205,6 +205,16 @@ class WalWriter:
         self._fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
                            0o644)
         self._queue: queue.Queue = queue.Queue(maxsize=256)
+        # Group-commit observability: records vs fsync batches is the
+        # coalescing ratio the fleetsim fan-in test asserts on.
+        from ..telemetry import metrics
+        tm = metrics()
+        self._m_batches = tm.counter(
+            "horovod_rendezvous_wal_commit_batches_total",
+            "WAL group-commit fsync batches flushed by this writer")
+        self._m_records = tm.counter(
+            "horovod_rendezvous_wal_records_total",
+            "WAL records committed by this writer")
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"hvd-rdzv-wal-{writer_id}")
@@ -248,6 +258,8 @@ class WalWriter:
         for record, _done in batch:
             os.write(self._fd, record)
         os.fsync(self._fd)
+        self._m_batches.inc()
+        self._m_records.inc(len(batch))
         for _record, done in batch:
             done.set()
 

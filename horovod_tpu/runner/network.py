@@ -152,6 +152,64 @@ def _kv_apply(httpd, kind: str, scope: str, key: str, value: bytes):
     return commit, result
 
 
+def _kv_apply_many(httpd, records):
+    """Apply a batch of put records under ONE KV-lock hold: every WAL
+    record is enqueued back-to-back so the group-commit lane drains them
+    in one (or very few) fsync batches instead of interleaving with
+    other writers.  Returns the LAST commit event only — the WAL queue
+    is FIFO and the writer sets commit events in batch order, so the
+    last record's durability implies every earlier record's."""
+    cp = httpd.controlplane
+    last = None
+    with httpd.kv_lock:
+        state = {"kv": httpd.kv, "counters": httpd.counters,
+                 "claims": httpd.claims, "digest": httpd.kv_digest}
+        for scope, key, value in records:
+            if cp is not None:
+                last = cp.record("put", scope, key, value)
+            apply_record(state, "put", scope, key, value)
+        httpd.kv_digest = state["digest"]
+        httpd.kv_cond.notify_all()
+    return last
+
+
+def encode_batch(records) -> bytes:
+    """Frame ``[(scope, key, value), ...]`` put records for the
+    ``PUT /.batch/`` fan-in verb (wire.py varint framing)."""
+    enc = wire.Encoder()
+    records = list(records)
+    enc.uvarint(len(records))
+    for scope, key, value in records:
+        enc.string(scope).string(key).blob(value)
+    return enc.getvalue()
+
+
+def decode_batch(raw: bytes) -> list[tuple[str, str, bytes]]:
+    dec = wire.Decoder(bytes(raw))
+    return [(dec.string(), dec.string(), dec.blob())
+            for _ in range(dec.uvarint())]
+
+
+def encode_scope(entries: dict) -> bytes:
+    """Frame one scope's key->value dict for the empty-key GET (scope
+    dump) response."""
+    enc = wire.Encoder()
+    enc.uvarint(len(entries))
+    for key, value in entries.items():
+        enc.string(key).blob(value)
+    return enc.getvalue()
+
+
+def decode_scope(raw: bytes) -> dict[str, bytes]:
+    dec = wire.Decoder(bytes(raw))
+    return {dec.string(): dec.blob() for _ in range(dec.uvarint())}
+
+
+# Reserved scope name carrying batched put records (PUT body is a
+# wire-framed record list, not a single value).
+BATCH_SCOPE = ".batch"
+
+
 class _KVHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
@@ -207,6 +265,18 @@ class _KVHandler(BaseHTTPRequestHandler):
         scope, key = self._split()
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
+        if scope == BATCH_SCOPE:
+            # Fan-in verb: one request carries a host-group's worth of
+            # put records (fleetsim heartbeat stamps), applied under a
+            # single lock hold so WAL group-commit coalesces them.
+            try:
+                records = decode_batch(value)
+            except (ValueError, IndexError):
+                return self._reply(400)
+            commit = _kv_apply_many(self.server, records)
+            if self._commit_or_fail(commit):
+                self._reply(200, str(len(records)).encode())
+            return
         commit, _ = _kv_apply(self.server, "put", scope, key, value)
         if self._commit_or_fail(commit):
             self._reply(200)
@@ -217,6 +287,13 @@ class _KVHandler(BaseHTTPRequestHandler):
             return self._ctl(key)
         if not self._gate():
             return
+        if key == "":
+            # Scope dump: one request returns every key in the scope
+            # (fleetsim host groups refresh their heartbeat snapshot
+            # with ONE read instead of size-many gets per window).
+            with self.server.kv_lock:
+                entries = dict(self.server.kv.get(scope, {}))
+            return self._reply(200, encode_scope(entries))
         wait_q = self._query().get("wait", ["0"])[0]
         try:
             wait_s = max(0.0, min(float(wait_q) / 1e3, 60.0))
@@ -340,9 +417,20 @@ class RendezvousServer:
         if commit is not None:
             commit.wait(timeout=10.0)
 
+    def put_many(self, records) -> None:
+        """Batched puts (``[(scope, key, value), ...]``) applied under
+        one lock hold — the in-proc mirror of ``PUT /.batch/``."""
+        commit = _kv_apply_many(self._httpd, list(records))
+        if commit is not None:
+            commit.wait(timeout=10.0)
+
     def get(self, scope: str, key: str) -> bytes | None:
         with self._httpd.kv_lock:
             return self._httpd.kv.get(scope, {}).get(key)
+
+    def get_scope(self, scope: str) -> dict[str, bytes]:
+        with self._httpd.kv_lock:
+            return dict(self._httpd.kv.get(scope, {}))
 
     def kv_digest(self) -> int:
         """Rolling FNV digest of every applied mutation (matches the
@@ -394,6 +482,30 @@ class RendezvousClient:
             self._endpoints = self.parse_endpoints(addr, port)
         self._active = 0
         self.timeout = timeout
+        # Per-verb latency histograms, bound lazily to the live registry
+        # (telemetry may be configured after the client is built).
+        self._lat: dict[str, object] = {}
+        self._lat_reg = None
+
+    def _observe_latency(self, verb: str, start: float) -> None:
+        """Record one verb's wall time (retries + failover included) on
+        ``horovod_rendezvous_kv_latency_ms{verb}`` — the fleet-scale
+        control-plane latency SLO the 256-rank battery asserts on."""
+        from ..telemetry import metrics
+        tm = metrics()
+        if not tm.enabled:
+            return
+        if self._lat_reg is not tm:
+            self._lat = {}
+            self._lat_reg = tm
+        hist = self._lat.get(verb)
+        if hist is None:
+            hist = tm.histogram(
+                "horovod_rendezvous_kv_latency_ms",
+                "Client-observed rendezvous KV verb latency, failover "
+                "retries included", labels={"verb": verb})
+            self._lat[verb] = hist
+        hist.observe((time.monotonic() - start) * 1e3)
 
     @staticmethod
     def parse_endpoints(addr: str, port: int | None) -> list[str]:
@@ -440,7 +552,8 @@ class RendezvousClient:
               data: bytes | None = None, query: str = "",
               idempotent: bool = True,
               deadline: float | None = None,
-              attempt_timeout: float | None = None) -> bytes | None:
+              attempt_timeout: float | None = None,
+              verb: str | None = None) -> bytes | None:
         """One verb with bounded endpoint failover.  Returns the body,
         or None on 404.  Non-idempotent calls never retry a transport
         error (the request may have committed server-side); 409 leader
@@ -450,6 +563,8 @@ class RendezvousClient:
             deadline = time.monotonic() + self.timeout
         if attempt_timeout is None:
             attempt_timeout = min(self.timeout, _ATTEMPT_TIMEOUT_S)
+        verb = verb or method.lower()
+        start = time.monotonic()
         attempt = 0
         last_exc: Exception | None = None
         while True:
@@ -460,9 +575,12 @@ class RendezvousClient:
             try:
                 with urlrequest.urlopen(
                         req, timeout=attempt_timeout) as resp:
-                    return resp.read()
+                    body = resp.read()
+                self._observe_latency(verb, start)
+                return body
             except urlerror.HTTPError as e:
                 if e.code == 404:
+                    self._observe_latency(verb, start)
                     return None
                 if e.code not in (409, 503):
                     raise
@@ -490,6 +608,24 @@ class RendezvousClient:
         # A put is a blind last-write-wins set: retrying a possibly-
         # committed put re-applies the same value (idempotent).
         self._call("PUT", scope, key, data=value)
+
+    def put_many(self, records) -> None:
+        """Batched puts: ``[(scope, key, value), ...]`` in ONE request
+        (``PUT /.batch/``), applied server-side under a single lock
+        hold so the WAL group-commits them in one fsync lane pass.
+        Idempotent — every record is a last-write-wins put."""
+        records = list(records)
+        if not records:
+            return
+        self._call("PUT", BATCH_SCOPE, "", data=encode_batch(records),
+                   verb="put_many")
+
+    def get_scope(self, scope: str) -> dict[str, bytes]:
+        """One request returning the scope's full key->value dict (the
+        empty-key GET): what a fleetsim host group polls instead of
+        size-many per-peer gets."""
+        raw = self._call("GET", scope, "", verb="get_scope")
+        return {} if raw is None else decode_scope(raw)
 
     def claim(self, scope: str, key: str, task_key: str = "") -> int:
         """Atomic fetch-and-increment of the (scope, key) counter.
@@ -559,7 +695,8 @@ class RendezvousClient:
                 value = self._call("GET", scope, key,
                                    query=f"?wait={chunk_ms}",
                                    deadline=deadline,
-                                   attempt_timeout=chunk_ms / 1e3 + 5.0)
+                                   attempt_timeout=chunk_ms / 1e3 + 5.0,
+                                   verb="wait")
             except TimeoutError:
                 raise TimeoutError(
                     f"Rendezvous key {scope}/{key} not available after "

@@ -1,0 +1,67 @@
+"""Regenerate the committed console golden fixture.
+
+Runs a small deterministic in-process fleet episode — 4 virtual ranks,
+6 steps, one orderly preemption (v2 at step 3) and one injected
+straggler (v3, +60 ms) — dumps its rank-stamped evidence into
+``episode4/`` and records ``summary_lines`` of the rendered episode as
+``episode4.summary.txt``.
+
+Run from the repo root after changing dump formats or the renderer::
+
+    JAX_PLATFORMS=cpu python tests/fixtures/console/regen.py
+
+The committed dump dir is the test input and the summary file the
+golden; ``tests/test_console.py`` renders the former and byte-compares
+against the latter (no fleet run at test time).
+"""
+import os
+import shutil
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(HERE, "..", "..", "..")))
+EPISODE = os.path.join(HERE, "episode4")
+GOLDEN = os.path.join(HERE, "episode4.summary.txt")
+
+
+def main() -> int:
+    os.environ["HOROVOD_METRICS"] = "on"
+    os.environ["HOROVOD_CHAOS"] = "preempt:rank=2,op=3"
+    os.environ["HOROVOD_FLIGHT_FILE"] = os.path.join(EPISODE,
+                                                     "flight.json")
+    from horovod_tpu import telemetry
+    from horovod_tpu.telemetry import flight
+    from horovod_tpu.fleetsim import FleetConfig, FleetSim
+    from horovod_tpu.console import load_dump_dir, summary_lines
+    from horovod_tpu.runner.network import RendezvousServer
+
+    telemetry.configure()
+    flight.configure(0)
+    shutil.rmtree(EPISODE, ignore_errors=True)
+    os.makedirs(EPISODE)
+    server = RendezvousServer()
+    port = server.start()
+    try:
+        cfg = FleetConfig(ranks=4, steps=6, step_ms=2.0,
+                          heartbeat_s=0.2, fault_timeout_s=10.0,
+                          step_timeout_s=30.0, host_group=4,
+                          straggler_vid=3, straggler_ms=60.0,
+                          epoch="golden", dump_dir=EPISODE,
+                          endpoints=f"127.0.0.1:{port}")
+        report = FleetSim(cfg).run()
+    finally:
+        server.stop()
+    assert report.failed_steps == 0, report
+    assert report.outcomes == {"finished": 3, "preempted": 1}, report
+    assert report.straggler_rank == 3, report
+
+    lines = summary_lines(load_dump_dir(EPISODE))
+    with open(GOLDEN, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    print("\n".join(lines))
+    print(f"\nwrote {EPISODE}/ and {GOLDEN}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

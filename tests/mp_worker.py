@@ -2784,9 +2784,24 @@ BATTERIES = {
     "flow": battery_flow,
 }
 
+def battery_fleetsim(port):
+    """ISSUE 16 fleet-scale acceptance: ONE worker process hosts the
+    whole virtual fleet — hundreds of protocol-only ranks running the
+    real rendezvous client / heartbeat / membership paths against the
+    external (possibly replicated) control plane, with chaos from
+    HOROVOD_CHAOS composing unchanged.  Pre-init: the fleet never calls
+    hvd.init (no tensor data plane).  Prints the FLEETSIM_SUMMARY line
+    the test asserts on; rc 0 iff zero failed steps."""
+    from horovod_tpu.fleetsim.__main__ import main as fleet_main
+    return fleet_main()
+
+
 PREINIT_BATTERIES = {
     "statesync_joiner": battery_statesync_joiner,
     "statesync_serve_joiner": battery_statesync_serve_joiner,
+    # ISSUE 16: the rank-virtualized fleet harness (one process = the
+    # whole fleet; `size` counts host processes, not virtual ranks).
+    "fleetsim": battery_fleetsim,
 }
 
 
@@ -2803,6 +2818,18 @@ def main() -> int:
     # Generous under CI load: a peer may still be importing torch/tf when
     # this rank reaches rendezvous.
     os.environ.setdefault("HOROVOD_GLOO_TIMEOUT_SECONDS", "90")
+    if battery == "fleetsim":
+        # The whole fleet lives in THIS process: metrics + flight on so
+        # the episode leaves console-renderable rank-stamped evidence.
+        os.environ.setdefault("HOROVOD_METRICS", "on")
+        _dump = os.environ.get("HOROVOD_FLEETSIM_DUMP_DIR")
+        if _dump:
+            # The dump dir owns the episode's evidence: force the
+            # flight file into it (an inherited default — e.g. the
+            # pytest conftest's — would strand the flight dump outside
+            # the directory the console is pointed at).
+            os.environ["HOROVOD_FLIGHT_FILE"] = \
+                os.path.join(_dump, "flight.json")
     if battery == "stall":
         os.environ["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "1"
         os.environ["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = "3"

@@ -1,0 +1,157 @@
+"""Unit tests for bench.py's resumable accelerator-probe watcher.
+
+The BENCH_r01-05 regression had two shapes: a transient rc=1 probe
+crash was treated like "no accelerator" (burning a full probe interval
+per crash), and the round window was wall-clock — a multi-hour tunnel
+outage that also killed the bench process expired the window while
+nobody was watching.  These tests drive ``_orchestrate`` with the
+probe, the inner spawn, ``time.sleep`` and ``time.time`` stubbed, so
+the schedule itself is under test (no jax, no subprocesses).
+"""
+import json
+import os
+import sys
+import types
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+class _Clock:
+    def __init__(self, start=1000.0):
+        self.now = start
+        self.sleeps = []
+
+    def time(self):
+        return self.now
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.now += s
+
+
+@pytest.fixture
+def clock(monkeypatch, tmp_path):
+    clk = _Clock()
+    monkeypatch.setattr(bench.time, "time", clk.time)
+    monkeypatch.setattr(bench.time, "sleep", clk.sleep)
+    monkeypatch.setenv("HOROVOD_BENCH_STATE_FILE",
+                       str(tmp_path / "probe.json"))
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("HOROVOD_BENCH_PROBE_ATTEMPTS", raising=False)
+    return clk
+
+
+def _args():
+    return types.SimpleNamespace(model="resnet50")
+
+
+def test_probe_crash_is_retryable_with_capped_backoff(clock, monkeypatch,
+                                                      capsys):
+    """rc!=0 probe crashes retry on a 5s-doubling backoff capped at the
+    probe interval — not one full interval per crash."""
+    monkeypatch.setenv("HOROVOD_BENCH_WINDOW_SECONDS", "200")
+    monkeypatch.setenv("HOROVOD_BENCH_PROBE_INTERVAL", "60")
+    statuses = ["crash", "crash", "crash", "ok"]
+    monkeypatch.setattr(bench, "_probe_backend_status",
+                        lambda timeout: (statuses.pop(0), None))
+    payload = {"metric": "resnet50_images_sec", "value": 1.0,
+               "backend": "tpu"}
+    monkeypatch.setattr(bench, "_spawn_inner",
+                        lambda *a, **k: (0, dict(payload), "", False))
+    emitted = []
+    monkeypatch.setattr(bench, "_emit", emitted.append)
+    assert bench._orchestrate(_args()) == 0
+    # Backoff ladder 5, 10, 20 — NOT 60, 60, 60.
+    assert clock.sleeps == [5.0, 10.0, 20.0]
+    assert emitted and emitted[0]["attempts"] == 4
+    # Success clears the checkpoint: the next round starts fresh.
+    assert not os.path.exists(bench._probe_state_path())
+
+
+def test_absent_probe_keeps_full_interval(clock, monkeypatch):
+    monkeypatch.setenv("HOROVOD_BENCH_WINDOW_SECONDS", "200")
+    monkeypatch.setenv("HOROVOD_BENCH_PROBE_INTERVAL", "60")
+    statuses = ["absent", "ok"]
+    monkeypatch.setattr(bench, "_probe_backend_status",
+                        lambda timeout: (statuses.pop(0), None))
+    monkeypatch.setattr(
+        bench, "_spawn_inner",
+        lambda *a, **k: (0, {"metric": "resnet50_images_sec",
+                             "value": 1.0, "backend": "tpu"}, "", False))
+    monkeypatch.setattr(bench, "_emit", lambda p: None)
+    assert bench._orchestrate(_args()) == 0
+    assert clock.sleeps == [60.0]
+
+
+def test_window_survives_multi_hour_process_death_gap(clock, monkeypatch):
+    """A resumed watcher whose state file is hours old (the outage
+    killed the driver too) continues the SAME round with its budget
+    nearly intact: the gap charges at most ~one sleep of active time,
+    and the next probe can still record a real payload."""
+    monkeypatch.setenv("HOROVOD_BENCH_WINDOW_SECONDS", "3600")
+    monkeypatch.setenv("HOROVOD_BENCH_PROBE_INTERVAL", "60")
+    # A checkpoint from 5 wall-clock hours ago, 300s of budget spent.
+    bench._save_probe_state({"window_start": clock.now - 5 * 3600.0,
+                             "attempts": 7, "active_s": 300.0,
+                             "last_seen": clock.now - 5 * 3600.0})
+    monkeypatch.setattr(bench, "_probe_backend_status",
+                        lambda timeout: ("ok", None))
+    monkeypatch.setattr(
+        bench, "_spawn_inner",
+        lambda *a, **k: (0, {"metric": "resnet50_images_sec",
+                             "value": 1.0, "backend": "tpu"}, "", False))
+    emitted = []
+    monkeypatch.setattr(bench, "_emit", emitted.append)
+    assert bench._orchestrate(_args()) == 0
+    # The same window resumed (attempts continue, not restart) and the
+    # 5 h gap did not exhaust the 1 h budget.
+    assert emitted and emitted[0]["attempts"] == 8
+    assert emitted[0]["probe_active_s"] < 3600.0
+
+
+def test_spent_budget_starts_next_round_fresh(clock, monkeypatch):
+    monkeypatch.setenv("HOROVOD_BENCH_WINDOW_SECONDS", "600")
+    bench._save_probe_state({"window_start": clock.now - 9999.0,
+                             "attempts": 40, "active_s": 600.0,
+                             "last_seen": clock.now - 9999.0})
+    state = bench._load_probe_state(600.0)
+    assert state["attempts"] == 0
+    assert state["active_s"] == 0.0
+
+
+def test_old_format_state_resumes_without_active_time(clock, monkeypatch):
+    """Pre-active-time checkpoints ({window_start, attempts}) load with
+    a zero spent budget instead of being discarded."""
+    with open(bench._probe_state_path(), "w") as f:
+        json.dump({"window_start": clock.now - 50.0, "attempts": 3}, f)
+    state = bench._load_probe_state(3600.0)
+    assert state["attempts"] == 3
+    assert state["active_s"] == 0.0
+    assert state["last_seen"] == clock.now - 50.0
+
+
+def test_exhausted_budget_falls_back_to_cpu_once(clock, monkeypatch):
+    monkeypatch.setenv("HOROVOD_BENCH_WINDOW_SECONDS", "100")
+    monkeypatch.setenv("HOROVOD_BENCH_PROBE_INTERVAL", "60")
+    monkeypatch.setattr(bench, "_probe_backend_status",
+                        lambda timeout: ("absent", None))
+    calls = []
+
+    def _inner(args, extra_env, timeout):
+        calls.append(dict(extra_env))
+        return (0, {"metric": "resnet50_images_sec", "value": 0.5},
+                "", False)
+
+    monkeypatch.setattr(bench, "_spawn_inner", _inner)
+    emitted = []
+    monkeypatch.setattr(bench, "_emit", emitted.append)
+    assert bench._orchestrate(_args()) == 0
+    assert calls == [{"JAX_PLATFORMS": "cpu"}]
+    assert emitted[0]["backend"] == "cpu-fallback"
+    # The spent window is checkpointed: the NEXT invocation of
+    # _load_probe_state starts round N+1 fresh.
+    assert bench._load_probe_state(100.0)["attempts"] == 0
