@@ -132,15 +132,19 @@ def _auto_name(prefix: str, name: str | None) -> str:
 def allreduce_async(tensor, average: bool | None = None, name: str | None = None,
                     op=None, prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0,
-                    compression=None) -> Handle:
+                    compression=None, spec=None) -> Handle:
     """``compression`` selects the wire codec: a name ("fp16", "bf16",
     "int8", "uint4"), a compress.CompressionCodec, or a framework
-    Compression marker class; None honors HOROVOD_COMPRESSION."""
+    Compression marker class; None honors HOROVOD_COMPRESSION.
+    ``spec`` annotates the tensor's sharding layout (PartitionSpec,
+    axis-entry iterable, or canonical token string): it joins the
+    collective's cross-rank fingerprint identity and rides the wire as
+    sp_spec (hvdshard; docs/analysis.md)."""
     kind, adasum = _op_kind(op, average)
     _, handle = core.enqueue_allreduce(
         _auto_name("allreduce", name), tensor, op=kind,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        adasum=adasum, codec=compression)
+        adasum=adasum, codec=compression, spec=spec)
     handle.wrap_refs = [tensor]
     return handle
 
@@ -162,15 +166,17 @@ def grouped_allreduce_async(tensors: Sequence[Any],
     return handle
 
 
-def allgather_async(tensor, name: str | None = None) -> Handle:
-    _, handle = core.enqueue_allgather(_auto_name("allgather", name), tensor)
+def allgather_async(tensor, name: str | None = None, spec=None) -> Handle:
+    _, handle = core.enqueue_allgather(_auto_name("allgather", name), tensor,
+                                       spec=spec)
     handle.wrap_refs = [tensor]
     return handle
 
 
-def broadcast_async(tensor, root_rank: int, name: str | None = None) -> Handle:
+def broadcast_async(tensor, root_rank: int, name: str | None = None,
+                    spec=None) -> Handle:
     _, handle = core.enqueue_broadcast(_auto_name("broadcast", name), tensor,
-                                       root_rank)
+                                       root_rank, spec=spec)
     handle.wrap_refs = [tensor]
     return handle
 
@@ -204,9 +210,9 @@ def poll(handle: Handle) -> bool:
 # ---------------------------------------------------------------------------
 def allreduce(tensor, average: bool | None = None, name: str | None = None,
               op=None, prescale_factor: float = 1.0,
-              postscale_factor: float = 1.0, compression=None):
+              postscale_factor: float = 1.0, compression=None, spec=None):
     handle = allreduce_async(tensor, average, name, op, prescale_factor,
-                             postscale_factor, compression)
+                             postscale_factor, compression, spec)
     return _result(handle, tensor)
 
 
@@ -241,8 +247,8 @@ def reducescatter_async(tensor, name: str | None = None, op=None,
     return handle
 
 
-def allgather(tensor, name: str | None = None):
-    return _result(allgather_async(tensor, name), tensor)
+def allgather(tensor, name: str | None = None, spec=None):
+    return _result(allgather_async(tensor, name, spec=spec), tensor)
 
 
 def reducescatter(tensor, name: str | None = None, op=None,

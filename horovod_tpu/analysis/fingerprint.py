@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 
 from ..common import config
 from ..common.message import Request, RequestType
+from .hvdshard.specs import fold_token
 
 _MASK = (1 << 64) - 1
 _FNV_OFFSET = 0xcbf29ce484222325
@@ -90,6 +91,13 @@ class Divergence:
                 names.append(name)
         return sorted(names)
 
+    def _spec_divergent(self) -> bool:
+        """True when any located descriptor carries a sharding-spec
+        column (the op×spec identity class: ops may agree while the
+        spec disagrees)."""
+        return any(len(d.split("|")) >= 6 and d.split("|")[5]
+                   for d in self.descriptors.values())
+
     def message(self) -> str:
         by_rank = ", ".join(
             f"rank {r}: {_pretty(d)}"
@@ -98,11 +106,21 @@ class Divergence:
                  else f"at or before op #{self.seq} (divergence predates "
                       f"the fingerprint window; raise "
                       f"HOROVOD_FINGERPRINT_WINDOW to pin it exactly)")
+        if self._spec_divergent():
+            hint = (f"Every rank must submit the same collectives — "
+                    f"op, name, dims AND sharding spec — in the same "
+                    f"order; check for rank-gated collective or spec "
+                    f"choices (hvdshard: python -m "
+                    f"horovod_tpu.analysis.lint --shard reports the "
+                    f"same spec-annotated per-arm streams as HVD803).")
+        else:
+            hint = (f"Every rank must submit the same collectives in "
+                    f"the same order; check for rank-gated collective "
+                    f"calls (hvdlint/hvdflow: python -m "
+                    f"horovod_tpu.analysis.lint --flow reports the "
+                    f"same per-arm op streams as HVD601).")
         return (f"Collective fingerprint divergence {where}: {by_rank}. "
-                f"Every rank must submit the same collectives in the same "
-                f"order; check for rank-gated collective calls "
-                f"(hvdlint/hvdflow: python -m horovod_tpu.analysis.lint "
-                f"--flow reports the same per-arm op streams as HVD601).")
+                + hint)
 
 
 def _pretty(descriptor: str) -> str:
@@ -110,27 +128,42 @@ def _pretty(descriptor: str) -> str:
     if len(parts) >= 4:
         op, name, dtype, dims = parts[:4]
         shape = dims or "scalar"
+        if len(parts) >= 6 and parts[5]:
+            return f"{op}({name}, {dtype}, shape={shape}, spec={parts[5]})"
         return f"{op}({name}, {dtype}, shape={shape})"
     return descriptor
 
 
-def describe(req: Request) -> str:
-    """Canonical descriptor folded into the hash: op|name|dtype|dims|codec.
+def describe(req: Request, with_spec: bool = False) -> str:
+    """Canonical descriptor folded into the hash:
+    op|name|dtype|dims|codec[|spec].
 
     ALLGATHER's FIRST dim is rank-local by contract (uneven-row gather
     is the documented semantic — allgather_object payloads, serving
     completion exchanges), so it folds as ``*``: a cross-rank digest
     that included it would flag every legitimate uneven gather as a
-    divergence.  Trailing dims must still agree."""
+    divergence.  Trailing dims must still agree.
+
+    With ``with_spec`` (the tracker's fold_spec flag: on only when the
+    mesh negotiated FEATURE_SHARDING, so every rank folds the same
+    bytes), a non-empty ``sp_spec`` token appends as a sixth column —
+    folded through :func:`hvdshard.specs.fold_token`, which wildcards
+    ALLGATHER's rank-local dim-0 entry exactly like the shape rule
+    above.  Unannotated requests keep the 5-column descriptor
+    byte-identical to pre-sharding builds."""
     shape = list(req.tensor_shape)
     parts = [str(int(d)) for d in shape]
     from ..common.message import RequestType
     if req.request_type == RequestType.ALLGATHER and parts:
         parts[0] = "*"
     dims = "x".join(parts)
-    return (f"{req.request_type.name}|{req.tensor_name}|"
+    desc = (f"{req.request_type.name}|{req.tensor_name}|"
             f"{req.tensor_type.name}|{dims}|"
             f"{req.codec}/{req.codec_block_size}")
+    spec = getattr(req, "sp_spec", "")
+    if with_spec and spec:
+        desc += "|" + fold_token(req.request_type.name, spec)
+    return desc
 
 
 class FingerprintTracker:
@@ -148,6 +181,12 @@ class FingerprintTracker:
             mode = FingerprintMode.parse(mode)
         self.mode = mode
         self.window = max(int(window), 1)
+        # Spec column gate: the controller sets this from the mesh's
+        # negotiated features (FEATURE_SHARDING) — identical on every
+        # rank by the HELLO min-proto/AND construction, so either all
+        # ranks fold the spec column or none do.  A mixed-proto world
+        # that negotiated sp_* away stays fingerprint-green.
+        self.fold_spec = True
         self.seq = 0
         self.digest = _FNV_OFFSET
         self._tail: list[OpRecord] = []
@@ -176,7 +215,7 @@ class FingerprintTracker:
         if getattr(req, "_fp_folded", False):
             return
         req._fp_folded = True  # type: ignore[attr-defined]
-        desc = describe(req)
+        desc = describe(req, with_spec=self.fold_spec)
         self.seq += 1
         self.digest = _fnv1a(desc.encode(), self.digest)
         self._tail.append(OpRecord(self.seq, self.digest, desc))

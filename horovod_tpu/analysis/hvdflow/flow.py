@@ -6,7 +6,7 @@ Model
 Per function (one AST walk, riding the shared single-parse driver):
 
 - an **effect tree**: the ordered sequence of collective call sites
-  (``("coll", op, label, site)``), unresolved calls
+  (``("coll", op, label, spec, submesh, site)``), unresolved calls
   (``("call", spine, site)``), branches (``("branch", test, site,
   then_effects, else_effects)``) and loops (``("loop", trip_expr,
   site, body_effects)``) the function body may execute;
@@ -34,6 +34,17 @@ of a **rank-tainted** branch must produce sequence-equal streams
 (HVD602).  The stream rendering in each finding is exactly the op
 sequence the runtime fingerprint would fold, so a static finding and
 its runtime divergence ERROR describe the same evidence.
+
+Since collective identity grew a sharding-spec column (hvdshard), a
+collective call site carrying a resolvable ``spec=`` literal renders
+its token as ``op(name|spec)``; arms sequence-equal on ``op(name)`` but
+unequal on spec are the HVD803 divergent-spec finding (the runtime twin
+is the strict-mode fingerprint ERROR on the first spec-divergent op).
+Collectives invoked through a sub-mesh receiver (``self.cross.…``,
+``self.local.…``, the shm legs — SUBMESH_ATTRS) are *symmetric per
+sub-mesh*: an HVD601 whose divergent tokens are ALL sub-mesh-scoped
+demotes to a warning documenting the per-submesh symmetry instead of
+requiring an inline suppression.
 """
 from __future__ import annotations
 
@@ -101,6 +112,17 @@ WAIT_NAMES = frozenset({"recv", "recv_into", "join", "wait", "urlopen",
 _BOUND_HINTS = ("timeout", "deadline", "poll")
 _MAX_SERVE_DEPTH = 14
 
+# Sub-mesh receiver attributes: a collective invoked through one of
+# these receivers executes within a proper sub-mesh of the world
+# (backend/hierarchical.py's RS(local)→AR(cross)→AG(local) legs, the
+# shm twins).  Membership of each sub-mesh is a pure function of
+# world-symmetric data (payload size, local_size) beneath one
+# already-negotiated response, so arms whose divergent tokens are ALL
+# sub-mesh-scoped are symmetric-per-submesh: HVD601 demotes them to a
+# warning naming the sub-meshes instead of demanding a suppression.
+# Reviewed manifest, like the ownership/LOCK_HOLD_ALLOWED idiom.
+SUBMESH_ATTRS = frozenset({"cross", "local", "shm_local", "shm_cross"})
+
 # Stream caps: a divergence is located within the first tokens; capping
 # keeps pathological recursion bounded.
 _MAX_STREAM = 48
@@ -161,6 +183,55 @@ def _call_label(node: ast.Call) -> str:
     for arg in node.args:
         if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
             return arg.value
+    return ""
+
+
+def _spec_token_of_ast(node: ast.AST) -> str:
+    """Canonical spec token of a ``spec=`` argument value, when it is a
+    resolvable literal: a string constant (already canonical), or a
+    ``P(...)``/``PartitionSpec(...)`` call whose per-dim entries are
+    constants (None, axis-name strings, or tuples/lists of axis names).
+    Anything dynamic yields '' — imprecision loses spec columns, never
+    invents them (the hvdflow confidence discipline)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Call) and \
+            _terminal(node) in ("P", "PartitionSpec"):
+        entries = []
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and arg.value is None:
+                entries.append("*")
+            elif isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str):
+                entries.append(arg.value)
+            elif isinstance(arg, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, str) for e in arg.elts):
+                entries.append("+".join(e.value for e in arg.elts))
+            else:
+                return ""
+        if not entries:
+            return "*"
+        return "(" + ",".join(entries) + ")"
+    return ""
+
+
+def _call_spec(node: ast.Call) -> str:
+    """Spec token a collective call site carries (``spec=`` keyword)."""
+    for kw in node.keywords:
+        if kw.arg == "spec":
+            return _spec_token_of_ast(kw.value)
+    return ""
+
+
+def _submesh_qual(node: ast.Call) -> str:
+    """The sub-mesh receiver attribute a collective is invoked through
+    (SUBMESH_ATTRS), or ''."""
+    sp = _spine(node.func)
+    if sp:
+        for part in sp[:-1]:
+            if part in SUBMESH_ATTRS:
+                return part
     return ""
 
 
@@ -338,6 +409,7 @@ class _FuncScan:
                 name = _terminal(node)
                 if name in FLOW_COLLECTIVES:
                     out.append(("coll", name, _call_label(node),
+                                _call_spec(node), _submesh_qual(node),
                                 node.lineno))
                 else:
                     sp = _spine(node.func)
@@ -571,14 +643,21 @@ class FlowAnalysis:
 
     def _stream_of(self, effs: list, fn: FlowFunc,
                    stack: frozenset) -> list:
-        """[(token, (path, line))] — see the module docstring."""
+        """[(token, base_token, (path, line), quals)] — token is the
+        spec-annotated rendering (``op(name|spec)``), base_token the
+        spec-stripped one (HVD601 compares bases, HVD803 compares
+        tokens), and quals the sub-mesh qualifier set — a frozenset of
+        SUBMESH_ATTRS when every collective under this entry is
+        sub-mesh-scoped, else None."""
         out: list = []
         for e in effs:
             kind = e[0]
             if kind == "coll":
-                _, op, label, line = e
-                tok = f"{op}({label})" if label else op
-                out.append((tok, (fn.path, line)))
+                _, op, label, spec, qual, line = e
+                base = f"{op}({label})" if label else op
+                tok = f"{op}({label}|{spec})" if spec else base
+                out.append((tok, base, (fn.path, line),
+                            frozenset({qual}) if qual else None))
             elif kind == "call":
                 _, sp, line = e
                 for tkey, conf in self._resolve(fn, sp, line):
@@ -589,18 +668,21 @@ class FlowAnalysis:
                 _, test, line, then_e, else_e = e
                 t = self._stream_of(then_e, fn, stack)
                 o = self._stream_of(else_e, fn, stack)
-                if [x for x, _ in t] == [x for x, _ in o]:
+                if [x[0] for x in t] == [x[0] for x in o]:
                     out.extend(t)
                 elif t or o:
                     out.append((
                         "{%s|%s}" % (_render(t) or "-", _render(o) or "-"),
-                        (fn.path, line)))
+                        "{%s|%s}" % (_render_base(t) or "-",
+                                     _render_base(o) or "-"),
+                        (fn.path, line), _merge_quals(t + o)))
             elif kind == "loop":
                 _, _trip, line, body_e = e
                 body = self._stream_of(body_e, fn, stack)
                 if body:
                     out.append((f"loop[{_render(body)}]",
-                                (fn.path, line)))
+                                f"loop[{_render_base(body)}]",
+                                (fn.path, line), _merge_quals(body)))
             if len(out) > _MAX_STREAM:
                 return out[:_MAX_STREAM]
         return out
@@ -631,7 +713,7 @@ class FlowAnalysis:
                 yield from self._walk_effects(e[3])
 
     def _check_divergence(self) -> None:
-        """HVD601 + HVD602."""
+        """HVD601 + HVD602 + HVD803."""
         for fn in self.flow.funcs.values():
             for e in self._walk_effects(fn.effects):
                 if e[0] == "branch":
@@ -640,15 +722,61 @@ class FlowAnalysis:
                         continue
                     t = self._stream_of(then_e, fn, frozenset({fn.key}))
                     o = self._stream_of(else_e, fn, frozenset({fn.key}))
-                    tt = [x for x, _ in t]
-                    oo = [x for x, _ in o]
+                    tt = [x[0] for x in t]
+                    oo = [x[0] for x in o]
                     if tt == oo:
                         continue
                     k = next((i for i, (a, b) in enumerate(
                         zip(tt, oo)) if a != b), min(len(tt), len(oo)))
                     a_tok = tt[k] if k < len(tt) else "(end of stream)"
                     b_tok = oo[k] if k < len(oo) else "(end of stream)"
-                    sites = tuple(s for _, s in (t + o)[:6])
+                    sites = tuple(e2[2] for e2 in (t + o)[:6])
+                    span_end = getattr(test, "end_lineno", line)
+                    if [x[1] for x in t] == [x[1] for x in o]:
+                        # Sequence-equal on op×name, unequal on spec:
+                        # the spec-divergence class (hvdshard HVD803).
+                        self._emit(
+                            "divergent-spec-collective", "error",
+                            fn.path, line,
+                            f"rank-tainted branch in '{fn.key}' gates "
+                            f"collective arms that agree on the op "
+                            f"sequence but disagree on sharding spec: "
+                            f"if-arm [{_render(t) or '(empty)'}] vs "
+                            f"else-arm [{_render(o) or '(empty)'}]; "
+                            f"first spec-divergent op #{k + 1}: {a_tok}"
+                            f" vs {b_tok}.  Negotiation proceeds (the "
+                            f"ops match) and the data plane then moves "
+                            f"differently-sharded bytes into one "
+                            f"reduction — runtime: the strict-mode "
+                            f"HOROVOD_FINGERPRINT divergence ERROR on "
+                            f"the first spec-divergent op (lint "
+                            f"--shard).  Make the spec rank-invariant, "
+                            f"or justify with a suppression",
+                            sites=sites, span_end=span_end)
+                        continue
+                    tq = _merge_quals(t)
+                    oq = _merge_quals(o)
+                    if tq is not None and oq is not None:
+                        # Every divergent token is sub-mesh-scoped:
+                        # symmetric per sub-mesh (the hierarchical
+                        # legs), not a world-level divergence.
+                        subs = ", ".join(sorted(tq | oq)) or "-"
+                        self._emit(
+                            "divergent-collective", "warning", fn.path,
+                            line,
+                            f"rank-tainted branch in '{fn.key}' gates "
+                            f"collective streams that differ only "
+                            f"within sub-mesh legs ({subs}): if-arm "
+                            f"[{_render(t) or '(empty)'}] vs else-arm "
+                            f"[{_render(o) or '(empty)'}].  Sub-mesh "
+                            f"membership is a pure function of "
+                            f"world-symmetric data beneath one "
+                            f"negotiated response (SUBMESH_ATTRS), so "
+                            f"every member of the executing sub-mesh "
+                            f"takes the same arm — symmetric per "
+                            f"sub-mesh, demoted from the HVD601 error",
+                            sites=sites, span_end=span_end)
+                        continue
                     self._emit(
                         "divergent-collective", "error", fn.path, line,
                         f"rank-tainted branch in '{fn.key}' gates a "
@@ -663,7 +791,7 @@ class FlowAnalysis:
                         f"(rank-gated non-collective work is legal), or "
                         f"justify with a suppression",
                         sites=sites,
-                        span_end=getattr(test, "end_lineno", line))
+                        span_end=span_end)
                 elif e[0] == "loop":
                     _, trip, line, body_e = e
                     if trip is None or not self._expr_tainted(fn, trip):
@@ -672,7 +800,7 @@ class FlowAnalysis:
                                            frozenset({fn.key}))
                     if not body:
                         continue
-                    sites = tuple(s for _, s in body[:6])
+                    sites = tuple(e2[2] for e2 in body[:6])
                     self._emit(
                         "divergent-loop-trip", "error", fn.path, line,
                         f"collective stream [{_render(body)}] inside a "
@@ -772,7 +900,23 @@ class FlowAnalysis:
 
 
 def _render(stream: list) -> str:
-    return " -> ".join(tok for tok, _ in stream)
+    return " -> ".join(e[0] for e in stream)
+
+
+def _render_base(stream: list) -> str:
+    return " -> ".join(e[1] for e in stream)
+
+
+def _merge_quals(entries: list):
+    """Union of the entries' sub-mesh qualifier sets, or None when any
+    entry is NOT fully sub-mesh-scoped (an empty entry list merges to
+    the empty set: a silent arm is vacuously scoped)."""
+    quals: set = set()
+    for e in entries:
+        if e[3] is None:
+            return None
+        quals |= e[3]
+    return frozenset(quals)
 
 
 def analyze_flow(program: Program, flow: FlowProgram,
@@ -795,7 +939,11 @@ def analyze_paths(paths) -> list[Finding]:
             continue
         program.collect_source(p, src, tree)
         flow.collect_source(p, src, tree)
-    return analyze_flow(program, flow)
+    # The engine also emits HVD803 (spec-divergent arms); that rule is
+    # hvdshard's to report — the standalone CLIs partition the same way
+    # the lint driver's --flow/--shard flags do.
+    return [f for f in analyze_flow(program, flow)
+            if f.rule.id in FLOW_RULE_IDS]
 
 
 # --- CLI ---------------------------------------------------------------------

@@ -60,7 +60,7 @@ _DEC_METHODS = ("decode", "from_bytes")
 # encoded unconditionally breaks every peer that negotiated the bit
 # away.  Mirrors common/wire.py OPTIONAL_FIELD_FEATURES (tests assert
 # the two tables agree).
-_OPTIONAL_WIRE_PREFIXES = ("fp_", "tm_", "trace_")
+_OPTIONAL_WIRE_PREFIXES = ("fp_", "tm_", "trace_", "sp_")
 
 
 def collect_wire_method(program, mod, cls, node) -> None:
@@ -345,8 +345,9 @@ def check_wire_drift(analysis: Analysis) -> None:
                         f"Decoder in common/wire.py — the peer cannot "
                         f"decode what this side writes")
             # Optional-field feature-bit gate (the compile-time half of
-            # the versioned HELLO handshake): every fp_*/tm_*/trace_*
-            # field must encode/decode inside an `if features & ...:`
+            # the versioned HELLO handshake): every
+            # fp_*/tm_*/trace_*/sp_* field must encode/decode inside
+            # an `if features & ...:`
             # arm, or a peer that negotiated the bit away desyncs.
             for prim, field, line in toks:
                 if not field or \
@@ -396,7 +397,8 @@ def check_wire_drift(analysis: Analysis) -> None:
                     f"starting with '{prim}'{f' ({f})' if f else ''} "
                     f"that {shorter['method']} never "
                     f"{'reads' if longer is enc else 'writes'} — "
-                    f"fp_*/tm_*/trace_*-style field growth must land "
+                    f"fp_*/tm_*/trace_*/sp_*-style field growth must "
+                    f"land "
                     f"on both sides in the same change")
 
 

@@ -1,0 +1,52 @@
+"""hvdshard — sharding-spec static analysis + the op×spec identity core.
+
+The sharding half of the analysis suite: hvdflow proves every rank runs
+the same *sequence* of collectives; hvdshard proves they agree on the
+*layout* each collective moves.  Collective identity becomes
+op×name×dtype×dims×**spec**: the canonical spec token
+(:func:`specs.spec_token`) enters the runtime fingerprint fold
+(analysis/fingerprint.py), rides Request/Response as the
+feature-bit-gated ``sp_*`` wire group (FEATURE_SHARDING,
+PR-15 OPTIONAL_FIELD_FEATURES discipline, HVD505-enforced), and
+annotates hvdflow stream tokens as ``op(name|spec)``.
+
+Rules (``shard.py``; catalogue in docs/analysis.md):
+
+- **HVD801 dead-partition-rule** — a ShardingRules regex matching no
+  parameter path the harvested vocabulary can produce, or a path that
+  falls through to the replicated default while a sibling path matched
+  a sharded rule (the finding names the path and the nearest
+  non-matching rule).
+- **HVD802 spec-mesh-axis-mismatch** — a PartitionSpec literal naming
+  a mesh axis absent from the harvested axis vocabulary (DEFAULT_AXES
+  assignments, ``Mesh(...)`` constructor literals, MeshSpec fields).
+- **HVD803 divergent-spec-collective** — rank-tainted branch arms
+  sequence-equal on op×name but unequal on spec (emitted by the
+  hvdflow pass over its spec-annotated streams; the runtime twin is
+  the strict-mode fingerprint ERROR on the first spec-divergent op).
+- **HVD804 spec-drop** — a sharded value (``shard_params`` /
+  ``constrain`` / ``with_sharding_constraint`` / ``device_put`` with a
+  NamedSharding) flowing into a collective call that serializes dims
+  but discards the spec (no ``spec=``).
+
+This ``__init__`` stays light — ``specs`` is dependency-free and is
+imported by the fingerprint/wire layer of every rank; the whole-program
+pass in ``shard`` (which drags in the lint/hvdsan/hvdflow machinery) is
+resolved lazily.
+
+CLI: ``python -m horovod_tpu.analysis.hvdshard`` (or ``lint --shard``
+to ride the shared single-parse driver).  See docs/analysis.md.
+"""
+from .specs import (fold_token, missing_axes, rule_coverage,  # noqa: F401
+                    spec_token, token_axes)
+
+_LAZY = ("SHARD_RULE_IDS", "ShardProgram", "analyze_shard",
+         "analyze_paths", "main")
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        from . import shard
+        return getattr(shard, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

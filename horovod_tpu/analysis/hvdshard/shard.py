@@ -1,0 +1,396 @@
+"""The hvdshard whole-program pass: HVD801/802/804 over harvested
+sharding facts, plus the CLI that merges in hvdflow's HVD803.
+
+Harvest (one AST walk per file, riding the shared single-parse driver
+when invoked as ``lint --shard``):
+
+- **Rule tables** — ``ShardingRules([...])`` constructor calls whose
+  first argument is a literal list of ``(pattern, P(...))`` pairs.
+- **Spec literal sites** — ``P(...)``/``PartitionSpec(...)`` calls with
+  constant entries, plus ``spec=`` keywords on collective calls
+  (string-token or P-literal form).
+- **Mesh-axis vocabulary** — tuple-of-string assignments to ``*AXES*``
+  names (parallel/mesh.DEFAULT_AXES), literal string tuples passed to a
+  ``Mesh(...)`` constructor (backend/xla.py's ``("world", "local")``
+  device mesh must not be a false HVD802 positive), and the axis-named
+  keywords of ``MeshSpec(...)``/``build_mesh(...)``.
+- **Parameter-path vocabulary** — flax ``name="..."`` keyword literals
+  and ``self.param("...", ...)`` first arguments; candidate paths are
+  synthesized from these tokens plus the implicit flax leaf names
+  (kernel/bias/scale/embedding), so a rule regex can be judged dead or
+  a sibling path uncovered without executing any model code.
+- **Spec-drop flows** (HVD804) — per-function: locals assigned from a
+  spec-producing call (``shard_params``/``constrain``/
+  ``with_sharding_constraint``/``device_put`` with a NamedSharding or
+  P argument) that later flow into a collective call carrying no
+  ``spec=``.
+
+Like hvdflow, imprecision only ever *loses* facts (a dynamic table or
+computed spec harvests as nothing) — the pass never invents a spec, so
+every finding is anchored to literal source the author wrote.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import sys
+from dataclasses import dataclass, field
+
+from ..hvdsan.lockgraph import Finding, Program, norm_path
+from ..lint import iter_python_files
+from ..rules import RULES
+from ..hvdflow.flow import (FLOW_COLLECTIVES, FlowProgram, _spec_token_of_ast,
+                            _terminal, analyze_flow)
+from .specs import missing_axes, rule_coverage
+
+SHARD_RULE_IDS = frozenset({"HVD801", "HVD802", "HVD803", "HVD804"})
+
+# Calls whose result carries a sharding layout: a local assigned from
+# one of these is "spec'd", and passing it to a collective without
+# ``spec=`` drops the layout on the floor (HVD804).
+SPEC_PRODUCERS = frozenset({
+    "shard_params", "constrain", "with_sharding_constraint", "device_put",
+})
+# device_put only produces a layout when a sharding rides along.
+_SHARDING_CTORS = ("NamedSharding", "P", "PartitionSpec")
+
+# Implicit flax leaf names: parameters these modules create without an
+# explicit ``name=`` (Dense kernels, LayerNorm scales, Embed tables).
+IMPLICIT_LEAVES = ("kernel", "bias", "scale", "embedding")
+
+# Vocabulary bound: candidate paths are the cross product of harvested
+# name tokens, so cap the token set to keep the synthesis linear-ish.
+_MAX_NAME_TOKENS = 128
+
+
+@dataclass
+class ShardProgram:
+    """Whole-program sharding facts, one collect_source() per file."""
+    # [(path, line, [(pattern, token, entry_line)])]
+    rule_tables: list = field(default_factory=list)
+    # {(path, line, token)}
+    spec_sites: set = field(default_factory=set)
+    # mesh axis vocabulary + first sighting of each source kind
+    axis_vocab: set = field(default_factory=set)
+    # parameter-path name tokens
+    param_names: set = field(default_factory=set)
+    # [(path, line, var, producer, collective)]
+    spec_drops: list = field(default_factory=list)
+
+    def collect_source(self, path: str, source: str,
+                       tree: ast.AST | None = None) -> None:
+        if tree is None:
+            tree = ast.parse(source, filename=path)
+        _ShardCollector(self, norm_path(path)).visit(tree)
+
+
+_NAME_RX = None
+
+
+def _is_pathish(s: str) -> bool:
+    """A name= literal that can be a parameter-path token (identifier
+    segments, optionally /-joined) — tensor tags with dots or spaces
+    ("statesync.flag.3") are wire names, not param-tree paths."""
+    global _NAME_RX
+    if _NAME_RX is None:
+        import re
+        _NAME_RX = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*(/[A-Za-z0-9_]+)*$")
+    return bool(s) and len(s) <= 64 and bool(_NAME_RX.match(s))
+
+
+class _ShardCollector(ast.NodeVisitor):
+    def __init__(self, program: ShardProgram, path: str) -> None:
+        self.p = program
+        self.path = path
+        # P(...) nodes already consumed as rule-table entries: their
+        # tokens are checked through the table, not re-reported as
+        # free-standing spec sites.
+        self._consumed: set = set()
+
+    # -- harvest --------------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and "AXES" in tgt.id.upper():
+                self._harvest_axis_tuple(node.value)
+        self.generic_visit(node)
+
+    def _harvest_axis_tuple(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.Tuple, ast.List)) and node.elts and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in node.elts):
+            self.p.axis_vocab.update(e.value for e in node.elts)
+            return True
+        return False
+
+    def visit_Call(self, node: ast.Call) -> None:
+        term = _terminal(node)
+        if term == "ShardingRules" and node.args:
+            self._harvest_rule_table(node)
+        elif term in ("P", "PartitionSpec") and id(node) not in \
+                self._consumed:
+            tok = _spec_token_of_ast(node)
+            if tok not in ("", "*"):
+                self.p.spec_sites.add((self.path, node.lineno, tok))
+        elif term == "Mesh":
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                self._harvest_axis_tuple(arg)
+        elif term in ("MeshSpec", "build_mesh"):
+            self.p.axis_vocab.update(
+                kw.arg for kw in node.keywords if kw.arg)
+        elif term == "param" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            if _is_pathish(node.args[0].value):
+                self.p.param_names.add(node.args[0].value)
+        for kw in node.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str) and \
+                    _is_pathish(kw.value.value):
+                self.p.param_names.add(kw.value.value)
+            elif kw.arg == "spec":
+                tok = _spec_token_of_ast(kw.value)
+                if tok not in ("", "*"):
+                    self.p.spec_sites.add(
+                        (self.path, kw.value.lineno, tok))
+                self._consumed.add(id(kw.value))
+        self.generic_visit(node)
+
+    def _harvest_rule_table(self, node: ast.Call) -> None:
+        table = node.args[0]
+        if not isinstance(table, (ast.Tuple, ast.List)):
+            return
+        entries = []
+        for elt in table.elts:
+            if not (isinstance(elt, (ast.Tuple, ast.List))
+                    and len(elt.elts) >= 2):
+                return          # dynamic table: harvest nothing
+            pat, spec = elt.elts[0], elt.elts[1]
+            if not (isinstance(pat, ast.Constant)
+                    and isinstance(pat.value, str)):
+                return
+            self._consumed.add(id(spec))
+            entries.append((pat.value, _spec_token_of_ast(spec),
+                            elt.lineno))
+        if entries:
+            self.p.rule_tables.append((self.path, node.lineno, entries))
+
+    # -- HVD804: spec-producing locals into spec-less collectives -------
+    def visit_FunctionDef(self, node) -> None:
+        self._scan_func(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _scan_func(self, fn) -> None:
+        spec_vars: dict[str, tuple[str, int]] = {}
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call):
+                prod = _terminal(stmt.value)
+                if prod in SPEC_PRODUCERS and \
+                        self._produces_layout(stmt.value, prod):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            spec_vars[tgt.id] = (prod, stmt.lineno)
+        if not spec_vars:
+            return
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            coll = _terminal(call)
+            if coll not in FLOW_COLLECTIVES:
+                continue
+            if any(kw.arg == "spec" for kw in call.keywords):
+                continue
+            for arg in call.args:
+                if isinstance(arg, ast.Name) and arg.id in spec_vars:
+                    prod, _ = spec_vars[arg.id]
+                    self.p.spec_drops.append(
+                        (self.path, call.lineno, arg.id, prod, coll))
+                    break
+
+    @staticmethod
+    def _produces_layout(call: ast.Call, prod: str) -> bool:
+        if prod != "device_put":
+            return True
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Call) and \
+                    _terminal(arg) in _SHARDING_CTORS:
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+def _candidate_paths(names) -> list[str]:
+    """Synthesized parameter-path vocabulary: harvested name tokens,
+    their /-joined pairs, and each with an implicit flax leaf appended
+    — enough structure for a rule regex to be judged against without
+    running any model."""
+    toks = sorted(names)[:_MAX_NAME_TOKENS]
+    cands = set(toks)
+    for a in toks:
+        for b in toks:
+            if a != b:
+                cands.add(f"{a}/{b}")
+    for c in list(cands):
+        for leaf in IMPLICIT_LEAVES:
+            cands.add(f"{c}/{leaf}")
+    return sorted(cands)
+
+
+class ShardAnalysis:
+    def __init__(self, program: Program, shard: ShardProgram) -> None:
+        self.program = program
+        self.shard = shard
+        self.findings: list[Finding] = []
+
+    def _emit(self, rule_key: str, severity: str, path: str, line: int,
+              message: str, sites: tuple = ()) -> None:
+        rule = RULES[rule_key]
+        sup = self.program.suppressions.get(path)
+        if sup and sup.active_span(line, line, rule):
+            return
+        self.findings.append(Finding(rule=rule, severity=severity,
+                                     path=path, line=line,
+                                     message=message, sites=sites))
+
+    # -- HVD801 ---------------------------------------------------------
+    def _check_rule_tables(self) -> None:
+        if not self.shard.rule_tables:
+            return
+        cands = _candidate_paths(self.shard.param_names)
+        for path, line, entries in self.shard.rule_tables:
+            rules = [(pat, tok) for pat, tok, _ in entries]
+            entry_line = {pat: ln for pat, _, ln in entries}
+            dead, uncovered = rule_coverage(rules, cands)
+            for pat in dead:
+                self._emit(
+                    "dead-partition-rule", "warning", path,
+                    entry_line.get(pat, line),
+                    f"partition rule {pat!r} matches none of the "
+                    f"{len(cands)} parameter paths synthesized from the "
+                    f"harvested name vocabulary (flax name=/self.param "
+                    f"literals + implicit kernel/bias/scale/embedding "
+                    f"leaves): the rule documents a layout no parameter "
+                    f"gets — fix the regex or delete the row")
+            seen = set()
+            for cpath, sib in uncovered:
+                if sib in seen:
+                    continue        # one representative path per rule
+                seen.add(sib)
+                self._emit(
+                    "dead-partition-rule", "warning", path,
+                    entry_line.get(sib, line),
+                    f"parameter path '{cpath}' falls through to the "
+                    f"replicated default while sibling rule {sib!r} "
+                    f"shards its neighbours under the same parent — "
+                    f"replicating one tensor of a sharded family is "
+                    f"usually an anchoring bug; name the path in a rule "
+                    f"or justify the replication")
+
+    # -- HVD802 ---------------------------------------------------------
+    def _check_axis_vocab(self) -> None:
+        vocab = self.shard.axis_vocab
+        if not vocab:
+            return   # no mesh literals harvested: nothing to judge against
+        sites = list(self.shard.spec_sites)
+        for path, line, entries in self.shard.rule_tables:
+            sites.extend((path, ln, tok) for _, tok, ln in entries)
+        for path, line, tok in sorted(set(sites)):
+            bad = missing_axes(tok, vocab)
+            if bad:
+                self._emit(
+                    "spec-mesh-axis-mismatch", "error", path, line,
+                    f"sharding spec {tok} names mesh "
+                    f"ax{'es' if len(bad) > 1 else 'is'} "
+                    f"{', '.join(repr(a) for a in bad)} absent from the "
+                    f"harvested axis vocabulary "
+                    f"{sorted(vocab)} (DEFAULT_AXES assignments, "
+                    f"Mesh(...) constructor literals, MeshSpec/"
+                    f"build_mesh axis keywords): at runtime this raises "
+                    f"only when the spec is applied — or silently "
+                    f"replicates under a permissive resolver")
+
+    # -- HVD804 ---------------------------------------------------------
+    def _check_spec_drops(self) -> None:
+        for path, line, var, prod, coll in self.shard.spec_drops:
+            self._emit(
+                "spec-drop", "warning", path, line,
+                f"'{var}' carries a sharding layout (assigned from "
+                f"{prod}(...)) but flows into {coll}(...) without "
+                f"spec=: the wire packs dims and bytes while the "
+                f"layout is discarded, so the collective's fingerprint "
+                f"identity degrades to the 5-column op×name×dtype×dims "
+                f"form and a cross-rank spec disagreement on this "
+                f"tensor goes unwitnessed — pass spec= (hvdshard)")
+
+    def analyze(self) -> "ShardAnalysis":
+        self._check_rule_tables()
+        self._check_axis_vocab()
+        self._check_spec_drops()
+        self.findings.sort(key=lambda f: (f.path, f.line, f.rule.id))
+        return self
+
+
+def analyze_shard(program: Program, shard: ShardProgram,
+                  cfg=None) -> list[Finding]:
+    """HVD801/802/804 from the harvest; HVD803 is emitted by the
+    hvdflow pass (its spec-annotated streams) and merged by the caller
+    — the lint driver's partition, or main() below."""
+    findings = ShardAnalysis(program, shard).analyze().findings
+    if cfg is not None:
+        findings = [f for f in findings if cfg.wants(f.rule)]
+    return findings
+
+
+def analyze_paths(paths) -> list[Finding]:
+    program = Program()
+    flow = FlowProgram()
+    shard = ShardProgram()
+    for p in iter_python_files(list(paths)):
+        try:
+            with open(p, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=p)
+        except (OSError, SyntaxError):
+            continue
+        program.collect_source(p, src, tree)
+        flow.collect_source(p, src, tree)
+        shard.collect_source(p, src, tree)
+    findings = [f for f in analyze_flow(program, flow)
+                if f.rule.id in SHARD_RULE_IDS]
+    findings.extend(analyze_shard(program, shard))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule.id))
+    return findings
+
+
+# --- CLI ---------------------------------------------------------------------
+def main(argv: list[str] | None = None) -> int:
+    import time as _time
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis.hvdshard",
+        description="Sharding-spec static analysis "
+                    "(HVD801-804; see docs/analysis.md).")
+    parser.add_argument("paths", nargs="*", default=["horovod_tpu"])
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text")
+    args = parser.parse_args(argv)
+    t0 = _time.monotonic()
+    findings = analyze_paths(args.paths)
+    wall_ms = round((_time.monotonic() - t0) * 1e3, 3)
+    errors = [f for f in findings if f.severity == "error"]
+    if args.format == "json":
+        print(json.dumps({"shard": [f.json() for f in findings],
+                          "wall_ms": wall_ms}, indent=2))
+    elif args.format == "sarif":
+        from ..hvdsan.san import sarif_payload
+        print(json.dumps(sarif_payload(findings), indent=2))
+    else:
+        for f in findings:
+            print(f.text())
+        print(f"hvdshard: {len(errors)} error(s), "
+              f"{len(findings) - len(errors)} warning(s) in "
+              f"{', '.join(args.paths)} ({wall_ms:.1f} ms)",
+              file=sys.stderr)
+    return 1 if errors else 0

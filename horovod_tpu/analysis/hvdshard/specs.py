@@ -1,0 +1,142 @@
+"""Canonical sharding-spec tokens and the rule-table checks — the ONE
+implementation shared by the static pass (HVD801/802), the runtime
+validator (parallel/sharding.validate) and the collective fingerprint
+fold (analysis/fingerprint.py).
+
+Deliberately dependency-free: no jax import, no analysis-layer import —
+this module must be loadable from the wire/fingerprint layer of a rank
+that never touches jax, and from the analyzer running on a box with no
+accelerator stack at all.
+
+The canonical token grammar::
+
+    ""            unannotated (legacy request; folds as absent)
+    "*"           explicitly replicated (PartitionSpec())
+    "(tp)"        dim 0 sharded over mesh axis tp
+    "(dp+fsdp,*)" dim 0 over two axes, dim 1 replicated
+
+Tokens are strings so they ride the sp_* wire fields and the
+fingerprint fold byte-for-byte identically on every rank.
+"""
+from __future__ import annotations
+
+import re
+
+__all__ = ["spec_token", "fold_token", "token_axes", "missing_axes",
+           "rule_coverage"]
+
+
+def spec_token(spec=None) -> str:
+    """Canonical token of a PartitionSpec-like value.
+
+    Accepts None (unannotated), an already-canonical string (passed
+    through), or any iterable of per-dim entries where each entry is
+    None (replicated dim), an axis name, or a tuple/list of axis names
+    (a dim sharded over several axes)."""
+    if spec is None:
+        return ""
+    if isinstance(spec, str):
+        return spec.strip()
+    entries = []
+    for e in spec:
+        if e is None:
+            entries.append("*")
+        elif isinstance(e, (tuple, list)):
+            entries.append("+".join(str(a) for a in e))
+        else:
+            entries.append(str(e))
+    if not entries:
+        return "*"
+    return "(" + ",".join(entries) + ")"
+
+
+def fold_token(op: str, token: str) -> str:
+    """The token as folded into the cross-rank fingerprint: ALLGATHER's
+    FIRST dim is rank-local by contract (the uneven-row gather rule in
+    fingerprint.describe), so its dim-0 spec entry folds as ``*`` —
+    a digest that included it would flag every legitimate uneven
+    gather's per-rank layout as a divergence."""
+    if op != "ALLGATHER" or not token.startswith("("):
+        return token
+    inner = token[1:-1].split(",")
+    inner[0] = "*"
+    return "(" + ",".join(inner) + ")"
+
+
+def token_axes(token: str) -> set[str]:
+    """Mesh axis names a canonical token references."""
+    if not token or token == "*":
+        return set()
+    inner = token[1:-1] if token.startswith("(") else token
+    axes = set()
+    for entry in inner.split(","):
+        for ax in entry.split("+"):
+            ax = ax.strip()
+            if ax and ax != "*":
+                axes.add(ax)
+    return axes
+
+
+def missing_axes(token: str, mesh_axes) -> list[str]:
+    """Axes the token names that the mesh does not carry (HVD802 core)."""
+    vocab = set(mesh_axes)
+    return sorted(a for a in token_axes(token) if a not in vocab)
+
+
+def rule_coverage(rules, paths):
+    """HVD801 core, shared by the static pass and runtime validate().
+
+    ``rules``: ordered [(pattern_str, token)] — the ShardingRules table
+    (first match wins).  ``paths``: the parameter path vocabulary
+    ("layer/attn/wq/kernel" strings).
+
+    Returns ``(dead_rules, uncovered)``:
+
+    - ``dead_rules``: patterns matching no path at all — the rule
+      documents a layout no parameter gets.
+    - ``uncovered``: [(path, nearest_rule_pattern)] — paths that fall
+      through to the replicated default while a SIBLING path (same
+      parent prefix) matched a sharded (non-replicated) rule; the
+      nearest rule named is the sibling's, the one most likely meant to
+      cover this path too.
+    """
+    compiled = []
+    for pat, tok in rules:
+        try:
+            compiled.append((pat, re.compile(pat), tok))
+        except re.error:
+            compiled.append((pat, None, tok))
+    hits = {pat: 0 for pat, _, _ in compiled}
+    matched_by = {}
+    for path in paths:
+        m = None
+        for pat, rx, tok in compiled:
+            if rx is not None and rx.search(path):
+                m = (pat, tok)
+                hits[pat] += 1
+                break
+        matched_by[path] = m
+
+    dead = [pat for pat, rx, _ in compiled
+            if rx is not None and hits[pat] == 0]
+
+    def _parent(p: str) -> str:
+        return p.rsplit("/", 1)[0] if "/" in p else ""
+
+    # Parent-indexed sibling lookup: the candidate vocabulary can be
+    # large (synthesized path combinations), so the uncovered scan must
+    # stay linear, not all-pairs.
+    sharded_sib: dict[str, str] = {}
+    for path in sorted(matched_by):
+        m = matched_by[path]
+        if m is not None and m[1] not in ("", "*"):
+            sharded_sib.setdefault(_parent(path), m[0])
+
+    uncovered = []
+    for path in sorted(matched_by):
+        if matched_by[path] is not None:
+            continue
+        sib = sharded_sib.get(_parent(path))
+        if sib is not None:
+            uncovered.append((path, sib))
+    return dead, uncovered

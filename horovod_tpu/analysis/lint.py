@@ -6,7 +6,8 @@ CLI::
                                         [--format text|json|sarif]
                                         [--select RULES] [--ignore RULES]
                                         [--changed-only] [--san]
-                                        [--flow] [--life] [--knobs]
+                                        [--flow] [--life] [--shard]
+                                        [--knobs]
 
 ``--changed-only`` lints only files git reports as modified/untracked
 (sub-second gate as the rule count grows; cross-file rules see only the
@@ -16,7 +17,10 @@ of each file — one AST per file serves both rule families.  ``--flow``
 does the same for the hvdflow interprocedural rank-divergence dataflow
 analysis (HVD601-604, analysis/hvdflow/), ``--life`` for the hvdlife
 whole-program resource-lifecycle analysis (HVD701-705,
-analysis/hvdlife/).  ``--knobs`` prints the
+analysis/hvdlife/), ``--shard`` for the hvdshard sharding-spec
+analysis (HVD801-804, analysis/hvdshard/ — HVD803 rides the hvdflow
+spec-annotated streams, so --shard builds the flow program too).
+``--knobs`` prints the
 generated typed-knob registry table (docs/configuration.md) and exits.
 ``--sarif`` emits SARIF 2.1.0 so findings annotate PRs.
 
@@ -958,11 +962,14 @@ def changed_py_files(paths: list[str], diff_base: str | None = None
 def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
                      san: bool = False, changed_only: bool = False,
                      diff_base: str | None = None, flow: bool = False,
-                     life: bool = False
+                     life: bool = False, shard: bool = False
                      ) -> tuple[list[Violation], list, dict]:
     """One parse + one rule walk per file; hvdsan (``san=True``),
-    hvdflow (``flow=True``) and hvdlife (``life=True``) ride the SAME
-    trees.  Returns (violations, san+flow+life findings, stats)."""
+    hvdflow (``flow=True``), hvdlife (``life=True``) and hvdshard
+    (``shard=True``) ride the SAME trees.  ``shard`` implies building
+    the flow program: HVD803 is located by the hvdflow pass over its
+    spec-annotated streams.  Returns (violations,
+    san+flow+life+shard findings, stats)."""
     import time as _time
     cfg = cfg or LintConfig()
     out: list[Violation] = []
@@ -971,15 +978,19 @@ def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
     program = None
     flowprog = None
     lifeprog = None
-    if san or flow or life:
+    shardprog = None
+    if san or flow or life or shard:
         from .hvdsan.lockgraph import Program
         program = Program()
-    if flow:
+    if flow or shard:
         from .hvdflow.flow import FlowProgram
         flowprog = FlowProgram()
     if life:
         from .hvdlife.life import LifeProgram
         lifeprog = LifeProgram()
+    if shard:
+        from .hvdshard.shard import ShardProgram
+        shardprog = ShardProgram()
     files = list(iter_python_files(paths))
     if changed_only:
         changed, warning = changed_py_files(paths,
@@ -1014,6 +1025,8 @@ def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
             flowprog.collect_source(path, source, tree)
         if lifeprog is not None:
             lifeprog.collect_source(path, source, tree)
+        if shardprog is not None:
+            shardprog.collect_source(path, source, tree)
     findings: list = []
     if san and program is not None:
         from .hvdsan.lockgraph import Analysis
@@ -1025,6 +1038,19 @@ def lint_paths_timed(paths: list[str], cfg: LintConfig | None = None,
     if lifeprog is not None:
         from .hvdlife.life import analyze_life
         findings.extend(analyze_life(program, lifeprog, cfg))
+    if shardprog is not None:
+        from .hvdshard.shard import analyze_shard
+        findings.extend(analyze_shard(program, shardprog, cfg))
+    # The flow pass emits both families; keep only what was asked for
+    # (--shard without --flow must not surface HVD6xx, and vice versa).
+    if flowprog is not None and not flow:
+        from .hvdflow.flow import FLOW_RULE_IDS
+        findings = [f for f in findings
+                    if f.rule.id not in FLOW_RULE_IDS]
+    if flowprog is not None and not shard:
+        from .hvdshard.shard import SHARD_RULE_IDS
+        findings = [f for f in findings
+                    if f.rule.id not in SHARD_RULE_IDS]
     stats = {"files": nfiles,
              "wall_ms": round((_time.monotonic() - t0) * 1e3, 3),
              "warnings": warnings}
@@ -1089,6 +1115,12 @@ def main(argv: list[str] | None = None) -> int:
                              "resource-lifecycle analysis "
                              "(HVD701-705) over the same parse of "
                              "each file")
+    parser.add_argument("--shard", action="store_true",
+                        help="also run the hvdshard sharding-spec "
+                             "analysis (HVD801-804) over the same "
+                             "parse of each file (builds the hvdflow "
+                             "program too: HVD803 rides its "
+                             "spec-annotated streams)")
     parser.add_argument("--knobs", action="store_true",
                         help="print the generated typed-knob registry "
                              "table (the docs/configuration.md "
@@ -1108,14 +1140,19 @@ def main(argv: list[str] | None = None) -> int:
                                 if b.strip()}
     violations, findings, stats = lint_paths_timed(
         args.paths, cfg, san=args.san, changed_only=args.changed_only,
-        diff_base=args.diff_base, flow=args.flow, life=args.life)
+        diff_base=args.diff_base, flow=args.flow, life=args.life,
+        shard=args.shard)
     from .hvdflow.flow import FLOW_RULE_IDS
     from .hvdlife.life import LIFE_RULE_IDS
+    from .hvdshard.shard import SHARD_RULE_IDS
     san_findings = [f for f in findings
                     if f.rule.id not in FLOW_RULE_IDS
-                    and f.rule.id not in LIFE_RULE_IDS]
+                    and f.rule.id not in LIFE_RULE_IDS
+                    and f.rule.id not in SHARD_RULE_IDS]
     flow_findings = [f for f in findings if f.rule.id in FLOW_RULE_IDS]
     life_findings = [f for f in findings if f.rule.id in LIFE_RULE_IDS]
+    shard_findings = [f for f in findings
+                      if f.rule.id in SHARD_RULE_IDS]
     errors = [f for f in findings if f.severity == "error"]
     for w in stats["warnings"]:
         print(f"hvdlint: warning: {w}", file=sys.stderr)
@@ -1125,6 +1162,7 @@ def main(argv: list[str] | None = None) -> int:
             "san": [f.json() for f in san_findings],
             "flow": [f.json() for f in flow_findings],
             "life": [f.json() for f in life_findings],
+            "shard": [f.json() for f in shard_findings],
             "files": stats["files"],
             "wall_ms": stats["wall_ms"],
             "warnings": stats["warnings"],
@@ -1139,9 +1177,10 @@ def main(argv: list[str] | None = None) -> int:
         for f in findings:
             print(f.text())
         print(f"hvdlint: {len(violations)} violation(s)"
-              + (f", {len(errors)} san/flow/life error(s), "
+              + (f", {len(errors)} san/flow/life/shard error(s), "
                  f"{len(findings) - len(errors)} warning(s)"
-                 if (args.san or args.flow or args.life) else "")
+                 if (args.san or args.flow or args.life or args.shard)
+                 else "")
               + f" in {', '.join(args.paths)} "
               f"({stats['files']} file(s), {stats['wall_ms']:.1f} ms)",
               file=sys.stderr)

@@ -116,6 +116,41 @@ _RULE_LIST = [
          "console — register the knob (name, type, default, doc) and "
          "read it through the registry, or justify the raw read with "
          "a suppression."),
+    Rule("HVD801", "dead-partition-rule",
+         "Sharding rule whose regex matches no parameter path reachable "
+         "from the Trainer/serving model init, or a parameter path that "
+         "falls through to the replicated default while a sibling path "
+         "matched a sharded rule (hvdshard): the dead rule documents a "
+         "layout nobody gets, and the fallen-through param silently "
+         "re-replicates — rename the pattern to match the model's "
+         "actual param paths (the finding names the nearest "
+         "non-matching rule), or delete it."),
+    Rule("HVD802", "spec-mesh-axis-mismatch",
+         "PartitionSpec naming a mesh axis absent from every Mesh "
+         "construction the call site can reach (hvdshard): "
+         "jax.sharding raises at device_put time on the real mesh, or "
+         "— worse — a size-1 stand-in axis silently replicates the "
+         "dim. The mesh axis vocabulary is harvested from "
+         "parallel/mesh.py DEFAULT_AXES and every literal Mesh(...) "
+         "axis tuple; name an axis the mesh actually carries."),
+    Rule("HVD803", "divergent-spec-collective",
+         "Rank-tainted branch whose collective arm streams are "
+         "sequence-equal on op×name but unequal on sharding spec "
+         "(hvdshard's spec column over HVD601's arm-stream evidence): "
+         "every rank submits the same ops, so negotiation proceeds — "
+         "and then the data plane moves differently-sharded bytes into "
+         "one reduction, corrupting silently where HVD601's shape "
+         "would at least wedge.  The runtime twin is the strict-mode "
+         "fingerprint ERROR on the first spec-divergent op."),
+    Rule("HVD804", "spec-drop",
+         "A value produced by a spec-carrying site (shard_params/"
+         "constrain/with_sharding_constraint/NamedSharding device_put) "
+         "flows into a collective that serializes dims but not the "
+         "spec (no spec= at the call site, hvdshard): the wire "
+         "re-replicates the tensor and the receiving ranks cannot "
+         "detect the layout loss — thread the spec through "
+         "(spec=, or spec_token(...)), or drop the annotation "
+         "explicitly."),
     Rule("HVD701", "unjoined-thread",
          "Thread/Timer started with no join/cancel reachable from the "
          "owner's teardown path (hvdlife): every start leaks one live "

@@ -565,6 +565,14 @@ FINGERPRINT_WINDOW = register(
     "Ops of fingerprint history each rank ships with its RequestList; "
     "divergences older than the window are reported as 'at or before' "
     "the oldest commonly-visible op.")
+SHARD_SPEC_IDENTITY = register(
+    "HOROVOD_SHARD_SPEC_IDENTITY", True, _parse_bool,
+    "Fold each collective's canonical sharding-spec token (the sp_spec "
+    "wire field) into the runtime fingerprint, making collective "
+    "identity op×name×dtype×dims×spec (hvdshard; docs/analysis.md).  "
+    "Only effective when the mesh negotiated FEATURE_SHARDING; "
+    "launcher-set and identical on every rank.  0 restores the "
+    "5-column identity.")
 
 # --- Stall inspector (reference: common/stall_inspector.cc) -----------------
 STALL_CHECK_DISABLE = register(

@@ -128,6 +128,18 @@ class Controller:
         self.stall_inspector = stall_inspector or StallInspector()
         self.fingerprint = fingerprint if fingerprint is not None \
             else FingerprintTracker.from_config()
+        # Spec column of the collective identity (hvdshard): folded only
+        # when the mesh negotiated FEATURE_SHARDING — the negotiated
+        # feature word is identical on every rank (min proto / AND of
+        # HELLO bits), so either every rank folds op×name×dtype×dims×spec
+        # or every rank folds the legacy 5-column identity.  A
+        # mixed-version world that negotiated sp_* away stays
+        # fingerprint-green.  HOROVOD_SHARD_SPEC_IDENTITY=0 is the
+        # launcher-set (hence world-symmetric) kill switch.
+        from .wire import FEATURE_SHARDING, FEATURES_ALL
+        self.fingerprint.fold_spec = bool(
+            getattr(transport, "features", FEATURES_ALL)
+            & FEATURE_SHARDING) and config.SHARD_SPEC_IDENTITY.get()
         self.timeline = timeline
         self.tensor_fusion_threshold = config.FUSION_THRESHOLD.get()
         self.disable_group_fusion = config.DISABLE_GROUP_FUSION.get()
@@ -776,7 +788,8 @@ class Controller:
                 postscale_factor=first.postscale_factor,
                 last_joined_rank=self.last_joined_rank,
                 codec=first.codec,
-                codec_block_size=first.codec_block_size)
+                codec_block_size=first.codec_block_size,
+                sp_spec=first.sp_spec)
 
         if rtype == RequestType.ALLGATHER:
             if joined:
@@ -796,7 +809,8 @@ class Controller:
             return Response(response_type=ResponseType.ALLGATHER,
                             tensor_names=[name], devices=devices,
                             tensor_type=first.tensor_type,
-                            tensor_sizes=sizes)
+                            tensor_sizes=sizes,
+                            sp_spec=first.sp_spec)
 
         if rtype == RequestType.BROADCAST:
             if joined:
@@ -819,7 +833,8 @@ class Controller:
                             tensor_names=[name], devices=devices,
                             tensor_type=first.tensor_type,
                             tensor_sizes=[root.tensor_size_elements()],
-                            root_rank=first.root_rank)
+                            root_rank=first.root_rank,
+                            sp_spec=first.sp_spec)
 
         if rtype == RequestType.ALLTOALL:
             if joined:

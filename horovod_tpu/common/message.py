@@ -18,8 +18,8 @@ import enum
 from dataclasses import dataclass, field
 
 from .dtypes import DataType
-from .wire import (FEATURE_FINGERPRINT, FEATURE_TELEMETRY, FEATURE_TRACE,
-                   FEATURES_ALL, Decoder, Encoder)
+from .wire import (FEATURE_FINGERPRINT, FEATURE_SHARDING, FEATURE_TELEMETRY,
+                   FEATURE_TRACE, FEATURES_ALL, Decoder, Encoder)
 
 
 class RequestType(enum.IntEnum):
@@ -63,6 +63,13 @@ class Request:
     # fp32 would corrupt silently).
     codec: int = 0
     codec_block_size: int = 0
+    # Canonical sharding-spec token (analysis/hvdshard/specs.py
+    # spec_token): the mesh-axis tuple string this rank believes the
+    # tensor is partitioned over, "" = unannotated/replicated.  Part of
+    # collective identity (op×name×dtype×dims×spec) folded into the
+    # runtime fingerprint, so two ranks disagreeing on *how* a tensor is
+    # sharded diverge loudly instead of silently re-replicating.
+    sp_spec: str = ""
 
     def tensor_size_elements(self) -> int:
         n = 1
@@ -70,7 +77,8 @@ class Request:
             n *= d
         return n
 
-    def encode(self, enc: Encoder) -> None:
+    def encode(self, enc: Encoder,
+               features: int = FEATURES_ALL) -> None:
         (enc.uvarint(self.request_rank)
             .uvarint(int(self.request_type))
             .uvarint(int(self.tensor_type))
@@ -82,10 +90,13 @@ class Request:
             .f64(self.postscale_factor)
             .uvarint(self.codec)
             .uvarint(self.codec_block_size))
+        if features & FEATURE_SHARDING:
+            enc.string(self.sp_spec)
 
     @classmethod
-    def decode(cls, dec: Decoder) -> "Request":
-        return cls(
+    def decode(cls, dec: Decoder,
+               features: int = FEATURES_ALL) -> "Request":
+        req = cls(
             request_rank=dec.uvarint(),
             request_type=RequestType(dec.uvarint()),
             tensor_type=DataType(dec.uvarint()),
@@ -98,6 +109,9 @@ class Request:
             codec=dec.uvarint(),
             codec_block_size=dec.uvarint(),
         )
+        if features & FEATURE_SHARDING:
+            req.sp_spec = dec.string()
+        return req
 
 
 @dataclass
@@ -146,7 +160,7 @@ class RequestList:
             enc.uvarint(self.tm_queue_depth)
         enc.uvarint(len(self.requests))
         for r in self.requests:
-            r.encode(enc)
+            r.encode(enc, features)
         return enc.getvalue()
 
     @classmethod
@@ -172,7 +186,8 @@ class RequestList:
             tm_sync_wait_ms = dec.f64()
             tm_queue_depth = dec.uvarint()
         n = dec.uvarint()
-        return cls(requests=[Request.decode(dec) for _ in range(n)],
+        return cls(requests=[Request.decode(dec, features)
+                             for _ in range(n)],
                    shutdown=shutdown, fp_seq=fp_seq, fp_digest=fp_digest,
                    fp_tail_seqs=fp_tail_seqs,
                    fp_tail_digests=fp_tail_digests,
@@ -214,6 +229,11 @@ class Response:
     # deadlock-freedom invariant makes the local stamp rank-identical).
     trace_cycle: int = -1
     trace_seq: int = -1
+    # Negotiated sharding-spec token the data planes must honour
+    # (identical on every rank by construction — see Request.sp_spec;
+    # the coordinator rejects cross-rank spec mismatches with a
+    # structured ERROR before any response is built).
+    sp_spec: str = ""
 
     def encode(self, enc: Encoder,
                features: int = FEATURES_ALL) -> None:
@@ -233,6 +253,8 @@ class Response:
         if features & FEATURE_TRACE:
             enc.svarint(self.trace_cycle)
             enc.svarint(self.trace_seq)
+        if features & FEATURE_SHARDING:
+            enc.string(self.sp_spec)
 
     @classmethod
     def decode(cls, dec: Decoder,
@@ -255,6 +277,8 @@ class Response:
         if features & FEATURE_TRACE:
             resp.trace_cycle = dec.svarint()
             resp.trace_seq = dec.svarint()
+        if features & FEATURE_SHARDING:
+            resp.sp_spec = dec.string()
         return resp
 
     def trace_id(self) -> str | None:

@@ -122,7 +122,8 @@ class TcpTransport(Transport):
         schema."""
         import dataclasses
 
-        from .wire import FEATURE_FINGERPRINT, FEATURE_TELEMETRY
+        from .wire import (FEATURE_FINGERPRINT, FEATURE_SHARDING,
+                           FEATURE_TELEMETRY)
         kw = {}
         if not self.features & FEATURE_FINGERPRINT:
             kw.update(fp_seq=0, fp_digest=0, fp_tail_seqs=[],
@@ -130,6 +131,11 @@ class TcpTransport(Transport):
         if not self.features & FEATURE_TELEMETRY:
             kw.update(tm_cycles=0, tm_cycle_ms=0.0,
                       tm_sync_wait_ms=0.0, tm_queue_depth=0)
+        if not self.features & FEATURE_SHARDING and \
+                any(r.sp_spec for r in request_list.requests):
+            # sp_spec is per-Request, not list-level: blank each one.
+            kw.update(requests=[dataclasses.replace(r, sp_spec="")
+                                for r in request_list.requests])
         return dataclasses.replace(request_list, **kw) if kw \
             else request_list
         # Coordinator-side: monotonic arrival time of each rank's last

@@ -25,17 +25,26 @@ import struct
 # gate asserts this at lint time), so a world can roll from framework
 # version N to N+1 rank-by-rank: mixed-version peers simply negotiate
 # the old schema until the last rank upgrades.
-PROTO_VERSION = 2
+PROTO_VERSION = 3
 
 FEATURE_FINGERPRINT = 1 << 0   # RequestList fp_* (collective digests)
 FEATURE_TELEMETRY = 1 << 1     # RequestList tm_* (straggler snapshot)
 FEATURE_TRACE = 1 << 2         # Response trace_* (distributed tracing)
+FEATURE_SHARDING = 1 << 3      # Request/Response sp_* (partition specs)
 
-FEATURES_ALL = (FEATURE_FINGERPRINT | FEATURE_TELEMETRY | FEATURE_TRACE)
+FEATURES_ALL = (FEATURE_FINGERPRINT | FEATURE_TELEMETRY | FEATURE_TRACE
+                | FEATURE_SHARDING)
 
 # Feature bits each protocol version may carry: proto 1 is the base
-# schema with every optional group absent; proto 2 is current.
-PROTO_FEATURE_SETS = {1: 0, 2: FEATURES_ALL}
+# schema with every optional group absent; proto 2 froze the fp_/tm_/
+# trace_ groups (spelled as the literal three-bit mask — FEATURES_ALL
+# keeps growing, a frozen proto's field set must not); proto 3 adds the
+# sharding-spec group and is current.
+PROTO_FEATURE_SETS = {
+    1: 0,
+    2: FEATURE_FINGERPRINT | FEATURE_TELEMETRY | FEATURE_TRACE,
+    3: FEATURES_ALL,
+}
 
 # Optional-field prefix -> gating feature bit.  The single source of
 # truth both message.py's conditional encode/decode and the HVD505
@@ -45,6 +54,7 @@ OPTIONAL_FIELD_FEATURES = {
     "fp_": FEATURE_FINGERPRINT,
     "tm_": FEATURE_TELEMETRY,
     "trace_": FEATURE_TRACE,
+    "sp_": FEATURE_SHARDING,
 }
 
 HELLO_MAGIC = b"HVDH"

@@ -23,6 +23,7 @@ from typing import Any, Sequence
 
 import numpy as np
 
+from .analysis.hvdshard.specs import spec_token
 from .backend.base import OperationManager
 from .backend.basic import BasicBackend
 from .common import config
@@ -1104,12 +1105,12 @@ def enqueue_allreduce(name: str, tensor, *, op: str = "sum",
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0,
                       adasum: bool = False,
-                      codec=None) -> tuple[int, Handle]:
+                      codec=None, spec=None) -> tuple[int, Handle]:
     return enqueue_grouped_allreduce([name], [tensor], op=op,
                                      prescale_factor=prescale_factor,
                                      postscale_factor=postscale_factor,
                                      adasum=adasum, register_group=False,
-                                     codec=codec)
+                                     codec=codec, spec=spec)
 
 
 def enqueue_grouped_allreduce(names: Sequence[str], tensors: Sequence[Any], *,
@@ -1118,7 +1119,12 @@ def enqueue_grouped_allreduce(names: Sequence[str], tensors: Sequence[Any], *,
                               postscale_factor: float = 1.0,
                               adasum: bool = False,
                               register_group: bool = True,
-                              codec=None) -> tuple[int, Handle]:
+                              codec=None, spec=None) -> tuple[int, Handle]:
+    """``spec`` annotates the tensor's sharding layout (a PartitionSpec,
+    an axis-entry iterable, or an already-canonical token string); it
+    rides the Request as the sp_spec wire field and joins the
+    collective's fingerprint identity — op×name×dtype×dims×spec — when
+    the mesh negotiated FEATURE_SHARDING (hvdshard; docs/analysis.md)."""
     st = _require_init()
     if op == "average":
         postscale_factor = postscale_factor / st.size
@@ -1126,6 +1132,7 @@ def enqueue_grouped_allreduce(names: Sequence[str], tensors: Sequence[Any], *,
         raise ValueError(f"Unknown allreduce op: {op}")
     rtype = RequestType.ADASUM if adasum else RequestType.ALLREDUCE
     codec_id, codec_bs = _resolve_codec(codec)
+    sp = spec_token(spec)
     entries, requests = [], []
     if register_group and len(names) > 1:
         st.group_table.register_group(list(names))
@@ -1138,14 +1145,15 @@ def enqueue_grouped_allreduce(names: Sequence[str], tensors: Sequence[Any], *,
             tensor_shape=tuple(arr.shape),
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
-            codec=codec_id, codec_block_size=codec_bs))
+            codec=codec_id, codec_block_size=codec_bs,
+            sp_spec=sp))
     return _enqueue(entries, requests)
 
 
 def enqueue_reducescatter(name: str, tensor, *, op: str = "sum",
                           prescale_factor: float = 1.0,
-                          postscale_factor: float = 1.0
-                          ) -> tuple[int, Handle]:
+                          postscale_factor: float = 1.0,
+                          spec=None) -> tuple[int, Handle]:
     """Reduce over all ranks, scatter dim-0 slices back (the eager analogue
     of upstream Horovod's reducescatter; rides the XLA device plane when
     dim 0 divides evenly, the TCP plane otherwise)."""
@@ -1161,22 +1169,25 @@ def enqueue_reducescatter(name: str, tensor, *, op: str = "sum",
                       tensor_type=from_any(arr.dtype), tensor_name=name,
                       tensor_shape=tuple(arr.shape),
                       prescale_factor=prescale_factor,
-                      postscale_factor=postscale_factor)
+                      postscale_factor=postscale_factor,
+                      sp_spec=spec_token(spec))
     return _enqueue([entry], [request])
 
 
-def enqueue_allgather(name: str, tensor) -> tuple[int, Handle]:
+def enqueue_allgather(name: str, tensor, *, spec=None) -> tuple[int, Handle]:
     st = _require_init()
     arr = _as_array(tensor)
     entry = TensorTableEntry(tensor_name=name, tensor=arr)
     request = Request(request_rank=st.rank,
                       request_type=RequestType.ALLGATHER,
                       tensor_type=from_any(arr.dtype), tensor_name=name,
-                      tensor_shape=tuple(arr.shape))
+                      tensor_shape=tuple(arr.shape),
+                      sp_spec=spec_token(spec))
     return _enqueue([entry], [request])
 
 
-def enqueue_broadcast(name: str, tensor, root_rank: int) -> tuple[int, Handle]:
+def enqueue_broadcast(name: str, tensor, root_rank: int, *,
+                      spec=None) -> tuple[int, Handle]:
     st = _require_init()
     arr = _as_array(tensor)
     entry = TensorTableEntry(tensor_name=name, tensor=arr,
@@ -1184,7 +1195,8 @@ def enqueue_broadcast(name: str, tensor, root_rank: int) -> tuple[int, Handle]:
     request = Request(request_rank=st.rank,
                       request_type=RequestType.BROADCAST,
                       tensor_type=from_any(arr.dtype), tensor_name=name,
-                      root_rank=root_rank, tensor_shape=tuple(arr.shape))
+                      root_rank=root_rank, tensor_shape=tuple(arr.shape),
+                      sp_spec=spec_token(spec))
     return _enqueue([entry], [request])
 
 

@@ -14,6 +14,9 @@ from typing import Any, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..analysis.hvdshard.specs import (missing_axes, rule_coverage,
+                                       spec_token)
+
 
 def _path_str(path) -> str:
     parts = []
@@ -41,6 +44,7 @@ class ShardingRules:
 
     def __init__(self, rules: Sequence[tuple[str, P]] = (),
                  default: P = P()) -> None:
+        self._patterns = [pat for pat, _ in rules]
         self._rules = [(re.compile(pat), spec) for pat, spec in rules]
         self._default = default
 
@@ -55,6 +59,42 @@ class ShardingRules:
     def tree_specs(self, tree: Any) -> Any:
         return jax.tree_util.tree_map_with_path(
             lambda path, leaf: self.spec_for(_path_str(path), leaf), tree)
+
+    def validate(self, mesh: Mesh, params: Any) -> list[str]:
+        """Human-readable problems in this rule table against a REAL
+        mesh and parameter tree — the runtime consumer of the same
+        analysis core (specs.rule_coverage/missing_axes) hvdshard's
+        HVD801/HVD802 run statically over harvested literals: one
+        implementation, two call sites, so the static pass and the
+        runtime check can never disagree on what a dead rule or an
+        unknown axis is.  Returns [] when the table is coherent; the
+        Trainer logs (or, strictly, raises on) anything else."""
+        problems: list[str] = []
+        mesh_axes = tuple(mesh.axis_names)
+        for (_, spec), pat in zip(self._rules, self._patterns):
+            bad = missing_axes(spec_token(spec), mesh_axes)
+            if bad:
+                problems.append(
+                    f"rule {pat!r} names mesh ax"
+                    f"{'es' if len(bad) > 1 else 'is'} "
+                    f"{', '.join(repr(a) for a in bad)} absent from the "
+                    f"mesh {mesh_axes} (HVD802)")
+        paths: list[str] = []
+        jax.tree_util.tree_map_with_path(
+            lambda path, leaf: paths.append(_path_str(path)), params)
+        table = [(pat, spec_token(spec))
+                 for (_, spec), pat in zip(self._rules, self._patterns)]
+        dead, uncovered = rule_coverage(table, paths)
+        for pat in dead:
+            problems.append(
+                f"rule {pat!r} matches no parameter path in this tree "
+                f"(HVD801 dead rule)")
+        for path, sib in uncovered:
+            problems.append(
+                f"path '{path}' falls through to the replicated default "
+                f"while sibling rule {sib!r} shards its neighbours "
+                f"(HVD801 uncovered path)")
+        return problems
 
 
 def named_sharding(mesh: Mesh, spec: P = P()) -> NamedSharding:

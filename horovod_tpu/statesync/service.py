@@ -311,18 +311,24 @@ class StateSyncService:
                      "ready": self._ready_join,
                      "depart": self.rank if self._preempt_at is not None
                      else -1}
-        if self.size > 1:
-            views = hvd.allgather_object(
-                local, name=f"statesync.flag.{seq}")
-        else:
-            views = [local]
+        # Unconditionally allgather'd — at size 1 the collective is a
+        # local no-op returning [local], byte-identical to the old
+        # ``else: views = [local]`` fallback arm, and the service is
+        # documented (and constructed everywhere in-tree) to exist only
+        # inside initialized worlds.  The payoff: ``views`` provably
+        # derives from a collective exchange on EVERY path, so the
+        # boundary decisions below are world-symmetric by dataflow and
+        # need no HVD601 suppressions (the old size==1 ternary was the
+        # only taint source).
+        views = hvd.allgather_object(
+            local, name=f"statesync.flag.{seq}")
         departing = sorted({v["depart"] for v in views
                             if v["depart"] >= 0})
         ready_id = max(v["ready"] for v in views)
         join_id = max(v["join"] for v in views)
-        if departing:  # hvdlint: disable=HVD601 -- boundary decision derives from the allgather'd membership views, identical on every rank (hvdmc boundary-agreement property); the taint is the size==1 fallback arm, which has no peer to diverge from
+        if departing:
             return self._transition_depart(departing)
-        if ready_id >= 0:  # hvdlint: disable=HVD601 -- same allgather'd-views agreement as the depart arm above: every rank computes the same ready_id at the same boundary seq
+        if ready_id >= 0:
             return self._transition_grow(ready_id)
         if join_id >= 0:
             self._start_donation(join_id)

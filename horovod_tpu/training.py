@@ -25,6 +25,7 @@ import optax
 from .common.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .common.logging import logger
 from .parallel.collectives import allreduce
 from .parallel.grad_sync import (GradSyncConfig, init_ring_optimizer_state,
                                  sync_and_apply, sync_gradients)
@@ -153,6 +154,13 @@ class Trainer:
         variables = jax.eval_shape(
             partial(self.model.init, train=False), rng,
             jnp.zeros((1,) + images.shape[1:], images.dtype))
+        # Runtime twin of hvdshard's HVD801/802 (same rule_coverage/
+        # missing_axes core, real mesh + real param tree): a dead rule
+        # or unknown-axis spec surfaces at init, loudly, instead of as
+        # a silently replicated layout three days into a run.
+        for problem in self.param_rules.validate(self.mesh,
+                                                 variables["params"]):
+            logger.warning("sharding rules: %s", problem)
         param_specs = self.param_rules.tree_specs(variables["params"])
 
         def _init():

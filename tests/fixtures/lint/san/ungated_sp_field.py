@@ -1,0 +1,50 @@
+"""Seeded HVD505 (optional-field gate, sp_* group): the sharding-spec
+wire field encoded/decoded OUTSIDE a feature-bit gate — the
+rolling-upgrade hazard: a peer that negotiated FEATURE_SHARDING away
+cannot skip the field, so every frame after it decodes garbage."""
+
+
+class UngatedShardRequest:
+    """Symmetric codec (no sequence drift) with the sp_* optional field
+    unconditionally on the wire on both sides."""
+
+    def __init__(self, tensor_name="", sp_spec="", device=0):
+        self.tensor_name = tensor_name
+        self.sp_spec = sp_spec
+        self.device = device
+
+    def encode(self, enc, features=0):
+        (enc.string(self.tensor_name)
+            .string(self.sp_spec)       # HVD505: not behind a feature bit
+            .uvarint(self.device))
+
+    @classmethod
+    def decode(cls, dec, features=0):
+        return cls(tensor_name=dec.string(),
+                   sp_spec=dec.string(),   # HVD505: symmetric, same bug
+                   device=dec.uvarint())
+
+
+class GatedShardRequest:
+    """The sanctioned form: both sides gate the sp_* group identically
+    on the negotiated FEATURE_SHARDING bit."""
+
+    FEATURE_SHARDING = 8
+
+    def __init__(self, tensor_name="", sp_spec="", device=0):
+        self.tensor_name = tensor_name
+        self.sp_spec = sp_spec
+        self.device = device
+
+    def encode(self, enc, features=0):
+        enc.string(self.tensor_name)
+        enc.uvarint(self.device)
+        if features & self.FEATURE_SHARDING:
+            enc.string(self.sp_spec)
+
+    @classmethod
+    def decode(cls, dec, features=0):
+        req = cls(tensor_name=dec.string(), device=dec.uvarint())
+        if features & cls.FEATURE_SHARDING:
+            req.sp_spec = dec.string()
+        return req

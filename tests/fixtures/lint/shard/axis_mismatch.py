@@ -1,0 +1,18 @@
+"""Seeded HVD802 fixture: a spec naming a mesh axis the harvested axis
+vocabulary (DEFAULT_AXES / Mesh literals / build_mesh keywords) does not
+carry — raises only when applied at runtime, or silently replicates."""
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel import build_mesh
+from horovod_tpu.parallel.sharding import constrain
+
+DEFAULT_AXES = ("dp", "tp")
+
+
+def build():
+    return build_mesh(dp=4, tp=2)
+
+
+def place(x, mesh):
+    # 'model' is Megatron vocabulary, not this mesh's.
+    return constrain(x, mesh, P("model", None))

@@ -1,0 +1,16 @@
+"""Clean twin of axis_mismatch.py: every spec axis is in the harvested
+mesh vocabulary, including a multi-axis dim (dp+tp)."""
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel import build_mesh
+from horovod_tpu.parallel.sharding import constrain
+
+DEFAULT_AXES = ("dp", "tp")
+
+
+def build():
+    return build_mesh(dp=4, tp=2)
+
+
+def place(x, mesh):
+    return constrain(x, mesh, P(("dp", "tp"), None))

@@ -1,0 +1,23 @@
+"""Seeded HVD801 fixtures: a partition rule matching no reachable
+parameter path, and a sibling path falling through to replicated while
+its neighbour is sharded (the forgotten-family-member hole)."""
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel import ShardingRules
+
+DEFAULT_AXES = ("dp", "tp")
+
+
+class Attention(nn.Module):
+    def setup(self):
+        self.wq = nn.Dense(64, name="attn/wq")
+        self.wk = nn.Dense(64, name="attn/wk")
+
+
+RULES = ShardingRules([
+    # Dead: the harvested name vocabulary has no decoder token.
+    (r"decoder/.*kernel", P(None, "tp")),
+    # attn/wq is sharded; sibling attn/wk falls through to replicated.
+    (r"attn/wq", P(None, "tp")),
+])

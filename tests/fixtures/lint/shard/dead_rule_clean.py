@@ -1,0 +1,20 @@
+"""Clean twin of dead_rule.py: every rule matches a synthesized path
+and the sharded family is covered whole (w[qk]), so no path replicates
+while a sibling shards."""
+import flax.linen as nn
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel import ShardingRules
+
+DEFAULT_AXES = ("dp", "tp")
+
+
+class Attention(nn.Module):
+    def setup(self):
+        self.wq = nn.Dense(64, name="attn/wq")
+        self.wk = nn.Dense(64, name="attn/wk")
+
+
+RULES = ShardingRules([
+    (r"attn/w[qk]", P(None, "tp")),
+])

@@ -1,0 +1,26 @@
+"""Seeded HVD803 fixture: a rank-tainted branch whose arms agree on the
+collective op sequence (negotiation proceeds) but disagree on the
+sharding spec — the data plane then folds differently-partitioned bytes
+into one reduction."""
+import horovod_tpu as hvd
+
+
+def rank_gated_spec(t, rank):
+    if rank == 0:
+        hvd.allreduce(t, name="grads/w", spec="(tp,*)")
+    else:
+        hvd.allreduce(t, name="grads/w", spec="(dp,*)")
+    return hvd.allreduce(t, name="step")
+
+
+def deep_spec(t):
+    if hvd.rank() % 2 == 0:
+        _leg(t, "(dp)")
+    else:
+        _leg(t, "(tp)")
+
+
+def _leg(t, sp):
+    # Dynamic spec harvests as '' on both arms — equal, NOT a finding:
+    # imprecision loses columns, never invents divergence.
+    return hvd.allgather(t, name="acts", spec=sp)

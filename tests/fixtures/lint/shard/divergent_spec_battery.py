@@ -1,0 +1,22 @@
+"""The shared seed of the 2-rank shard battery (test_multiprocess
+test_shard_spec_divergence_caught_static_and_runtime): the SAME
+spec-divergent collective below is caught
+
+- statically by hvdshard — HVD803 names the tainted branch in
+  ``spec_gated_step`` whose arms agree on the op sequence
+  (negotiation proceeds) but disagree on the sharding spec
+  ([allreduce(shard_step|(dp,*))] vs [allreduce(shard_step|(tp,*))]),
+  and
+- at runtime by op×name×dtype×dims×spec collective fingerprinting —
+  the seeded rank folds a different sp_spec token for the same op, and
+  every rank receives the structured divergence ERROR naming the first
+  spec-divergent op within one strict-mode negotiation cycle.
+"""
+
+
+def spec_gated_step(hvd, t, rank, seed_rank):
+    if rank == seed_rank:
+        out = hvd.allreduce(t, name="shard_step", spec="(dp,*)")
+    else:
+        out = hvd.allreduce(t, name="shard_step", spec="(tp,*)")
+    return out

@@ -1,0 +1,19 @@
+"""Clean twin of divergent_spec.py: the spec is rank-invariant — both
+arms carry the same token, and a genuinely local branch (not
+rank-tainted) may spec freely."""
+import horovod_tpu as hvd
+
+
+def rank_gated_same_spec(t, rank):
+    if rank == 0:
+        hvd.allreduce(t, name="grads/w", spec="(tp,*)")
+    else:
+        hvd.allreduce(t, name="grads/w", spec="(tp,*)")
+    return hvd.allreduce(t, name="step")
+
+
+def untainted_branch(t, use_tp):
+    if use_tp:
+        hvd.allreduce(t, name="grads/w", spec="(tp,*)")
+    else:
+        hvd.allreduce(t, name="grads/w", spec="(dp,*)")

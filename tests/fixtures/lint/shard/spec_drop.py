@@ -1,0 +1,32 @@
+"""Seeded HVD804 fixture: a value carrying a sharding layout flows into
+a collective that serializes its dims and bytes but discards the spec —
+collective identity degrades to the 5-column form for exactly the
+tensors whose layout most needs witnessing."""
+import horovod_tpu as hvd
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.parallel import shard_params
+from horovod_tpu.parallel.sharding import constrain
+
+
+def sync_sharded_params(params, mesh, rules):
+    placed = shard_params(params, mesh, rules)
+    # spec= omitted: the layout shard_params just applied is dropped.
+    return hvd.allreduce(placed, name="params")
+
+
+def gather_constrained(x, mesh):
+    y = constrain(x, mesh, P("dp"))
+    return hvd.allgather(y, name="acts")
+
+
+def put_with_layout(x, mesh):
+    z = jax.device_put(x, NamedSharding(mesh, P("tp")))
+    return hvd.broadcast(z, root_rank=0, name="init")
+
+
+def put_without_layout(x, device):
+    # device_put with no sharding ctor produces no layout: not a drop.
+    w = jax.device_put(x, device)
+    return hvd.allreduce(w, name="plain")

@@ -1,0 +1,17 @@
+"""Clean twin of spec_drop.py: the layout a producer applied rides the
+collective as its spec= — identity stays op×name×dtype×dims×spec."""
+import horovod_tpu as hvd
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel import shard_params
+from horovod_tpu.parallel.sharding import constrain
+
+
+def sync_sharded_params(params, mesh, rules):
+    placed = shard_params(params, mesh, rules)
+    return hvd.allreduce(placed, name="params", spec="(dp,*)")
+
+
+def gather_constrained(x, mesh):
+    y = constrain(x, mesh, P("dp"))
+    return hvd.allgather(y, name="acts", spec=P("dp"))

@@ -341,6 +341,74 @@ def battery_flow(hvd, rank, size):
                          "fingerprint divergence ERROR")
 
 
+def battery_shard(hvd, rank, size):
+    """ISSUE 17 acceptance (the runtime half): the seeded
+    spec-divergent collective from tests/fixtures/lint/shard/
+    divergent_spec_battery.py — the very file hvdshard flags with
+    HVD803 — is caught by strict-mode op×name×dtype×dims×spec
+    fingerprinting as a structured divergence ERROR on EVERY rank,
+    naming the first spec-divergent op and both ranks' spec tokens."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "fixtures", "lint", "shard"))
+    import divergent_spec_battery
+
+    t = np.ones(64, np.float32)
+    # Warm-up: a rank-INVARIANT spec folds identically everywhere —
+    # annotated collectives must stay fingerprint-green.
+    for i in range(3):
+        out = hvd.allreduce(t, op=hvd.Sum, name=f"shard_warm{i}",
+                            spec="(dp,*)")
+        np.testing.assert_allclose(np.asarray(out), t * size)
+    seed = int(os.environ.get("HOROVOD_SHARD_SEED_RANK", "1"))
+    try:
+        for _ in range(4):
+            divergent_spec_battery.spec_gated_step(hvd, t, rank, seed)
+    except Exception as exc:
+        msg = str(exc)
+        assert "fingerprint divergence" in msg.lower(), msg
+        assert "shard_step" in msg, msg
+        assert "spec=(dp,*)" in msg or "spec=(tp,*)" in msg, msg
+        assert "--shard" in msg, msg          # the HVD803 cross-hint
+        print(f"SHARD_DIVERGENCE_CAUGHT rank={rank} {msg[:240]}",
+              flush=True)
+        return
+    raise AssertionError("spec-divergent collective completed without "
+                         "a fingerprint divergence ERROR")
+
+
+def battery_shard_compat(hvd, rank, size):
+    """ISSUE 17 mixed-world leg: rank 1 pins wire proto 2 (pre-sharding
+    schema), so every mesh negotiates FEATURE_SHARDING off — sp_spec is
+    blanked at the wire and the fingerprint folds the 5-column identity
+    on EVERY rank symmetrically.  The same spec-divergent step that
+    kills the native-proto world must stay fingerprint-green here, with
+    correct numerics."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "fixtures", "lint", "shard"))
+    import divergent_spec_battery
+
+    from horovod_tpu import core as _core
+    from horovod_tpu.common import wire as _wire
+    from horovod_tpu.runner.network import PeerMesh as _PeerMesh
+
+    meshes = [r for r in _core.global_state().resources
+              if isinstance(r, _PeerMesh)]
+    assert meshes, "no TCP meshes formed"
+    for m in meshes:
+        assert m.negotiated_proto == 2, m.negotiated_proto
+        assert not (m.negotiated_features & _wire.FEATURE_SHARDING), \
+            m.negotiated_features
+
+    t = np.ones(64, np.float32) * (rank + 1)
+    want = np.ones(64, np.float32) * (size + 1) / 2   # default op: average
+    for i in range(4):
+        out = divergent_spec_battery.spec_gated_step(hvd, t, rank, 1)
+        np.testing.assert_allclose(np.asarray(out), want)
+    print(f"SHARD_COMPAT_GREEN rank={rank} proto=2", flush=True)
+
+
 def battery_errors(hvd, rank, size):
     # Shape mismatch must raise a structured error on every rank, not hang.
     shape = (4,) if rank == 0 else (5,)
@@ -2782,6 +2850,11 @@ BATTERIES = {
     # hvdflow runtime cross-check (ISSUE 12): the seeded rank-gated
     # collective must die as a structured fingerprint ERROR, not a hang.
     "flow": battery_flow,
+    # hvdshard runtime cross-check (ISSUE 17): the seeded spec-divergent
+    # collective dies under op×spec identity; the proto-2 mixed world
+    # negotiates sp_* off and stays green on the same step.
+    "shard": battery_shard,
+    "shard_compat": battery_shard_compat,
 }
 
 def battery_fleetsim(port):
@@ -2848,6 +2921,16 @@ def main() -> int:
         # negotiation heartbeat even in cache steady state.
         os.environ.setdefault("HOROVOD_FINGERPRINT", "strict")
         os.environ.setdefault("HOROVOD_FLOW_SEED_RANK", "2")
+    if battery in ("shard", "shard_compat"):
+        # Strict mode so the op×spec divergence (or, in the compat
+        # world, its negotiated absence) is judged every cycle.
+        os.environ.setdefault("HOROVOD_FINGERPRINT", "strict")
+    if battery == "shard_compat":
+        # Rank 1 is the pre-sharding framework version: proto 2 carries
+        # fp_/tm_/trace_ but not sp_*, so every mesh negotiates
+        # FEATURE_SHARDING off and both ranks fold 5-column identity.
+        if rank == 1:
+            os.environ["HOROVOD_PROTO_COMPAT"] = "2"
     if battery == "autotune":
         os.environ["HOROVOD_AUTOTUNE"] = "1"
         os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"

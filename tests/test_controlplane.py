@@ -374,9 +374,16 @@ class TestWireHandshake:
                                           wire.FEATURES_ALL)
         with pytest.raises(ValueError):
             wire.unpack_hello(b"\x00" * wire.HELLO_LEN)
+        assert wire.negotiate(wire.PROTO_VERSION, wire.FEATURES_ALL,
+                              wire.PROTO_VERSION, wire.FEATURES_ALL) \
+            == (wire.PROTO_VERSION, wire.FEATURES_ALL)
+        # A frozen proto's feature set does not grow with FEATURES_ALL:
+        # two proto-2 peers negotiate the three-bit fp/tm/trace mask,
+        # never the sharding bit proto 3 added.
         assert wire.negotiate(2, wire.FEATURES_ALL, 2,
-                              wire.FEATURES_ALL) == (2,
-                                                     wire.FEATURES_ALL)
+                              wire.FEATURES_ALL) == \
+            (2, wire.PROTO_FEATURE_SETS[2])
+        assert not wire.PROTO_FEATURE_SETS[2] & wire.FEATURE_SHARDING
         # An old peer drags the pair to the base schema: features the
         # old proto cannot carry are masked even if advertised.
         assert wire.negotiate(2, wire.FEATURES_ALL, 1,
@@ -385,8 +392,11 @@ class TestWireHandshake:
     def test_optional_field_table_matches_analyzer_mirror(self):
         from horovod_tpu.analysis.hvdsan.san import \
             _OPTIONAL_WIRE_PREFIXES
-        assert set(_OPTIONAL_WIRE_PREFIXES) == \
-            set(wire.OPTIONAL_FIELD_FEATURES)
+        # Byte-for-byte: same prefixes, same order — a new group
+        # appended to one table and not the other fails here before
+        # any rolling upgrade can ship the skew.
+        assert tuple(_OPTIONAL_WIRE_PREFIXES) == \
+            tuple(wire.OPTIONAL_FIELD_FEATURES)
         # Every optional group vanishes from the wire when its bit is
         # negotiated away — and the base schema stays decodable.
         from horovod_tpu.common.message import RequestList, Response
@@ -398,6 +408,18 @@ class TestWireHandshake:
         resp = Response(trace_cycle=4, trace_seq=2)
         assert len(_encode_response(resp, 0)) < \
             len(_encode_response(resp, wire.FEATURES_ALL))
+        # The sp_* group rides per-Request/Response and vanishes the
+        # same way when FEATURE_SHARDING is negotiated off.
+        from horovod_tpu.common.message import Request, RequestType
+        req = Request(request_type=RequestType.ALLREDUCE,
+                      tensor_name="w", sp_spec="(tp,*)")
+        rl2 = RequestList(requests=[req])
+        back = RequestList.from_bytes(rl2.to_bytes(), wire.FEATURES_ALL)
+        assert back.requests[0].sp_spec == "(tp,*)"
+        base2 = RequestList.from_bytes(
+            rl2.to_bytes(wire.PROTO_FEATURE_SETS[2]),
+            wire.PROTO_FEATURE_SETS[2])
+        assert base2.requests[0].sp_spec == ""
 
     def test_proto_compat_knob_masks_advertisement(self, monkeypatch):
         from horovod_tpu.runner.network import advertised_hello

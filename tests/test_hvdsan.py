@@ -103,6 +103,19 @@ def test_fixture_ungated_optional_field_hvd505():
             for f in a.findings} == {"UngatedRequestList"}
 
 
+def test_fixture_ungated_sp_field_hvd505():
+    """ISSUE 17 satellite: the sp_* sharding-spec group joins the
+    optional-field prefix table, so an sp_spec string encoded or
+    decoded outside a FEATURE_SHARDING gate is flagged once per codec
+    side; the gated twin next to it is clean."""
+    a = _fixture("ungated_sp_field.py")
+    assert _slugs(a) == ["wire-schema-drift"] * 2
+    msgs = [f.message for f in a.findings]
+    assert all("feature-bit gate" in m and "sp_spec" in m for m in msgs)
+    assert {f.message.split(".")[0].rsplit(" ", 1)[-1]
+            for f in a.findings} == {"UngatedShardRequest"}
+
+
 def test_fixture_state_frame_drift_hvd505():
     """ISSUE 11 satellite: HVD505 extended over the statesync
     STATE_MAGIC frame codec — the seeded fixture drifts every check

@@ -1,0 +1,195 @@
+"""hvdshard tests (analysis/hvdshard/): the canonical spec-token
+grammar, the shared rule-coverage core, HVD801-804 on the seeded
+fixtures, the CLI, and the lint --shard driver integration.  The
+runtime half of op×name×dtype×dims×spec identity (fingerprint fold,
+sp_* wire fields) is covered in test_fingerprint.py /
+test_controlplane.py; the 2-rank acceptance battery lives in
+tests/test_multiprocess.py."""
+import json
+import os
+import subprocess
+import sys
+
+from horovod_tpu.analysis.hvdshard import (fold_token, missing_axes,
+                                           rule_coverage, spec_token,
+                                           token_axes)
+from horovod_tpu.analysis.hvdshard.shard import (SHARD_RULE_IDS,
+                                                 analyze_paths)
+from horovod_tpu.analysis.hvdshard.shard import main as shard_main
+from horovod_tpu.analysis.lint import LintConfig, lint_paths_timed
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SHARD = os.path.join(REPO, "tests", "fixtures", "lint", "shard")
+
+
+def _fx(name: str) -> str:
+    return os.path.join(SHARD, name)
+
+
+def _rules(findings):
+    return [f.rule.id for f in findings]
+
+
+# --- the canonical token grammar ---------------------------------------------
+def test_spec_token_grammar():
+    assert spec_token(None) == ""
+    assert spec_token(()) == "*"                      # P() replicated
+    assert spec_token(("tp",)) == "(tp)"
+    assert spec_token((None, "tp")) == "(*,tp)"
+    assert spec_token((("dp", "fsdp"), None)) == "(dp+fsdp,*)"
+    assert spec_token("(tp,*)") == "(tp,*)"           # idempotent
+
+
+def test_fold_token_wildcards_allgather_dim0():
+    # ALLGATHER's first dim is rank-local by contract (uneven rows):
+    # folding its spec entry would flag every legitimate uneven gather.
+    assert fold_token("ALLGATHER", "(dp,tp)") == "(*,tp)"
+    assert fold_token("ALLREDUCE", "(dp,tp)") == "(dp,tp)"
+    assert fold_token("ALLGATHER", "*") == "*"
+    assert fold_token("ALLGATHER", "") == ""
+
+
+def test_token_axes_and_missing_axes():
+    assert token_axes("(dp+fsdp,*)") == {"dp", "fsdp"}
+    assert token_axes("*") == set()
+    assert token_axes("") == set()
+    assert missing_axes("(model,*)", ("dp", "tp")) == ["model"]
+    assert missing_axes("(dp,tp)", ("dp", "tp")) == []
+
+
+def test_rule_coverage_dead_and_uncovered():
+    table = [("decoder/.*", "(*,tp)"), ("attn/wq", "(*,tp)")]
+    paths = ["attn/wq", "attn/wk"]
+    dead, uncovered = rule_coverage(table, paths)
+    assert dead == ["decoder/.*"]
+    assert uncovered == [("attn/wk", "attn/wq")]
+
+
+def test_rule_coverage_replicated_sibling_is_not_sharded():
+    # A sibling matched by an explicitly-replicated rule ('*') does not
+    # make an unmatched neighbour "uncovered".
+    table = [("attn/wq", "*")]
+    dead, uncovered = rule_coverage(table, ["attn/wq", "attn/wk"])
+    assert dead == [] and uncovered == []
+
+
+# --- seeded fixtures: flagged/clean pairs ------------------------------------
+def test_fixture_dead_rule_flagged_and_clean():
+    out = analyze_paths([_fx("dead_rule.py")])
+    assert _rules(out) == ["HVD801"] * 2
+    msgs = " | ".join(f.message for f in out)
+    assert "decoder/.*kernel" in msgs                 # dead rule named
+    assert "attn/wk" in msgs and "attn/wq" in msgs    # path + sibling rule
+    assert all(f.severity == "warning" for f in out)
+    assert analyze_paths([_fx("dead_rule_clean.py")]) == []
+
+
+def test_fixture_axis_mismatch_flagged_and_clean():
+    out = analyze_paths([_fx("axis_mismatch.py")])
+    assert _rules(out) == ["HVD802"]
+    assert out[0].severity == "error"
+    assert "'model'" in out[0].message
+    assert "['dp', 'tp']" in out[0].message
+    assert analyze_paths([_fx("axis_mismatch_clean.py")]) == []
+
+
+def test_fixture_divergent_spec_flagged_and_clean():
+    out = analyze_paths([_fx("divergent_spec.py")])
+    assert _rules(out) == ["HVD803"]
+    f = out[0]
+    assert f.severity == "error"
+    assert "allreduce(grads/w|(tp,*))" in f.message
+    assert "allreduce(grads/w|(dp,*))" in f.message
+    assert "first spec-divergent op #1" in f.message
+    assert analyze_paths([_fx("divergent_spec_clean.py")]) == []
+
+
+def test_fixture_spec_drop_flagged_and_clean():
+    out = analyze_paths([_fx("spec_drop.py")])
+    assert _rules(out) == ["HVD804"] * 3
+    producers = {f.message.split("assigned from ")[1].split("(")[0]
+                 for f in out}
+    assert producers == {"shard_params", "constrain", "device_put"}
+    assert all(f.severity == "warning" for f in out)
+    assert analyze_paths([_fx("spec_drop_clean.py")]) == []
+
+
+def test_all_shard_fixtures_flagged_together():
+    out = analyze_paths([SHARD])
+    assert sorted(set(_rules(out))) == ["HVD801", "HVD802", "HVD803",
+                                        "HVD804"]
+
+
+# --- CLI ---------------------------------------------------------------------
+def test_cli_json(capsys):
+    rc = shard_main([_fx("axis_mismatch.py"), "--format", "json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert [f["rule"] for f in payload["shard"]] == ["HVD802"]
+    assert payload["wall_ms"] > 0
+
+
+def test_cli_warnings_exit_zero(capsys):
+    rc = shard_main([_fx("spec_drop.py"), "--format", "json"])
+    capsys.readouterr()
+    assert rc == 0          # warnings only: the gate is on errors
+
+
+def test_cli_sarif(capsys):
+    rc = shard_main([_fx("divergent_spec.py"), "--format", "sarif"])
+    assert rc == 1
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["version"] == "2.1.0"
+    results = sarif["runs"][0]["results"]
+    assert [r["ruleId"] for r in results] == ["HVD803"]
+    assert results[0]["level"] == "error"
+
+
+def test_cli_module_entrypoint():
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.analysis.hvdshard",
+         _fx("dead_rule.py"), "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert [f["rule"] for f in payload["shard"]] == ["HVD801"] * 2
+
+
+def test_lint_driver_shard_rides_same_parse():
+    """`lint --shard` runs hvdshard (and the HVD803 leg of hvdflow)
+    over the same single parse; findings respect --select/--ignore."""
+    cfg = LintConfig()
+    _v, findings, stats = lint_paths_timed(
+        [_fx("divergent_spec.py")], cfg, shard=True)
+    assert _rules(findings) == ["HVD803"]
+    assert stats["files"] == 1
+    cfg = LintConfig(ignore={"HVD803"})
+    _v, findings, _s = lint_paths_timed(
+        [_fx("divergent_spec.py")], cfg, shard=True)
+    assert findings == []
+    # Without --shard the same parse yields no HVD80x: the partition.
+    _v, findings, _s = lint_paths_timed(
+        [_fx("divergent_spec.py")], LintConfig(), flow=True)
+    assert findings == []
+
+
+def test_shard_rule_ids_registered():
+    from horovod_tpu.analysis.rules import RULES
+    assert SHARD_RULE_IDS == {"HVD801", "HVD802", "HVD803", "HVD804"}
+    for rid in SHARD_RULE_IDS:
+        assert rid in RULES
+    assert RULES["HVD801"].slug == "dead-partition-rule"
+    assert RULES["HVD802"].slug == "spec-mesh-axis-mismatch"
+    assert RULES["HVD803"].slug == "divergent-spec-collective"
+    assert RULES["HVD804"].slug == "spec-drop"
+
+
+def test_suppression_silences_shard_finding(tmp_path):
+    src = open(_fx("axis_mismatch.py"), encoding="utf-8").read()
+    src = src.replace(
+        'return constrain(x, mesh, P("model", None))',
+        'return constrain(x, mesh, P("model", None))'
+        '  # hvdlint: disable=HVD802 -- megatron import shim')
+    p = tmp_path / "suppressed.py"
+    p.write_text(src)
+    assert analyze_paths([str(p)]) == []

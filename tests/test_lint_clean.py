@@ -542,6 +542,56 @@ def test_cli_life_changed_only_smoke(capsys):
         os.path.join(FIXTURES, "life")))
 
 
+# --- ISSUE 17: the hvdshard gates -------------------------------------------
+def test_horovod_tpu_tree_is_shard_clean():
+    """ISSUE 17 acceptance: zero unsuppressed HVD801-804 errors on the
+    tree — hvdshard rides the same single-parse driver run (--shard).
+    The sharding rule tables, spec literals and collective spec=
+    streams the tree ships are mutually coherent."""
+    from horovod_tpu.analysis.lint import lint_paths_timed
+    violations, findings, stats = lint_paths_timed([TREE], shard=True)
+    assert violations == [], "\n".join(v.text() for v in violations)
+    errors = [f for f in findings if f.severity == "error"]
+    assert errors == [], "\n".join(f.text() for f in errors)
+    assert stats["files"] > 50
+
+
+def test_cli_shard_flag_and_sarif_shape(capsys):
+    """--shard rides the shared driver with the shared emitters: JSON
+    grows a 'shard' list, SARIF results carry the HVD80x rule ids, and
+    the other families stay in their own keys."""
+    shard_fixture = os.path.join(FIXTURES, "shard", "divergent_spec.py")
+    rc = main([shard_fixture, "--shard", "--format", "json"])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert [f["rule"] for f in payload["shard"]] == ["HVD803"]
+    # the rank-gated arms are ALSO per-line HVD101s — same parse, both
+    # families report, each under its own JSON key
+    assert [v["rule"] for v in payload["violations"]] == ["HVD101"] * 2
+    assert payload["san"] == [] and payload["flow"] == []
+    rc = main([shard_fixture, "--shard", "--format", "sarif"])
+    assert rc == 1
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["version"] == "2.1.0"
+    assert [r["ruleId"] for r in sarif["runs"][0]["results"]
+            if r["ruleId"] == "HVD803"] == ["HVD803"]
+    assert {r["id"] for r in
+            sarif["runs"][0]["tool"]["driver"]["rules"]} == \
+        {"HVD101", "HVD803"}
+
+
+def test_cli_shard_changed_only_smoke(capsys):
+    """--shard composes with --changed-only (the fast CI gate shape);
+    on an untouched fixture dir it must not crash and reports at most
+    the changed subset."""
+    rc = main([os.path.join(FIXTURES, "shard"), "--shard",
+               "--changed-only", "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc in (0, 1)
+    assert payload["files"] <= len(os.listdir(
+        os.path.join(FIXTURES, "shard")))
+
+
 # --- ISSUE 12: typed knob registry + generated docs --------------------------
 def test_knobs_cli_emits_registry_table(capsys):
     rc = main(["--knobs"])

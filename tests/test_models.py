@@ -90,10 +90,12 @@ def test_vgg16_forward_and_params():
     assert n > 130e6
 
 
+@pytest.mark.slow
 def test_inception_v3_forward_and_params():
     """Inception V3 (reference headline benchmark): forward shape at the
     canonical 299px (via eval_shape — no FLOPs) and a real forward at
-    96px; ~27M params in the tf-slim model."""
+    96px; ~27M params in the tf-slim model.  Benchmark-class (~20s of
+    real conv FLOPs on the CPU mesh), so it rides the slow tier."""
     model = models.InceptionV3(num_classes=1000, dtype=jnp.float32)
     x = jnp.zeros((2, 96, 96, 3))
     variables = model.init(jax.random.key(0), x, train=False)

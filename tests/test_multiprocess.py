@@ -287,7 +287,12 @@ def test_torch_full_2rank():
     _run_world(2, "torch_all", timeout=420.0)
 
 
+@pytest.mark.slow
 def test_torch_distributed_optimizer_4rank():
+    """4-rank scale-out of the DistributedOptimizer battery.  The
+    2-rank torch_all world and the 3-rank binding grid keep the
+    optimizer surface in tier-1; the 4x torch-import world is
+    scale-redundant there, so it rides the slow tier."""
     _run_world(4, "torch", timeout=120.0)
 
 
@@ -356,3 +361,54 @@ def test_flow_divergence_caught_static_and_runtime():
     for r, out in enumerate(outputs):
         assert "FLOW_DIVERGENCE_CAUGHT" in out, \
             f"rank {r} missed the divergence ERROR:\n{out}"
+
+
+def test_shard_spec_divergence_caught_static_and_runtime():
+    """ISSUE 17 acceptance: ONE seeded spec-divergent collective
+    (tests/fixtures/lint/shard/divergent_spec_battery.py) is caught
+    BOTH
+
+    - statically: hvdshard HVD803 names the tainted branch whose arms
+      agree on the op sequence but disagree on sharding spec, carrying
+      both arms' spec-annotated streams, and
+    - at runtime: a 2-rank HOROVOD_FINGERPRINT=strict world folds
+      op×name×dtype×dims×spec identity and answers the same gated
+      collective with the structured divergence ERROR on EVERY rank,
+      naming the first spec-divergent op and its spec tokens.
+    """
+    from horovod_tpu.analysis.hvdshard.shard import analyze_paths
+    fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "fixtures", "lint", "shard",
+                           "divergent_spec_battery.py")
+    findings = analyze_paths([fixture])
+    assert [f.rule.id for f in findings] == ["HVD803"]
+    finding = findings[0]
+    with open(fixture) as f:
+        lines = f.read().splitlines()
+    gate_line = next(i for i, ln in enumerate(lines, start=1)
+                     if "if rank == seed_rank:" in ln)
+    assert finding.line == gate_line          # names the branch site
+    # …and carries the spec-annotated stream diff of the two arms.
+    assert "allreduce(shard_step|(dp,*))" in finding.message
+    assert "allreduce(shard_step|(tp,*))" in finding.message
+    assert "HOROVOD_FINGERPRINT" in finding.message
+
+    outputs = _run_world(2, "shard", timeout=120.0,
+                         extra_env={"HOROVOD_FINGERPRINT": "strict",
+                                    "HOROVOD_SHARD_SEED_RANK": "1"})
+    for r, out in enumerate(outputs):
+        assert "SHARD_DIVERGENCE_CAUGHT" in out, \
+            f"rank {r} missed the spec-divergence ERROR:\n{out}"
+
+
+def test_shard_mixed_world_negotiates_spec_off_and_stays_green():
+    """ISSUE 17 mixed-world leg: with rank 1 pinned to the pre-sharding
+    wire proto (HOROVOD_PROTO_COMPAT=2), every mesh negotiates
+    FEATURE_SHARDING off — the SAME spec-divergent step that kills the
+    native world completes fingerprint-green with correct numerics on
+    both ranks (5-column identity everywhere; no half-folded world)."""
+    outputs = _run_world(2, "shard_compat", timeout=120.0,
+                         extra_env={"HOROVOD_FINGERPRINT": "strict"})
+    for r, out in enumerate(outputs):
+        assert "SHARD_COMPAT_GREEN" in out, \
+            f"rank {r} not green in the proto-2 world:\n{out}"

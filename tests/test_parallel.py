@@ -195,6 +195,63 @@ class TestSharding:
         placed = shard_params(params, mesh_dp_tp, rules)
         assert tuple(placed["bias"].sharding.spec) == ()
 
+    def test_overlapping_rules_first_match_wins(self):
+        rules = ShardingRules([
+            (r"attn.*kernel", P(None, "tp")),
+            (r".*kernel", P("dp", None)),
+        ])
+        assert tuple(rules.spec_for("attn/q/kernel")) == (None, "tp")
+        assert tuple(rules.spec_for("mlp/up/kernel")) == ("dp", None)
+
+    def test_patterns_are_searched_not_anchored(self):
+        # search(), not fullmatch(): a mid-path token matches, and an
+        # author who wants anchoring spells ^...$ explicitly.
+        rules = ShardingRules([(r"mlp/up", P(None, "tp")),
+                               (r"^bias$", P("dp"))])
+        assert tuple(rules.spec_for("layer0/mlp/up/kernel")) \
+            == (None, "tp")
+        assert tuple(rules.spec_for("bias")) == ("dp",)
+        assert tuple(rules.spec_for("layer0/bias")) == ()
+
+    def test_empty_spec_rule_blocks_later_rules(self):
+        # P() is a legitimate "explicitly replicated" terminal rule —
+        # it wins for its paths and never rank-skips (len 0 fits any
+        # leaf).
+        rules = ShardingRules([(r"norm", P()),
+                               (r".*", P("dp"))])
+        leaf = np.zeros((4,), np.float32)
+        assert tuple(rules.spec_for("norm/scale", leaf)) == ()
+        assert tuple(rules.spec_for("w", leaf)) == ("dp",)
+
+    def test_validate_flags_unknown_axis(self, mesh_dp_tp):
+        rules = ShardingRules([(r".*kernel", P(None, "model"))])
+        params = {"attn": {"kernel": np.zeros((2, 2), np.float32)}}
+        problems = rules.validate(mesh_dp_tp, params)
+        assert any("HVD802" in p and "'model'" in p for p in problems)
+
+    def test_validate_flags_dead_rule(self, mesh_dp_tp):
+        rules = ShardingRules([(r"decoder.*kernel", P(None, "tp"))])
+        params = {"attn": {"kernel": np.zeros((2, 2), np.float32)}}
+        problems = rules.validate(mesh_dp_tp, params)
+        assert any("HVD801 dead rule" in p and "decoder" in p
+                   for p in problems)
+
+    def test_validate_flags_uncovered_sibling(self, mesh_dp_tp):
+        # wq is sharded; wk under the same parent falls through to
+        # replicated — the classic forgotten-sibling hole.
+        rules = ShardingRules([(r"attn/wq", P(None, "tp"))])
+        params = {"attn": {"wq": np.zeros((2, 2), np.float32),
+                           "wk": np.zeros((2, 2), np.float32)}}
+        problems = rules.validate(mesh_dp_tp, params)
+        assert any("HVD801 uncovered path" in p and "attn/wk" in p
+                   for p in problems)
+
+    def test_validate_clean_table_returns_empty(self, mesh_dp_tp):
+        rules = ShardingRules([(r"attn/w[qk]", P(None, "tp"))])
+        params = {"attn": {"wq": np.zeros((2, 2), np.float32),
+                           "wk": np.zeros((2, 2), np.float32)}}
+        assert rules.validate(mesh_dp_tp, params) == []
+
 
 def test_hierarchical_allreduce_matches_flat():
     """Explicit reduce_scatter->cross->all_gather equals the flat psum

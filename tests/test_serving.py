@@ -557,13 +557,16 @@ def test_loadgen_paged_report_carries_kv_section(tmp_path, monkeypatch):
 
 
 # --- the 4-rank chaos acceptance battery ------------------------------------
+@pytest.mark.slow
 def test_serving_chaos_shrink_4rank():
     """ISSUE 9 acceptance: chaos SIGKILLs rank 2 mid-serve (global
     collective index 11, ~16 requests in flight); the 4-rank world
     shrinks to 3, every survivor completes every admitted in-flight
     request (asserted in-battery), accounting balances with bounded
     shed, and a post-shrink hopeless-SLO burst is shed at admission
-    without ever being prefilled."""
+    without ever being prefilled.  Slow tier: the paged chaos battery
+    below rides the same 4->3 shrink machinery (plus paged-KV checks)
+    and stays in tier-1."""
     outputs = _run_world(4, "serving", timeout=360.0,
                          expected_rcs={2: -signal.SIGKILL})
     assert "shrink at step" in outputs[0], outputs[0]
