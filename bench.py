@@ -156,6 +156,15 @@ def _step_flops(trainer, state, batch) -> float | None:
 
 
 def _emit(payload: dict) -> None:
+    # Every bench payload records WHAT ran, not just how fast: the
+    # declared fabric topology and allreduce-algorithm knob ride along so
+    # the perf trajectory can attribute a shift to a layout/algo change.
+    # Env-sourced (not registry) so even failure payloads from processes
+    # that never imported the package carry the stamp; legs that know the
+    # runtime-selected value set the keys explicitly and win (setdefault).
+    payload.setdefault("topology",
+                       os.environ.get("HOROVOD_TOPOLOGY", "") or "flat")
+    payload.setdefault("algo", os.environ.get("HOROVOD_ALGO", "") or "auto")
     print(json.dumps(payload))
 
 
@@ -305,8 +314,12 @@ def _orchestrate(args) -> int:
     bench process contributes at most one sleep's worth of budget per
     gap, so the resumed watcher still has its round budget and the
     next round records a real payload.  Each probe runs
-    in the PARENT with a short timeout (a wedged tunnel costs 90 s, not
-    a full inner spawn) and the inner run still fail-fasts via
+    in the PARENT with a short timeout (HOROVOD_BENCH_PROBE_BUDGET_S,
+    default 25 s — a wedged tunnel costs seconds, not a full inner
+    spawn), TWO consecutive timed-out probes are DEFINITIVE (the
+    accelerator-free container goes to CPU fallback in under a minute
+    instead of re-timing-out across the window), and the inner run
+    still fail-fasts via
     HVD_BENCH_REQUIRE_ACCEL if the tunnel dies between probe and run.
     A successful capture clears the checkpoint (the next round starts a
     fresh window); a CPU fallback leaves it — a re-run resumes any
@@ -326,6 +339,19 @@ def _orchestrate(args) -> int:
 
     window = _env_float("HOROVOD_BENCH_WINDOW_SECONDS", 3600.0)
     interval = max(_env_float("HOROVOD_BENCH_PROBE_INTERVAL", 60.0), 1.0)
+    # Per-probe subprocess timeout (registry knob, env fallback when the
+    # package is not importable from the bench entrypoint).  A probe that
+    # runs to this timeout is a wedged/blackholed tunnel — and TWO
+    # consecutive timeouts are DEFINITIVE: in accelerator-free containers
+    # the old schedule burned the whole 15->300 s ladder re-timing-out
+    # forever; now CPU fallback starts after ~2x this budget (<1 min at
+    # the default 25 s).
+    try:
+        from horovod_tpu.common import config as _hvd_config
+        probe_budget = float(_hvd_config.BENCH_PROBE_BUDGET_S.get())
+    except Exception:
+        probe_budget = _env_float("HOROVOD_BENCH_PROBE_BUDGET_S", 25.0)
+    probe_budget = max(probe_budget, 1.0)
     cap_raw = os.environ.get("HOROVOD_BENCH_PROBE_ATTEMPTS", "")
     try:
         attempts_cap = int(cap_raw) if cap_raw else None
@@ -342,6 +368,7 @@ def _orchestrate(args) -> int:
 
     state = _load_probe_state(window)
     crash_streak = 0
+    absent_streak = 0
 
     def _tick(cap: float) -> None:
         """Advance the active-time budget: wall time since the last
@@ -365,10 +392,12 @@ def _orchestrate(args) -> int:
             break
         state["attempts"] += 1
         _save_probe_state(state)
-        status, _probed = _probe_backend_status(timeout=90.0)
-        _tick(120.0)   # the probe itself ran in-process (<= 90 s)
+        status, _probed = _probe_backend_status(timeout=probe_budget)
+        # the probe itself ran in-process (<= probe_budget)
+        _tick(probe_budget + 30.0)
         if status == "ok":
             crash_streak = 0
+            absent_streak = 0
             # Attempt runs fail fast on probe failure
             # (HVD_BENCH_REQUIRE_ACCEL) instead of silently completing a
             # CPU benchmark the watcher would discard; CPU execution
@@ -414,13 +443,32 @@ def _orchestrate(args) -> int:
             # a short capped backoff instead of burning a full probe
             # interval per crash (the BENCH_r01-05 failure shape).
             crash_streak += 1
+            absent_streak = 0
             delay = min(5.0 * (2.0 ** (crash_streak - 1)), interval)
             print(f"bench: probe {state['attempts']}: transient probe "
                   f"crash (#{crash_streak} in a row); retrying in "
                   f"{delay:.0f}s", file=sys.stderr)
         else:
             crash_streak = 0
-            delay = interval
+            absent_streak += 1
+            if absent_streak >= 2:
+                # Two consecutive full-budget timeouts: the tunnel is not
+                # merely resetting, it is absent — classify as definitive
+                # and start the CPU fallback NOW instead of re-timing-out
+                # across the whole round window (the BENCH_r01-05
+                # cpu-fallback rounds each burned the full backoff ladder
+                # this way).
+                print(f"bench: probe {state['attempts']}: timed out "
+                      f"{absent_streak}x in a row "
+                      f"(HOROVOD_BENCH_PROBE_BUDGET_S={probe_budget:.0f})"
+                      f" — definitive; starting CPU fallback",
+                      file=sys.stderr)
+                _save_probe_state(state)
+                break
+            # The timeout itself already burned probe_budget seconds of
+            # wall time; re-probe immediately to reach the 2-strike
+            # verdict fast.
+            delay = 0.0
             print(f"bench: probe {state['attempts']}: no accelerator "
                   f"({max(window - state['active_s'], 0):.0f}s of probe "
                   f"budget left in the round window)", file=sys.stderr)
@@ -877,6 +925,44 @@ def _eager_worker(payload_mb: int, cycles: int) -> dict:
         hvd.shutdown()
 
 
+def _ladder_worker(sizes_bytes: tuple, reps: int) -> dict:
+    """Per-rank body for the allreduce size-ladder leg (median latency
+    per algorithm × payload size); module-level for pickling."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import core as _core
+
+    # Pin the flat TCP plane: the ladder compares ring vs tree SCHEDULES,
+    # so the shm/XLA planes (which ignore the algo knob) must not claim
+    # the op on localhost worlds.
+    os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+    os.environ["HOROVOD_XLA_OPERATIONS"] = "0"
+    hvd.init()
+    try:
+        st = _core.global_state()
+        out: dict = {}
+        for algo in ("ring", "tree"):
+            # Symmetric flip (every rank runs this same line before the
+            # same op sequence) — the same mechanism as tuned_algo.
+            for c in st.tcp_collectives:
+                c.algo = algo
+            for nb in sizes_bytes:
+                x = np.ones(max(nb // 4, 1), dtype=np.float32)
+                name = f"ladder_{algo}_{nb}"
+                hvd.allreduce(x, op=hvd.Sum, name=name)   # warm the cache
+                samples = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    hvd.allreduce(x, op=hvd.Sum, name=name)
+                    samples.append(time.perf_counter() - t0)
+                out[f"{algo}_{nb}"] = sorted(samples)[len(samples) // 2] \
+                    * 1e3
+        return out
+    finally:
+        hvd.shutdown()
+
+
 def bench_eager(args) -> int:
     """Eager-core microbenchmark: steady-state cached negotiation cycle rate
     and TCP-ring allreduce bandwidth (reference analogue: the 1ms
@@ -893,6 +979,20 @@ def bench_eager(args) -> int:
     r = results[0]
     fused_ms = r.get("codec_fused_ms", 0.0)
     ref_ms = r.get("codec_reference_ms", 0.0)
+
+    # Allreduce size ladder (ISSUE 18): median latency per algorithm ×
+    # payload size on a 4-rank world (tree degenerates to ring at 2
+    # ranks), plus the measured tree/ring crossover — the empirical
+    # counterpart of HOROVOD_TREE_THRESHOLD_BYTES.
+    ladder_sizes = (4 << 10, 64 << 10, 1 << 20)
+    lad = horovod_tpu.run(_ladder_worker, args=(ladder_sizes, 5), np=4)[0]
+    ladder = {str(nb): {"ring_ms": round(lad[f"ring_{nb}"], 3),
+                        "tree_ms": round(lad[f"tree_{nb}"], 3)}
+              for nb in ladder_sizes}
+    crossover = 0
+    for nb in ladder_sizes:
+        if lad[f"tree_{nb}"] < lad[f"ring_{nb}"]:
+            crossover = nb
     _emit({
         "metric": "eager_cached_cycles_per_sec",
         "value": round(r["cycles_per_sec"], 1),
@@ -906,6 +1006,11 @@ def bench_eager(args) -> int:
         "codec_reference_ms": round(ref_ms, 2),
         "codec_fused_speedup": round(ref_ms / fused_ms, 3)
         if fused_ms > 0 else 0.0,
+        # ISSUE 18 size ladder: per-algo median latency by payload size
+        # and the largest size where the tree still beat the ring (0 =
+        # the ring won everywhere).
+        "allreduce_ladder": ladder,
+        "tree_ring_crossover_bytes": crossover,
         # End-of-run telemetry snapshot: the trajectory records counters
         # (wire bytes, cache hit rate, stream utilization) alongside
         # the latency headline.

@@ -121,7 +121,11 @@ _MAX_SERVE_DEPTH = 14
 # sub-mesh-scoped are symmetric-per-submesh: HVD601 demotes them to a
 # warning naming the sub-meshes instead of demanding a suppression.
 # Reviewed manifest, like the ownership/LOCK_HOLD_ALLOWED idiom.
-SUBMESH_ATTRS = frozenset({"cross", "local", "shm_local", "shm_cross"})
+SUBMESH_ATTRS = frozenset({"cross", "local", "shm_local", "shm_cross",
+                           # multi-level hierarchical ladder legs: the
+                           # per-level collectives loop over
+                           # `for level in self.levels[...]` receivers
+                           "level"})
 
 # Stream caps: a divergence is located within the first tokens; capping
 # keeps pathological recursion bounded.

@@ -93,6 +93,12 @@ class CollectiveBackend(ABC):
     # Which dispatch stream this instance serves (annotates timeline
     # activities; per-stream instances are built by core.init).
     stream = 0
+    # Algorithm used by the most recent collective on this backend
+    # instance ("ring", "tree", "rhd", "torus", "adasum", "pairwise",
+    # "hierarchical", ...).  Telemetry reads it right after execute() to
+    # label the per-plane latency histogram; single dispatch thread per
+    # stream instance, so a plain attribute is race-free.
+    last_algo = "none"
 
     def _act_start(self, entries, activity: str) -> None:
         tl = self.timeline

@@ -41,23 +41,41 @@ class HierarchicalTcpBackend(CollectiveBackend):
 
     def __init__(self, local: TcpCollectives, cross: TcpCollectives, *,
                  allreduce_on: bool, allgather_on: bool,
-                 shm_local=None) -> None:
-        self.local = local
-        self.cross = cross
+                 shm_local=None,
+                 levels: list[TcpCollectives] | None = None) -> None:
+        # Generalized reduction ladder, innermost (fastest links) first.
+        # The classic host×slot case is exactly two levels [local, cross];
+        # a torus is [row, col]; deeper fabrics (slot×host×pod) pass more.
+        # Every rank descends the SAME ladder (level sizes come from the
+        # launcher-uniform topology), so shard bounds stay rank-symmetric.
+        self.levels = list(levels) if levels else [local, cross]
+        assert len(self.levels) >= 2, "hierarchical needs >= 2 levels"
+        self.local = self.levels[0] if levels else local
+        self.cross = self.levels[-1] if levels else cross
+        self._level_names = ["local", "cross"] if len(self.levels) == 2 \
+            else [f"l{i}" for i in range(len(self.levels) - 1)] + ["top"]
         # Optional same-host shm world over the LOCAL ranks: the
         # intra-host legs then ride mmap regions instead of TCP loopback
         # (the NCCL-intra-node analogue; ~2x on multi-rank hosts).  The
         # decision is per-host — hosts with and without shm interoperate
         # because the cross-leg traffic pattern is identical either way.
-        self.shm_local = shm_local
+        # Only meaningful for the two-level ladder (its 3-barrier protocol
+        # assumes exactly one descend leg).
+        self.shm_local = shm_local if len(self.levels) == 2 else None
         self.allreduce_on = allreduce_on
         self.allgather_on = allgather_on
         # Per-leg observability: op counts and analytic payload volumes.
         # Tests (and PERFORMANCE.md) use these to prove the knob changes
         # the executed path, independent of whether a leg took the native
-        # C++ ring or the Python fallback.
-        self.leg_ops = {"local_rs": 0, "cross_ar": 0, "local_ag": 0,
-                        "local_gather": 0, "cross_gather": 0}
+        # C++ ring or the Python fallback.  Two-level keys are unchanged
+        # from the pre-multi-level backend (local_rs/cross_ar/local_ag).
+        self.leg_ops = {}
+        for name in self._level_names[:-1]:
+            self.leg_ops[f"{name}_rs"] = 0
+            self.leg_ops[f"{name}_ag"] = 0
+        self.leg_ops[f"{self._level_names[-1]}_ar"] = 0
+        self.leg_ops["local_gather"] = 0
+        self.leg_ops["cross_gather"] = 0
         self.leg_bytes = dict(self.leg_ops)
 
     def enabled(self, response: Response,
@@ -85,11 +103,12 @@ class HierarchicalTcpBackend(CollectiveBackend):
                 # shm regions cannot represent.
                 and _accum_dtype(wire_dtype) == wire_dtype)
 
-    # -- allreduce: RS(local) -> AR(cross) -> AG(local) -------------------
+    # -- allreduce: RS(levels 0..k-1) -> AR(top) -> AG(k-1..0) ------------
     def allreduce(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:
         from .base import accum_dtype as _accum_dtype
 
+        self.last_algo = "hierarchical"
         buf = self.pack_fusion_buffer(response, entries)
         buf = self.scale_buffer(buf, response.prescale_factor)
         wire_dtype = buf.dtype
@@ -102,57 +121,66 @@ class HierarchicalTcpBackend(CollectiveBackend):
         # as a warning instead of an HVD601 error, so no suppression.
         if self._use_shm_legs(wire_dtype, nbytes):
             return self._allreduce_shm_local(response, entries, buf)
-        # Accumulate ALL THREE legs in the widened dtype: each leg's
-        # round-trip through TcpCollectives returns its input dtype, so a
-        # 16-bit wire buffer would otherwise be rounded between legs —
-        # numerics diverging from the flat ring's single fp32 accumulation.
+        # Accumulate ALL legs in the widened dtype: each leg's round-trip
+        # through TcpCollectives returns its input dtype, so a 16-bit wire
+        # buffer would otherwise be rounded between legs — numerics
+        # diverging from the flat ring's single fp32 accumulation.
         buf = np.ascontiguousarray(buf.astype(_accum_dtype(wire_dtype),
                                               copy=False))
+        names = self._level_names
+        item = wire_dtype.itemsize
 
-        lsize = self.local.size
-        base, rem = divmod(buf.size, lsize)
-        sizes = [base + (1 if i < rem else 0) for i in range(lsize)]
-        bounds = np.cumsum([0] + sizes)
-
-        # Leg 1: reduce-scatter across the local (intra-host) mesh; this
-        # rank ends up owning the fully host-reduced shard local_rank.
-        self._act_start(entries, "LOCAL_REDUCESCATTER")
-        try:
-            shard = self.local.reduce_scatter(buf, bounds)
-        finally:
-            self._act_end(entries)
-        self.leg_ops["local_rs"] += 1
-        self.leg_bytes["local_rs"] += nbytes
-
-        # Leg 2: allreduce the owned shard across hosts (same local_rank on
-        # every host holds the same shard index, so the cross mesh is
-        # exactly the set of peers sharing this shard).  Only 1/local_size
-        # of the payload crosses the slow axis — the point of the schedule.
-        # Shard bounds are a pure function of (payload size, local_size):
-        # every member of the cross mesh shares one shard index, so the
-        # leg set is identical within the sub-mesh executing it —
-        # symmetric-per-submesh, demoted by hvdflow's SUBMESH_ATTRS rule
-        # rather than suppressed.
-        if shard.size:
-            self._act_start(entries, "CROSS_ALLREDUCE")
+        # Descend: reduce-scatter through every inner level; after level i
+        # this rank owns shard index levels[i].rank of the previous shard.
+        # Shard bounds at each level are a pure function of (payload size,
+        # level sizes), so every member of each sub-mesh runs an identical
+        # leg set — symmetric-per-submesh, demoted by hvdflow's
+        # SUBMESH_ATTRS rule rather than suppressed.
+        shard = buf
+        sizes_stack: list[list[int]] = []
+        for i, level in enumerate(self.levels[:-1]):
+            base, rem = divmod(shard.size, level.size)
+            sizes = [base + (1 if j < rem else 0)
+                     for j in range(level.size)]
+            bounds = np.cumsum([0] + sizes)
+            self._act_start(entries, f"{names[i].upper()}_REDUCESCATTER")
             try:
-                shard = self.cross.allreduce(np.ascontiguousarray(shard))
+                shard = level.reduce_scatter(
+                    np.ascontiguousarray(shard), bounds)
             finally:
                 self._act_end(entries)
-        self.leg_ops["cross_ar"] += 1
-        self.leg_bytes["cross_ar"] += \
-            shard.size * wire_dtype.itemsize  # analytic wire volume
+            sizes_stack.append(sizes)
+            self.leg_ops[f"{names[i]}_rs"] += 1
+            self.leg_bytes[f"{names[i]}_rs"] += \
+                int(bounds[-1]) * item  # analytic wire volume of the leg
 
-        # Leg 3: allgather the reduced shards back across the local mesh.
-        self._act_start(entries, "LOCAL_ALLGATHER")
-        try:
-            full = self.local.allgatherv(shard.reshape(-1), sizes)
-        finally:
-            self._act_end(entries)
-        self.leg_ops["local_ag"] += 1
-        self.leg_bytes["local_ag"] += nbytes
+        # Top leg: allreduce the owned shard across the slowest axis; only
+        # 1/prod(inner sizes) of the payload crosses it — the point of the
+        # schedule.  (Empty shards — more inner ranks than elements — skip
+        # the exchange but still count the leg, matching 2-level behavior.)
+        cross = self.levels[-1]
+        if shard.size:
+            self._act_start(entries, f"{names[-1].upper()}_ALLREDUCE")
+            try:
+                shard = cross.allreduce(np.ascontiguousarray(shard))
+            finally:
+                self._act_end(entries)
+        self.leg_ops[f"{names[-1]}_ar"] += 1
+        self.leg_bytes[f"{names[-1]}_ar"] += shard.size * item
 
-        full = self.scale_buffer(full, response.postscale_factor)
+        # Ascend: allgather the reduced shards back out, innermost last,
+        # mirroring the descend exactly.
+        for i in range(len(self.levels) - 2, -1, -1):
+            level = self.levels[i]
+            self._act_start(entries, f"{names[i].upper()}_ALLGATHER")
+            try:
+                shard = level.allgatherv(shard.reshape(-1), sizes_stack[i])
+            finally:
+                self._act_end(entries)
+            self.leg_ops[f"{names[i]}_ag"] += 1
+            self.leg_bytes[f"{names[i]}_ag"] += shard.size * item
+
+        full = self.scale_buffer(shard, response.postscale_factor)
         full = full.astype(wire_dtype, copy=False)
         self.unpack_fusion_buffer(full, response, entries)
         return Status.ok()
@@ -269,6 +297,7 @@ class HierarchicalTcpBackend(CollectiveBackend):
         unpack_fused_allgather): rank-major, entry-major within a rank;
         the global rank order is host-major × local-rank-major, so
         concatenating host blocks reproduces it."""
+        self.last_algo = "hierarchical"
         lsize = self.local.size
         csize = self.cross.size
         crank = self.cross.rank

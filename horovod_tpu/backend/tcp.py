@@ -58,7 +58,11 @@ class TcpCollectives:
 
     def __init__(self, mesh: PeerMesh,
                  segment_bytes: int | None = None,
-                 fused: bool | None = None) -> None:
+                 fused: bool | None = None,
+                 ring_order: list[int] | None = None,
+                 torus: tuple[int, int] | None = None,
+                 algo: str | None = None,
+                 tree_threshold: int | None = None) -> None:
         self.mesh = mesh
         self.rank = mesh.rank
         self.size = mesh.size
@@ -67,6 +71,38 @@ class TcpCollectives:
         # ResponseList.tuned_segment_bytes); 0 = monolithic receives.
         self.segment_bytes = config.SEGMENT_BYTES.get() \
             if segment_bytes is None else int(segment_bytes)
+        # Topology-aware ring order (common/topology.py): a permutation
+        # of ranks in ring-walk order.  The allreduce ring sends to the
+        # NEXT position and chunk ownership follows position, so a torus
+        # snake / host-grouped order keeps every hop on a neighbor link.
+        # Identity (the default) reproduces the pre-topology schedule
+        # bit-for-bit.  The permutation is launcher-uniform
+        # (HOROVOD_TOPOLOGY), so positions are rank-symmetric.
+        if ring_order is not None:
+            order = [int(r) for r in ring_order]
+            assert sorted(order) == list(range(self.size)), order
+            self._order = order
+            self._pos = order.index(self.rank)
+        else:
+            self._order = list(range(self.size))
+            self._pos = self.rank
+        # Declared torus shape (rows, cols) with rank = row*cols + col;
+        # None = no torus, the two-phase algorithm is ineligible.
+        self._torus = None
+        if torus is not None and torus[0] * torus[1] == self.size:
+            self._torus = (int(torus[0]), int(torus[1]))
+        # Allreduce algorithm selection (HOROVOD_ALGO) and the small-
+        # tensor crossover (HOROVOD_TREE_THRESHOLD_BYTES).  Both are
+        # runtime-tunable through ResponseList.tuned_algo /
+        # tuned_tree_threshold — applied before dispatch on every rank,
+        # so selection (a pure function of these fields and the
+        # negotiated payload size) can never diverge across ranks.
+        self.algo = config.ALGO.get() if algo is None else str(algo)
+        self.tree_threshold = config.TREE_THRESHOLD_BYTES.get() \
+            if tree_threshold is None else int(tree_threshold)
+        # Algorithm the last allreduce actually executed (telemetry's
+        # algo= label reads it through the owning backend).
+        self.last_algo = "ring"
         # Fused single-pass codec kernels (compress/fused.py) vs the
         # reference per-chunk dequant/requant chain — runtime-tunable
         # through ResponseList.tuned_fused, swept by the autotuner.
@@ -180,18 +216,74 @@ class TcpCollectives:
             self.mesh.recv_raw_into(frm, view)
         return view
 
+    # -- algorithm selection --------------------------------------------
+    def _select_algo(self, nbytes: int) -> str:
+        """Pick the allreduce algorithm for an `nbytes` payload.
+
+        A pure function of rank-symmetric inputs only: the negotiated
+        payload size, the launcher-uniform HOROVOD_ALGO /
+        HOROVOD_TREE_THRESHOLD_BYTES / HOROVOD_TOPOLOGY knobs, and the
+        coordinator-broadcast tuned_algo / tuned_tree_threshold fields —
+        so every rank of a response picks the identical algorithm (the
+        deadlock-freedom invariant).  Feasibility fallbacks are
+        themselves symmetric (world size and torus declaration are
+        world-constant)."""
+        algo = self.algo
+        if algo == "auto":
+            if 0 < self.tree_threshold and nbytes <= self.tree_threshold \
+                    and self.size > 2:
+                algo = "tree"
+            elif self._torus is not None:
+                algo = "torus"
+            else:
+                algo = "ring"
+        if algo == "rhd" and (self.size & (self.size - 1)) != 0:
+            algo = "tree"      # halving/doubling needs a power-of-two world
+        if algo == "torus" and self._torus is None:
+            algo = "ring"
+        if self.size <= 2 and algo in ("tree", "rhd", "torus"):
+            # Two ranks: every schedule degenerates to the same single
+            # exchange; keep the ring (native fast path, fewer frames).
+            algo = "ring"
+        return algo
+
     # -- allreduce ------------------------------------------------------
     def allreduce(self, buf: np.ndarray) -> np.ndarray:
-        """In-place-style ring allreduce; returns the reduced buffer."""
-        n, rank, size = buf.size, self.rank, self.size
+        """In-place-style allreduce; returns the reduced buffer.
+
+        Dispatches per payload size (see _select_algo): segmented ring
+        for bandwidth-bound tensors, binomial tree / recursive
+        halving-doubling for latency-bound ones, the two-phase torus
+        schedule on a declared torus.  All variants reduce in the
+        widened accumulation dtype end-to-end; fp32 results may differ
+        from the ring in the last ulp where the accumulation ORDER
+        differs (tree root adds in rank order, rhd adds pairwise) —
+        integer dtypes are exact everywhere."""
+        n, size = buf.size, self.size
         if size == 1:
             return buf
+        algo = self._select_algo(buf.size * buf.dtype.itemsize)
+        self.last_algo = algo
+        if algo != "ring":
+            acc = np.ascontiguousarray(
+                buf.astype(_accum_dtype(buf.dtype), copy=True))
+            if algo == "tree":
+                acc = self._allreduce_tree(acc)
+            elif algo == "rhd":
+                acc = self._allreduce_rhd(acc)
+            else:
+                acc = self._allreduce_torus(acc)
+            return acc.astype(buf.dtype, copy=False)
+        pos = self._pos
         acc = buf.astype(_accum_dtype(buf.dtype), copy=True)
-        # Chunk boundaries: chunk i = [bounds[i], bounds[i+1])
+        # Chunk boundaries: chunk i = [bounds[i], bounds[i+1]), owned by
+        # ring POSITION i (identity order: position == rank, the
+        # pre-topology schedule unchanged).
         base, rem = divmod(n, size)
         sizes = [base + (1 if i < rem else 0) for i in range(size)]
         bounds = np.cumsum([0] + sizes)
-        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        nxt = self._order[(pos + 1) % size]
+        prv = self._order[(pos - 1) % size]
 
         # Native C++ ring (same schedule, GIL released, SIMD adds); falls
         # through to the Python ring for unsupported dtypes/toolchains.
@@ -210,15 +302,17 @@ class TcpCollectives:
         if native_ok and \
                 native.ring_allreduce(self.mesh._socks[nxt].fileno(),
                                       self.mesh._socks[prv].fileno(),
-                                      acc, rank, size):
+                                      acc, pos, size):
             # The native path writes the raw fds directly; account its
             # known ring volume so the mesh byte counters stay truthful
-            # (2(N-1) chunk sends per rank, uneven chunk split).
-            sent = sum(sizes[(rank - s) % size] +
-                       sizes[(rank + 1 - s) % size]
+            # (2(N-1) chunk sends per rank, uneven chunk split).  The C
+            # loop's schedule is indexed by ring position: handing it
+            # `pos` and the permuted neighbor fds IS the topology ring.
+            sent = sum(sizes[(pos - s) % size] +
+                       sizes[(pos + 1 - s) % size]
                        for s in range(size - 1)) * acc.dtype.itemsize
-            rcvd = sum(sizes[(rank - s - 1) % size] +
-                       sizes[(rank - s) % size]
+            rcvd = sum(sizes[(pos - s - 1) % size] +
+                       sizes[(pos - s) % size]
                        for s in range(size - 1)) * acc.dtype.itemsize
             with self.mesh._lock:
                 self.mesh.bytes_sent += sent
@@ -228,14 +322,14 @@ class TcpCollectives:
                 self.mesh._tm_count_recv(prv, rcvd)
             return acc.astype(buf.dtype, copy=False)
 
-        # Reduce-scatter: after step s, rank owns-partial chunk
-        # (rank - s) % size.  Send the chunk we just accumulated straight
+        # Reduce-scatter: after step s, this position owns-partial chunk
+        # (pos - s) % size.  Send the chunk we just accumulated straight
         # from the accumulator (zero copy — never re-mutated while queued:
-        # step s writes chunk (rank-s-1), which is not sent until s+1) and
+        # step s writes chunk (pos-s-1), which is not sent until s+1) and
         # accumulate the incoming chunk segment-by-segment.
         for step in range(size - 1):
-            send_idx = (rank - step) % size
-            recv_idx = (rank - step - 1) % size
+            send_idx = (pos - step) % size
+            recv_idx = (pos - step - 1) % size
             self.mesh.send_async(
                 nxt, _bv(acc[bounds[send_idx]:bounds[send_idx + 1]]))
             self._recv_accum(prv, acc[bounds[recv_idx]:bounds[recv_idx + 1]])
@@ -243,8 +337,8 @@ class TcpCollectives:
         # Ring allgather of the fully reduced chunks, received straight
         # into their final position in the accumulator.
         for step in range(size - 1):
-            send_idx = (rank + 1 - step) % size
-            recv_idx = (rank - step) % size
+            send_idx = (pos + 1 - step) % size
+            recv_idx = (pos - step) % size
             self.mesh.send_async(
                 nxt, _bv(acc[bounds[send_idx]:bounds[send_idx + 1]]))
             self._recv_into(prv, acc[bounds[recv_idx]:bounds[recv_idx + 1]])
@@ -253,6 +347,200 @@ class TcpCollectives:
         # the result (the pre-channel code's per-step join guaranteed it).
         self.mesh.flush()
         return acc.astype(buf.dtype, copy=False)
+
+    # -- binomial tree primitives (small-tensor allreduce) --------------
+    def _tree_low(self) -> int:
+        """My subtree stride in the rank-0-rooted binomial tree: lowbit
+        of the rank, or the covering power of two at the root (the same
+        vrank schedule as broadcast(), with root pinned to 0)."""
+        if self.rank == 0:
+            low = 1
+            while low < self.size:
+                low <<= 1
+            return low
+        return self.rank & -self.rank
+
+    def _tree_gather(self, payload, item: int) -> bytearray | None:
+        """Binomial gather of one fixed-size `payload` per rank to rank
+        0: internal ranks concatenate their subtree's contributions
+        (subtree of rank r = ranks [r, r+lowbit(r)), so child r+m's
+        block lands at slot offset m) and forward the whole block to the
+        parent — log N rounds, and the root ends holding all N
+        contributions ordered BY RANK.  Returns the slot buffer on rank
+        0, None elsewhere.  Latency-path only: the root's O(N·item)
+        memory is exactly why selection caps this at the tree
+        threshold."""
+        size, rank = self.size, self.rank
+        low = self._tree_low()
+        span = min(low, size - rank)        # my subtree = [rank, rank+span)
+        block: bytearray | None = None
+        if span > 1:
+            block = bytearray(span * item)
+            block[0:item] = payload
+        # Children rank+m, ascending m: the shallow subtrees drain first
+        # while the deepest (largest m) is still gathering.
+        m = 1
+        while m < low:
+            child = rank + m
+            if child < size:
+                cspan = min(m, size - child)
+                view = memoryview(block)[m * item:(m + cspan) * item]
+                nb = self.mesh.recv_begin(child)
+                assert nb == cspan * item, (nb, cspan, item)
+                self.mesh.recv_raw_into(child, view)
+            m <<= 1
+        if rank == 0:
+            return block
+        parent = rank - low
+        self.mesh.send_async(
+            parent, payload if block is None else memoryview(block))
+        return None
+
+    def _tree_bcast_into(self, view: memoryview) -> None:
+        """Binomial broadcast of rank 0's `view` into every rank's view
+        (the broadcast() schedule with root pinned to 0); flushes the
+        lanes so the caller may mutate the buffer on return."""
+        size, rank = self.size, self.rank
+        low = self._tree_low()
+        if rank != 0:
+            parent = rank - low
+            nb = self.mesh.recv_begin(parent)
+            assert nb == len(view), (nb, len(view))
+            self.mesh.recv_raw_into(parent, view)
+        m = low >> 1
+        while m:
+            child = rank + m
+            if child < size:
+                self.mesh.send_async(child, view)
+            m >>= 1
+        self.mesh.flush()
+
+    def _allreduce_tree(self, acc: np.ndarray) -> np.ndarray:
+        """Binomial-tree allreduce for latency-bound payloads: 2·log N
+        rounds instead of the ring's 2(N-1).  Contributions ride the
+        binomial gather to rank 0, the root accumulates all N in RANK
+        ORDER in the widened dtype (the same order — hence the same fp32
+        bit pattern — as the codec planes' owner-reduce), and the
+        reduced buffer returns on the mirrored binomial broadcast."""
+        n = acc.size
+        item = acc.nbytes
+        block = self._tree_gather(_bv(acc), item)
+        if block is not None:               # root: rank-order accumulate
+            for j in range(1, self.size):
+                arr = np.frombuffer(block, dtype=acc.dtype,
+                                    count=n, offset=j * item)
+                np.add(acc, arr, out=acc)
+        self._tree_bcast_into(_bv(acc))
+        return acc
+
+    # -- recursive halving-doubling (power-of-two worlds) ---------------
+    def _allreduce_rhd(self, acc: np.ndarray) -> np.ndarray:
+        """Recursive vector-halving/distance-doubling allreduce
+        (reference: gloo's CPU halving-doubling, Rabenseifner): log N
+        exchange rounds each moving half the live window — latency
+        O(log N) like the tree with no gather hotspot at the root.
+        Power-of-two worlds only (selection falls back to tree
+        otherwise).  Partner pairs at mask m share an identical window
+        (they agree on all lower bits), so the halves line up by
+        construction."""
+        size, rank = self.size, self.rank
+        lo, hi = 0, acc.size
+        steps: list[tuple[int, int, int]] = []
+        mask = 1
+        while mask < size:
+            partner = rank ^ mask
+            mid = (lo + hi) // 2
+            steps.append((lo, hi, mid))
+            if rank & mask:
+                # Keep the upper half: ship [lo, mid) and fold the
+                # partner's upper contribution into [mid, hi).  The sent
+                # region is never re-mutated before the partner consumed
+                # it (the mirrored doubling recv below happens only
+                # after the partner progressed past this very frame).
+                self.mesh.send_async(partner, _bv(acc[lo:mid]))
+                self._recv_accum(partner, acc[mid:hi])
+                lo = mid
+            else:
+                self.mesh.send_async(partner, _bv(acc[mid:hi]))
+                self._recv_accum(partner, acc[lo:mid])
+                hi = mid
+            mask <<= 1
+        # Distance-doubling allgather: replay the halving in reverse,
+        # exchanging my fully reduced window for the partner's.
+        for plo, phi, mid in reversed(steps):
+            mask >>= 1
+            partner = rank ^ mask
+            self.mesh.send_async(partner, _bv(acc[lo:hi]))
+            if lo == mid:                   # I kept the upper half
+                self._recv_into(partner, acc[plo:mid])
+            else:
+                self._recv_into(partner, acc[mid:phi])
+            lo, hi = plo, phi
+        self.mesh.flush()
+        return acc
+
+    # -- two-phase torus allreduce --------------------------------------
+    def _group_ring_reduce_scatter(self, group: list[int], k: int,
+                                   acc: np.ndarray,
+                                   bounds: np.ndarray) -> int:
+        """Ring reduce-scatter among `group` (I am group[k]) over the
+        caller's chunk bounds; returns the chunk index this member ends
+        up owning fully reduced — the flat ring's (k+1) % len(group)."""
+        m = len(group)
+        nxt, prv = group[(k + 1) % m], group[(k - 1) % m]
+        for step in range(m - 1):
+            si = (k - step) % m
+            ri = (k - step - 1) % m
+            self.mesh.send_async(nxt, _bv(acc[bounds[si]:bounds[si + 1]]))
+            self._recv_accum(prv, acc[bounds[ri]:bounds[ri + 1]])
+        return (k + 1) % m
+
+    def _group_ring_allgather(self, group: list[int], k: int,
+                              acc: np.ndarray, bounds: np.ndarray,
+                              own: int) -> None:
+        """Ring allgather among `group` of the fully reduced chunks,
+        starting from each member's owned chunk index."""
+        m = len(group)
+        nxt, prv = group[(k + 1) % m], group[(k - 1) % m]
+        for step in range(m - 1):
+            si = (own - step) % m
+            ri = (own - step - 1) % m
+            self.mesh.send_async(nxt, _bv(acc[bounds[si]:bounds[si + 1]]))
+            self._recv_into(prv, acc[bounds[ri]:bounds[ri + 1]])
+
+    def _group_ring_allreduce(self, group: list[int], k: int,
+                              seg: np.ndarray) -> None:
+        """In-place ring allreduce of `seg` among `group` (RS + AG over
+        sub-chunks of the segment)."""
+        m = len(group)
+        base, rem = divmod(seg.size, m)
+        sizes = [base + (1 if i < rem else 0) for i in range(m)]
+        bounds = np.cumsum([0] + sizes)
+        own = self._group_ring_reduce_scatter(group, k, seg, bounds)
+        self._group_ring_allgather(group, k, seg, bounds, own)
+
+    def _allreduce_torus(self, acc: np.ndarray) -> np.ndarray:
+        """Two-phase torus allreduce on a declared R×C grid (reference:
+        arXiv:1909.09756's 2-D schedule): ring reduce-scatter along my
+        ROW, ring allreduce of the owned chunk along my COLUMN, ring
+        allgather back along the row.  Every hop stays on a grid-
+        neighbor link, and each phase's ring spans only one axis —
+        2(C-1)/C + 2(R-1)/(R·C) bytes per link instead of the flat
+        ring's 2(N-1)/N over arbitrary-distance hops."""
+        rows, cols = self._torus
+        row, col = divmod(self.rank, cols)
+        row_group = [row * cols + j for j in range(cols)]
+        col_group = [i * cols + col for i in range(rows)]
+        base, rem = divmod(acc.size, cols)
+        sizes = [base + (1 if j < rem else 0) for j in range(cols)]
+        bounds = np.cumsum([0] + sizes)
+        own = self._group_ring_reduce_scatter(row_group, col, acc, bounds)
+        seg = acc[bounds[own]:bounds[own + 1]]
+        if seg.size and rows > 1:
+            self._group_ring_allreduce(col_group, row, seg)
+        self._group_ring_allgather(row_group, col, acc, bounds, own)
+        self.mesh.flush()
+        return acc
 
     # -- cast-codec allreduce (compress/ subsystem) ---------------------
     def cast_allreduce(self, buf: np.ndarray,
@@ -271,6 +559,18 @@ class TcpCollectives:
         astype chain.  Bitwise-identical results either way."""
         if self.size == 1:
             return buf
+        # Small-tensor leg: the binomial tree composes with the codec
+        # (whole-buffer contributions gather encoded, the root
+        # accumulates in rank order and rounds ONCE — bitwise identical
+        # to the owner-reduce below).  rhd/torus stay on the owner-
+        # reduce exchange: their windowed hops would need per-hop
+        # re-rounding, breaking the one-rounding contract.
+        wire_dtype = np.dtype(wire_dtype)
+        if self.size > 2 and self._select_algo(
+                buf.size * wire_dtype.itemsize) == "tree":
+            self.last_algo = "tree"
+            return self._cast_allreduce_tree(buf, wire_dtype)
+        self.last_algo = "ring"
         if self.fused:
             return self._cast_allreduce_fused(buf, wire_dtype)
         return self._cast_allreduce_reference(buf, wire_dtype)
@@ -404,6 +704,23 @@ class TcpCollectives:
         interoperate — both sides move one frame per peer per leg."""
         if self.size == 1:
             return buf
+        # Small-tensor tree leg (see cast_allreduce): selection keys on
+        # the LOGICAL fp32 bytes — the negotiated size every rank
+        # shares, independent of codec framing.  Auto-selection is
+        # additionally gated on chunk bounds aligning to quantization
+        # blocks: only then do the ring's per-chunk block stats equal the
+        # tree's whole-buffer stats, keeping the tree BITWISE identical
+        # to the owner-reduce (and to the shm plane's schedule — the
+        # cross-plane contract asserted in tests/test_compress.py).  The
+        # gate is a pure function of (n, size, block_size), all
+        # world-symmetric.  An explicitly pinned algo="tree" skips it —
+        # the operator traded last-ulp block-stat drift for latency.
+        aligned = buf.size % (self.size * block_size) == 0
+        if self.size > 2 and (aligned or self.algo == "tree") and \
+                self._select_algo(buf.size * 4) == "tree":
+            self.last_algo = "tree"
+            return self._quantized_allreduce_tree(buf, codec, block_size)
+        self.last_algo = "ring"
         if self.fused:
             return self._quantized_allreduce_fused(buf, codec, block_size)
         return self._quantized_allreduce_reference(buf, codec, block_size)
@@ -526,6 +843,86 @@ class TcpCollectives:
             self._m_leg[("return", False)].observe(
                 (time.perf_counter() - t0) * 1e3)
         out = np.concatenate(out_parts) if size > 1 else out_parts[0]
+        return out.astype(buf.dtype, copy=False)
+
+    # -- small-tensor codec legs on the binomial tree -------------------
+    def _cast_allreduce_tree(self, buf: np.ndarray,
+                             wire_dtype: np.dtype) -> np.ndarray:
+        """Cast-codec allreduce on the binomial tree: whole-buffer
+        wire-cast contributions gather to rank 0 in log N rounds, the
+        root widens + accumulates all N in RANK ORDER in fp32
+        (fk.cast_add — bitwise equal to the reference astype chain) and
+        rounds ONCE to the wire dtype, and the reduced wire image
+        returns on the binomial broadcast.  Same accumulation order and
+        single rounding as the owner-reduce gather leg — results are
+        bitwise identical to the flat codec path."""
+        n, size = buf.size, self.size
+        fk = self._fk
+        x = np.ascontiguousarray(buf).astype(wire_dtype, copy=False)
+        item = x.nbytes
+        t0 = time.perf_counter() if self._tm_on else 0.0
+        block = self._tree_gather(_bv(x), item)
+        if block is not None:               # root: rank-order accumulate
+            acc = fk.f32(("tcacc",), n)
+            acc[:] = 0.0
+            mv = memoryview(block)
+            for j in range(size):
+                fk.cast_add(mv[j * item:(j + 1) * item], wire_dtype,
+                            acc, ("tcin",))
+            out = acc.astype(wire_dtype)    # the ONE rounding
+        else:
+            out = np.empty(n, dtype=wire_dtype)
+        if self._tm_on:
+            self._m_leg[("gather", self.fused)].observe(
+                (time.perf_counter() - t0) * 1e3)
+        t0 = time.perf_counter() if self._tm_on else 0.0
+        self._tree_bcast_into(_bv(out))
+        if self._tm_on:
+            self._m_leg[("return", self.fused)].observe(
+                (time.perf_counter() - t0) * 1e3)
+        return out.astype(buf.dtype, copy=False)
+
+    def _quantized_allreduce_tree(self, buf: np.ndarray, codec,
+                                  block_size: int) -> np.ndarray:
+        """Quantized allreduce on the binomial tree: whole-buffer
+        ENCODED contributions gather to rank 0, the root dequantizes +
+        accumulates all N in RANK ORDER in fp32 (fk.decode_add — the
+        fused kernels are bitwise equal to the reference chain) and
+        requantizes ONCE, and every rank decodes the broadcast reduced
+        image.  Same accumulation order and single rounding as the
+        owner-reduce path; additionally bitwise identical to it when
+        the flat path's chunk bounds fall on quantization-block
+        boundaries (blockwise scales then agree — e.g. payloads
+        divisible by size × block_size), documented fp32 tolerance
+        otherwise."""
+        n, size = buf.size, self.size
+        fk = self._fk
+        x = np.ascontiguousarray(buf).astype(np.float32, copy=False)
+        t0 = time.perf_counter() if self._tm_on else 0.0
+        wire = fk.encode(x, codec, block_size, ("tqenc",))
+        item = wire.nbytes                  # deterministic in (n, codec)
+        block = self._tree_gather(_bv(wire), item)
+        if block is not None:               # root: rank-order accumulate
+            acc = fk.f32(("tqacc",), n)
+            acc[:] = 0.0
+            mv = memoryview(block)
+            for j in range(size):
+                fk.decode_add(mv[j * item:(j + 1) * item], n, codec,
+                              block_size, acc, ("tqin",))
+            reduced = np.ascontiguousarray(
+                fk.encode(acc, codec, block_size, ("tqred",)))
+        else:
+            reduced = np.empty(item, np.uint8)
+        if self._tm_on:
+            self._m_leg[("gather", self.fused)].observe(
+                (time.perf_counter() - t0) * 1e3)
+        t0 = time.perf_counter() if self._tm_on else 0.0
+        self._tree_bcast_into(_bv(reduced))
+        out = np.empty(n, np.float32)
+        fk.decode_into(reduced, n, codec, block_size, out, ("tqout",))
+        if self._tm_on:
+            self._m_leg[("return", self.fused)].observe(
+                (time.perf_counter() - t0) * 1e3)
         return out.astype(buf.dtype, copy=False)
 
     # -- reduce-scatter --------------------------------------------------
@@ -696,6 +1093,7 @@ class TcpBackend(CollectiveBackend):
             finally:
                 self._act_end(entries)
             buf = buf.astype(np_dtype, copy=False)
+            self.last_algo = "adasum"
         elif self.quantized_codec(response) is not None:
             self._act_start(entries, "TCP_QUANTIZED_ALLREDUCE")
             try:
@@ -704,24 +1102,28 @@ class TcpBackend(CollectiveBackend):
                     self.codec_block_size(response))
             finally:
                 self._act_end(entries)
+            self.last_algo = self.coll.last_algo
         elif wire_dt is not None:
             self._act_start(entries, "TCP_CAST_ALLREDUCE")
             try:
                 buf = self.coll.cast_allreduce(buf, wire_dt)
             finally:
                 self._act_end(entries)
+            self.last_algo = self.coll.last_algo
         else:
             self._act_start(entries, "TCP_RING_ALLREDUCE")
             try:
                 buf = self.coll.allreduce(buf)
             finally:
                 self._act_end(entries)
+            self.last_algo = self.coll.last_algo
         buf = self.scale_buffer(buf, response.postscale_factor)
         self.unpack_fusion_buffer(buf, response, entries)
         return Status.ok()
 
     def allgather(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:
+        self.last_algo = "ring"
         self._act_start(entries, "TCP_ALLGATHERV")
         try:
             dtype = to_numpy(response.tensor_type)
@@ -747,6 +1149,7 @@ class TcpBackend(CollectiveBackend):
     def broadcast(self, response: Response,
                   entries: list[TensorTableEntry]) -> Status:
         dtype = to_numpy(response.tensor_type)
+        self.last_algo = "tree"            # binomial broadcast schedule
         self._act_start(entries, "TCP_BCAST")
         try:
             for e in entries:
@@ -763,6 +1166,7 @@ class TcpBackend(CollectiveBackend):
 
     def alltoall(self, response: Response,
                  entries: list[TensorTableEntry]) -> Status:
+        self.last_algo = "pairwise"
         self._act_start(entries, "TCP_ALLTOALLV")
         try:
             for e in entries:
@@ -783,6 +1187,7 @@ class TcpBackend(CollectiveBackend):
         # True ring reduce-scatter: chunk bounds follow the per-rank dim-0
         # split (uneven allowed), (N-1)/N bytes per link (reference: the
         # ReduceScatter leg of nccl_operations.cc:187-398).
+        self.last_algo = "ring"
         size = self.coll.size
         if len(entries) > 1:
             # Multi-entry responses keep ONE fused ring (2(N-1) rounds on

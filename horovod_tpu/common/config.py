@@ -134,6 +134,38 @@ SEGMENT_BYTES = register(
     "segments of this many bytes, accumulating segment k while the NIC "
     "streams segment k+1 (comm/compute overlap; bit-identical numerics). "
     "0 disables segmentation (one monolithic receive+add per chunk).")
+TOPOLOGY = register(
+    "HOROVOD_TOPOLOGY", "", str,
+    "Physical layout declaration for topology-aware collectives: flat "
+    "(layout-oblivious) | host (two-level host x slot; rings keep "
+    "intra-host peers adjacent) | torus:RxC (R x C grid, rank = "
+    "row*C + col; rings walk grid neighbors and the two-phase torus "
+    "allreduce becomes eligible).  Empty = auto: host when the env "
+    "describes a homogeneous two-level layout, else flat.  Must be "
+    "launcher-uniform across ranks.")
+HOST_IDS = register(
+    "HOROVOD_HOST_IDS", "", str,
+    "World-wide rank-to-host-index map as comma-separated ints "
+    "(\"0,0,1,1\"), set by the launcher from the slot layout so topology "
+    "resolution can group ring orders by host even when the layout is "
+    "not homogeneous host-major (elastic re-assignments, uneven slots "
+    "per host).  Empty = derive from local/cross sizes.  Ignored unless "
+    "its length equals the world size.  Launcher-uniform across ranks.")
+ALGO = register(
+    "HOROVOD_ALGO", "auto", str,
+    "Eager-plane allreduce algorithm: auto (tree under "
+    "HOROVOD_TREE_THRESHOLD_BYTES, torus two-phase on a declared torus, "
+    "segmented ring otherwise) | ring | tree (binomial gather-to-root + "
+    "broadcast, O(log N) latency) | rhd (recursive halving-doubling; "
+    "power-of-two worlds, else tree) | torus.  Launcher-uniform; the "
+    "autotuner can retune it at runtime (ResponseList.tuned_algo).")
+TREE_THRESHOLD_BYTES = register(
+    "HOROVOD_TREE_THRESHOLD_BYTES", 64 * 1024, int,
+    "Payloads at or below this many wire bytes take the O(log N) tree "
+    "allreduce instead of the O(N)-step ring under HOROVOD_ALGO=auto "
+    "(latency-bound small tensors; the ring stays bandwidth-optimal "
+    "above it).  0 disables the small-tensor path; the autotuner sweeps "
+    "it (ResponseList.tuned_tree_threshold).")
 BATCH_D2D_MEMCOPIES = register(
     "HOROVOD_BATCH_D2D_MEMCOPIES", True, _parse_bool,
     "Fuse gather/scatter staging copies into batched device ops.")
@@ -659,6 +691,14 @@ AUTOTUNE_PIPELINE = register(
     "active streams, bounded by HOROVOD_NUM_STREAMS) by measured "
     "allreduce throughput before the Bayesian phase, broadcasting the "
     "winner to every rank.")
+BENCH_PROBE_BUDGET_S = register(
+    "HOROVOD_BENCH_PROBE_BUDGET_S", 25.0, float,
+    "Per-probe timeout for bench.py's accelerator probe (seconds).  A "
+    "probe that runs to this timeout means jax.devices() itself wedged "
+    "— after 2 consecutive timed-out probes the absence is definitive "
+    "and the CPU fallback starts immediately (2 x default 25 s keeps "
+    "it under a minute).  Probe CRASHES stay retryable on the watcher "
+    "schedule; only timeouts are terminal.")
 TRACK_ACCURACY = register(
     "HOROVOD_TRACK_ACCURACY", True, _parse_bool,
     "Compute the per-step training-accuracy metric in Trainer.step. "

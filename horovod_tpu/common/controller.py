@@ -163,6 +163,9 @@ class Controller:
         self.pending_tuned_pipeline: tuple[int, int] | None = None
         # Fused-codec-kernel proposal (0/1; compress/fused.py dispatch).
         self.pending_tuned_fused: int | None = None
+        # (algo index, tree threshold bytes) allreduce-algorithm proposal
+        # (common/topology.ALGO_NAMES; backend/tcp.py selection).
+        self.pending_tuned_algo: tuple[int, int] | None = None
         # Last request params per tensor, for cache insertion on every rank.
         self._last_request_params: dict[str, Request] = {}
 
@@ -281,7 +284,8 @@ class Controller:
                     self.pending_tuned_params is not None
                     or self.pending_tuned_codec is not None
                     or self.pending_tuned_pipeline is not None
-                    or self.pending_tuned_fused is not None):
+                    or self.pending_tuned_fused is not None
+                    or self.pending_tuned_algo is not None):
                 # Force one negotiation cycle so autotuned parameters reach
                 # every rank even in cache steady state.
                 coordinator.uncached_in_queue = True
@@ -560,6 +564,11 @@ class Controller:
             if self.pending_tuned_fused is not None:
                 response_list.tuned_fused = self.pending_tuned_fused
                 self.pending_tuned_fused = None
+            if self.pending_tuned_algo is not None:
+                algo, tree_threshold = self.pending_tuned_algo
+                response_list.tuned_algo = algo
+                response_list.tuned_tree_threshold = tree_threshold
+                self.pending_tuned_algo = None
             # Coordinator-assigned trace ids ride the broadcast wire
             # (the fp_* pattern): seq is offset past this cycle's cached
             # hits, which every rank prepends in the same order.

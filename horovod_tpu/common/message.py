@@ -313,6 +313,13 @@ class ResponseList:
     # flips HOROVOD_FUSED_KERNELS at runtime on every rank in the same
     # cycle (compress/fused.py single-pass legs vs the reference chain).
     tuned_fused: int = -1
+    # Autotuned allreduce algorithm (-1 = unchanged, else an index into
+    # common/topology.ALGO_NAMES) and tree/ring crossover threshold in
+    # bytes (-1 = unchanged).  Broadcast like every other tuned field and
+    # applied by all ranks BEFORE dispatch, so algorithm choice can never
+    # diverge across ranks (the deadlock-freedom invariant).
+    tuned_algo: int = -1
+    tuned_tree_threshold: int = -1
 
     def to_bytes(self, features: int = FEATURES_ALL) -> bytes:
         enc = Encoder()
@@ -323,6 +330,8 @@ class ResponseList:
         enc.svarint(self.tuned_segment_bytes)
         enc.svarint(self.tuned_num_streams)
         enc.svarint(self.tuned_fused)
+        enc.svarint(self.tuned_algo)
+        enc.svarint(self.tuned_tree_threshold)
         enc.uvarint(len(self.responses))
         for r in self.responses:
             r.encode(enc, features)
@@ -339,6 +348,8 @@ class ResponseList:
         segment = dec.svarint()
         streams = dec.svarint()
         fused = dec.svarint()
+        algo = dec.svarint()
+        tree_threshold = dec.svarint()
         n = dec.uvarint()
         return cls(responses=[Response.decode(dec, features)
                               for _ in range(n)],
@@ -348,4 +359,6 @@ class ResponseList:
                    tuned_codec=codec,
                    tuned_segment_bytes=segment,
                    tuned_num_streams=streams,
-                   tuned_fused=fused)
+                   tuned_fused=fused,
+                   tuned_algo=algo,
+                   tuned_tree_threshold=tree_threshold)

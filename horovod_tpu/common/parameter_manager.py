@@ -85,6 +85,24 @@ class ParameterManager:
         self._fused_scores: dict[int, float] = {}
         self._fused_index = 0
 
+        # Allreduce-algorithm sweep (rides HOROVOD_AUTOTUNE_PIPELINE):
+        # after the fused sweep, score (algo, tree threshold) candidates
+        # one window each and pin the winner through
+        # ResponseList.tuned_algo / tuned_tree_threshold.  Candidates are
+        # (ALGO_NAMES index, threshold bytes): the pure flat ring as the
+        # baseline, then "auto" selection at increasing tree/ring
+        # crossover thresholds — each one a different small-tensor
+        # latency/bandwidth trade on the live workload.
+        self._algo_candidates: list[tuple[int, int]] = []
+        if active and config.AUTOTUNE_PIPELINE.get():
+            from .topology import algo_index
+            ring, auto = algo_index("ring"), algo_index("auto")
+            self._algo_candidates = [
+                (ring, 0), (auto, 1 << 14), (auto, 1 << 16),
+                (auto, 1 << 18)]
+        self._algo_scores: dict[tuple[int, int], float] = {}
+        self._algo_index = 0
+
     def observe(self, tensor_names: list[str], nbytes: int) -> None:
         """Called once per background cycle with the allreduced bytes."""
         if not self._active or self._done:
@@ -170,6 +188,29 @@ class ParameterManager:
             logger.info("autotune fused-kernel sweep: %s -> fused=%d",
                         self._fused_scores, best)
             self._fused_candidates = []
+            return
+
+        if self._algo_candidates:
+            from .topology import ALGO_NAMES, algo_name
+            if self._algo_index > 0:
+                measured = self._algo_candidates[self._algo_index - 1]
+                self._algo_scores[measured] = score
+                self._log(*self._current, score,
+                          event=f"algo-{algo_name(measured[0])}"
+                                f"@{measured[1]}")
+            if self._algo_index < len(self._algo_candidates):
+                cand = self._algo_candidates[self._algo_index]
+                self._algo_index += 1
+                self._controller.pending_tuned_algo = cand
+                return
+            best = max(self._algo_scores, key=self._algo_scores.get)
+            self._controller.pending_tuned_algo = best
+            self._log(*self._current, self._algo_scores[best],
+                      event=f"algo-winner-{algo_name(best[0])}"
+                            f"@{best[1]}")
+            logger.info("autotune algo sweep: %s -> algo=%s threshold=%d",
+                        self._algo_scores, ALGO_NAMES[best[0]], best[1])
+            self._algo_candidates = []
             return
 
         import math

@@ -218,6 +218,10 @@ class GlobalState:
     # Runtime default wire codec (autotuner override via the ResponseList
     # tuned_codec field); None = honor HOROVOD_COMPRESSION.
     codec_override: str | None = None
+    # Resolved fabric layout (common/topology.Topology) from
+    # HOROVOD_TOPOLOGY + the launcher env; drives ring orders, the torus
+    # allreduce eligibility and the hierarchical level ladder.
+    topology: Any = None
     # resources to close at shutdown (sockets, rendezvous server, ...)
     resources: list[Any] = field(default_factory=list)
 
@@ -287,6 +291,27 @@ def init(*, rank: int | None = None, size: int | None = None,
         _global.rank, _global.size = rank, size
         _global.local_rank, _global.local_size = local_rank, local_size
         _global.cross_rank, _global.cross_size = cross_rank, cross_size
+        # Fabric layout (HOROVOD_TOPOLOGY; common/topology.py).  The knob
+        # is launcher-uniform, so every rank resolves the same Topology —
+        # the ring orders / torus shape derived below are rank-symmetric
+        # by construction.
+        from .common import topology as _topology
+        # The launcher exports the full rank→host-index map (hosts.py
+        # host_ids_env) for layouts that break the homogeneous host-major
+        # assumption behind local/cross-size auto-detection; a map whose
+        # length doesn't match the world (stale env across an elastic
+        # resize) is ignored rather than trusted.
+        host_ids = config.HOST_IDS.get()
+        hosts = None
+        if host_ids:
+            try:
+                parsed = tuple(int(x) for x in host_ids.split(","))
+            except ValueError:
+                parsed = ()
+            if len(parsed) == size:
+                hosts = parsed
+        topo = _topology.resolve(size, local_size, cross_size, hosts=hosts)
+        _global.topology = topo
         _global.cycle_time_ms = config.CYCLE_TIME.get()
         _global.shutdown_requested = False
         _global.tensor_queue.reset()
@@ -396,7 +421,28 @@ def init(*, rank: int | None = None, size: int | None = None,
             # layout is the launcher's homogeneous host-major assignment.
             hier_ar = config.HIERARCHICAL_ALLREDUCE.get()
             hier_ag = config.HIERARCHICAL_ALLGATHER.get()
-            if hier_ar or hier_ag:
+            if (hier_ar or hier_ag) and topo.kind == "torus":
+                # Declared torus (HOROVOD_TOPOLOGY=torus:RxC): the
+                # hierarchical ladder follows the grid axes — RS along
+                # the row, AR along the column, AG back along the row —
+                # so every leg rides neighbor links.  The knob is
+                # launcher-uniform and topology.parse degrades invalid
+                # shapes to flat identically on every rank, so the
+                # build decision is symmetric without a KV verdict.
+                from .backend.hierarchical import HierarchicalTcpBackend
+                t_row, t_col = divmod(rank, topo.cols)
+                row_mesh = PeerMesh(
+                    t_col, topo.cols, kv,
+                    scope=f"htor{epoch}.r{t_row}", timeout=timeout)
+                col_mesh = PeerMesh(
+                    t_row, topo.rows, kv,
+                    scope=f"htor{epoch}.c{t_col}", timeout=timeout)
+                _global.resources.extend([row_mesh, col_mesh])
+                backends.append(HierarchicalTcpBackend(
+                    TcpCollectives(row_mesh),
+                    TcpCollectives(col_mesh),
+                    allreduce_on=hier_ar, allgather_on=hier_ag))
+            elif hier_ar or hier_ag:
                 # Every rank must make the SAME build-or-skip decision: a
                 # rank skipping while peers form the sub-meshes would hang
                 # their rendezvous.  The knob env is launcher-set (uniform),
@@ -448,7 +494,16 @@ def init(*, rank: int | None = None, size: int | None = None,
                         TcpCollectives(cross_mesh),
                         allreduce_on=hier_ar, allgather_on=hier_ag,
                         shm_local=hier_shm))
-            tcp_coll = TcpCollectives(data_mesh)
+            # Topology-aware ring order + torus shape for the flat data
+            # plane: a non-flat layout permutes the ring walk (grid
+            # neighbors / host-adjacent slots) and, for a torus, enables
+            # the two-phase row×column allreduce.  Identity order keeps
+            # the pre-topology schedule bit-for-bit.
+            ring_order = topo.ring_order() if topo.kind != "flat" else None
+            torus_shape = (topo.rows, topo.cols) \
+                if topo.kind == "torus" else None
+            tcp_coll = TcpCollectives(data_mesh, ring_order=ring_order,
+                                      torus=torus_shape)
             tcp_backend = TcpBackend(tcp_coll)
             _global.tcp_collectives = [tcp_coll]
             if shm_backend is not None:
@@ -468,7 +523,9 @@ def init(*, rank: int | None = None, size: int | None = None,
                                        scope=f"data{epoch}.s{s}",
                                        timeout=timeout)
                 _global.resources.append(stream_mesh)
-                coll_s = TcpCollectives(stream_mesh)
+                coll_s = TcpCollectives(stream_mesh,
+                                        ring_order=ring_order,
+                                        torus=torus_shape)
                 _global.tcp_collectives.append(coll_s)
                 tcp_s = TcpBackend(coll_s)
                 basic_s = BasicBackend(size)
@@ -737,6 +794,16 @@ def _background_loop() -> None:
                 for be in mgr.backends:
                     if be.name == "shm":
                         be.fused = bool(response_list.tuned_fused)
+        # Allreduce-algorithm autotune applies BEFORE dispatch for the
+        # same reason as the pipeline knobs: all ranks flip on the same
+        # broadcast cycle, so _select_algo stays rank-symmetric.
+        if response_list.tuned_algo >= 0:
+            from .common.topology import algo_name
+            for coll in st.tcp_collectives:
+                coll.algo = algo_name(response_list.tuned_algo)
+        if response_list.tuned_tree_threshold >= 0:
+            for coll in st.tcp_collectives:
+                coll.tree_threshold = response_list.tuned_tree_threshold
 
         # Chaos harness (HOROVOD_CHAOS): deterministic response-level
         # fault injection fires HERE, on the coordinator-ordered
@@ -923,8 +990,10 @@ def _execute_response(st: GlobalState, response: Response,
             else:
                 status = manager.execute_operation(response, entries)
             if tm_on:
+                algo = getattr(backend, "last_algo", "none") \
+                    if backend is not None else "none"
                 _observe_collective(tm, response, plane, stream,
-                                    (time.monotonic() - t0) * 1e3)
+                                    (time.monotonic() - t0) * 1e3, algo)
         except Exception as exc:  # noqa: BLE001 - backend failure
             logger.error("collective execution failed: %s", exc)
             status = Status.unknown_error(str(exc))
@@ -961,7 +1030,7 @@ def _execute_response(st: GlobalState, response: Response,
 
 
 def _observe_collective(tm, response: Response, plane: str, stream: int,
-                        latency_ms: float) -> None:
+                        latency_ms: float, algo: str = "none") -> None:
     """Per-plane/per-codec collective latency+bytes and per-stream busy
     time (registry lookups are dict hits; metric objects are cached by
     the registry itself)."""
@@ -972,9 +1041,14 @@ def _observe_collective(tm, response: Response, plane: str, stream: int,
     tm.histogram(
         "horovod_collective_latency_ms",
         "End-to-end latency of one executed response, by data plane, "
-        "op and wire codec",
-        labels={"plane": plane, "op": op, "codec": codec}
+        "op, wire codec and collective algorithm",
+        labels={"plane": plane, "op": op, "codec": codec, "algo": algo}
     ).observe(latency_ms)
+    tm.counter(
+        "horovod_collective_algo_total",
+        "Executed responses by collective algorithm (ring / tree / rhd "
+        "/ torus / hierarchical / ... — the per-size selection verdict)",
+        labels={"algo": algo}).inc(1)
     tm.counter(
         "horovod_collective_bytes_total",
         "Uncompressed payload bytes of executed responses (allgather "

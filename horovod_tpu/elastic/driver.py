@@ -23,7 +23,8 @@ import time
 from typing import Callable
 
 from ..common.logging import logger
-from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
+from ..runner.hosts import (HostInfo, SlotInfo, get_host_assignments,
+                            host_ids_env)
 from .discovery import HostManager, HostUpdateResult
 from .registration import WorkerStateRegistry
 from .rpc import RpcClient
@@ -345,6 +346,11 @@ class ElasticDriver:
                 "epoch": self._epoch,
                 "notify_ts": self._notify_clock,
                 "hostname": info.hostname,
+                # Whole-round rank→host map: rounds formed on uneven
+                # slots-per-host break the homogeneous layout that
+                # local/cross-size topology auto-detection assumes, so the
+                # worker feeds this into topology.resolve(hosts=...).
+                "host_ids": host_ids_env(list(self._assignments.values())),
             }
 
     # ------------------------------------------------------------------

@@ -88,7 +88,7 @@ def launch_elastic(args, command: list[str], *,
     # worker) would make fresh workers wait for an epoch that never
     # forms or adopt a stale rank.
     for stale in ("HOROVOD_RENDEZVOUS_EPOCH", "HOROVOD_RANK",
-                  "HOROVOD_SIZE"):
+                  "HOROVOD_SIZE", "HOROVOD_HOST_IDS"):
         base_env.pop(stale, None)
     base_env.update(extra_env or {})
     base_env.update(args_to_env(args))

@@ -30,6 +30,7 @@ def _apply_assignment(assignment: dict) -> None:
         "HOROVOD_LOCAL_SIZE": assignment["local_size"],
         "HOROVOD_CROSS_RANK": assignment["cross_rank"],
         "HOROVOD_CROSS_SIZE": assignment["cross_size"],
+        "HOROVOD_HOST_IDS": assignment.get("host_ids", ""),
         "HOROVOD_RENDEZVOUS_EPOCH": assignment["epoch"],
     }
     for key, value in env.items():

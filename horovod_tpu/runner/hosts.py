@@ -108,6 +108,24 @@ def get_host_assignments(hosts: list[HostInfo], min_np: int,
     return assignments
 
 
+def host_ids_env(assignments: list[SlotInfo]) -> str:
+    """World-wide rank→host-index map ("0,0,1,1") for the slot layout.
+
+    Per-slot env (``SlotInfo.to_env``) tells each rank only its OWN host;
+    topology-aware collectives need the whole map to group ring orders by
+    host when the layout is not the homogeneous host-major shape that
+    local_size/cross_size auto-detection covers (elastic re-assignments,
+    uneven slots-per-host).  The string is identical for every rank —
+    launcher-uniform, so algo/ring-order decisions derived from it stay
+    rank-symmetric.
+    """
+    by_rank = sorted(assignments, key=lambda s: s.rank)
+    order: dict[str, int] = {}
+    for slot in by_rank:
+        order.setdefault(slot.hostname, len(order))
+    return ",".join(str(order[s.hostname]) for s in by_rank)
+
+
 def is_local_host(hostname: str) -> bool:
     """True for localhost and any 127/8 loopback alias.  Loopback aliases
     count as local everywhere (launcher AND programmatic run) so the

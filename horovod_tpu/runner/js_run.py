@@ -208,8 +208,9 @@ def adopt_jsm_env(env: dict | None = None) -> bool:
     rank, size = int(rank), int(size)
     hosts_string = env.get(JSRUN_HOSTS_ENV)
     if hosts_string:
-        from .hosts import get_host_assignments, parse_hosts
-        slot = get_host_assignments(parse_hosts(hosts_string), size)[rank]
+        from .hosts import get_host_assignments, host_ids_env, parse_hosts
+        assignments = get_host_assignments(parse_hosts(hosts_string), size)
+        slot = assignments[rank]
         jsm_local = env.get("JSM_NAMESPACE_LOCAL_RANK")
         if jsm_local is not None and int(jsm_local) != slot.local_rank:
             # jsrun placed this task somewhere other than the host-major
@@ -221,6 +222,7 @@ def adopt_jsm_env(env: dict | None = None) -> bool:
                 f"{slot.local_rank}; launch with {CPU_PER_SLOT_ENV} set "
                 "(ERF rankfile pins placement explicitly).")
         env.update(slot.to_env())
+        env["HOROVOD_HOST_IDS"] = host_ids_env(assignments)
         return True
     # Bare JSM/PMIx launch (no layout exported): rank/size and the local
     # identity are per-rank facts JSM provides directly.  The cross

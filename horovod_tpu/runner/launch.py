@@ -22,8 +22,8 @@ import threading
 
 from ..common.logging import logger
 from . import safe_shell_exec
-from .hosts import (get_host_assignments, parse_host_files, parse_hosts,
-                    SlotInfo)
+from .hosts import (get_host_assignments, host_ids_env, parse_host_files,
+                    parse_hosts, SlotInfo)
 from .network import RendezvousServer, free_port
 
 LOCAL_HOSTS = ("localhost", "127.0.0.1", "0.0.0.0")
@@ -291,6 +291,8 @@ def launch_static(args, command: list[str]) -> int:
     base_env.update(args_to_env(args))
     base_env.update(rendezvous_env(addr_spec, port,
                                    args.start_timeout))
+    # Full rank→host map for topology-aware ring orders (hosts.py).
+    base_env["HOROVOD_HOST_IDS"] = host_ids_env(slots)
 
     exit_codes = [None] * len(slots)
     # Workers run from launcher threads, so signal forwarding must go

@@ -20,7 +20,8 @@ import threading
 import traceback
 from typing import Any, Callable, Sequence
 
-from .hosts import (get_host_assignments, is_local_host, parse_hosts,
+from .hosts import (get_host_assignments, host_ids_env, is_local_host,
+                    parse_hosts,
                     ssh_argv)
 from .launch import rendezvous_env
 from .network import RendezvousClient, RendezvousServer
@@ -173,9 +174,11 @@ def run(func: Callable, args: Sequence = (), kwargs: dict | None = None,
     remote_procs: dict[int, subprocess.Popen] = {}
     remote_ranks: list[int] = []
     try:
+        host_ids = host_ids_env(slot_infos)
         for slot in slot_infos:
             slot_env = dict(env or {})
             slot_env.update(slot.to_env())
+            slot_env["HOROVOD_HOST_IDS"] = host_ids
             slot_env.update(rendezvous_env(addr, port, start_timeout))
             if is_local_host(slot.hostname):
                 parent, child = ctx.Pipe()

@@ -286,6 +286,41 @@ def battery_autotune(hvd, rank, size):
         (rank, tuned, np.asarray(gathered))
 
 
+def battery_algotune(hvd, rank, size):
+    """ISSUE 18 acceptance (the negotiated half): the autotuner's
+    algo x tree-threshold sweep proposes every candidate through
+    ResponseList.tuned_algo / tuned_tree_threshold and pins the winner
+    on EVERY rank's live TcpCollectives — selection inputs stay
+    rank-symmetric end to end (the deadlock-freedom invariant)."""
+    from horovod_tpu.core import _global
+
+    # Window ladder at WARMUP=1, STEPS_PER_SAMPLE=1, BO_MAX_SAMPLES=1:
+    # 1 warmup + 5 pipeline (4 candidates + pin) + 3 fused + 5 algo
+    # + 1 BO ~= 15 counted cycles; 70 allreduces give generous slack.
+    for i in range(70):
+        hvd.allreduce(np.ones(256, dtype=np.float32), op=hvd.Sum,
+                      name=f"algotune_{i % 3}")
+    if rank == 0:
+        pm = _global.parameter_manager
+        assert pm is not None and pm._done
+        assert pm._algo_candidates == []          # sweep ran to the end
+        assert len(pm._algo_scores) == 4, pm._algo_scores
+        assert _global.controller.pending_tuned_algo is None
+    hvd.barrier()
+    # The pinned winner reached every rank's dispatch layer identically
+    # (tuned_algo is applied BEFORE dispatch on the broadcast cycle).
+    from horovod_tpu.common.topology import ALGO_NAMES, algo_index
+    colls = _global.tcp_collectives
+    assert colls, "TCP data plane expected (HOROVOD_SHM_OPERATIONS=0)"
+    algo, thr = colls[0].algo, colls[0].tree_threshold
+    assert algo in ALGO_NAMES, algo
+    assert all((c.algo, c.tree_threshold) == (algo, thr) for c in colls)
+    gathered = np.asarray(hvd.allgather(
+        np.array([[float(algo_index(algo)), float(thr)]]),
+        name="algotune_verdict"))
+    assert np.all(gathered == gathered[0]), (rank, algo, thr, gathered)
+
+
 def battery_stall(hvd, rank, size):
     """Stall inspector end-to-end (reference: test/integration/
     test_stall.py + stall_inspector.cc): rank 0 submits a collective that
@@ -2855,6 +2890,9 @@ BATTERIES = {
     # negotiates sp_* off and stays green on the same step.
     "shard": battery_shard,
     "shard_compat": battery_shard_compat,
+    # ISSUE 18: autotuned algo x tree-threshold sweep, negotiated
+    # end-to-end through ResponseList.tuned_algo.
+    "algotune": battery_algotune,
 }
 
 def battery_fleetsim(port):
@@ -2936,6 +2974,14 @@ def main() -> int:
         os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"
         os.environ["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = "2"
         os.environ["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = "3"
+    if battery == "algotune":
+        os.environ["HOROVOD_AUTOTUNE"] = "1"
+        os.environ["HOROVOD_AUTOTUNE_PIPELINE"] = "1"
+        os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"
+        os.environ["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = "1"
+        os.environ["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = "1"
+        # Pin the TCP plane: the algo verdict lands on TcpCollectives.
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
     if battery == "telemetry":
         os.environ["HOROVOD_METRICS"] = "on"
         os.environ["HOROVOD_METRICS_WINDOW"] = "8"
@@ -3012,6 +3058,10 @@ def main() -> int:
         # Chaos batteries pin the TCP plane so the socket-level deadline
         # guards are the ones exercised (the shm plane has its own).
         os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+        # Pin the flat ring schedule: the chaos scripts target specific
+        # ring edges (e.g. rank 1 -> rank 2 delayed-send), which the
+        # small-tensor tree leg (ISSUE 18) would never traverse.
+        os.environ["HOROVOD_TREE_THRESHOLD_BYTES"] = "0"
         # Flight dumps land in /tmp, not the repo working directory.
         os.environ["HOROVOD_FLIGHT_FILE"] = \
             f"/tmp/hvd_flight_{os.environ['HOROVOD_RENDEZVOUS_EPOCH']}.json"
@@ -3058,8 +3108,12 @@ def main() -> int:
         os.environ["HOROVOD_FAULT_TIMEOUT"] = "3"
         os.environ["HOROVOD_CHAOS"] = "freeze:rank=1,op=1,ms=12000"
     if battery == "compress":
-        # Pin the TCP plane so its byte counters see the traffic.
+        # Pin the TCP plane so its byte counters see the traffic, and
+        # the ring schedule so the asserted 2(N-1)/N wire-byte fractions
+        # hold (the small-tensor tree of ISSUE 18 trades bytes for
+        # latency: whole-buffer contributions gather to the root).
         os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+        os.environ["HOROVOD_TREE_THRESHOLD_BYTES"] = "0"
     if battery == "compress_shm":
         os.environ["HOROVOD_SHM_OPERATIONS"] = "1"
         os.environ["HOROVOD_SHM_CAPACITY"] = str(1 << 20)

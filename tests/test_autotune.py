@@ -132,3 +132,15 @@ def test_autotune_propagates_across_ranks():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from test_multiprocess import _run_world
     _run_world(2, "autotune", timeout=120.0)
+
+
+def test_algo_sweep_propagates_across_ranks():
+    """2-process world with the pipeline sweep on: the coordinator's
+    algo x tree-threshold winner must reach every rank's live
+    TcpCollectives through ResponseList.tuned_algo /
+    tuned_tree_threshold, applied BEFORE dispatch (ISSUE 18)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_multiprocess import _run_world
+    _run_world(2, "algotune", timeout=120.0)

@@ -72,7 +72,11 @@ def test_probe_crash_is_retryable_with_capped_backoff(clock, monkeypatch,
     assert not os.path.exists(bench._probe_state_path())
 
 
-def test_absent_probe_keeps_full_interval(clock, monkeypatch):
+def test_absent_probe_reprobes_immediately(clock, monkeypatch):
+    """A single timed-out probe already burned its full probe budget of
+    wall time — the watcher re-probes IMMEDIATELY to reach the 2-strike
+    verdict fast (ISSUE 18), instead of sleeping a full interval; a
+    recovery on the second probe resets the strike count."""
     monkeypatch.setenv("HOROVOD_BENCH_WINDOW_SECONDS", "200")
     monkeypatch.setenv("HOROVOD_BENCH_PROBE_INTERVAL", "60")
     statuses = ["absent", "ok"]
@@ -82,9 +86,40 @@ def test_absent_probe_keeps_full_interval(clock, monkeypatch):
         bench, "_spawn_inner",
         lambda *a, **k: (0, {"metric": "resnet50_images_sec",
                              "value": 1.0, "backend": "tpu"}, "", False))
-    monkeypatch.setattr(bench, "_emit", lambda p: None)
+    emitted = []
+    monkeypatch.setattr(bench, "_emit", emitted.append)
     assert bench._orchestrate(_args()) == 0
-    assert clock.sleeps == [60.0]
+    assert clock.sleeps == [0.0]
+    assert emitted and emitted[0]["backend"] == "tpu"
+
+
+def test_two_absent_probes_are_definitive(clock, monkeypatch):
+    """TWO consecutive timed-out probes mean the accelerator is absent,
+    not resetting: the watcher goes straight to the CPU fallback instead
+    of re-timing-out across the whole round window (ISSUE 18)."""
+    monkeypatch.setenv("HOROVOD_BENCH_WINDOW_SECONDS", "3600")
+    monkeypatch.setenv("HOROVOD_BENCH_PROBE_INTERVAL", "60")
+    probes = []
+    monkeypatch.setattr(
+        bench, "_probe_backend_status",
+        lambda timeout: (probes.append(timeout), ("absent", None))[1])
+    calls = []
+
+    def _inner(args, extra_env, timeout):
+        calls.append(dict(extra_env))
+        return (0, {"metric": "resnet50_images_sec", "value": 0.5},
+                "", False)
+
+    monkeypatch.setattr(bench, "_spawn_inner", _inner)
+    emitted = []
+    monkeypatch.setattr(bench, "_emit", emitted.append)
+    assert bench._orchestrate(_args()) == 0
+    assert len(probes) == 2                  # the verdict, no ladder
+    assert calls == [{"JAX_PLATFORMS": "cpu"}]
+    assert emitted[0]["backend"] == "cpu-fallback"
+    # The round's un-spent budget is checkpointed: a re-run RESUMES the
+    # same window (the tunnel may come back mid-round).
+    assert bench._load_probe_state(3600.0)["attempts"] == 2
 
 
 def test_window_survives_multi_hour_process_death_gap(clock, monkeypatch):
@@ -135,10 +170,13 @@ def test_old_format_state_resumes_without_active_time(clock, monkeypatch):
 
 
 def test_exhausted_budget_falls_back_to_cpu_once(clock, monkeypatch):
+    """Transient probe crashes stay retryable (no 2-strike verdict), so
+    a tunnel that crash-loops for the whole round window exhausts the
+    budget on the backoff ladder and falls back to CPU exactly once."""
     monkeypatch.setenv("HOROVOD_BENCH_WINDOW_SECONDS", "100")
     monkeypatch.setenv("HOROVOD_BENCH_PROBE_INTERVAL", "60")
     monkeypatch.setattr(bench, "_probe_backend_status",
-                        lambda timeout: ("absent", None))
+                        lambda timeout: ("crash", None))
     calls = []
 
     def _inner(args, extra_env, timeout):

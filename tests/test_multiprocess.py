@@ -262,7 +262,16 @@ def test_hierarchical_collectives(local_plane):
                else "hierarchical_tcp", timeout=120.0)
 
 
-@pytest.mark.parametrize("size", [2, 4])
+@pytest.mark.parametrize("size", [
+    # The size-2 battery imports torch AND tensorflow in every worker
+    # (the serialization bottleneck noted below) for the framework
+    # delta-optimizer glue; the numpy-only size-4 twin keeps the
+    # two-level VHDD pairing algorithm in tier-1 and the torch/tf
+    # binding surfaces stay via test_torch_full_2rank /
+    # test_tensorflow_full_2rank (tier-1 wall clock, round 6).
+    pytest.param(2, marks=pytest.mark.slow),
+    4,
+])
 def test_adasum(size):
     # Generous timeout: workers import torch AND tensorflow for the
     # delta-optimizer checks, which serializes badly under CI load — so

@@ -155,6 +155,18 @@ def test_prometheus_exposition_golden_file():
     reg.counter("horovod_serve_prefill_stream_bytes_total",
                 "KV bytes streamed prefill->decode",
                 labels={"role": "sent"}).inc(8192)
+    # Core-dispatch collective metrics (ISSUE 18): the latency histogram
+    # carries the algo label and the per-algorithm verdict counter rides
+    # next to it.
+    reg.histogram("horovod_collective_latency_ms",
+                  "End-to-end latency of one executed response, by data "
+                  "plane, op, wire codec and collective algorithm",
+                  labels={"plane": "tcp", "op": "allreduce",
+                          "codec": "none", "algo": "tree"}).observe(2.0)
+    reg.counter("horovod_collective_algo_total",
+                "Executed responses by collective algorithm (ring / tree "
+                "/ rhd / torus / hierarchical / ... — the per-size "
+                "selection verdict)", labels={"algo": "tree"}).inc(1)
     reg.counter("hvd_test_bytes_total", "Bytes moved",
                 labels={"peer": "1"}).inc(2048)
     reg.counter("hvd_test_bytes_total", labels={"peer": "2"}).inc(1024)
