@@ -46,20 +46,6 @@ BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # reference, P100
 _BASELINES = {"resnet50": BASELINE_IMG_PER_SEC_PER_DEVICE,
               "resnet101": BASELINE_IMG_PER_SEC_PER_DEVICE}
 
-# Peak dense bf16 FLOP/s per chip, by substring of device_kind.
-# Public numbers from cloud.google.com/tpu/docs (v2-v6e system architecture
-# pages). Order matters: first match wins.
-_PEAK_FLOPS = (
-    ("v6", 918e12),       # Trillium / v6e
-    ("v5p", 459e12),
-    ("v5 lite", 197e12),  # v5e reports device_kind "TPU v5 lite"
-    ("v5e", 197e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
-
 _PROBE_CODE = (
     "import jax; d = jax.devices(); "
     "print('|'.join([str(len(d)), d[0].platform, d[0].device_kind]))"
@@ -136,11 +122,13 @@ def _init_backend(retries: int = 2, timeout: float = 150.0) -> dict:
 
 
 def _peak_flops(device_kind: str) -> float | None:
-    kind = device_kind.lower()
-    for key, peak in _PEAK_FLOPS:
-        if key in kind:
-            return peak
-    return None
+    """Chip peak from the shared perfscope table (the Trainer, the
+    serving replica and the bench all read PEAK_FLOPS_TABLE through
+    telemetry/perfmodel.py).  None for unknown kinds so the headline
+    "mfu" stays null rather than nominal-1TFLOP/s noise."""
+    from horovod_tpu.telemetry import perfmodel
+    peak = perfmodel.peak_flops(device_kind)
+    return None if peak == perfmodel.NOMINAL_PEAK_FLOPS else peak
 
 
 def _step_flops(trainer, state, batch) -> float | None:
@@ -369,6 +357,22 @@ def _orchestrate(args) -> int:
     state = _load_probe_state(window)
     crash_streak = 0
     absent_streak = 0
+    # Failure forensics (never an empty failure round): every probe and
+    # attempt outcome lands in a bounded history, and every terminal
+    # payload carries the classification + the window accounting below.
+    history: list[dict] = []
+    exit_reason = "cpu-pinned" if cpu_pinned else "window-exhausted"
+
+    def _window_accounting() -> dict:
+        return {"attempts": state["attempts"],
+                "probe_window_s": round(
+                    time.time() - state["window_start"], 1),
+                "probe_active_s": round(state["active_s"], 1)}
+
+    def _note(status: str, delay: float, **extra) -> None:
+        history.append({"probe": state["attempts"], "status": status,
+                        "delay_s": round(delay, 1), **extra})
+        del history[:-20]          # bounded: the last 20 events
 
     def _tick(cap: float) -> None:
         """Advance the active-time budget: wall time since the last
@@ -409,13 +413,11 @@ def _orchestrate(args) -> int:
                     not str(payload.get("metric", "")
                             ).endswith("_failed") and \
                     payload.get("backend") != "cpu-fallback":
-                payload["attempts"] = state["attempts"]
-                payload["probe_window_s"] = round(
-                    time.time() - state["window_start"], 1)
-                payload["probe_active_s"] = round(state["active_s"], 1)
+                payload.update(_window_accounting())
                 _clear_probe_state()
                 _emit(payload)
                 return 0
+            _note("attempt-failed", interval, rc=rc, oom=oom)
             print(f"bench: attempt {state['attempts']} failed "
                   f"(rc={rc}): {err}", file=sys.stderr)
             if oom:
@@ -435,7 +437,10 @@ def _orchestrate(args) -> int:
                                  "ELEMENTS — 0 forces the streaming "
                                  "cross-entropy path): "
                                  f"{err[-300:]}"),
-                       "attempts": state["attempts"]})
+                       "failure": {"class": "deterministic-oom",
+                                   "retryable": False},
+                       "backoff": history,
+                       **_window_accounting()})
                 return 0
             delay = interval
         elif status == "crash":
@@ -445,12 +450,14 @@ def _orchestrate(args) -> int:
             crash_streak += 1
             absent_streak = 0
             delay = min(5.0 * (2.0 ** (crash_streak - 1)), interval)
+            _note("probe-crash", delay, streak=crash_streak)
             print(f"bench: probe {state['attempts']}: transient probe "
                   f"crash (#{crash_streak} in a row); retrying in "
                   f"{delay:.0f}s", file=sys.stderr)
         else:
             crash_streak = 0
             absent_streak += 1
+            _note("probe-absent", 0.0, streak=absent_streak)
             if absent_streak >= 2:
                 # Two consecutive full-budget timeouts: the tunnel is not
                 # merely resetting, it is absent — classify as definitive
@@ -463,6 +470,7 @@ def _orchestrate(args) -> int:
                       f"(HOROVOD_BENCH_PROBE_BUDGET_S={probe_budget:.0f})"
                       f" — definitive; starting CPU fallback",
                       file=sys.stderr)
+                exit_reason = "accelerator-absent"
                 _save_probe_state(state)
                 break
             # The timeout itself already burned probe_budget seconds of
@@ -476,6 +484,7 @@ def _orchestrate(args) -> int:
         if attempts_cap is not None and state["attempts"] >= attempts_cap:
             print(f"bench: HOROVOD_BENCH_PROBE_ATTEMPTS cap "
                   f"({attempts_cap}) reached", file=sys.stderr)
+            exit_reason = "probe-attempts-cap"
             break
         time.sleep(min(delay, max(window - state["active_s"], 0.0)))
 
@@ -485,18 +494,29 @@ def _orchestrate(args) -> int:
           file=sys.stderr)
     rc, payload, err, _ = _spawn_inner(args, {"JAX_PLATFORMS": "cpu"},
                                        timeout=900.0)
+    state["attempts"] += 1
     if rc == 0 and payload:
         payload["backend"] = "cpu-fallback"
-        payload["attempts"] = state["attempts"] + 1
-        payload["note"] = ("accelerator unavailable after "
-                           f"{state['attempts']} probe(s); numbers are "
-                           "CPU-only")
+        payload["note"] = ("accelerator unavailable "
+                           f"({exit_reason}); numbers are CPU-only")
+        payload.update(_window_accounting())
         _emit(payload)
         return 0
-    # Even CPU died — still one structured line, rc 0 per the contract.
+    # Even CPU died — still one structured line, rc 0 per the contract,
+    # and NEVER an empty round: the payload classifies the failure,
+    # carries the probe/backoff history and accounts for the watched
+    # window, so the trajectory records WHY instead of a bare zero.
+    _note("cpu-fallback-failed", 0.0, rc=rc)
     _emit({"metric": f"{args.model}_failed", "value": 0.0, "unit": "error",
            "vs_baseline": 0.0, "backend": "none",
-           "error": f"all attempts failed; last: rc={rc} {err[-500:]}"})
+           "error": f"all attempts failed; last: rc={rc} {err[-500:]}",
+           "failure": {"class": "cpu-fallback-crash",
+                       "probe_exit": exit_reason,
+                       "crash_streak": crash_streak,
+                       "absent_streak": absent_streak,
+                       "retryable": True},
+           "backoff": history,
+           **_window_accounting()})
     return 0
 
 
@@ -564,7 +584,10 @@ def main() -> int:
             traceback.print_exc()
             _emit({"metric": "eager_failed", "value": 0.0, "unit": "error",
                    "vs_baseline": 0.0,
-                   "error": f"{type(exc).__name__}: {exc}"})
+                   "error": f"{type(exc).__name__}: {exc}",
+                   "failure": {"class": "harness-exception",
+                               "exception": type(exc).__name__,
+                               "retryable": True}})
             return 1
     if args.model == "serve":   # CPU/localhost only — no tunnel exposure
         try:
@@ -574,7 +597,10 @@ def main() -> int:
             traceback.print_exc()
             _emit({"metric": "serve_failed", "value": 0.0, "unit": "error",
                    "vs_baseline": 0.0,
-                   "error": f"{type(exc).__name__}: {exc}"})
+                   "error": f"{type(exc).__name__}: {exc}",
+                   "failure": {"class": "harness-exception",
+                               "exception": type(exc).__name__,
+                               "retryable": True}})
             return 1
     if not args.inner:
         return _orchestrate(args)
@@ -588,7 +614,10 @@ def main() -> int:
         traceback.print_exc()
         _emit({"metric": f"{args.model}_failed", "value": 0.0,
                "unit": "error", "vs_baseline": 0.0,
-               "error": f"{type(exc).__name__}: {exc}"})
+               "error": f"{type(exc).__name__}: {exc}",
+               "failure": {"class": "inner-exception",
+                           "exception": type(exc).__name__,
+                           "retryable": True}})
         return 1
 
 
@@ -620,7 +649,9 @@ def bench_serve(args) -> int:
         if out.returncode != 0:
             _emit({"metric": f"serve_{name}_failed", "value": 0.0,
                    "unit": "error", "vs_baseline": 0.0,
-                   "error": out.stderr[-500:] or out.stdout[-500:]})
+                   "error": out.stderr[-500:] or out.stdout[-500:],
+                   "failure": {"class": "loadgen-crash", "rc": out.returncode,
+                               "retryable": True}})
             return None
         with open(output.replace("{rank}", "0")) as f:
             return json.load(f)

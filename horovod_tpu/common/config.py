@@ -265,6 +265,32 @@ STRAGGLER_THRESHOLD_MS = register(
     "which the coordinator logs a structured straggler warning and sets "
     "the straggler-rank gauge.")
 
+# --- perfscope roofline accounting (telemetry/perfmodel.py; ISSUE 19) -------
+PERF_PEAK_MBPS = register(
+    "HOROVOD_PERF_PEAK_MBPS", 0.0, float,
+    "Peak per-link bus bandwidth (MB/s) the perfscope roofline divides "
+    "measured busbw by (docs/observability.md).  0 = self-calibrate: "
+    "the best measured (plane, algo, codec, size-bucket) cell in the "
+    "ledger window IS the roofline, so efficiencies answer 'how far "
+    "below the best this fabric demonstrated' without a link spec.")
+PERF_PEAK_FLOPS = register(
+    "HOROVOD_PERF_PEAK_FLOPS", 0.0, float,
+    "Peak per-chip dense FLOP/s the MFU ledger divides by.  0 = the "
+    "published per-device_kind table (telemetry/perfmodel.py), with a "
+    "nominal 1e12 for unknown kinds (CPU dev boxes) so the MFU "
+    "trajectory stays populated and self-comparable.")
+PERF_TOLERANCE_PCT = register(
+    "HOROVOD_PERF_TOLERANCE_PCT", 10.0, float,
+    "Regression-gate tolerance: telemetry.perfcheck fails (exit 1, "
+    "structured finding) when a (plane, op, size-bucket) busbw cell or "
+    "the MFU drops more than this percentage below the baseline "
+    "ledger.")
+PERF_MIN_SAMPLES = register(
+    "HOROVOD_PERF_MIN_SAMPLES", 1, int,
+    "Observations a (plane, op, codec, algo, size-bucket) cell needs "
+    "before the perf ledger includes it (noise floor for the busbw "
+    "table and the perfcheck gate).")
+
 # --- Flight recorder (telemetry/flight.py; docs/observability.md) -----------
 FLIGHT = register(
     "HOROVOD_FLIGHT", True, _parse_bool,

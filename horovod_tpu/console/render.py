@@ -251,6 +251,21 @@ def _sec_kv(ep: Episode, lines: list[str]) -> None:
                      f"{row['p50']:>9.1f} {row['p99']:>9.1f}")
 
 
+def _sec_perf(ep: Episode, lines: list[str], topk: int) -> None:
+    """perfscope panel (ISSUE 19): the roofline-attributed busbw cells
+    and the train/serve step ledger, built from whatever metric evidence
+    the episode carries — full histograms from dumps, count/sum-only
+    snapshots from a live scrape (the p50 column degrades to 0 there)."""
+    lines.append("== perf ==")
+    from ..telemetry import perfmodel
+    ledger = perfmodel.build_ledger(ep.metrics)
+    if not ledger.get("busbw") and not ledger.get("step"):
+        lines.append("no busbw/MFU evidence (HOROVOD_METRICS off, or "
+                     "no collectives executed)")
+        return
+    lines.extend(perfmodel.ledger_summary(ledger, top=topk))
+
+
 def _sec_admission(ep: Episode, lines: list[str]) -> None:
     lines.append("== admission ==")
     outcomes = _counter_by_label(ep, "horovod_serve_requests_total",
@@ -283,6 +298,7 @@ def render(ep: Episode, topk: int = 8) -> str:
     _sec_autoscale(ep, lines, topk)
     _sec_kv(ep, lines)
     _sec_admission(ep, lines)
+    _sec_perf(ep, lines, topk)
     return "\n".join(lines) + "\n"
 
 

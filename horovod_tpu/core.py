@@ -993,7 +993,8 @@ def _execute_response(st: GlobalState, response: Response,
                 algo = getattr(backend, "last_algo", "none") \
                     if backend is not None else "none"
                 _observe_collective(tm, response, plane, stream,
-                                    (time.monotonic() - t0) * 1e3, algo)
+                                    (time.monotonic() - t0) * 1e3, algo,
+                                    st)
         except Exception as exc:  # noqa: BLE001 - backend failure
             logger.error("collective execution failed: %s", exc)
             status = Status.unknown_error(str(exc))
@@ -1030,14 +1031,17 @@ def _execute_response(st: GlobalState, response: Response,
 
 
 def _observe_collective(tm, response: Response, plane: str, stream: int,
-                        latency_ms: float, algo: str = "none") -> None:
-    """Per-plane/per-codec collective latency+bytes and per-stream busy
-    time (registry lookups are dict hits; metric objects are cached by
-    the registry itself)."""
+                        latency_ms: float, algo: str = "none",
+                        st: GlobalState | None = None) -> None:
+    """Per-plane/per-codec collective latency+bytes, per-stream busy
+    time, and the perfscope busbw observation (registry lookups are
+    dict hits; metric objects are cached by the registry itself)."""
     from .common.dtypes import element_size
     from .compress import CompressionCodec, codec_name
+    from .telemetry import perfmodel
     op = response.response_type.name.lower()
     codec = codec_name(CompressionCodec(response.codec))
+    nbytes = sum(response.tensor_sizes) * element_size(response.tensor_type)
     tm.histogram(
         "horovod_collective_latency_ms",
         "End-to-end latency of one executed response, by data plane, "
@@ -1053,13 +1057,43 @@ def _observe_collective(tm, response: Response, plane: str, stream: int,
         "horovod_collective_bytes_total",
         "Uncompressed payload bytes of executed responses (allgather "
         "counts per-rank first dims as elements)",
-        labels={"plane": plane, "op": op}
-    ).inc(sum(response.tensor_sizes)
-          * element_size(response.tensor_type))
+        labels={"plane": plane, "op": op}).inc(nbytes)
     tm.counter(
         "horovod_stream_busy_ms_total",
         "Cumulative execution time on each dispatch stream",
         labels={"stream": str(stream)}).inc(latency_ms)
+    # perfscope (ISSUE 19): bus bandwidth per (plane, op, codec, algo,
+    # size-bucket) — the nccl-tests normalization, so the ledger compares
+    # cells across algorithms and world sizes on one scale.
+    size = st.size if st is not None else 1
+    if size > 1 and nbytes > 0 and latency_ms > 0.0:
+        busbw = perfmodel.busbw_mbps(op, nbytes, latency_ms, size)
+        bucket = perfmodel.size_bucket(nbytes)
+        tm.histogram(
+            "horovod_collective_busbw_mbps",
+            "Bus bandwidth of one executed collective (busbw = algbw x "
+            "op factor, MB/s) by data plane, op, wire codec, algorithm "
+            "and payload size bucket — the perf ledger's raw table "
+            "(telemetry/perfmodel.py)",
+            labels={"plane": plane, "op": op, "codec": codec,
+                    "algo": algo, "size_bucket": bucket}
+        ).observe(busbw)
+        peak = tm.gauge(
+            "horovod_collective_busbw_peak_mbps",
+            "Best bus bandwidth any collective demonstrated on this "
+            "rank's data planes (the self-calibrated roofline when "
+            "HOROVOD_PERF_PEAK_MBPS is unset)")
+        if busbw > peak.value:
+            peak.set(busbw)
+        roof = float(config.PERF_PEAK_MBPS.get()) or peak.value
+        tm.gauge(
+            "horovod_collective_efficiency",
+            "Roofline-relative bus-bandwidth efficiency of the most "
+            "recent collective in each (plane, algo, size-bucket) cell: "
+            "busbw / peak (HOROVOD_PERF_PEAK_MBPS, else the "
+            "self-calibrated peak gauge)",
+            labels={"plane": plane, "algo": algo, "size_bucket": bucket}
+        ).set(busbw / roof if roof > 0.0 else 0.0)
 
 
 def _perform_operation(st: GlobalState, response: Response) -> None:

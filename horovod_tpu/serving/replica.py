@@ -199,6 +199,10 @@ class ReplicaExecutor:
         # so a request finished during the failure window is never
         # misclassified as lost (front dedups via batcher membership).
         self._unreported: list[dict] = []
+        # perfscope serve ledger (telemetry/perfmodel.py): smoothed
+        # accepted-tokens/s and the cached per-chip peak for serve MFU.
+        self._perf_tps = 0.0
+        self._peak_flops: float | None = None
         self.stats = {"offered": 0, "expired": 0, "served": 0,
                       "served_slo": 0, "lost": 0,
                       "latencies_ms": [], "completed_at": [],
@@ -828,6 +832,35 @@ class ReplicaExecutor:
         logger.warning("serving: grow %d->%d (join %d) at step %d",
                        old_size, new_size, join_id, self._step)
 
+    def _note_perf(self, tokens: int, ctx_sum: int, dt_s: float) -> None:
+        """Fold one decode step into the perfscope serve ledger gauges:
+        accepted tokens/s, analytic FLOPs per token at the step's mean
+        KV context, and their product over the chip peak (serve MFU) —
+        the step ledger telemetry/perfmodel.build_ledger merges."""
+        from ..telemetry import metrics as telemetry_metrics
+        tm = telemetry_metrics()
+        if not tm.enabled or tokens <= 0 or dt_s <= 0.0:
+            return
+        from ..telemetry import perfmodel
+        if self._peak_flops is None:
+            kind = ""
+            try:
+                kind = jax.local_devices()[0].device_kind
+            except Exception:  # noqa: BLE001 - backend probing only
+                pass
+            self._peak_flops = perfmodel.peak_flops(kind)
+        tps = tokens / dt_s
+        # EMA over steps: a serve step is milliseconds, and the raw
+        # per-step rate whipsaws with batch occupancy.
+        self._perf_tps = tps if self._perf_tps <= 0.0 \
+            else 0.8 * self._perf_tps + 0.2 * tps
+        flops_per_token = perfmodel.transformer_decode_flops(
+            self.model.cfg, ctx_sum / tokens)
+        tm.gauge("horovod_serve_tokens_per_sec").set(self._perf_tps)
+        tm.gauge("horovod_serve_flops_per_token").set(flops_per_token)
+        tm.gauge("horovod_serve_mfu").set(
+            self._perf_tps * flops_per_token / self._peak_flops)
+
     # -- the loop --------------------------------------------------------
     def _serve_step(self) -> bool:
         t0 = time.monotonic()
@@ -837,16 +870,23 @@ class ReplicaExecutor:
         if plan.stop:
             return False
         self._apply_plan(plan)
+        decoded = ctx_sum = 0
         if not self.is_prefill:
             if self.cfg.paged and self.prefill_rank_list:
                 self._integrate_prefills()
             self._decode_once()
+            for s in self.slots:
+                if s is not None and s.pending is None:
+                    decoded += 1
+                    ctx_sum += s.seq_len
             self._collect_completions()
         completions = self._exchange_completions()
         self._account(completions)
         if self.statesync is not None:
             self._statesync_boundary()
-        self.admission.observe_step_ms((time.monotonic() - t0) * 1e3)
+        dt = time.monotonic() - t0
+        self.admission.observe_step_ms(dt * 1e3)
+        self._note_perf(decoded, ctx_sum, dt)
         return True
 
     def serve_loop(self, *, stop_when=None, max_steps: int | None = None,

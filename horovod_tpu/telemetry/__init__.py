@@ -23,6 +23,10 @@ Module surface:
 - ``python -m horovod_tpu.telemetry.trace`` — cross-rank trace merge
   (flow-linked Perfetto output, clock offsets applied) and
   ``--critical-path`` step attribution.
+- :mod:`.perfmodel` / ``python -m horovod_tpu.telemetry.perf`` /
+  ``python -m horovod_tpu.telemetry.perfcheck`` — perfscope (ISSUE 19):
+  the algorithm-aware roofline cost model, the rank-merged PERF.json
+  busbw/MFU ledger, and the regression gate over the bench trajectory.
 """
 from __future__ import annotations
 
@@ -102,4 +106,10 @@ def summary() -> dict:
         out["stream_utilization"] = {
             s: (v / total if total else 0.0)
             for s, v in sorted(streams.items())}
+    # perfscope stamp (ISSUE 19): the single-rank busbw/MFU ledger, so
+    # every bench payload carries the numbers perfcheck gates against.
+    from . import perfmodel
+    ledger = perfmodel.build_ledger([reg.snapshot()])
+    if ledger.get("busbw") or ledger.get("step"):
+        out["perf"] = ledger
     return out

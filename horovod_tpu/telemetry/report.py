@@ -36,6 +36,51 @@ def _label_str(labels: dict) -> str:
     return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
 
 
+def _collective_tables(payload: dict) -> list[str]:
+    """The perfscope view of one dump: per-activity latency broken out
+    by the algo label (one row per plane/op/codec/algo instead of one
+    collapsed labels blob), and the busbw/efficiency rows the roofline
+    ledger is built from (telemetry/perfmodel.py)."""
+    lat_rows: list[list[str]] = []
+    bus_rows: list[list[str]] = []
+    eff = {}
+    for m in payload.get("metrics", []):
+        labels = m.get("labels", {})
+        if m["name"] == "horovod_collective_efficiency":
+            key = (labels.get("plane", ""), labels.get("algo", ""),
+                   labels.get("size_bucket", ""))
+            eff[key] = m.get("value", 0.0)
+    for m in payload.get("metrics", []):
+        if m.get("type") != "histogram":
+            continue
+        labels = m.get("labels", {})
+        if m["name"] == "horovod_collective_latency_ms":
+            lat_rows.append([
+                labels.get("plane", ""), labels.get("op", ""),
+                labels.get("codec", ""), labels.get("algo", ""),
+                str(m["count"]), f"{m['p50']:.3f}", f"{m['p99']:.3f}"])
+        elif m["name"] == "horovod_collective_busbw_mbps":
+            key = (labels.get("plane", ""), labels.get("algo", ""),
+                   labels.get("size_bucket", ""))
+            bus_rows.append([
+                labels.get("plane", ""), labels.get("op", ""),
+                labels.get("algo", ""), labels.get("size_bucket", ""),
+                str(m["count"]), f"{m['mean']:.1f}", f"{m['p50']:.1f}",
+                f"{eff[key]:.2f}" if key in eff else "-"])
+    parts = []
+    if lat_rows:
+        parts.append(_fmt_table(
+            sorted(lat_rows),
+            ["plane", "op", "codec", "algo", "count", "p50_ms",
+             "p99_ms"]))
+    if bus_rows:
+        parts.append(_fmt_table(
+            sorted(bus_rows),
+            ["plane", "op", "algo", "size_bucket", "samples",
+             "busbw_mbps", "p50_mbps", "efficiency"]))
+    return parts
+
+
 def summarize_dump(payload: dict) -> str:
     """Per-metric table for a HOROVOD_METRICS_FILE snapshot."""
     scalar_rows: list[list[str]] = []
@@ -58,6 +103,7 @@ def summarize_dump(payload: dict) -> str:
         parts.append(_fmt_table(
             hist_rows,
             ["histogram", "labels", "count", "mean", "p50", "p99", "sum"]))
+    parts.extend(_collective_tables(payload))
     if not scalar_rows and not hist_rows:
         parts.append("(no metrics recorded — was HOROVOD_METRICS=on?)")
     return "\n\n".join(parts)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from functools import partial
 from typing import Any, Callable, Sequence
 
@@ -147,6 +148,14 @@ class Trainer:
         # AOT executable from the compile→barrier→dispatch path: dispatched
         # directly so the warm-up compile is never repeated (see step()).
         self._compiled: Callable | None = None
+        # perfscope MFU ledger (telemetry/perfmodel.py): analytic FLOPs
+        # per step, resolved once from the first batch's shape, timed by
+        # the wall clock between step() dispatches (steady-state pipeline
+        # throughput — blocking on the result here would serialize the
+        # async dispatch the fit loop is careful to preserve).
+        self._step_flops: float | None = None
+        self._peak_flops: float | None = None
+        self._last_dispatch: float | None = None
 
     # -- initialization ----------------------------------------------------
     def init(self, rng: jax.Array, sample_batch: dict) -> TrainState:
@@ -328,7 +337,44 @@ class Trainer:
             check_vma=False)
         return jax.jit(mapped, donate_argnums=(0,))
 
+    def _note_step(self, batch: dict, first: bool) -> None:
+        """Fold one dispatched step into the MFU ledger gauges.  The
+        first call (carrying the compile) only arms the clock."""
+        from .telemetry import metrics as _telemetry_metrics
+        tm = _telemetry_metrics()
+        if not tm.enabled:
+            return
+        from .telemetry import perfmodel
+        now = time.monotonic()
+        prev, self._last_dispatch = self._last_dispatch, now
+        if self._step_flops is None:
+            x = _model_input(batch)
+            ndim = getattr(x, "ndim", 0)
+            self._step_flops = perfmodel.model_step_flops(
+                self.model, int(x.shape[0]) if ndim else 1,
+                seq=int(x.shape[1]) if ndim == 2 else 0,
+                image_size=int(x.shape[1]) if ndim == 4 else 224,
+                train=True)
+            tm.gauge("horovod_train_step_flops").set(self._step_flops)
+        if self._peak_flops is None:
+            kind = ""
+            try:
+                kind = jax.local_devices()[0].device_kind
+            except Exception:  # noqa: BLE001 - backend probing only
+                pass
+            # The step consumes the GLOBAL batch, so the denominator is
+            # the whole mesh's peak, not one chip's.
+            self._peak_flops = perfmodel.peak_flops(kind) \
+                * max(jax.device_count(), 1)
+        if first or prev is None:
+            return
+        dt = now - prev
+        tm.histogram("horovod_train_step_ms").observe(dt * 1e3)
+        tm.gauge("horovod_train_mfu").set(
+            perfmodel.mfu(self._step_flops, dt, self._peak_flops))
+
     def step(self, state: TrainState, batch: dict):
+        first = self._step_fn is None
         if self._step_fn is None:
             self._step_fn = self._build(state)
             from .parallel import multihost
@@ -350,14 +396,18 @@ class Trainer:
                     multihost.kv_barrier("trainer-step-compile")
         if self._compiled is not None:
             try:
-                return self._compiled(state, batch)
+                result = self._compiled(state, batch)
+                self._note_step(batch, first)
+                return result
             except TypeError:
                 # Shape/dtype drift vs the AOT signature (e.g. a ragged
                 # final batch): the executable rejects the call before
                 # dispatch (donated buffers untouched), so fall back to
                 # the jit path, which re-specializes per signature.
                 self._compiled = None
-        return self._step_fn(state, batch)
+        result = self._step_fn(state, batch)
+        self._note_step(batch, first)
+        return result
 
     # -- fit loop with callbacks ------------------------------------------
     def fit(self, state: TrainState, data, epochs: int = 1,

@@ -2028,6 +2028,39 @@ def battery_telemetry(hvd, rank, size):
     # contents after the world exits.
 
 
+def battery_perfscope(hvd, rank, size):
+    """perfscope smoke (ISSUE 19): a 2-rank HOROVOD_METRICS=on world
+    runs allreduces spanning three size buckets; every rank's registry
+    must carry busbw cells whose roofline-relative efficiency lands in
+    (0, 1.05] with a known algorithm label (at 2 ranks every schedule
+    degenerates to the ring).  The parent test merges the shutdown
+    dumps through the perf CLI and gates them with perfcheck."""
+    from horovod_tpu.core import _global
+    from horovod_tpu.telemetry import perfmodel
+
+    assert _global.telemetry.enabled
+    # 2 KiB / 32 KiB / 512 KiB payloads → 4KiB / 64KiB / 1MiB buckets.
+    for step in range(4):
+        for tag, n in (("s", 512), ("m", 8192), ("l", 131072)):
+            out = hvd.allreduce(np.ones(n, np.float32), op=hvd.Sum,
+                                name=f"pf_{tag}_{step}")
+            np.testing.assert_allclose(out, np.full(n, float(size)))
+    hvd.barrier()
+
+    ledger = perfmodel.build_ledger([_global.telemetry.snapshot()])
+    rows = ledger.get("busbw", [])
+    assert rows, "no busbw cells in the local registry"
+    buckets = {r["size_bucket"] for r in rows}
+    assert {"4KiB", "64KiB", "1MiB"} <= buckets, buckets
+    for r in rows:
+        assert 0.0 < r["efficiency"] <= 1.05, r
+        assert r["algo"] in ("ring", "tree", "rhd", "torus",
+                             "hierarchical"), r
+    # The degenerate 2-rank world keeps the ring fast path everywhere.
+    assert {r["algo"] for r in rows} == {"ring"}, rows
+    # The shutdown JSON dump (asserted by the parent) rides hvd.shutdown.
+
+
 def battery_trace(hvd, rank, size):
     """ISSUE 7 acceptance (4-rank, in-battery half): uniquely-named
     allreduces under per-rank HOROVOD_TIMELINE files while chaos
@@ -2826,6 +2859,7 @@ BATTERIES = {
     "san": battery_san,
     "trace": battery_trace,
     "telemetry": battery_telemetry,
+    "perfscope": battery_perfscope,
     "streams": battery_streams,
     "matrix": battery_matrix,
     "autotune": battery_autotune,
@@ -2991,6 +3025,14 @@ def main() -> int:
             f"/tmp/hvd_tm_{os.environ['HOROVOD_RENDEZVOUS_EPOCH']}.json"
         # Pin the TCP plane so the per-peer byte counters see the traffic.
         os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+    if battery == "perfscope":
+        os.environ["HOROVOD_METRICS"] = "on"
+        os.environ["HOROVOD_METRICS_FILE"] = \
+            f"/tmp/hvd_perf_{os.environ['HOROVOD_RENDEZVOUS_EPOCH']}.json"
+        # Pin the TCP plane so the busbw cells land on one plane; fusion
+        # off keeps each named payload its own size-bucket sample.
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+        os.environ["HOROVOD_FUSION_THRESHOLD"] = "0"
     if battery == "streams":
         # Two dispatch streams over the TCP plane; fusion off so async
         # bursts negotiate into SEVERAL responses per cycle (the unit the

@@ -629,6 +629,20 @@ def test_docs_analysis_rule_table_is_complete():
     assert missing == [], f"rules missing from docs/analysis.md: {missing}"
 
 
+def test_docs_observability_metric_table_is_complete():
+    """ISSUE 19 satellite: every metric name the runtime can register
+    (static AST sweep of the package — telemetry/catalog.py) has a table
+    row in docs/observability.md, so a new metric cannot land
+    undocumented.  Same contract as undocumented_rules above."""
+    from horovod_tpu.telemetry.catalog import undocumented_metrics
+    with open(os.path.join(REPO, "docs", "observability.md"),
+              encoding="utf-8") as f:
+        doc = f.read()
+    missing = undocumented_metrics(doc)
+    assert missing == [], \
+        f"metrics missing from docs/observability.md: {missing}"
+
+
 def test_rule_id_uniqueness_asserted_at_build():
     """The registry build raises on a duplicate id or slug — simulated
     here by replaying the build loop with a colliding rule."""

@@ -85,6 +85,30 @@ def test_histogram_quantile_interpolates_and_clamps():
     assert snap["q_ms"]["p99"] == pytest.approx(12.0)
 
 
+def test_histogram_quantile_edge_cases():
+    """ISSUE 19 satellite: the degenerate shapes the busbw ledger folds
+    over — a single observation, everything in one bucket, and an empty
+    histogram — must all produce sane quantiles (the PERF.json p50/p99
+    columns are built from exactly these)."""
+    reg = MetricsRegistry(0)
+    # Single observation: every quantile is that value (min == max
+    # clamps both ends of the interpolation).
+    h = reg.histogram("single")
+    h.observe(7.25)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert h.quantile(q) == pytest.approx(7.25)
+    # All observations in one bucket: interpolation cannot escape it.
+    h2 = reg.histogram("uniform")
+    for _ in range(50):
+        h2.observe(3.0)
+    assert h2.quantile(0.01) == pytest.approx(3.0)
+    assert h2.quantile(0.999) == pytest.approx(3.0)
+    # Empty: quantiles are 0.0 at every q, no division by zero.
+    h3 = reg.histogram("void")
+    for q in (0.0, 0.5, 1.0):
+        assert h3.quantile(q) == 0.0
+
+
 def test_histogram_bucket_edges():
     reg = MetricsRegistry(0)
     h = reg.histogram("edges")
@@ -167,6 +191,24 @@ def test_prometheus_exposition_golden_file():
                 "Executed responses by collective algorithm (ring / tree "
                 "/ rhd / torus / hierarchical / ... — the per-size "
                 "selection verdict)", labels={"algo": "tree"}).inc(1)
+    # perfscope roofline metrics (ISSUE 19): the busbw histogram with
+    # the size-bucket axis, the self-calibrated peak gauge, and the
+    # efficiency/MFU gauges the PERF.json ledger merges.
+    reg.histogram("horovod_collective_busbw_mbps",
+                  "Bus bandwidth of one executed collective (MB/s, "
+                  "nccl-tests convention)",
+                  labels={"plane": "tcp", "op": "allreduce",
+                          "codec": "none", "algo": "ring",
+                          "size_bucket": "1MiB"}).observe(260.0)
+    reg.gauge("horovod_collective_busbw_peak_mbps",
+              "Best demonstrated bus bandwidth — the self-calibrated "
+              "roofline").set(314.6)
+    reg.gauge("horovod_collective_efficiency",
+              "Latest bus bandwidth over the roofline",
+              labels={"plane": "tcp", "algo": "ring",
+                      "size_bucket": "1MiB"}).set(0.83)
+    reg.gauge("horovod_train_mfu",
+              "Model-FLOPs utilization of the last train step").set(0.41)
     reg.counter("hvd_test_bytes_total", "Bytes moved",
                 labels={"peer": "1"}).inc(2048)
     reg.counter("hvd_test_bytes_total", labels={"peer": "2"}).inc(1024)
