@@ -27,8 +27,8 @@ their implementation:
   warnings.
 """
 from .conformance import all_specs, check_tree  # noqa: F401
-from .machines import (MUTATIONS, GrowModel, PreemptModel,  # noqa: F401
-                       ShrinkModel, ToyTornModel)
+from .machines import (MUTATIONS, FleetModel, GrowModel,  # noqa: F401
+                       PreemptModel, ShrinkModel, ToyTornModel)
 from .model import explore, render_trace  # noqa: F401
 from .spec import ProtocolSpec, Transition, Verb  # noqa: F401
 from .witness import check as witness_check  # noqa: F401

@@ -15,8 +15,8 @@ import json
 import sys
 import time
 
-from .machines import (MUTATIONS, FailoverModel, GrowModel, PreemptModel,
-                       ShrinkModel, ToyTornModel)
+from .machines import (MUTATIONS, FailoverModel, FleetModel, GrowModel,
+                       PreemptModel, ShrinkModel, ToyTornModel)
 from .model import explore, render_trace
 
 __all__ = ["main"]
@@ -26,6 +26,7 @@ PROTOCOLS = {
     "preempt": PreemptModel,
     "shrink": ShrinkModel,
     "failover": FailoverModel,
+    "fleet": FleetModel,
     "toy": ToyTornModel,
 }
 
@@ -83,7 +84,7 @@ def main(argv=None) -> int:
     if not args.check_tree or args.protocol != "all" or args.mutate:
         # "all" = the real protocols; the deliberately broken toy model
         # (golden-counterexample fixture) only runs when named.
-        names = ("grow", "preempt", "shrink", "failover") \
+        names = ("grow", "preempt", "shrink", "failover", "fleet") \
             if args.protocol == "all" else (args.protocol,)
         if args.check_tree and args.protocol == "all" \
                 and not args.mutate:

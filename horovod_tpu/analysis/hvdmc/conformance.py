@@ -35,12 +35,13 @@ __all__ = ["all_specs", "check_spec_conformance", "check_tree"]
 
 def all_specs():
     """The registered protocol specs (order is report order)."""
+    from ...fleet.specs import fleet_spec
     from ...resilience.specs import shrink_spec
     from ...runner.specs import failover_spec
     from ...statesync.specs import grow_spec, preempt_spec, stream_spec
 
     return (grow_spec(), stream_spec(), preempt_spec(), shrink_spec(),
-            failover_spec())
+            failover_spec(), fleet_spec())
 
 
 def _module_of(program, funckey: str):

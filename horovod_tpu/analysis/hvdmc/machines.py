@@ -31,17 +31,21 @@ the checker bites:
 - ``accept-stale-lease`` — a rendezvous primary resumed after a lease
   lapse keeps serving without re-reading the log (kills the
   ``epoch-fence`` guard): the checker answers with a two-leaders +
-  lost-committed-write counterexample (FailoverModel).
+  lost-committed-write counterexample (FailoverModel);
+- ``swap-before-verify`` — a serving replica stages a pulled weight
+  snapshot without digest-verifying it (kills the
+  ``verify-before-stage`` guard): the shard-corrupt fault then drives
+  a corrupt image through the boundary swap (FleetModel).
 """
 from __future__ import annotations
 
 from .model import Model
 
-__all__ = ["FailoverModel", "GrowModel", "MUTATIONS", "PreemptModel",
-           "ShrinkModel", "ToyTornModel", "toy_spec"]
+__all__ = ["FailoverModel", "FleetModel", "GrowModel", "MUTATIONS",
+           "PreemptModel", "ShrinkModel", "ToyTornModel", "toy_spec"]
 
 MUTATIONS = ("drop-torn-reject", "early-ready-ack",
-             "accept-stale-lease")
+             "accept-stale-lease", "swap-before-verify")
 
 _SEQ_CAP = 4
 
@@ -719,6 +723,155 @@ class FailoverModel(Model):
         if actor == "client":
             return "client"
         return super().actor_label(actor)
+
+
+# ---------------------------------------------------------------------------
+# Fleet handoff: migration journal + continuous weight deployment
+# ---------------------------------------------------------------------------
+# ctl: (js, epoch, recovering)  js -=no migration P=planned D=departing
+#      C=done A=aborted; recovering = a failover landed, the successor
+#      must adopt the journal before anything else.
+# mover: mph T=training B=boundary(directive consumed) J=joining
+#        S=serving.
+# joined: the mover's arrival mark is in the KV.
+# pub: head version (0 = nothing published; cap 1).
+# rep: (fph, fv, fok, av, aok, seen)  fph serving/fetched/staged;
+#      (fv, fok) = the in-flight image and whether it matches the
+#      published digest; (av, aok) = the applied (swapped) image;
+#      seen = newest version staged (the puller's head watermark).
+# faults: (failover, corrupt) budgets.
+class FleetModel(Model):
+    name = "fleet-handoff"
+
+    def __init__(self, ranks: int = 2, mutations=(), *,
+                 faults: bool = True) -> None:
+        from ...fleet.specs import fleet_spec
+
+        self.n = int(ranks)
+        self.mutations = frozenset(mutations)
+        unknown = self.mutations - set(MUTATIONS)
+        if unknown:
+            raise ValueError(f"unknown mutation(s) {sorted(unknown)}; "
+                             f"known: {list(MUTATIONS)}")
+        self.spec = (fleet_spec(),)
+        self._budget = (1, 1) if faults else (0, 0)
+
+    def initial(self):
+        return (("-", 0, False), "T", False, 0,
+                ("serving", 0, True, 0, True, 0), self._budget)
+
+    def describe(self, state) -> str:
+        (js, epoch, rec), mph, joined, head, rep, faults = state
+        fph, fv, fok, av, aok, seen = rep
+        inflight = (f"/v{fv}" + ("" if fok else "!corrupt")) if fv else ""
+        applied = f"v{av}" + ("" if aok else "!corrupt")
+        return (f"mig={js}/e{epoch}{'/recovering' if rec else ''} "
+                f"mover={mph}{'+joined' if joined else ''} "
+                f"head=v{head} rep={fph}{inflight} "
+                f"applied={applied}")
+
+    def invariants(self, state):
+        _ctl, _mph, _joined, _head, rep, _faults = state
+        _fph, _fv, _fok, av, aok, _seen = rep
+        if av > 0 and not aok:
+            return ["swap-verified"]
+        return []
+
+    def is_terminal(self, state) -> bool:
+        (js, _e, rec), mph, joined, _head, rep, _faults = state
+        fph, _fv, _fok, av, _aok, _seen = rep
+        if rec:
+            return False
+        migration_closed = js == "A" or (js == "C" and joined)
+        return migration_closed and av >= 1 and fph == "serving"
+
+    def resolved(self, state) -> bool:
+        return self.is_terminal(state)
+
+    def successors(self, state):
+        (js, epoch, rec), mph, joined, head, rep, faults = state
+        fph, fv, fok, av, aok, seen = rep
+        fo, co = faults
+        if self.is_terminal(state):
+            return []
+        out = []
+
+        def st(ctl=(js, epoch, rec), mph=mph, joined=joined, head=head,
+               rep=(fph, fv, fok, av, aok, seen), faults=(fo, co)):
+            return (ctl, mph, joined, head, rep, faults)
+
+        # -- controller --------------------------------------------------
+        if rec:
+            # A successor controller adopts the journal before anything
+            # else: planned-with-no-directive aborts, departing resumes.
+            if js == "P":
+                out.append(("ctl", ("ctl.abort-planned",),
+                            st(ctl=("A", epoch, False))))
+            else:
+                out.append(("ctl", ("ctl.resume",),
+                            st(ctl=("D", epoch, False))))
+        elif js == "-":
+            out.append(("ctl", ("ctl.observe", "ctl.plan"),
+                        st(ctl=("P", epoch, False))))
+        elif js == "P":
+            out.append(("ctl", ("ctl.direct",),
+                        st(ctl=("D", epoch, False))))
+        elif js == "D" and joined:
+            out.append(("ctl", ("ctl.complete",),
+                        st(ctl=("C", epoch, False))))
+
+        # -- mover -------------------------------------------------------
+        if mph == "T" and js == "D" and not rec:
+            out.append(("mover", ("mov.directive",), st(mph="B")))
+        elif mph == "B":
+            out.append(("mover", ("mov.depart",), st(mph="J")))
+        elif mph == "J":
+            out.append(("mover", ("mov.join",), st(mph="S")))
+        elif mph == "S" and not joined:
+            out.append(("mover", ("mov.arrive",), st(joined=True)))
+
+        # -- publisher ---------------------------------------------------
+        if head == 0:
+            out.append(("pub", ("pub.shards", "pub.meta", "pub.head"),
+                        st(head=1)))
+
+        # -- replica -----------------------------------------------------
+        if fph == "serving" and head > seen:
+            out.append(("rep", ("rep.poll", "rep.fetch"),
+                        st(rep=("fetched", head, True, av, aok, seen))))
+            if co > 0:
+                out.append(("net", ("net.shard-corrupt", "rep.poll",
+                                    "rep.fetch"),
+                            st(rep=("fetched", head, False, av, aok,
+                                    seen),
+                               faults=(fo, co - 1))))
+        elif fph == "fetched":
+            if "swap-before-verify" in self.mutations:
+                # MUTATED: the image is staged whether or not its
+                # digest reproduced the meta record.
+                out.append(("rep", ("rep.verify-stage",),
+                            st(rep=("staged", fv, fok, av, aok, fv))))
+            elif fok:
+                out.append(("rep", ("rep.verify-stage",),
+                            st(rep=("staged", fv, fok, av, aok, fv))))
+            else:
+                out.append(("rep", ("rep.verify-reject",),
+                            st(rep=("serving", 0, True, av, aok,
+                                    seen))))
+        elif fph == "staged":
+            out.append(("rep", ("rep.swap",),
+                        st(rep=("serving", 0, True, fv, fok, seen))))
+
+        # -- faults ------------------------------------------------------
+        if fo > 0 and js in "PD" and not rec:
+            out.append(("net", ("net.failover",),
+                        st(ctl=(js, epoch + 1, True),
+                           faults=(fo - 1, co))))
+        return out
+
+    def actor_label(self, actor):
+        return {"ctl": "controller", "mover": "mover", "pub": "publisher",
+                "rep": "replica"}.get(actor, str(actor))
 
 
 # ---------------------------------------------------------------------------

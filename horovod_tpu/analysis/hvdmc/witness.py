@@ -74,11 +74,12 @@ def load_dumps(paths) -> list:
 
 def _fired_tids(specs) -> set:
     """Union of transition ids the head models actually fire."""
-    from .machines import GrowModel, PreemptModel, ShrinkModel
+    from .machines import FleetModel, GrowModel, PreemptModel, ShrinkModel
     from .model import explore
 
     fired: set = set()
-    for m in (GrowModel(3), PreemptModel(3), ShrinkModel(3)):
+    for m in (GrowModel(3), PreemptModel(3), ShrinkModel(3),
+              FleetModel(2)):
         fired |= explore(m).fired
     return fired
 

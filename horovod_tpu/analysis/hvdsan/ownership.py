@@ -249,6 +249,24 @@ THREAD_ROOTS: dict[str, tuple[str, str]] = {
         "episode-long sampler of every rendezvous replica's /.ctl/role "
         "(the console's failover timeline); stopped + joined by "
         "_CtlRoleProber.close from FleetSim.close"),
+    # Fleet controller (ISSUE 20): Thread subclasses whose run() the
+    # static Thread(target=) scan cannot see.
+    "hvd-fleet-controller": (
+        "fleet.controller.FleetController.run",
+        "rank-0 arbitration loop: polls both worlds' load gauges, "
+        "feeds the rebalancing policy, journals + directs migrations; "
+        "stopped + joined by FleetController.stop"),
+    "hvd-fleet-publisher": (
+        "fleet.deploy.WeightPublisher.run",
+        "trainer-side snapshot committer: digests, shards and commits "
+        "published param images to the coordinator KV off the step "
+        "critical path; stopped + joined by WeightPublisher.close"),
+    "hvd-fleet-puller": (
+        "fleet.deploy.WeightPuller.run",
+        "serving-side snapshot fetcher: polls the published head, "
+        "digest-verifies and stages new versions for the plan-boundary "
+        "swap; stopped + joined by WeightPuller.close (reachable from "
+        "ReplicaExecutor.close)"),
     "hvd-chaos-cont": (
         "resilience.chaos._sigcont",
         "coordpause resume Timer: delivers SIGCONT to the paused "

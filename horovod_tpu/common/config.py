@@ -413,6 +413,14 @@ STATESYNC_TIMEOUT_SECONDS = register(
     "Deadline for one streaming round (mesh formation + transfer + "
     "verify) on both the donor and joiner side; a round that exceeds "
     "it is abandoned (the joiner re-announces, donors stand down).")
+STATESYNC_WORLD = register(
+    "HOROVOD_STATESYNC_WORLD", "world", str,
+    "Name of this process's world-membership record in the coordinator "
+    "KV (scope 'statesync').  A fleet deployment runs TWO live worlds "
+    "— training and serving — against one coordinator "
+    "(fleet/controller.py), so each names its record distinctly "
+    "('train' / 'serve') and a joiner targets the right one; single-"
+    "world deployments keep the default.")
 PREEMPT_GRACE_SECONDS = register(
     "HOROVOD_PREEMPT_GRACE_S", 0.0, float,
     "Preemption-notice grace window: > 0 installs a SIGTERM handler "
@@ -458,6 +466,73 @@ AUTOSCALE_HYSTERESIS_ROUNDS = register(
     "Consecutive intervals a scale condition must hold before a "
     "decision fires (and the cooldown after each decision), so one "
     "burst never flaps the world size.")
+
+# --- Fleet controller (fleet/ subsystem; docs/fleet.md) ---------------------
+FLEET = register(
+    "HOROVOD_FLEET", False, _parse_bool,
+    "Unified train+serve fleet controller: a rank-0-hosted, "
+    "coordinator-KV-backed loop that arbitrates one shared host pool "
+    "between a training world and a serving world — traffic-driven "
+    "rank rebalancing plus continuous weight deployment.")
+FLEET_INTERVAL_S = register(
+    "HOROVOD_FLEET_INTERVAL_S", 2.0, float,
+    "Observation interval of the fleet controller loop (gauge poll + "
+    "policy tick + migration-journal advance).")
+FLEET_PUBLISH_STEPS = register(
+    "HOROVOD_FLEET_PUBLISH_STEPS", 50, int,
+    "The trainer publishes a version-stamped param snapshot to the "
+    "fleet KV scope every this many optimizer steps (0 disables "
+    "continuous weight deployment).")
+FLEET_PUBLISH_KEEP = register(
+    "HOROVOD_FLEET_PUBLISH_KEEP", 2, int,
+    "Published snapshot versions retained in the KV before the "
+    "publisher garbage-collects the oldest (>= 2, so a puller mid-"
+    "fetch never races the GC of the version it is verifying).")
+FLEET_CHUNK_BYTES = register(
+    "HOROVOD_FLEET_CHUNK_BYTES", 1 << 20, int,
+    "Shard size of one published-snapshot KV record; serving pullers "
+    "fetch shards independently and digest-verify the reassembly.")
+FLEET_HYSTERESIS_ROUNDS = register(
+    "HOROVOD_FLEET_HYSTERESIS_ROUNDS", 3, int,
+    "Consecutive controller intervals a rebalance condition must hold "
+    "before a migration fires, so one traffic burst never flaps ranks "
+    "between the worlds.")
+FLEET_COOLDOWN_ROUNDS = register(
+    "HOROVOD_FLEET_COOLDOWN_ROUNDS", 5, int,
+    "Controller intervals the policy stays silent after each "
+    "migration decision (on top of hysteresis): a move must settle — "
+    "join complete, gauges refreshed — before the next is considered.")
+FLEET_UP_SHED_RATE = register(
+    "HOROVOD_FLEET_UP_SHED_RATE", 0.05, float,
+    "Move a rank train->serve when the serving shed rate over one "
+    "interval exceeds this fraction (serving capacity, not deadline, "
+    "is the binding constraint).")
+FLEET_UP_QUEUE_FRACTION = register(
+    "HOROVOD_FLEET_UP_QUEUE_FRACTION", 0.5, float,
+    "Move a rank train->serve when serving queue depth exceeds this "
+    "fraction of the configured depth limit.")
+FLEET_IDLE_QUEUE_FRACTION = register(
+    "HOROVOD_FLEET_IDLE_QUEUE_FRACTION", 0.05, float,
+    "Move a rank serve->train when serving queue depth stays under "
+    "this fraction (and nothing is shed) while the trainer drags: the "
+    "serving world is over-provisioned.")
+FLEET_TRAIN_LAG_MS = register(
+    "HOROVOD_FLEET_TRAIN_LAG_MS", 50.0, float,
+    "Trainer straggler-lag threshold (ms) that, combined with an idle "
+    "serving queue, marks the trainer as the starved world.")
+FLEET_MIN_TRAIN = register(
+    "HOROVOD_FLEET_MIN_TRAIN", 2, int,
+    "Floor on the training world size: the policy never proposes a "
+    "migration that would shrink training below this many ranks.")
+FLEET_MIN_SERVE = register(
+    "HOROVOD_FLEET_MIN_SERVE", 1, int,
+    "Floor on the serving world size: the policy never proposes a "
+    "migration that would shrink serving below this many ranks.")
+FLEET_MIGRATE_TIMEOUT_S = register(
+    "HOROVOD_FLEET_MIGRATE_TIMEOUT_S", 120.0, float,
+    "Deadline for one journaled migration (depart directive written -> "
+    "joined mark observed); a migration that exceeds it is marked "
+    "aborted so a wedged mover never blocks the controller forever.")
 
 # --- Fleet-scale harness (fleetsim/ subsystem; docs/fleetsim.md) ------------
 FLEETSIM_RANKS = register(

@@ -10,6 +10,9 @@ explicit ``none`` line rather than vanishing, so an operator can tell
 """
 from __future__ import annotations
 
+import json
+import re
+
 from .sources import Episode
 
 __all__ = ["render", "summary_lines"]
@@ -61,9 +64,11 @@ def _counter_total(ep: Episode, name: str) -> float:
                for e in _metric_entries(ep, name))
 
 
-def _membership_events(ep: Episode) -> list[tuple[float, int, dict]]:
-    """Merge membership-narrative flight events across ranks, on each
-    dump's own relative clock (monotonic clocks don't compare across
+def _membership_events(ep: Episode,
+                       kinds=_MEMBERSHIP_KINDS
+                       ) -> list[tuple[float, int, dict]]:
+    """Merge narrative flight events across ranks, on each dump's own
+    relative clock (monotonic clocks don't compare across
     processes)."""
     merged = []
     for dump in ep.flights:
@@ -73,12 +78,63 @@ def _membership_events(ep: Episode) -> list[tuple[float, int, dict]]:
         t0 = min(e.get("ts", 0.0) for e in events)
         rank = dump.get("rank", 0)
         for e in events:
-            if e.get("kind") in _MEMBERSHIP_KINDS:
+            if e.get("kind") in kinds:
                 merged.append((round(e.get("ts", 0.0) - t0, 3), rank, e))
     merged.sort(key=lambda item: (item[0], item[1],
                                   item[2].get("kind", ""),
                                   item[2].get("name", "")))
     return merged
+
+
+def _count(value) -> int:
+    """Loadgen world fields carry either a count or the list of
+    transition records; the panel wants the count."""
+    if isinstance(value, (list, tuple)):
+        return len(value)
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return 0
+
+
+def _fleet_version(name) -> int | None:
+    """``v{n}`` flight-event names (fleet-publish / -pull / -swap)."""
+    try:
+        return int(str(name).lstrip("v"))
+    except ValueError:
+        return None
+
+
+def _fleet_evidence(ep: Episode) -> dict:
+    """Everything the fleet subsystem (fleet/controller.py +
+    fleet/deploy.py) left behind: migration-journal flight events, the
+    weight-deployment events, and the serving front's loadgen report."""
+    migration = _membership_events(
+        ep, kinds=("fleet-migrate", "fleet-depart", "fleet-join"))
+    pubs = _membership_events(ep, kinds=("fleet-publish",))
+    pulls = _membership_events(ep, kinds=("fleet-pull",))
+    swaps = _membership_events(ep, kinds=("fleet-swap",))
+    fronts = sorted((r for r in ep.serve_reports
+                     if r.get("rank", 0) == 0),
+                    key=lambda r: r.get("_file", ""))
+    mids = sorted({e.get("name", "?") for _t, _r, e in migration
+                   if e.get("kind") == "fleet-migrate"})
+    outcomes: dict[str, int] = {}
+    for _t, _r, e in migration:
+        if e.get("kind") == "fleet-migrate":
+            what = str(e.get("detail", "?")).split(" ", 1)[0]
+            outcomes[what] = outcomes.get(what, 0) + 1
+    head = max((v for v in (_fleet_version(e.get("name"))
+                            for _t, _r, e in pubs) if v is not None),
+               default=None)
+    front: dict[int, int] = {}
+    for _t, rank, e in swaps:
+        v = _fleet_version(e.get("name"))
+        if v is not None:
+            front[rank] = max(front.get(rank, 0), v)
+    return {"migration": migration, "pubs": pubs, "pulls": pulls,
+            "swaps": swaps, "fronts": fronts, "mids": mids,
+            "outcomes": outcomes, "head": head, "front": front}
 
 
 def _role_timeline(ep: Episode) -> tuple[list[dict], list[str]]:
@@ -112,7 +168,7 @@ def _transitions(probes: list[dict]) -> list[str]:
 # ---------------------------------------------------------------------------
 # Sections
 # ---------------------------------------------------------------------------
-def _sec_fleet(ep: Episode, lines: list[str]) -> None:
+def _sec_fleet(ep: Episode, lines: list[str], topk: int = 8) -> None:
     s = _summary(ep)
     lines.append("== fleet ==")
     if s is None:
@@ -124,6 +180,7 @@ def _sec_fleet(ep: Episode, lines: list[str]) -> None:
                          "(no summary dump)")
         else:
             lines.append("no fleet summary")
+        _sec_fleetctl(ep, lines, topk)
         return
     lines.append(f"ranks={s['ranks']} steps={s['steps']} "
                  f"rank_steps={s['total_rank_steps']} "
@@ -135,6 +192,97 @@ def _sec_fleet(ep: Episode, lines: list[str]) -> None:
     shown = ",".join(map(str, world[:16]))
     more = f" (+{len(world) - 16} more)" if len(world) > 16 else ""
     lines.append(f"final_world[{len(world)}]: {shown}{more}")
+    _sec_fleetctl(ep, lines, topk)
+
+
+def _world_counts(ev: dict) -> list[str]:
+    """Per-world rank counts across each completed migration: the
+    donor's side from the journal record (its departing rank was
+    ``size - 1``), the destination's from the mover's joined mark."""
+    joins = {e.get("name"): e for _t, _r, e in ev["migration"]
+             if e.get("kind") == "fleet-join"}
+    moves = []
+    for _t, _r, e in ev["migration"]:
+        if e.get("kind") != "fleet-migrate":
+            continue
+        detail = str(e.get("detail", ""))
+        if not detail.startswith("done "):
+            continue
+        m = re.search(r"(\w+)->(\w+) rank=(\d+)", detail)
+        if m is None:
+            continue
+        donor, dest, rank = m.group(1), m.group(2), int(m.group(3))
+        dest_part = dest
+        joined = joins.get(e.get("name"))
+        if joined is not None:
+            try:
+                size = int(json.loads(
+                    joined.get("detail", "{}")).get("size"))
+                dest_part = f"{dest} {size - 1}->{size}"
+            except (TypeError, ValueError):
+                pass
+        moves.append(f"{e.get('name', '?')} {donor} "
+                     f"{rank + 1}->{rank}, {dest_part}")
+    return moves
+
+
+def _sec_fleetctl(ep: Episode, lines: list[str], topk: int) -> None:
+    """The train+serve controller story (fleet/, docs/fleet.md):
+    migration-journal timeline, weight-rollout front, and the serving
+    front's goodput phases — everything an operator needs to answer
+    "did the move land, and did the push reach every replica"."""
+    ev = _fleet_evidence(ep)
+    if not (ev["migration"] or ev["pubs"] or ev["swaps"]
+            or ev["fronts"]):
+        lines.append("controller: no migrations / rollouts")
+        return
+    outcomes = " ".join(f"{k}={v}"
+                        for k, v in sorted(ev["outcomes"].items()))
+    lines.append(f"migrations: {len(ev['mids'])} "
+                 f"({outcomes or 'no journal events'})")
+    shown = ev["migration"][:topk]
+    for t, rank, e in shown:
+        detail = f" {e['detail']}" if e.get("detail") else ""
+        lines.append(f"  [r{rank} +{t:.3f}s] {e['kind']} "
+                     f"{e.get('name', '')}{detail}")
+    if len(ev["migration"]) > len(shown):
+        lines.append(f"  ... {len(ev['migration']) - len(shown)} "
+                     "more events")
+    for move in _world_counts(ev)[:topk]:
+        lines.append(f"world counts: {move}")
+    if ev["pubs"] or ev["swaps"]:
+        head = f"v{ev['head']}" if ev["head"] is not None else "?"
+        front = " ".join(f"r{r}=v{v}"
+                         for r, v in sorted(ev["front"].items()))
+        lines.append(f"rollout: published={len(ev['pubs'])} "
+                     f"head={head} pulled={len(ev['pulls'])}; "
+                     f"swap front: {front or 'none'}")
+    else:
+        lines.append("rollout: none published")
+    for rep in ev["fronts"]:
+        world = rep.get("world", {})
+        lines.append(f"serve world: size={world.get('size', '?')} "
+                     f"grows={_count(world.get('grows', 0))} "
+                     f"shrinks={_count(world.get('shrinks', 0))} "
+                     f"offered={rep.get('offered', 0)} "
+                     f"served={rep.get('served', 0)} "
+                     f"lost={rep.get('lost_on_failure', 0)}")
+        phases = rep.get("goodput_phases")
+        if phases:
+            lines.append("goodput phases: " + " ".join(
+                f"{key}={_fmt(phases.get(key, 0.0))}"
+                for key in ("before_rps", "during_rps", "after_rps",
+                            "window_s")))
+        weights = rep.get("weights")
+        if weights:
+            mix = " ".join(
+                f"v{k}={v}" for k, v
+                in sorted(weights.get("versions", {}).items(),
+                          key=lambda kv: str(kv[0])))
+            lines.append(
+                f"weights: final=v{weights.get('final_version', 0)} "
+                f"mix {mix or 'none'} max_staleness="
+                f"{weights.get('max_staleness_steps', 0)} steps")
 
 
 def _sec_controlplane(ep: Episode, lines: list[str], topk: int) -> None:
@@ -291,7 +439,7 @@ def render(ep: Episode, topk: int = 8) -> str:
              f"metrics={len(ep.metrics)} ctl={len(ep.ctl_roles)} "
              f"summary={len(ep.summaries)} "
              f"skipped={len(ep.skipped)}"]
-    _sec_fleet(ep, lines)
+    _sec_fleet(ep, lines, topk)
     _sec_controlplane(ep, lines, topk)
     _sec_membership(ep, lines, topk)
     _sec_straggler(ep, lines)
@@ -332,4 +480,25 @@ def summary_lines(ep: Episode) -> list[str]:
     out.append("events "
                + (" ".join(f"{k}={v}" for k, v in sorted(kinds.items()))
                   or "none"))
+    ev = _fleet_evidence(ep)
+    if ev["migration"] or ev["pubs"] or ev["swaps"] or ev["fronts"]:
+        outcomes = " ".join(f"{k}={v}" for k, v
+                            in sorted(ev["outcomes"].items()))
+        out.append(f"fleetctl migrations={len(ev['mids'])} "
+                   + (outcomes or "none"))
+        head = f"v{ev['head']}" if ev["head"] is not None else "?"
+        front = " ".join(f"r{r}=v{v}"
+                         for r, v in sorted(ev["front"].items()))
+        out.append(f"rollout published={len(ev['pubs'])} head={head} "
+                   f"pulled={len(ev['pulls'])} "
+                   f"front {front or 'none'}")
+        for rep in ev["fronts"]:
+            weights = rep.get("weights") or {}
+            world = rep.get("world", {})
+            out.append(f"serve size={world.get('size', '?')} "
+                       f"grows={_count(world.get('grows', 0))} "
+                       f"offered={rep.get('offered', 0)} "
+                       f"served={rep.get('served', 0)} "
+                       f"lost={rep.get('lost_on_failure', 0)} "
+                       f"final=v{weights.get('final_version', 0)}")
     return out

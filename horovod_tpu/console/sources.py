@@ -5,8 +5,9 @@ Two modes feed the same renderer:
 - **Post-hoc**: a directory of rank-stamped dumps from a finished (or
   crashed) episode — flight-recorder rings (``flight.r*.json``),
   telemetry snapshots (``metrics.r*.json``), ``/.ctl`` role-probe
-  timelines (``ctl_roles.r*.json``) and fleetsim summaries
-  (``summary.r*.json``).  Files are classified by PAYLOAD SHAPE, not
+  timelines (``ctl_roles.r*.json``), fleetsim summaries
+  (``summary.r*.json``) and serving loadgen reports
+  (``SERVE_r*.json``).  Files are classified by PAYLOAD SHAPE, not
   filename, so dumps renamed by collection tooling still load.
 - **Live**: Prometheus text scraped from each rank's metrics exporter
   (telemetry/exporter.py) plus the rendezvous replicas' ``/.ctl/role``
@@ -37,12 +38,13 @@ class Episode:
     metrics: list = dataclasses.field(default_factory=list)
     ctl_roles: list = dataclasses.field(default_factory=list)
     summaries: list = dataclasses.field(default_factory=list)
+    serve_reports: list = dataclasses.field(default_factory=list)
     skipped: list = dataclasses.field(default_factory=list)
 
     @property
     def empty(self) -> bool:
         return not (self.flights or self.metrics or self.ctl_roles
-                    or self.summaries)
+                    or self.summaries or self.serve_reports)
 
 
 def _classify(payload) -> str | None:
@@ -51,6 +53,9 @@ def _classify(payload) -> str | None:
         return None
     if "fleetsim_summary" in payload:
         return "summary"
+    if str(payload.get("schema", "")).startswith(
+            "horovod_tpu.serving.loadgen"):
+        return "serve"
     if "events" in payload and "reason" in payload:
         return "flight"
     if "probes" in payload:
@@ -68,7 +73,8 @@ def load_dump_dir(path: str) -> Episode:
     except OSError:
         return ep
     buckets = {"flight": ep.flights, "metrics": ep.metrics,
-               "ctl": ep.ctl_roles, "summary": ep.summaries}
+               "ctl": ep.ctl_roles, "summary": ep.summaries,
+               "serve": ep.serve_reports}
     for name in names:
         if not name.endswith(".json"):
             continue

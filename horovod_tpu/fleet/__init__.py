@@ -1,0 +1,25 @@
+"""horovod_tpu.fleet — unified train+serve fleet controller: one shared
+host pool arbitrated between a training world and a serving world
+(traffic-driven rank rebalancing + continuous weight deployment).
+
+See docs/fleet.md for the architecture and the migration state
+machine; fleet/specs.py is the hvdmc protocol spec the implementation
+is conformance-bound to.
+"""
+from __future__ import annotations
+
+from .controller import (CTL_SCOPE, GAUGE_SCOPE, JOURNAL_SCOPE,
+                         FleetController, mark_joined, poll_depart,
+                         publish_gauge, read_gauge)
+from .deploy import PUB_SCOPE, WeightPublisher, WeightPuller
+from .policy import (SERVE_TO_TRAIN, TRAIN_TO_SERVE, FleetDecision,
+                     FleetPolicy)
+from .specs import fleet_spec
+
+__all__ = [
+    "CTL_SCOPE", "GAUGE_SCOPE", "JOURNAL_SCOPE", "PUB_SCOPE",
+    "SERVE_TO_TRAIN", "TRAIN_TO_SERVE", "FleetController",
+    "FleetDecision", "FleetPolicy", "WeightPublisher", "WeightPuller",
+    "fleet_spec", "mark_joined", "poll_depart", "publish_gauge",
+    "read_gauge",
+]

@@ -1,0 +1,245 @@
+"""FleetController: the rank-0-hosted arbiter of one shared host pool
+between a training world and a serving world (docs/fleet.md).
+
+Everything the controller knows and decides lives in the coordinator
+KV, under three scopes:
+
+- ``fleet.gauges`` — each world's front publishes its load gauges
+  (``train`` / ``serve`` keys: world size plus shed rate / queue depth
+  / straggler lag), so the controller never needs a direct channel to
+  either world;
+- ``fleet.journal`` — an epoch-stamped record per migration
+  (``mig:{id}``) advancing planned -> departing -> done | aborted.
+  The journal is the failover story: a re-elected controller claims a
+  fresh epoch, adopts every non-terminal record, and either resumes it
+  (directive already written — the mover may be mid-join) or safely
+  aborts it (never started);
+- ``fleet.ctl`` — the actuation records: ``depart:{id}`` directives a
+  donor rank consumes at its statesync step boundary, and
+  ``joined:{id}`` marks the mover writes after ``join_serving_world``
+  / statesync grow completes on the other side.
+
+Execution rides existing machinery end to end: the donor world shrinks
+through the statesync preemption-grace boundary
+(``StateSyncService.request_depart`` — orderly departure, no
+RanksFailedError) and the mover joins the other world via peer-streamed
+state.  The controller itself only writes KV records — which is what
+makes its failover trivial and its protocol model-checkable
+(fleet/specs.py).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..common import config
+from ..common.logging import logger
+from ..telemetry.flight import recorder
+from .policy import SERVE_TO_TRAIN, TRAIN_TO_SERVE, FleetPolicy
+
+__all__ = ["CTL_SCOPE", "GAUGE_SCOPE", "JOURNAL_SCOPE", "FleetController",
+           "mark_joined", "poll_depart", "publish_gauge", "read_gauge"]
+
+GAUGE_SCOPE = "fleet.gauges"
+JOURNAL_SCOPE = "fleet.journal"
+CTL_SCOPE = "fleet.ctl"
+
+
+# -- gauge + actuation records (both worlds' side) ------------------------
+def publish_gauge(kv, world: str, size: int, **fields) -> None:
+    """Publish one world's load gauge (world is "train" or "serve")."""
+    rec = {"world": world, "size": int(size), "ts": time.time()}
+    rec.update(fields)
+    kv.put(GAUGE_SCOPE, world, json.dumps(rec).encode())
+
+
+def read_gauge(kv, world: str) -> dict | None:
+    raw = kv.get(GAUGE_SCOPE, world)
+    return None if raw is None else json.loads(raw)
+
+
+def poll_depart(kv, world: str, rank: int) -> dict | None:
+    """A donor rank's boundary poll: the ``depart:{id}`` directive
+    addressed to (world, rank), or None.  One scope dump per poll."""
+    for key, raw in kv.get_scope(CTL_SCOPE).items():
+        if not key.startswith("depart:"):
+            continue
+        rec = json.loads(raw)
+        if rec.get("world") == world and int(rec.get("rank", -1)) == rank:
+            return rec
+    return None
+
+
+def mark_joined(kv, mid: int, **fields) -> None:
+    """The mover's arrival mark: written only after the destination
+    world's join (peer-streamed state, digest-verified) completed."""
+    rec = {"mid": int(mid), "ts": time.time()}
+    rec.update(fields)
+    kv.put(CTL_SCOPE, f"joined:{mid}", json.dumps(rec).encode())
+    rec2 = recorder()
+    if rec2.enabled:
+        rec2.record("fleet-join", name=f"mig:{mid}",
+                    detail=json.dumps(fields, sort_keys=True))
+
+
+class FleetController(threading.Thread):
+    """The rank-0 controller loop: poll gauges, tick the policy,
+    journal and drive migrations, survive its own failover."""
+
+    def __init__(self, kv, policy: FleetPolicy | None = None, *,
+                 interval_s: float | None = None,
+                 migrate_timeout_s: float | None = None) -> None:
+        super().__init__(daemon=True, name="hvd-fleet-controller")
+        self.kv = kv
+        self.policy = FleetPolicy() if policy is None else policy
+        self.interval_s = config.FLEET_INTERVAL_S.get() \
+            if interval_s is None else float(interval_s)
+        self.migrate_timeout_s = config.FLEET_MIGRATE_TIMEOUT_S.get() \
+            if migrate_timeout_s is None else float(migrate_timeout_s)
+        self._halt = threading.Event()
+        self.epoch = -1                  # claimed in recover()
+        self.open: dict[int, dict] = {}  # mid -> journal record
+        self.stats = {"migrations": 0, "completed": 0, "aborted": 0,
+                      "resumed": 0, "ticks": 0}
+
+    # -- journal primitives ----------------------------------------------
+    def _journal(self, rec: dict) -> None:
+        self.kv.put(JOURNAL_SCOPE, f"mig:{rec['mid']}",
+                    json.dumps(rec).encode())
+
+    def _flight(self, rec: dict, what: str) -> None:
+        fr = recorder()
+        if fr.enabled:
+            fr.record("fleet-migrate", name=f"mig:{rec['mid']}",
+                      detail=f"{what} {rec['direction']} "
+                             f"rank={rec['rank']} epoch={rec['epoch']}")
+
+    # -- failover --------------------------------------------------------
+    def recover(self) -> None:
+        """Claim a controller epoch and adopt every non-terminal
+        journal record left by a predecessor: a record whose directive
+        was already written is resumed (the mover may be mid-flight); a
+        merely planned one is safely aborted (its directive was never
+        published, so no rank can be acting on it)."""
+        self.epoch = self.kv.claim(JOURNAL_SCOPE, "epoch")
+        ctl = self.kv.get_scope(CTL_SCOPE)
+        for key, raw in self.kv.get_scope(JOURNAL_SCOPE).items():
+            if not key.startswith("mig:"):
+                continue
+            rec = json.loads(raw)
+            if rec.get("state") in ("done", "aborted"):
+                continue
+            rec["epoch"] = self.epoch
+            if rec.get("state") == "planned" \
+                    and f"depart:{rec['mid']}" not in ctl:
+                rec["state"] = "aborted"
+                rec["why"] = "controller failover before directive"
+                self._journal(rec)
+                self.stats["aborted"] += 1
+                self._flight(rec, "aborted")
+                continue
+            rec["deadline"] = time.time() + self.migrate_timeout_s
+            self._journal(rec)
+            self.open[int(rec["mid"])] = rec
+            self.stats["resumed"] += 1
+            self._flight(rec, "resumed")
+
+    # -- migration lifecycle ---------------------------------------------
+    def begin_migration(self, direction: str, donor_size: int) -> dict:
+        """Journal + actuate one move: the donor world's highest rank
+        departs.  Journal first (planned), directive second, journal
+        again (departing) — so every KV state a failover can observe is
+        unambiguous about whether the directive may exist."""
+        mid = self.kv.claim(JOURNAL_SCOPE, "seq")
+        donor = "train" if direction == TRAIN_TO_SERVE else "serve"
+        rec = {"mid": mid, "direction": direction, "world": donor,
+               "rank": donor_size - 1, "state": "planned",
+               "epoch": self.epoch, "ts": time.time(),
+               "deadline": time.time() + self.migrate_timeout_s}
+        self._journal(rec)
+        self.kv.put(CTL_SCOPE, f"depart:{mid}", json.dumps(
+            {"mid": mid, "world": donor, "rank": rec["rank"],
+             "direction": direction, "epoch": self.epoch}).encode())
+        rec["state"] = "departing"
+        self._journal(rec)
+        self.open[mid] = rec
+        self.stats["migrations"] += 1
+        self._flight(rec, "departing")
+        logger.info("fleet: migration %d %s rank %d departing",
+                    mid, direction, rec["rank"])
+        return rec
+
+    def _advance(self) -> None:
+        """Advance every open migration: joined mark -> done; expired
+        deadline -> aborted (directive withdrawn)."""
+        if not self.open:
+            return
+        ctl = self.kv.get_scope(CTL_SCOPE)
+        for mid, rec in list(self.open.items()):
+            if f"joined:{mid}" in ctl:
+                rec["state"] = "done"
+                rec["done_ts"] = time.time()
+                self._journal(rec)
+                self.kv.delete(CTL_SCOPE, f"depart:{mid}")
+                del self.open[mid]
+                self.stats["completed"] += 1
+                self._flight(rec, "done")
+                logger.info("fleet: migration %d complete", mid)
+            elif time.time() > rec.get("deadline", 0):
+                rec["state"] = "aborted"
+                rec["why"] = "migration deadline exceeded"
+                self._journal(rec)
+                self.kv.delete(CTL_SCOPE, f"depart:{mid}")
+                del self.open[mid]
+                self.stats["aborted"] += 1
+                self._flight(rec, "aborted")
+                logger.warning("fleet: migration %d aborted (deadline)",
+                               mid)
+
+    # -- the loop --------------------------------------------------------
+    def tick(self) -> dict | None:
+        """One controller interval: advance open migrations, then (only
+        when none is in flight — one move settles before the next is
+        considered) feed the policy.  Returns the migration record if a
+        new one began."""
+        self.stats["ticks"] += 1
+        self._advance()
+        if self.open:
+            return None
+        train = read_gauge(self.kv, "train")
+        serve = read_gauge(self.kv, "serve")
+        if train is None or serve is None:
+            return None
+        decision = self.policy.observe(
+            int(train["size"]), int(serve["size"]),
+            shed_rate=float(serve.get("shed_rate", 0.0)),
+            queue_depth=float(serve.get("queue_depth", 0.0)),
+            straggler_lag_ms=float(train.get("straggler_lag_ms", 0.0)))
+        if decision is None:
+            return None
+        donor_size = int(train["size"]) \
+            if decision.direction == TRAIN_TO_SERVE else int(serve["size"])
+        return self.begin_migration(decision.direction, donor_size)
+
+    def run(self) -> None:
+        try:
+            self.recover()
+        except (TimeoutError, OSError) as exc:
+            logger.warning("fleet: controller recover failed: %s", exc)
+            return
+        while not self._halt.wait(timeout=self.interval_s):
+            try:
+                self.tick()
+            except (TimeoutError, OSError) as exc:
+                logger.debug("fleet: controller tick failed: %s", exc)
+
+    def stop(self) -> None:
+        self._halt.set()
+        if self.is_alive() and self is not threading.current_thread():
+            self.join(timeout=self.interval_s + 10.0)
+
+    close = stop
+
+
+_DIRECTIONS = (TRAIN_TO_SERVE, SERVE_TO_TRAIN)

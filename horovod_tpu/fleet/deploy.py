@@ -1,0 +1,211 @@
+"""Continuous weight deployment: trainer-side publisher and serving-
+side puller over the coordinator KV (docs/fleet.md).
+
+The path is the statesync fast-donation mold (service.py
+``_fast_donate`` / ``fetch_donation``): the trainer's rank 0 flattens
+its param tree (snapshot.py leaf order), chunks it into independently
+addressed KV shards under the ``fleet.pub`` scope, and commits the
+version by writing the ``meta:{v}`` record (digest + nbytes + shard
+count) and only then bumping ``head``.  Pullers poll ``head`` on a
+timeout-bounded wait, fetch the shards, digest-verify the reassembly
+against the meta record, and hand the verified image to the replica's
+staging callback — the replica swaps it in at a BatchPlan boundary
+(serving/replica.py), never here.  Verify-before-stage is the safety
+property the fleet hvdmc spec model-checks (fleet/specs.py); the
+seeded ``swap-before-verify`` mutation is exactly this ordering
+dropped.
+
+Both threads are owned: ``close()`` sets the wakeup event and joins
+with a timeout (hvdlife HVD701/HVD705 posture, registered in hvdsan
+ownership.THREAD_ROOTS).
+"""
+from __future__ import annotations
+
+import json
+import threading
+
+from ..common import config
+from ..common.logging import logger
+from ..statesync.snapshot import flatten_state, state_digest
+from ..telemetry.flight import recorder
+
+__all__ = ["PUB_SCOPE", "WeightPublisher", "WeightPuller"]
+
+PUB_SCOPE = "fleet.pub"
+
+
+def _meta_key(version: int) -> str:
+    return f"meta:{version}"
+
+
+def _shard_key(version: int, i: int) -> str:
+    return f"shard:{version}.{i}"
+
+
+class WeightPublisher(threading.Thread):
+    """Trainer-side snapshot publisher (rank 0 only).
+
+    ``maybe_publish(step, tree)`` runs on the training thread: it
+    flattens the tree (the only device sync, paid once per
+    ``HOROVOD_FLEET_PUBLISH_STEPS``) and enqueues the image; the
+    publisher thread does the digest, the shard puts, the meta commit,
+    the head bump and old-version GC off the step critical path."""
+
+    def __init__(self, kv, *, publish_steps: int | None = None,
+                 chunk_bytes: int | None = None,
+                 keep: int | None = None) -> None:
+        super().__init__(daemon=True, name="hvd-fleet-publisher")
+        self.kv = kv
+        self.publish_steps = config.FLEET_PUBLISH_STEPS.get() \
+            if publish_steps is None else int(publish_steps)
+        self.chunk_bytes = max(config.FLEET_CHUNK_BYTES.get()
+                               if chunk_bytes is None else int(chunk_bytes),
+                               1)
+        self.keep = max(config.FLEET_PUBLISH_KEEP.get()
+                        if keep is None else int(keep), 2)
+        self._work: list = []          # [(version, step, image-bytes)]
+        self._wake = threading.Event()
+        self._halt = threading.Event()
+        self._lock = threading.Lock()
+        self.version = 0               # last version handed to the thread
+        self.published = 0             # versions fully committed to KV
+        self._shards: dict[int, int] = {}   # version -> shard count
+
+    # -- training-thread side -------------------------------------------
+    def maybe_publish(self, step: int, tree) -> int | None:
+        """Publish ``tree`` if ``step`` is on the publish cadence;
+        returns the assigned version (or None when off-cadence)."""
+        if self.publish_steps <= 0 or step % self.publish_steps != 0:
+            return None
+        image = bytes(flatten_state(tree))
+        with self._lock:
+            self.version += 1
+            version = self.version
+            self._work.append((version, step, image))
+        self._wake.set()
+        return version
+
+    # -- publisher thread -----------------------------------------------
+    def run(self) -> None:
+        while not self._halt.is_set():
+            self._wake.wait(timeout=1.0)
+            self._wake.clear()
+            while True:
+                with self._lock:
+                    if not self._work:
+                        break
+                    version, step, image = self._work.pop(0)
+                self._publish(version, step, image)
+
+    def _publish(self, version: int, step: int, image: bytes) -> None:
+        digest = state_digest(image)
+        shards = -(-len(image) // self.chunk_bytes) or 1
+        records = [(PUB_SCOPE, _shard_key(version, i),
+                    image[i * self.chunk_bytes:(i + 1) * self.chunk_bytes])
+                   for i in range(shards)]
+        self.kv.put_many(records)
+        # Shards first, meta second, head last: a puller that sees the
+        # head bump is guaranteed a complete, addressable snapshot.
+        meta = {"version": version, "step": step, "digest": digest,
+                "nbytes": len(image), "shards": shards}
+        self.kv.put(PUB_SCOPE, _meta_key(version),
+                    json.dumps(meta).encode())
+        self.kv.put(PUB_SCOPE, "head", str(version).encode())
+        self.published += 1
+        self._shards[version] = shards
+        rec = recorder()
+        if rec.enabled:
+            rec.record("fleet-publish", name=f"v{version}",
+                       detail=f"step={step} nbytes={len(image)} "
+                              f"shards={shards}")
+        stale = sorted(self._shards)[:-self.keep]
+        for old in stale:
+            n = self._shards.pop(old)
+            self.kv.delete(PUB_SCOPE, _meta_key(old))
+            for i in range(n):
+                self.kv.delete(PUB_SCOPE, _shard_key(old, i))
+
+    def drain(self, timeout: float = 10.0) -> None:
+        """Block (bounded) until every enqueued version is committed —
+        the battery's determinism hook, not a production path."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._work and self.published >= self.version:
+                    return
+            self._wake.set()
+            time.sleep(0.02)
+
+    def close(self) -> None:
+        self._halt.set()
+        self._wake.set()
+        if self.is_alive() and self is not threading.current_thread():
+            self.join(timeout=10.0)
+
+
+class WeightPuller(threading.Thread):
+    """Serving-side snapshot puller: polls ``head``, fetches + digest-
+    verifies new versions, and stages them through ``stage(version,
+    image, meta)`` — the replica swaps at its next plan boundary."""
+
+    def __init__(self, kv, stage, *, interval_s: float = 0.5) -> None:
+        super().__init__(daemon=True, name="hvd-fleet-puller")
+        self.kv = kv
+        self._stage = stage
+        self.interval_s = float(interval_s)
+        self._halt = threading.Event()
+        self.seen = 0                  # newest version staged
+        self.pulled = 0
+        self.verify_failures = 0
+
+    def run(self) -> None:
+        while not self._halt.wait(timeout=self.interval_s):
+            try:
+                self.poll_once()
+            except (TimeoutError, OSError) as exc:
+                logger.debug("fleet: puller poll failed: %s", exc)
+
+    def poll_once(self) -> int | None:
+        """One head poll; returns the version staged (None if no news).
+        Split out of run() so the battery and units can drive the pull
+        synchronously."""
+        raw = self.kv.get(PUB_SCOPE, "head")
+        if raw is None:
+            return None
+        head = int(raw)
+        if head <= self.seen:
+            return None
+        meta_raw = self.kv.get(PUB_SCOPE, _meta_key(head))
+        if meta_raw is None:
+            return None                # head raced the GC window: retry
+        meta = json.loads(meta_raw)
+        parts = []
+        for i in range(int(meta["shards"])):
+            shard = self.kv.get(PUB_SCOPE, _shard_key(head, i))
+            if shard is None:
+                return None            # torn fetch: retry next poll
+            parts.append(shard)
+        image = b"".join(parts)
+        # THE ordering the fleet spec model-checks: digest-verify BEFORE
+        # the image is staged anywhere a swap can reach it.
+        if len(image) != int(meta["nbytes"]) \
+                or state_digest(image) != int(meta["digest"]):
+            self.verify_failures += 1
+            logger.warning(
+                "fleet: snapshot v%d failed digest verify "
+                "(%d bytes); discarding", head, len(image))
+            return None
+        self.seen = head
+        self.pulled += 1
+        rec = recorder()
+        if rec.enabled:
+            rec.record("fleet-pull", name=f"v{head}",
+                       detail=f"nbytes={len(image)} verified")
+        self._stage(head, image, meta)
+        return head
+
+    def close(self) -> None:
+        self._halt.set()
+        if self.is_alive() and self is not threading.current_thread():
+            self.join(timeout=self.interval_s + 10.0)

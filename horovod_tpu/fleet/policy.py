@@ -1,0 +1,122 @@
+"""Pure-logic fleet rebalancing policy: which world is starved, and by
+how many ranks (docs/fleet.md).
+
+The shape is the autoscale policy's (statesync/autoscale.py) — streak
+counters with hysteresis, a cooldown after every decision — but the
+actuator differs: autoscale changes ONE world's target size against an
+external pool, while the fleet policy moves ranks BETWEEN two live
+worlds sharing a fixed host pool.  That makes oscillation the dominant
+failure mode (a move that fixes serving starves training, which a naive
+policy immediately reverses), so the cooldown here is its own knob
+(``HOROVOD_FLEET_COOLDOWN_ROUNDS``) layered on top of hysteresis and
+both floors (``HOROVOD_FLEET_MIN_TRAIN`` / ``_MIN_SERVE``) are hard:
+the policy never proposes a move it would have to take back on the
+next tick just to restore a floor.
+
+No I/O, no threads, no clocks: the controller (controller.py) feeds
+gauges in and executes decisions out, so every branch here is unit-
+testable in microseconds (tests/test_fleet.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from ..common import config
+
+__all__ = ["FleetDecision", "FleetPolicy"]
+
+TRAIN_TO_SERVE = "train->serve"
+SERVE_TO_TRAIN = "serve->train"
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetDecision:
+    """One rebalance decision: move ``n`` ranks in ``direction``."""
+    direction: str                 # TRAIN_TO_SERVE | SERVE_TO_TRAIN
+    n: int
+    reason: str
+
+
+class FleetPolicy:
+    """Hysteresis + cooldown rebalancer over the two worlds' gauges.
+
+    ``observe`` is called once per controller interval with the current
+    world sizes and the freshest gauges; it returns a
+    :class:`FleetDecision` or None.  A condition must hold for
+    ``hysteresis_rounds`` consecutive intervals before a decision
+    fires, and after every decision the policy stays silent for
+    ``cooldown_rounds`` intervals — so the number of migrations in any
+    window of R rounds is bounded by ``R / (hysteresis + cooldown)``
+    regardless of how adversarial the gauge sequence is (the
+    oscillation bound asserted in tests/test_fleet.py)."""
+
+    def __init__(self, *, min_train: int | None = None,
+                 min_serve: int | None = None,
+                 up_shed_rate: float | None = None,
+                 up_queue_fraction: float | None = None,
+                 idle_queue_fraction: float | None = None,
+                 train_lag_ms: float | None = None,
+                 hysteresis_rounds: int | None = None,
+                 cooldown_rounds: int | None = None,
+                 queue_depth_limit: int | None = None) -> None:
+        self.min_train = config.FLEET_MIN_TRAIN.get() \
+            if min_train is None else int(min_train)
+        self.min_serve = config.FLEET_MIN_SERVE.get() \
+            if min_serve is None else int(min_serve)
+        self.up_shed_rate = config.FLEET_UP_SHED_RATE.get() \
+            if up_shed_rate is None else float(up_shed_rate)
+        self.up_queue_fraction = config.FLEET_UP_QUEUE_FRACTION.get() \
+            if up_queue_fraction is None else float(up_queue_fraction)
+        self.idle_queue_fraction = config.FLEET_IDLE_QUEUE_FRACTION.get() \
+            if idle_queue_fraction is None else float(idle_queue_fraction)
+        self.train_lag_ms = config.FLEET_TRAIN_LAG_MS.get() \
+            if train_lag_ms is None else float(train_lag_ms)
+        self.hysteresis_rounds = config.FLEET_HYSTERESIS_ROUNDS.get() \
+            if hysteresis_rounds is None else int(hysteresis_rounds)
+        self.cooldown_rounds = config.FLEET_COOLDOWN_ROUNDS.get() \
+            if cooldown_rounds is None else int(cooldown_rounds)
+        self.queue_depth_limit = config.SERVE_QUEUE_DEPTH.get() \
+            if queue_depth_limit is None else int(queue_depth_limit)
+        self._serve_hot = 0            # consecutive overloaded intervals
+        self._train_hot = 0            # consecutive trainer-starved ones
+        self._cooldown = 0
+        self.decisions = 0
+
+    def _reset_streaks(self) -> None:
+        self._serve_hot = 0
+        self._train_hot = 0
+        self._cooldown = self.cooldown_rounds
+
+    def observe(self, train_size: int, serve_size: int, *,
+                shed_rate: float = 0.0, queue_depth: float = 0.0,
+                straggler_lag_ms: float = 0.0) -> FleetDecision | None:
+        """One policy tick.  Gauges: serving shed rate over the last
+        interval, serving queue depth, trainer straggler lag."""
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+        queue_frac = float(queue_depth) / max(self.queue_depth_limit, 1)
+        serve_hot = (shed_rate > self.up_shed_rate
+                     or queue_frac > self.up_queue_fraction)
+        serve_idle = (shed_rate <= 0.0
+                      and queue_frac < self.idle_queue_fraction)
+        train_hot = serve_idle and straggler_lag_ms > self.train_lag_ms
+        self._serve_hot = self._serve_hot + 1 if serve_hot else 0
+        self._train_hot = self._train_hot + 1 if train_hot else 0
+        if self._serve_hot >= self.hysteresis_rounds \
+                and train_size - 1 >= self.min_train:
+            self._reset_streaks()
+            self.decisions += 1
+            return FleetDecision(
+                TRAIN_TO_SERVE, 1,
+                f"serving overloaded (shed={shed_rate:.3f} "
+                f"queue={queue_frac:.2f})")
+        if self._train_hot >= self.hysteresis_rounds \
+                and serve_size - 1 >= self.min_serve:
+            self._reset_streaks()
+            self.decisions += 1
+            return FleetDecision(
+                SERVE_TO_TRAIN, 1,
+                f"trainer starved (lag={straggler_lag_ms:.1f}ms, "
+                f"serving idle)")
+        return None

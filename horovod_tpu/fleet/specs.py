@@ -1,0 +1,196 @@
+"""Protocol spec for the train<->serve fleet handoff (hvdmc DSL).
+
+One spec, two intertwined machines over the coordinator KV
+(docs/fleet.md):
+
+- **migration** — the controller journals a move (``mig:``), publishes
+  the ``depart:`` directive, the donor rank departs at its statesync
+  boundary and joins the other world, and its ``joined:`` mark closes
+  the journal record (done) — or a controller failover / deadline
+  closes it (aborted);
+- **deployment** — the publisher commits ``shard:`` records, then the
+  ``meta:`` stamp, then the ``head`` bump (strictly in that order), and
+  every replica pulls, digest-verifies, stages, and swaps at a
+  BatchPlan boundary.
+
+The safety property the checker earns its keep on is
+``swap-verified``: a replica must never swap in an image that did not
+reproduce the published meta digest.  The seeded ``swap-before-verify``
+mutation (machines.FleetModel) drops exactly that guard, and the
+shard-corrupt fault then drives a corrupt image into the serving path
+— the counterexample trace tier-1 asserts byte-for-byte.
+
+HVD506 binds every verb and transition to the fleet implementation
+(controller.py / deploy.py / the statesync depart hook / the replica
+boundary swap), so protocol drift in either direction fails the tree.
+"""
+from __future__ import annotations
+
+from ..analysis.hvdmc.spec import ProtocolSpec, Transition, Verb
+
+__all__ = ["fleet_spec"]
+
+_CTL = "fleet.controller"
+_DEP = "fleet.deploy"
+_FC = f"{_CTL}.FleetController"
+_PUB = f"{_DEP}.WeightPublisher"
+_PUL = f"{_DEP}.WeightPuller"
+
+
+def fleet_spec() -> ProtocolSpec:
+    verbs = (
+        Verb("GAUGE", "kv", "fleet.gauges",
+             doc="each world front's load gauge (size, shed rate, "
+                 "queue depth, straggler lag)"),
+        Verb("JOURNAL", "kv", "mig:",
+             doc="epoch-stamped migration journal record "
+                 "(planned -> departing -> done | aborted)"),
+        Verb("DEPART", "kv", "depart:",
+             doc="the directive a donor rank consumes at its statesync "
+                 "step boundary"),
+        Verb("JOINED", "kv", "joined:",
+             doc="the mover's arrival mark, written only after the "
+                 "destination world's join completed"),
+        Verb("SHARD", "kv", "shard:",
+             doc="one chunk of a published param snapshot"),
+        Verb("META", "kv", "meta:",
+             doc="a version's commit stamp: digest + nbytes + shard "
+                 "count"),
+        Verb("HEAD", "kv", "head",
+             doc="the newest fully committed snapshot version"),
+    )
+    transitions = (
+        # -- controller --------------------------------------------------
+        Transition("ctl.observe", "controller", "idle", "idle",
+                   "kv:GAUGE",
+                   binds=(f"{_FC}.tick", f"{_CTL}.publish_gauge",
+                          f"{_CTL}.read_gauge"),
+                   doc="poll both worlds' gauges, feed the policy"),
+        Transition("ctl.plan", "controller", "idle", "planning",
+                   "kv:JOURNAL", guard="hysteresis-held",
+                   requires_calls=("claim",), observe="fleet-migrate",
+                   binds=(f"{_FC}.begin_migration",),
+                   doc="journal first: every KV state a failover can "
+                       "observe is unambiguous about the directive"),
+        Transition("ctl.direct", "controller", "planning", "migrating",
+                   "kv:DEPART",
+                   binds=(f"{_FC}.begin_migration",)),
+        Transition("ctl.complete", "controller", "migrating", "idle",
+                   "kv:JOINED", requires_calls=("delete",),
+                   binds=(f"{_FC}._advance",),
+                   doc="joined mark observed: journal done, directive "
+                       "withdrawn"),
+        Transition("ctl.abort-planned", "controller", "planning",
+                   "idle", "internal:failover-abort",
+                   guard="directive-never-published",
+                   binds=(f"{_FC}.recover",),
+                   doc="failover adopted a planned record whose "
+                       "directive was never written: abort is safe, no "
+                       "rank can be acting on it"),
+        Transition("ctl.abort-deadline", "controller", "migrating",
+                   "idle", "internal:deadline-exceeded",
+                   binds=(f"{_FC}._advance",),
+                   doc="a wedged mover never blocks the controller "
+                       "forever"),
+        Transition("ctl.resume", "controller", "migrating", "migrating",
+                   "internal:epoch-claimed", guard="journal-resumable",
+                   requires_calls=("claim",),
+                   binds=(f"{_FC}.recover",),
+                   doc="failover adopted a departing record: the mover "
+                       "may be mid-join, keep waiting for its mark"),
+        # -- mover (the donor rank changing worlds) ----------------------
+        Transition("mov.directive", "mover", "training", "boundary",
+                   "kv:DEPART",
+                   binds=(f"{_CTL}.poll_depart",),
+                   doc="the donor rank's boundary poll consumed its "
+                       "directive"),
+        Transition("mov.depart", "mover", "boundary", "joining",
+                   "boundary", guard="depart-at-boundary",
+                   observe="fleet-depart",
+                   binds=("statesync.service.StateSyncService"
+                          ".request_depart",),
+                   doc="orderly departure through the preemption-grace "
+                       "boundary: survivors shrink proactively, no "
+                       "RanksFailedError"),
+        Transition("mov.join", "mover", "joining", "serving",
+                   "internal:join-complete",
+                   requires_calls=("join_world",),
+                   binds=("serving.replica.join_serving_world",),
+                   doc="peer-streamed state into the destination world "
+                       "(the statesync-grow machine runs here)"),
+        Transition("mov.arrive", "mover", "serving", "serving",
+                   "kv:JOINED", requires_calls=("put",),
+                   observe="fleet-join",
+                   binds=(f"{_CTL}.mark_joined",)),
+        # -- publisher (trainer rank 0) ----------------------------------
+        Transition("pub.shards", "publisher", "run", "run", "kv:SHARD",
+                   requires_calls=("put_many",),
+                   binds=(f"{_PUB}._publish",)),
+        Transition("pub.meta", "publisher", "run", "run", "kv:META",
+                   guard="meta-after-shards",
+                   binds=(f"{_PUB}._publish",)),
+        Transition("pub.head", "publisher", "run", "run", "kv:HEAD",
+                   observe="fleet-publish",
+                   binds=(f"{_PUB}._publish",),
+                   doc="head bumps LAST: a puller that sees it is "
+                       "guaranteed a complete, addressable snapshot"),
+        # -- replica (serving-side puller + boundary swap) ---------------
+        Transition("rep.poll", "replica", "serving", "serving",
+                   "kv:HEAD",
+                   binds=(f"{_PUL}.poll_once",)),
+        Transition("rep.fetch", "replica", "serving", "fetched",
+                   "kv:SHARD",
+                   binds=(f"{_PUL}.poll_once",)),
+        Transition("rep.verify-stage", "replica", "fetched", "staged",
+                   "internal:digest-verifies",
+                   guard="verify-before-stage", observe="fleet-pull",
+                   binds=(f"{_PUL}.poll_once",),
+                   doc="digest-verify BEFORE the image is staged "
+                       "anywhere a swap can reach it — the guard the "
+                       "swap-before-verify mutation drops"),
+        Transition("rep.verify-reject", "replica", "fetched", "serving",
+                   "internal:digest-mismatch",
+                   guard="verify-before-stage",
+                   binds=(f"{_PUL}.poll_once",)),
+        Transition("rep.swap", "replica", "staged", "serving",
+                   "boundary", guard="swap-at-plan-boundary",
+                   observe="fleet-swap",
+                   binds=("serving.replica.ReplicaExecutor"
+                          "._apply_plan",),
+                   doc="the broadcast BatchPlan IS the schedule: every "
+                       "rank swaps at the same step, zero dropped "
+                       "admitted requests"),
+        # -- injected faults ---------------------------------------------
+        Transition("net.failover", "net", "env", "env",
+                   "fault:controller-failover",
+                   doc="the controller host dies mid-migration; a "
+                       "successor claims the next epoch and adopts the "
+                       "journal"),
+        Transition("net.shard-corrupt", "net", "env", "env",
+                   "fault:shard-corrupt"),
+    )
+    return ProtocolSpec(
+        name="fleet-handoff",
+        doc="train<->serve rank migration + continuous weight "
+            "deployment (docs/fleet.md)",
+        roles=("controller", "mover", "publisher", "replica", "net"),
+        states={"controller": ("idle", "planning", "migrating"),
+                "mover": ("training", "boundary", "joining", "serving"),
+                "publisher": ("run",),
+                "replica": ("serving", "fetched", "staged"),
+                "net": ("env",)},
+        verbs=verbs,
+        transitions=transitions,
+        anchor_modules=(_CTL, _DEP),
+        properties={
+            "swap-verified":
+                "a replica never swaps in an image that did not "
+                "reproduce the published meta record's digest",
+            "journal-resolves":
+                "every journaled migration reaches done or aborted, "
+                "even across a controller failover",
+            "resolution-reachable":
+                "from every reachable state the handoff can still "
+                "complete: the migration closes and the published "
+                "version lands verified (AG EF)",
+        })

@@ -90,6 +90,19 @@ def parse_args(argv=None) -> argparse.Namespace:
     tuning.add_argument("--autotune", action="store_true")
     tuning.add_argument("--autotune-log-file", default=None)
 
+    fleet = parser.add_argument_group("fleet")
+    fleet.add_argument("--fleet", action="store_true",
+                       help="Run the unified train+serve fleet "
+                       "controller on rank 0 (traffic-driven rank "
+                       "rebalancing; docs/fleet.md).")
+    fleet.add_argument("--fleet-publish-steps", type=int, default=None,
+                       help="Trainer param-snapshot publish cadence in "
+                       "steps (continuous weight deployment; 0 "
+                       "disables).")
+    fleet.add_argument("--fleet-interval", type=float, default=None,
+                       help="Fleet controller gauge-poll/decision "
+                       "interval in seconds.")
+
     debug = parser.add_argument_group("debug")
     debug.add_argument("--timeline-filename", default=None)
     debug.add_argument("--timeline-mark-cycles", action="store_true")
@@ -164,6 +177,15 @@ def args_to_env(args) -> dict[str, str]:
     set_if(args.log_level is not None, "HOROVOD_LOG_LEVEL", args.log_level)
     set_if(args.network_interface is not None, "HOROVOD_GLOO_IFACE",
            args.network_interface)
+    # getattr: programmatic callers (elastic driver, run_api) build the
+    # Namespace by hand and may predate the fleet group.
+    set_if(getattr(args, "fleet", False), "HOROVOD_FLEET", 1)
+    fleet_publish = getattr(args, "fleet_publish_steps", None)
+    set_if(fleet_publish is not None,
+           "HOROVOD_FLEET_PUBLISH_STEPS", fleet_publish)
+    fleet_interval = getattr(args, "fleet_interval", None)
+    set_if(fleet_interval is not None, "HOROVOD_FLEET_INTERVAL_S",
+           fleet_interval)
     return env
 
 

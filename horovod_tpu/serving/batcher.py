@@ -48,6 +48,11 @@ class BatchPlan:
     step: int
     assign: list[Assignment] = field(default_factory=list)
     stop: bool = False
+    # Fleet continuous deployment (fleet/deploy.py): when non-zero,
+    # every rank swaps its staged weight snapshot to this version at
+    # THIS step — the broadcast IS the swap schedule, so replicas never
+    # decode one step with mixed weights.
+    swap_version: int = 0
 
 
 class ContinuousBatcher:

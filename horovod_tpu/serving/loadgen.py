@@ -31,7 +31,7 @@ import time
 from ..common import config
 from .replica import ReplicaExecutor, ServeConfig
 
-SCHEMA = "horovod_tpu.serving.loadgen/1"
+SCHEMA = "horovod_tpu.serving.loadgen/2"
 
 
 def arrival_times(rng: random.Random, n: int, duration: float,
@@ -144,8 +144,27 @@ def build_report(executor: ReplicaExecutor, *, offered: int,
         # numbers bench.py --model serve reports next to the dense leg.
         "kv": executor.kv_stats(),
         "max_concurrent_seqs": executor.batcher.max_concurrent,
+        # Fleet continuous-deployment staleness accounting: which weight
+        # versions served this rank's completions and how many trainer
+        # steps behind the newest staged snapshot any of them ran
+        # (docs/fleet.md).
+        "weights": _weights_report(executor),
     }
     return report
+
+
+def _weights_report(executor: ReplicaExecutor) -> dict:
+    versions: dict[str, int] = {}
+    stale_max = 0
+    for rec in executor.completed.values():
+        v = str(rec.get("weights", 0))
+        versions[v] = versions.get(v, 0) + 1
+        stale_max = max(stale_max, rec.get("weights_stale_steps", 0))
+    return {"final_version": executor.weight_version,
+            "versions": versions,
+            "max_staleness_steps": stale_max,
+            "swaps": [{"version": s["version"], "step": s["step"]}
+                      for s in executor.stats["weight_swaps"]]}
 
 
 def _goodput_phases(executor: ReplicaExecutor,

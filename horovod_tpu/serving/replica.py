@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 import time
 
 import jax
@@ -208,11 +209,22 @@ class ReplicaExecutor:
                       "latencies_ms": [], "completed_at": [],
                       "shrinks": [], "grows": [],
                       "prefill_streams": 0, "prefill_fallbacks": 0,
-                      "prefill_skipped": 0}
+                      "prefill_skipped": 0, "weight_swaps": []}
         # Elastic grow mid-serve (statesync/): attach_statesync wires a
         # membership service in; None = the pre-ISSUE-10 behavior with
         # zero extra collectives.
         self.statesync = None
+        # Fleet continuous weight deployment (fleet/deploy.py): the
+        # puller thread stages verified snapshots here; the front
+        # schedules the swap into a BatchPlan once EVERY rank's staged
+        # version (piggybacked on the completions allgather) covers it.
+        self.weight_version = 0
+        self._weight_step = 0          # trainer step of the live weights
+        self._fleet_lock = threading.Lock()
+        self._fleet_staged = None      # (version, params tree, step)
+        self._fleet_puller = None
+        self._fleet_minstaged = 0      # min staged across ranks (front)
+        self._fleet_scheduled = 0      # newest version the front swapped
 
         self.queue = RequestQueue(maxsize=self.cfg.queue_depth,
                                   default_slo_ms=self.cfg.slo_ms)
@@ -392,6 +404,13 @@ class ReplicaExecutor:
             # Expired while queued: shed at admission, never executed.
             self.admission.count("expired")
             self.stats["expired"] += 1
+        # Fleet weight rollout: once every rank's staged version (from
+        # the last completions exchange) passes the current weights,
+        # schedule the swap — the broadcast makes it simultaneous.
+        if self._fleet_minstaged > max(self.weight_version,
+                                       self._fleet_scheduled):
+            plan.swap_version = self._fleet_minstaged
+            self._fleet_scheduled = plan.swap_version
         return plan
 
     def _exchange_plan(self, plan: BatchPlan | None) -> BatchPlan:
@@ -404,6 +423,8 @@ class ReplicaExecutor:
 
     def _apply_plan(self, plan: BatchPlan) -> None:
         now = time.monotonic()
+        if plan.swap_version:
+            self._fleet_swap(plan.swap_version)
         for a in plan.assign:
             if self.is_prefill:
                 if a.prefill == self.rank:
@@ -723,13 +744,20 @@ class ReplicaExecutor:
 
     def _collect_completions(self) -> None:
         now = time.monotonic()
+        stale = self._fleet_staleness_steps()
         for i, s in enumerate(self.slots):
             if s is None or s.pending is not None or s.remaining > 0:
                 continue
             rec = {"rid": s.rid, "replica": self.group,
                    "latency_ms": s.age_ms + (now - s.assigned_at) * 1e3,
                    "tokens": len(s.generated),
-                   "slo_met": now <= s.deadline}
+                   "slo_met": now <= s.deadline,
+                   # Which published weights served this request, and
+                   # how many trainer steps behind the newest staged
+                   # snapshot — the loadgen staleness accounting
+                   # (docs/fleet.md).
+                   "weights": self.weight_version,
+                   "weights_stale_steps": stale}
             self.completed[s.rid] = rec
             if self.group_leader:
                 # Every group member frees slots identically; only the
@@ -750,13 +778,18 @@ class ReplicaExecutor:
 
     def _exchange_completions(self) -> list[dict]:
         from ..resilience import deadline_scope
-        done = list(self._unreported)
+        # Completions plus this rank's staged weight version ride one
+        # allgather: the front learns min(staged) with zero extra
+        # collectives, exactly like completions ride the step.
+        mine = {"done": list(self._unreported),
+                "staged": self._fleet_staged_version()}
         deadlines = [s.deadline for s in self.slots if s is not None]
         with deadline_scope(min(deadlines) if deadlines else None):
             per_rank = self.hvd.allgather_object(
-                done, name=f"serve.done.g{self._gen}.{self._step}")
+                mine, name=f"serve.done.g{self._gen}.{self._step}")
         self._unreported.clear()       # acknowledged by the exchange
-        return [rec for ranklist in per_rank for rec in ranklist]
+        self._fleet_minstaged = min(p.get("staged", 0) for p in per_rank)
+        return [rec for p in per_rank for rec in p["done"]]
 
     def _account(self, completions: list[dict]) -> None:
         if self.rank != self.front:
@@ -791,6 +824,86 @@ class ReplicaExecutor:
 
         return {"params": jax.tree_util.tree_map(np.asarray,
                                                  self.params)}
+
+    # -- fleet continuous weight deployment (fleet/) ---------------------
+    def attach_fleet(self, kv, *, interval_s: float | None = None):
+        """Start a fleet weight puller against the coordinator KV: it
+        polls the published ``head``, digest-verifies new snapshots and
+        stages them here; the front end schedules the swap into a
+        broadcast BatchPlan once every rank has staged (docs/fleet.md).
+        Returns the puller (owned by this executor — ``close`` joins
+        it)."""
+        from ..fleet.deploy import WeightPuller
+
+        kwargs = {} if interval_s is None else {"interval_s": interval_s}
+        self._fleet_puller = WeightPuller(kv, self._fleet_stage,
+                                          **kwargs)
+        self._fleet_puller.start()
+        return self._fleet_puller
+
+    def _fleet_stage(self, version: int, image, meta) -> None:
+        """WeightPuller stage callback (puller thread): decode the
+        already-verified image into a params-shaped tree and park it for
+        the front-scheduled boundary swap.  Never touches live params —
+        the swap happens on the serve thread inside ``_apply_plan``."""
+        from ..statesync.snapshot import unflatten_state
+
+        template = {"params": jax.tree_util.tree_map(np.asarray,
+                                                     self.params)}
+        tree = unflatten_state(image, template)
+        with self._fleet_lock:
+            self._fleet_staged = (version, tree["params"],
+                                  int(meta.get("step", 0)),
+                                  int(meta.get("digest", 0)))
+
+    def _fleet_staged_version(self) -> int:
+        with self._fleet_lock:
+            staged = self._fleet_staged
+        return max(self.weight_version,
+                   staged[0] if staged is not None else 0)
+
+    def _fleet_staleness_steps(self) -> int:
+        """Trainer steps between the newest snapshot this rank has
+        staged and the weights currently serving (0 when current) — the
+        loadgen staleness accounting (docs/fleet.md)."""
+        with self._fleet_lock:
+            staged = self._fleet_staged
+        newest = staged[2] if staged is not None else self._weight_step
+        return max(0, newest - self._weight_step)
+
+    def _fleet_swap(self, version: int) -> None:
+        """Swap the staged snapshot in at the plan boundary the front
+        scheduled.  Every rank executes this at the same step (the plan
+        broadcast IS the schedule): in-flight slots keep decoding under
+        the new weights, no admitted request is dropped."""
+        with self._fleet_lock:
+            staged = self._fleet_staged
+            if staged is not None and staged[0] >= version:
+                self._fleet_staged = None
+        if staged is None or staged[0] < version:
+            # The front schedules min(staged) across ranks, so a rank
+            # can only be missing the version after a local restart;
+            # keep serving the old weights until the puller re-stages.
+            return
+        v, params, meta_step, digest = staged
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self.weight_version = v
+        self._weight_step = meta_step
+        self.stats["weight_swaps"].append(
+            {"version": v, "step": self._step, "digest": digest,
+             "at": time.monotonic()})
+        from ..telemetry import flight
+        from ..telemetry import metrics as telemetry_metrics
+
+        rec = flight.recorder()
+        if rec.enabled:
+            rec.record("fleet-swap", name=f"v{v}",
+                       detail=f"swapped at plan step {self._step}")
+        tm = telemetry_metrics()
+        if tm.enabled:
+            tm.gauge("horovod_fleet_weight_version").set(v)
+        logger.info("serving: weights v%d swapped at step %d", v,
+                    self._step)
 
     def _statesync_boundary(self) -> None:
         change = self.statesync.step_boundary()
@@ -1025,6 +1138,9 @@ class ReplicaExecutor:
         kvstream mesh (drain threads + sockets) and the KV block pool
         (hvdlife HVD702/704 — the pool must not outlive the executor
         across elastic reinit cycles)."""
+        if self._fleet_puller is not None:
+            self._fleet_puller.close()
+            self._fleet_puller = None
         if self._kvstream is not None:
             self._kvstream.close()
             self._kvstream = None

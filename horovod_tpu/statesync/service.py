@@ -55,6 +55,14 @@ _WORLD_SCOPE = "statesync"
 _WORLD_KEY = "world"
 
 
+def _world_key() -> str:
+    # A fleet deployment runs two live worlds (train + serve) against
+    # ONE coordinator KV, so the membership record is namespaced by
+    # HOROVOD_STATESYNC_WORLD; join_world reads the same name to
+    # target the right world (docs/fleet.md).
+    return config.STATESYNC_WORLD.get() or _WORLD_KEY
+
+
 def _grow_scope(epoch: str) -> str:
     return f"ssgrow.{epoch}"
 
@@ -179,7 +187,7 @@ class StateSyncService:
             "(tracks every elastic grow/shrink transition)").set(self.size)
         if self.rank == 0:
             try:
-                self._kv.put(_WORLD_SCOPE, _WORLD_KEY, json.dumps(
+                self._kv.put(_WORLD_SCOPE, _world_key(), json.dumps(
                     {"epoch": self._epoch, "size": self.size,
                      "seq": self._seq}).encode())
             except Exception as exc:  # noqa: BLE001 - KV hiccup
@@ -248,6 +256,28 @@ class StateSyncService:
     @property
     def preempt_requested(self) -> bool:
         return self._preempt_at is not None
+
+    def request_depart(self) -> None:
+        """Programmatic orderly departure: arm the same boundary path a
+        SIGTERM preemption notice takes (announce via the ``depart``
+        flag of the next membership exchange, fast-donate, depart with
+        the ``bye|`` stamp — survivors shrink proactively, no
+        RanksFailedError), minus the signal handler and the backstop
+        timer.  The fleet controller's migration directive
+        (fleet/controller.py) lands here: moving a rank between worlds
+        IS a preemption from the donor world's point of view."""
+        if self._preempt_at is not None:
+            return
+        self._preempt_at = time.monotonic()
+        from ..telemetry import flight
+
+        rec = flight.recorder()
+        if rec.enabled:
+            rec.record("fleet-depart",
+                       detail="departing at the next step boundary "
+                              "(fleet migration directive)")
+        logger.info("statesync: departure requested; leaving at the "
+                    "next step boundary")
 
     # -- watcher ---------------------------------------------------------
     def _watch_loop(self) -> None:
@@ -585,7 +615,7 @@ def join_world(template_state: Any, *, timeout: float | None = None,
         else config.STATESYNC_TIMEOUT_SECONDS.get()
     last_exc: Exception | None = None
     for attempt in range(max_attempts):
-        world = json.loads(kv.wait(_WORLD_SCOPE, _WORLD_KEY, timeout))
+        world = json.loads(kv.wait(_WORLD_SCOPE, _world_key(), timeout))
         epoch, size = world["epoch"], int(world["size"])
         scope = _grow_scope(epoch)
         join_id = kv.claim(scope, "joins",

@@ -156,6 +156,13 @@ class Trainer:
         self._step_flops: float | None = None
         self._peak_flops: float | None = None
         self._last_dispatch: float | None = None
+        # Fleet continuous deployment (fleet/deploy.py): rank 0 wires a
+        # WeightPublisher in via attach_fleet_publisher; the host-side
+        # step counter drives the publish cadence (the device step
+        # number lives in donated buffers — syncing it every step to
+        # test a modulus would serialize the async dispatch).
+        self._fleet_publisher = None
+        self._fleet_step = 0
 
     # -- initialization ----------------------------------------------------
     def init(self, rng: jax.Array, sample_batch: dict) -> TrainState:
@@ -373,6 +380,24 @@ class Trainer:
         tm.gauge("horovod_train_mfu").set(
             perfmodel.mfu(self._step_flops, dt, self._peak_flops))
 
+    # -- fleet continuous deployment (fleet/) ------------------------------
+    def attach_fleet_publisher(self, publisher) -> None:
+        """Wire a fleet ``WeightPublisher`` in (rank 0 only — the
+        publisher is the single writer of the ``fleet.pub`` scope):
+        every ``step`` offers the params snapshot on the publish cadence
+        and the serving world pulls it (docs/fleet.md)."""
+        self._fleet_publisher = publisher
+
+    def _fleet_publish(self, state: TrainState) -> None:
+        if self._fleet_publisher is None:
+            return
+        self._fleet_step += 1
+        version = self._fleet_publisher.maybe_publish(
+            self._fleet_step, {"params": state.params})
+        if version is not None:
+            logger.info("fleet: offered params snapshot v%d at host "
+                        "step %d", version, self._fleet_step)
+
     def step(self, state: TrainState, batch: dict):
         first = self._step_fn is None
         if self._step_fn is None:
@@ -398,6 +423,7 @@ class Trainer:
             try:
                 result = self._compiled(state, batch)
                 self._note_step(batch, first)
+                self._fleet_publish(state)
                 return result
             except TypeError:
                 # Shape/dtype drift vs the AOT signature (e.g. a ragged
@@ -407,6 +433,7 @@ class Trainer:
                 self._compiled = None
         result = self._step_fn(state, batch)
         self._note_step(batch, first)
+        self._fleet_publish(state)
         return result
 
     # -- fit loop with callbacks ------------------------------------------

@@ -1,0 +1,62 @@
+"""Regenerate the committed fleet-controller console fixture.
+
+Runs the REAL 4-rank fleet acceptance battery (tests/mp_worker.py
+``battery_fleet`` — launch ranks 0-2 train, launch rank 3 serves, one
+traffic-driven train->serve migration plus a continuous weight rollout)
+and harvests its evidence into ``fleet4/``:
+
+- each launch rank's end-of-battery flight dump (the same files the
+  hvdmc witness replays) becomes ``flight.r{r}.json``;
+- the serving front's loadgen report (goodput phases, weight-version
+  mix, staleness) becomes ``SERVE_r0.json``.
+
+``summary_lines`` of the rendered episode is recorded as
+``fleet4.summary.txt``.  Run from the repo root after changing the
+fleet dump formats or the renderer::
+
+    JAX_PLATFORMS=cpu python tests/fixtures/console/regen_fleet.py
+
+The committed dump dir is the test input and the summary file the
+golden; ``tests/test_console.py`` renders the former and byte-compares
+against the latter (no battery run at test time).
+"""
+import glob
+import os
+import shutil
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(HERE, "..", "..", "..")))
+sys.path.insert(0, os.path.abspath(os.path.join(HERE, "..", "..")))
+EPISODE = os.path.join(HERE, "fleet4")
+GOLDEN = os.path.join(HERE, "fleet4.summary.txt")
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from test_multiprocess import _run_world
+    from test_statesync import _witness_env
+    from horovod_tpu.console import load_dump_dir, summary_lines
+
+    shutil.rmtree(EPISODE, ignore_errors=True)
+    os.makedirs(EPISODE)
+    extra = _witness_env("fleet", 4)
+    extra["HOROVOD_FLEET_DUMP_DIR"] = EPISODE
+    _run_world(4, "fleet", timeout=360.0, extra_env=extra)
+    for dump in sorted(glob.glob("/tmp/hvd_witness_fleet4"
+                                 ".launch*.json")):
+        launch = dump.rsplit(".launch", 1)[1].split(".", 1)[0]
+        shutil.copy(dump, os.path.join(EPISODE,
+                                       f"flight.r{launch}.json"))
+    ep = load_dump_dir(EPISODE)
+    assert ep.flights and ep.serve_reports, \
+        "battery left no console evidence"
+    with open(GOLDEN, "w") as fh:
+        fh.write("\n".join(summary_lines(ep)) + "\n")
+    print(f"regenerated {EPISODE} and {GOLDEN}:")
+    print(open(GOLDEN).read())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2851,6 +2851,277 @@ def battery_statesync_serve_joiner(port):
     return 0
 
 
+def _battery_fleet_train(port):
+    """ISSUE 20 fleet battery, training side (launch ranks 0-2, world
+    size 3): rank 0 hosts the FleetController + WeightPublisher; the
+    serving burst drives a train->serve migration of rank 2 (orderly
+    statesync departure — no RanksFailedError), survivors keep
+    training and publishing snapshots until the serving front posts
+    the done flag."""
+    import time as _time
+
+    import jax
+
+    from horovod_tpu import statesync
+    from horovod_tpu.fleet import (FleetController, FleetPolicy,
+                                   WeightPublisher, poll_depart,
+                                   publish_gauge)
+    from horovod_tpu.runner.network import RendezvousClient
+
+    launch_rank = int(sys.argv[1])
+    os.environ["HOROVOD_SIZE"] = "3"
+    os.environ["HOROVOD_STATESYNC_WORLD"] = "train"
+    import horovod_tpu as hvd
+
+    hvd.init()
+    kv = RendezvousClient("127.0.0.1", port, 20.0)
+    state = _statesync_state(n=1 << 10)
+    svc = statesync.StateSyncService(
+        lambda: state,
+        donate_provider=lambda: {"shard": state["opt"]})
+    ctl = pub = ptree = None
+    if launch_rank == 0:
+        from horovod_tpu.serving import ServeConfig
+        from horovod_tpu.serving.replica import serving_params_template
+
+        # The continuously-deployed params are serving-model-shaped:
+        # the publisher's snapshot must unflatten into the replicas'
+        # param template bit-for-bit.
+        ptree = serving_params_template(
+            ServeConfig.from_env(**_SERVE_GROW_CFG))
+        policy = FleetPolicy(min_train=2, min_serve=1,
+                             hysteresis_rounds=2, cooldown_rounds=1000,
+                             up_shed_rate=0.05, up_queue_fraction=0.25,
+                             idle_queue_fraction=0.01,
+                             train_lag_ms=1e9, queue_depth_limit=8)
+        ctl = FleetController(kv, policy, interval_s=0.25,
+                              migrate_timeout_s=240.0)
+        ctl.start()
+        pub = WeightPublisher(kv, publish_steps=5, chunk_bytes=1 << 14,
+                              keep=10)
+        pub.start()
+    directive = None
+    shrunk = False
+    departed = False
+    step = 0
+    deadline = _time.monotonic() + 300.0
+    while _time.monotonic() < deadline:
+        # Bare collectives: any RanksFailedError fails the battery —
+        # the migration must ride the orderly-departure boundary.
+        _statesync_train_step(hvd, state)
+        change = svc.step_boundary()
+        step += 1
+        if change is not None and change.kind == "departed":
+            departed = True
+            break
+        if change is not None and change.kind == "shrink":
+            assert change.dead == (2,), change
+            assert hvd.size() == 2
+            shrunk = True
+            state = statesync.resync_replicated(state,
+                                                int(state["step"]))
+        if launch_rank == 0:
+            ptree = {"params": jax.tree_util.tree_map(
+                lambda a: np.asarray(a) + np.float32(0.001),
+                ptree["params"])}
+            pub.maybe_publish(step, ptree)
+            publish_gauge(kv, "train", hvd.size(),
+                          straggler_lag_ms=0.0)
+        if directive is None:
+            directive = poll_depart(kv, "train", hvd.rank())
+            if directive is not None:
+                svc.request_depart()
+        if shrunk and kv.get("fleet.test", "done") is not None:
+            break
+        _time.sleep(0.1)
+    if departed:
+        assert launch_rank == 2 and directive is not None, \
+            (launch_rank, directive)
+        svc.close()
+        hvd.shutdown()
+        return _battery_fleet_mover(port, int(directive["mid"]))
+    assert shrunk, "the migration never happened"
+    if launch_rank == 0:
+        # The controller observed the joined mark and closed the
+        # journal record (done) — one migration, zero aborts.
+        ctl_deadline = _time.monotonic() + 60.0
+        while not ctl.stats["completed"] \
+                and _time.monotonic() < ctl_deadline:
+            _time.sleep(0.1)
+        assert ctl.stats["migrations"] == 1, ctl.stats
+        assert ctl.stats["completed"] == 1, ctl.stats
+        assert ctl.stats["aborted"] == 0, ctl.stats
+        assert pub.published >= 2, pub.published
+        pub.drain()
+        pub.close()
+        ctl.stop()
+        print(f"fleet trainer 0: migration journal closed "
+              f"{ctl.stats}; {pub.published} snapshots published")
+    _statesync_witness_dump("fleet battery trainer", launch_rank)
+    svc.close()
+    print(f"fleet trainer {launch_rank}: survived 3->2 at step "
+          f"{int(state['step'])}, no RanksFailedError anywhere")
+    return 0
+
+
+def _battery_fleet_mover(port, mid):
+    """The moved rank's second life: after the orderly train-world
+    departure it joins the serving world via peer-streamed state,
+    writes the joined mark that closes the controller's journal
+    record, and serves until the front drains — swapping in published
+    weights at the same broadcast plan boundaries as the incumbent."""
+    import jax
+
+    from horovod_tpu.fleet import mark_joined
+    from horovod_tpu.runner.network import RendezvousClient
+    from horovod_tpu.serving import ServeConfig
+    from horovod_tpu.serving.replica import join_serving_world
+    from horovod_tpu.statesync.snapshot import (flatten_state,
+                                                state_digest)
+
+    base = os.environ["HOROVOD_RENDEZVOUS_EPOCH"].split("~", 1)[0]
+    os.environ["HOROVOD_RENDEZVOUS_EPOCH"] = f"{base}~serve"
+    os.environ["HOROVOD_STATESYNC_WORLD"] = "serve"
+    os.environ.pop("HOROVOD_RANK", None)
+    os.environ.pop("HOROVOD_SIZE", None)
+    kv = RendezvousClient("127.0.0.1", port, 20.0)
+    cfg = ServeConfig.from_env(**_SERVE_GROW_CFG)
+    ex = join_serving_world(cfg)
+    mark_joined(kv, mid, rank=ex.rank, size=ex.size)
+    ex.attach_fleet(kv, interval_s=0.1)
+    import horovod_tpu as hvd
+
+    ex.serve_loop()                    # exits on the front's plan.stop
+    assert ex.weight_version >= 1, \
+        "no weight push landed on the moved replica"
+    last = ex.stats["weight_swaps"][-1]
+    assert last["version"] == ex.weight_version, ex.stats
+    image = flatten_state({"params": jax.tree_util.tree_map(
+        np.asarray, ex.params)})
+    assert state_digest(image) == last["digest"], \
+        "post-swap params diverge from the published snapshot digest"
+    print(f"fleet mover: joined serving as rank {ex.rank}/{ex.size} "
+          f"(mig {mid}), swapped to v{ex.weight_version}, digest "
+          f"verified")
+    _statesync_witness_dump("fleet battery mover", 2)
+    ex.close()
+    ex.statesync.close()
+    hvd.shutdown()
+    return 0
+
+
+def _battery_fleet_serve(port):
+    """ISSUE 20 fleet battery, serving side (launch rank 3 = the
+    size-1 serving world's front): a request burst overloads the
+    queue gauge, the controller migrates a trainer rank in (1->2
+    grow mid-serve), and the continuously-deployed weights roll out
+    to every replica at one broadcast plan boundary — zero failed
+    admitted requests, goodput phases recorded."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu import statesync
+    from horovod_tpu.fleet import publish_gauge
+    from horovod_tpu.runner.network import RendezvousClient
+    from horovod_tpu.serving import ReplicaExecutor, ServeConfig
+    from horovod_tpu.serving.loadgen import _goodput_phases
+    from horovod_tpu.serving.replica import serving_params_template
+
+    base = os.environ["HOROVOD_RENDEZVOUS_EPOCH"]
+    os.environ["HOROVOD_RENDEZVOUS_EPOCH"] = f"{base}~serve"
+    os.environ["HOROVOD_RANK"] = "0"
+    os.environ["HOROVOD_SIZE"] = "1"
+    os.environ["HOROVOD_STATESYNC_WORLD"] = "serve"
+    import horovod_tpu as hvd
+
+    hvd.init()
+    kv = RendezvousClient("127.0.0.1", port, 20.0)
+    cfg = ServeConfig.from_env(**_SERVE_GROW_CFG)
+    tmpl = serving_params_template(cfg)
+    ex = ReplicaExecutor(cfg, params=jax.tree_util.tree_map(
+        jnp.asarray, tmpl["params"]))
+    service = statesync.StateSyncService(state_provider=ex.state_tree,
+                                         static_state=True)
+    ex.attach_statesync(service)
+    ex.attach_fleet(kv, interval_s=0.1)
+    _serve_grow_submit(ex, 11, 24)     # the traffic burst
+    progress = {"v_at_grow": None, "wave": 100}
+
+    def tick():
+        # The front's per-step gauge publish IS the policy's input:
+        # outstanding work (queued + in-flight) over the configured
+        # depth limit is what the controller's policy thresholds.
+        depth = float(ex.queue.depth() + ex.batcher.inflight_count())
+        publish_gauge(kv, "serve", ex.size, shed_rate=0.0,
+                      queue_depth=depth)
+        if not ex.stats["grows"]:
+            if depth < 4:
+                # Keep the burst hot until the migration lands — the
+                # policy needs the overload to hold across its
+                # hysteresis window.
+                progress["wave"] += 1
+                _serve_grow_submit(ex, progress["wave"], 4)
+            return False
+        if progress["v_at_grow"] is None:
+            progress["v_at_grow"] = ex.weight_version
+            _serve_grow_submit(ex, 13, 12)   # post-migration wave
+        # Drain only after a weight push landed post-grow: the swap
+        # is scheduled at min(staged) across ranks, so reaching it
+        # proves the rollout hit the moved replica too.
+        return ex.weight_version > progress["v_at_grow"]
+
+    ex.serve_loop(stop_when=tick)
+    st = ex.stats
+    assert ex.size == 2 and st["grows"], (ex.size, st["grows"])
+    g = st["grows"][0]
+    assert g["from"] == 1 and g["to"] == 2, g
+    assert st["offered"] >= 36, st
+    assert st["served"] == st["offered"], st
+    assert st["lost"] == 0 and st["expired"] == 0, st
+    phases = _goodput_phases(ex, 1.0)
+    assert phases is not None and phases["after_rps"] > 0.0, phases
+    assert st["weight_swaps"], st
+    last = st["weight_swaps"][-1]
+    assert last["version"] == ex.weight_version \
+        > progress["v_at_grow"], (last, progress)
+    image = statesync.flatten_state({"params": jax.tree_util.tree_map(
+        np.asarray, ex.params)})
+    assert statesync.state_digest(image) == last["digest"], \
+        "post-swap params diverge from the published snapshot digest"
+    kv.put("fleet.test", "done", b"1")
+    print(f"fleet front: {st['served']} served across 1->2 with "
+          f"rollout to v{ex.weight_version}; goodput phases {phases}")
+    dump_dir = os.environ.get("HOROVOD_FLEET_DUMP_DIR")
+    if dump_dir:
+        # Console-fixture capture (tests/fixtures/console/regen_fleet
+        # .py): the front's loadgen report is the goodput/weights
+        # evidence the == fleet == panel renders.
+        from horovod_tpu.serving import loadgen
+
+        report = loadgen.build_report(
+            ex, offered=st["offered"], wall_s=1.0,
+            args_echo={"battery": "fleet"})
+        loadgen.write_report(
+            report, os.path.join(dump_dir, "SERVE_r{rank}.json"), 0)
+    _statesync_witness_dump("fleet battery front", 3)
+    ex.close()
+    service.close()
+    hvd.shutdown()
+    return 0
+
+
+def battery_fleet(port):
+    """ISSUE 20 acceptance (4 launch ranks, PRE-INIT): two statesync
+    worlds on ONE coordinator KV — launch ranks 0-2 train, launch
+    rank 3 serves.  A serving burst triggers a traffic-driven
+    train->serve migration AND a mid-run weight push lands on every
+    serving replica at one broadcast plan boundary."""
+    launch_rank = int(sys.argv[1])
+    if launch_rank == 3:
+        return _battery_fleet_serve(port)
+    return _battery_fleet_train(port)
+
+
 BATTERIES = {
     "collectives": battery_collectives,
     "serving": battery_serving,
@@ -2944,6 +3215,10 @@ def battery_fleetsim(port):
 PREINIT_BATTERIES = {
     "statesync_joiner": battery_statesync_joiner,
     "statesync_serve_joiner": battery_statesync_serve_joiner,
+    # ISSUE 20: unified train+serve fleet — launch ranks enter their
+    # own worlds (two statesync worlds, one coordinator KV), and the
+    # moved rank re-enters the other world mid-battery.
+    "fleet": battery_fleet,
     # ISSUE 16: the rank-virtualized fleet harness (one process = the
     # whole fleet; `size` counts host processes, not virtual ranks).
     "fleetsim": battery_fleetsim,
@@ -3072,6 +3347,19 @@ def main() -> int:
             f"/tmp/hvd_flight_{os.environ['HOROVOD_RENDEZVOUS_EPOCH']}.json"
         os.environ.setdefault("HOROVOD_STATESYNC_TIMEOUT_SECONDS", "45")
         os.environ.setdefault("HOROVOD_FAULT_TOLERANCE", "1")
+    if battery == "fleet":
+        # ISSUE 20: two statesync worlds (train + serve) share one
+        # coordinator KV.  TCP plane pinned, flight dumps for the
+        # hvdmc witness, generous deadlines — the moved rank compiles
+        # the serving model mid-migration.
+        os.environ["HOROVOD_SHM_OPERATIONS"] = "0"
+        os.environ["HOROVOD_FLIGHT_FILE"] = \
+            f"/tmp/hvd_flight_{os.environ['HOROVOD_RENDEZVOUS_EPOCH']}.json"
+        os.environ.setdefault("HOROVOD_STATESYNC_TIMEOUT_SECONDS", "120")
+        os.environ.setdefault("HOROVOD_FAULT_TOLERANCE", "1")
+        os.environ.setdefault("HOROVOD_FAULT_TIMEOUT", "30")
+        os.environ.setdefault("HOROVOD_METRICS", "on")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if battery == "statesync_grow":
         os.environ.setdefault("HOROVOD_FAULT_TIMEOUT", "5")
         # Real SIGKILL of rank 2 mid-training (~step 4: each step costs

@@ -1,0 +1,374 @@
+"""fleet/ — unified train+serve controller (ISSUE 20).
+
+Three layers:
+
+- **units** — FleetPolicy (pure logic: hysteresis, cooldown, floors,
+  the oscillation bound), FleetController against a fake KV (journal
+  lifecycle, failover-mid-migration resume/abort, deadline abort), and
+  the WeightPublisher/WeightPuller round-trip (shards -> meta -> head
+  ordering, digest verify-before-stage, torn-fetch retry, GC);
+- **loadgen accounting** — the SERVE report's weight-version mix and
+  staleness fields;
+- **the 4-rank acceptance battery** — two live statesync worlds on one
+  coordinator KV: a serving burst triggers a traffic-driven
+  train->serve migration (orderly departure, peer-streamed join) AND a
+  mid-run weight push lands on every serving replica at one broadcast
+  plan boundary; the flight dumps replay through the hvdmc witness.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_multiprocess import _run_world  # noqa: E402
+from test_statesync import _replay_witness, _witness_env  # noqa: E402
+
+from horovod_tpu.fleet import (  # noqa: E402
+    CTL_SCOPE, JOURNAL_SCOPE, PUB_SCOPE, SERVE_TO_TRAIN, TRAIN_TO_SERVE,
+    FleetController, FleetPolicy, WeightPublisher, WeightPuller,
+    mark_joined, poll_depart, publish_gauge)
+from horovod_tpu.statesync.snapshot import (  # noqa: E402
+    flatten_state, state_digest)
+
+
+class FakeKV:
+    """Dict-backed stand-in for the rendezvous KV client: the exact
+    call surface the fleet modules use (put/put_many/get/get_scope/
+    claim/delete)."""
+
+    def __init__(self):
+        self.data: dict = {}
+        self.counters: dict = {}
+
+    def put(self, scope, key, value):
+        self.data[(scope, key)] = bytes(value)
+
+    def put_many(self, records):
+        for scope, key, value in records:
+            self.put(scope, key, value)
+
+    def get(self, scope, key):
+        return self.data.get((scope, key))
+
+    def get_scope(self, scope):
+        return {k: v for (s, k), v in self.data.items() if s == scope}
+
+    def claim(self, scope, key, **_kw):
+        self.counters[(scope, key)] = \
+            self.counters.get((scope, key), 0) + 1
+        return self.counters[(scope, key)]
+
+    def delete(self, scope, key):
+        self.data.pop((scope, key), None)
+
+
+# ---------------------------------------------------------------------------
+# FleetPolicy
+# ---------------------------------------------------------------------------
+def _policy(**kw):
+    base = dict(min_train=1, min_serve=1, up_shed_rate=0.05,
+                up_queue_fraction=0.5, idle_queue_fraction=0.2,
+                train_lag_ms=50.0, hysteresis_rounds=3,
+                cooldown_rounds=0, queue_depth_limit=10)
+    base.update(kw)
+    return FleetPolicy(**base)
+
+
+def test_policy_hysteresis_requires_consecutive_rounds():
+    p = _policy(hysteresis_rounds=3)
+    assert p.observe(4, 2, queue_depth=10.0) is None
+    assert p.observe(4, 2, queue_depth=10.0) is None
+    # A cold round breaks the streak: the count starts over.
+    assert p.observe(4, 2, queue_depth=0.0) is None
+    assert p.observe(4, 2, queue_depth=10.0) is None
+    assert p.observe(4, 2, queue_depth=10.0) is None
+    d = p.observe(4, 2, queue_depth=10.0)
+    assert d is not None and d.direction == TRAIN_TO_SERVE and d.n == 1
+
+
+def test_policy_shed_rate_alone_marks_serving_hot():
+    p = _policy(hysteresis_rounds=1)
+    d = p.observe(4, 2, shed_rate=0.10, queue_depth=0.0)
+    assert d is not None and d.direction == TRAIN_TO_SERVE
+    assert "shed" in d.reason
+
+
+def test_policy_cooldown_silences_after_decision():
+    p = _policy(hysteresis_rounds=1, cooldown_rounds=2)
+    assert p.observe(4, 2, queue_depth=10.0) is not None
+    # Two cooldown rounds: hot gauges are ignored entirely.
+    assert p.observe(4, 2, queue_depth=10.0) is None
+    assert p.observe(4, 2, queue_depth=10.0) is None
+    assert p.observe(4, 2, queue_depth=10.0) is not None
+
+
+def test_policy_reverse_direction_needs_idle_serving():
+    p = _policy(hysteresis_rounds=1)
+    # Trainer drags but serving is NOT idle: no move.
+    assert p.observe(4, 2, queue_depth=5.0,
+                     straggler_lag_ms=200.0) is None
+    d = p.observe(4, 2, queue_depth=0.0, straggler_lag_ms=200.0)
+    assert d is not None and d.direction == SERVE_TO_TRAIN
+
+
+def test_policy_floors_are_hard():
+    p = _policy(hysteresis_rounds=1, min_train=2, min_serve=2)
+    # train at the floor: the hot serving gauge proposes nothing.
+    for _ in range(5):
+        assert p.observe(2, 2, queue_depth=10.0) is None
+    # serve at the floor: the starved trainer proposes nothing.
+    for _ in range(5):
+        assert p.observe(4, 2, queue_depth=0.0,
+                         straggler_lag_ms=200.0) is None
+    assert p.decisions == 0
+    assert p.observe(3, 2, queue_depth=10.0) is not None
+
+
+def test_policy_oscillation_bound_under_adversarial_gauges():
+    """Migrations in any window of R rounds are bounded by
+    R / (hysteresis + cooldown) no matter how the gauges flap."""
+    hys, cool, rounds = 2, 3, 120
+    p = _policy(hysteresis_rounds=hys, cooldown_rounds=cool)
+    decisions = 0
+    for i in range(rounds):
+        if (i // 2) % 2 == 0:          # flap every two rounds
+            d = p.observe(4, 4, queue_depth=10.0)
+        else:
+            d = p.observe(4, 4, queue_depth=0.0,
+                          straggler_lag_ms=200.0)
+        decisions += d is not None
+    assert decisions == p.decisions
+    assert decisions <= rounds // (hys + cool) + 1, decisions
+
+
+# ---------------------------------------------------------------------------
+# FleetController: journal lifecycle + failover
+# ---------------------------------------------------------------------------
+def _controller(kv, **kw):
+    # Cooldown matters here: the gauges in the KV stay hot after a
+    # migration settles, and without it the very next tick would fire
+    # a second one.
+    base = dict(policy=_policy(hysteresis_rounds=1, cooldown_rounds=100),
+                interval_s=0.01, migrate_timeout_s=60.0)
+    base.update(kw)
+    ctl = FleetController(kv, **base)
+    ctl.recover()
+    return ctl
+
+
+def test_controller_full_migration_lifecycle():
+    kv = FakeKV()
+    ctl = _controller(kv)
+    publish_gauge(kv, "train", 4, straggler_lag_ms=0.0)
+    publish_gauge(kv, "serve", 2, shed_rate=0.0, queue_depth=10.0)
+    rec = ctl.tick()
+    assert rec is not None and rec["state"] == "departing"
+    assert rec["direction"] == TRAIN_TO_SERVE and rec["rank"] == 3
+    # The directive is addressed to the donor world's highest rank.
+    directive = poll_depart(kv, "train", 3)
+    assert directive is not None and directive["mid"] == rec["mid"]
+    assert poll_depart(kv, "train", 2) is None
+    # One move settles before the next is considered.
+    assert ctl.tick() is None
+    mark_joined(kv, rec["mid"], rank=2, size=3)
+    ctl.tick()
+    journal = json.loads(kv.get(JOURNAL_SCOPE, f"mig:{rec['mid']}"))
+    assert journal["state"] == "done"
+    assert poll_depart(kv, "train", 3) is None   # directive withdrawn
+    assert ctl.stats["completed"] == 1 and not ctl.open
+
+
+def test_controller_deadline_aborts_wedged_migration():
+    kv = FakeKV()
+    ctl = _controller(kv, migrate_timeout_s=0.0)
+    publish_gauge(kv, "train", 4)
+    publish_gauge(kv, "serve", 2, queue_depth=10.0)
+    rec = ctl.tick()
+    assert rec is not None
+    ctl.tick()                          # past the (zero) deadline
+    journal = json.loads(kv.get(JOURNAL_SCOPE, f"mig:{rec['mid']}"))
+    assert journal["state"] == "aborted"
+    assert poll_depart(kv, "train", 3) is None   # directive withdrawn
+    assert ctl.stats["aborted"] == 1
+
+
+def test_controller_failover_resumes_departing_migration():
+    """The crash window AFTER the directive was published: a successor
+    adopts the journal record under its own claimed epoch and keeps
+    waiting for the mover's joined mark."""
+    kv = FakeKV()
+    a = _controller(kv)
+    publish_gauge(kv, "train", 4)
+    publish_gauge(kv, "serve", 2, queue_depth=10.0)
+    rec = a.tick()
+    assert rec is not None              # journal=departing, directive up
+    b = _controller(kv)                 # controller A dies; B recovers
+    assert b.epoch > a.epoch
+    assert b.stats["resumed"] == 1 and rec["mid"] in b.open
+    adopted = json.loads(kv.get(JOURNAL_SCOPE, f"mig:{rec['mid']}"))
+    assert adopted["state"] == "departing"
+    assert adopted["epoch"] == b.epoch
+    # The mover (possibly mid-join through the whole failover) arrives:
+    # B closes the record it never opened.
+    mark_joined(kv, rec["mid"], rank=2, size=3)
+    b.tick()
+    journal = json.loads(kv.get(JOURNAL_SCOPE, f"mig:{rec['mid']}"))
+    assert journal["state"] == "done" and b.stats["completed"] == 1
+
+
+def test_controller_failover_aborts_planned_migration():
+    """The crash window BETWEEN journal(planned) and the directive: no
+    rank can be acting on the record, so the successor aborts it."""
+    kv = FakeKV()
+    a = _controller(kv)
+    mid = kv.claim(JOURNAL_SCOPE, "seq")
+    kv.put(JOURNAL_SCOPE, f"mig:{mid}", json.dumps(
+        {"mid": mid, "direction": TRAIN_TO_SERVE, "world": "train",
+         "rank": 3, "state": "planned", "epoch": a.epoch,
+         "ts": 0.0, "deadline": 1e18}).encode())
+    b = _controller(kv)
+    journal = json.loads(kv.get(JOURNAL_SCOPE, f"mig:{mid}"))
+    assert journal["state"] == "aborted"
+    assert "failover" in journal["why"]
+    assert b.stats["aborted"] == 1 and not b.open
+    assert poll_depart(kv, "train", 3) is None
+
+
+# ---------------------------------------------------------------------------
+# WeightPublisher / WeightPuller round-trip
+# ---------------------------------------------------------------------------
+def _pub_tree(n=24, fill=1.0):
+    return {"params": {"w": np.full(n, fill, np.float32)}}
+
+
+def _drive(pub):
+    """Run the publisher's queued work synchronously (no thread)."""
+    while pub._work:
+        version, step, image = pub._work.pop(0)
+        pub._publish(version, step, image)
+
+
+def test_publish_pull_roundtrip_with_digest_verify():
+    kv = FakeKV()
+    pub = WeightPublisher(kv, publish_steps=2, chunk_bytes=16, keep=2)
+    assert pub.maybe_publish(1, _pub_tree()) is None   # off-cadence
+    assert pub.maybe_publish(2, _pub_tree(fill=2.0)) == 1
+    _drive(pub)
+    meta = json.loads(kv.get(PUB_SCOPE, "meta:1"))
+    assert meta["shards"] > 1                          # really chunked
+    assert kv.get(PUB_SCOPE, "head") == b"1"
+    staged = []
+    pul = WeightPuller(kv, lambda v, img, m: staged.append((v, img, m)))
+    assert pul.poll_once() == 1
+    assert pul.poll_once() is None                     # no news
+    (v, img, m), = staged
+    assert v == 1 and m == meta
+    assert state_digest(img) == meta["digest"]
+    tree = _pub_tree(fill=2.0)
+    assert bytes(flatten_state(tree)) == bytes(img)
+    assert pul.pulled == 1 and pul.verify_failures == 0
+
+
+def test_puller_rejects_corrupt_shard_before_staging():
+    kv = FakeKV()
+    pub = WeightPublisher(kv, publish_steps=1, chunk_bytes=16, keep=2)
+    pub.maybe_publish(1, _pub_tree())
+    _drive(pub)
+    corrupt = bytearray(kv.get(PUB_SCOPE, "shard:1.0"))
+    corrupt[0] ^= 0xFF
+    kv.put(PUB_SCOPE, "shard:1.0", bytes(corrupt))
+    staged = []
+    pul = WeightPuller(kv, lambda *a: staged.append(a))
+    assert pul.poll_once() is None
+    assert pul.verify_failures == 1 and staged == []
+    assert pul.seen == 0               # will retry, never staged
+
+
+def test_puller_retries_torn_fetch():
+    kv = FakeKV()
+    pub = WeightPublisher(kv, publish_steps=1, chunk_bytes=16, keep=2)
+    pub.maybe_publish(1, _pub_tree())
+    _drive(pub)
+    shard = kv.get(PUB_SCOPE, "shard:1.1")
+    kv.delete(PUB_SCOPE, "shard:1.1")  # head visible, shard not yet
+    staged = []
+    pul = WeightPuller(kv, lambda *a: staged.append(a))
+    assert pul.poll_once() is None
+    assert pul.verify_failures == 0 and staged == []
+    kv.put(PUB_SCOPE, "shard:1.1", shard)
+    assert pul.poll_once() == 1 and len(staged) == 1
+
+
+def test_publisher_gc_keeps_newest_versions():
+    kv = FakeKV()
+    pub = WeightPublisher(kv, publish_steps=1, chunk_bytes=16, keep=2)
+    for step in range(1, 4):
+        pub.maybe_publish(step, _pub_tree(fill=float(step)))
+    _drive(pub)
+    assert kv.get(PUB_SCOPE, "head") == b"3"
+    assert kv.get(PUB_SCOPE, "meta:1") is None
+    assert not [k for k in kv.get_scope(PUB_SCOPE)
+                if k.startswith("shard:1.")]
+    for v in (2, 3):
+        meta = json.loads(kv.get(PUB_SCOPE, f"meta:{v}"))
+        assert all(kv.get(PUB_SCOPE, f"shard:{v}.{i}") is not None
+                   for i in range(meta["shards"]))
+
+
+# ---------------------------------------------------------------------------
+# loadgen staleness accounting
+# ---------------------------------------------------------------------------
+def test_loadgen_weights_report_versions_and_staleness():
+    from horovod_tpu.serving.loadgen import _weights_report
+
+    class _Ex:
+        weight_version = 2
+        completed = {
+            1: {"weights": 1, "weights_stale_steps": 0},
+            2: {"weights": 1, "weights_stale_steps": 5},
+            3: {"weights": 2, "weights_stale_steps": 3},
+        }
+        stats = {"weight_swaps": [
+            {"version": 1, "step": 4, "digest": 7, "at": 0.0},
+            {"version": 2, "step": 9, "digest": 8, "at": 1.0},
+        ]}
+
+    rep = _weights_report(_Ex())
+    assert rep["final_version"] == 2
+    assert rep["versions"] == {"1": 2, "2": 1}
+    assert rep["max_staleness_steps"] == 5
+    assert rep["swaps"] == [{"version": 1, "step": 4},
+                            {"version": 2, "step": 9}]
+
+
+# ---------------------------------------------------------------------------
+# the 4-rank acceptance battery
+# ---------------------------------------------------------------------------
+def test_fleet_battery_4rank():
+    """ISSUE 20 acceptance: launch ranks 0-2 train (world size 3),
+    launch rank 3 serves (world size 1) — both statesync worlds on ONE
+    coordinator KV (HOROVOD_STATESYNC_WORLD namespacing).  The serving
+    burst drives the controller's policy over its hysteresis window;
+    rank 2 departs the training world at a statesync boundary (no
+    RanksFailedError anywhere), joins the serving world via
+    peer-streamed state, and the journal record closes as done.  The
+    trainer's published snapshots roll out to BOTH serving replicas at
+    one broadcast plan boundary (digest-asserted against the live
+    params on each), with zero failed admitted requests and goodput
+    phases recorded.  The flight dumps replay through the hvdmc
+    witness against the fleet + membership models."""
+    outputs = _run_world(4, "fleet", timeout=360.0,
+                         extra_env=_witness_env("fleet", 4))
+    assert "fleet front:" in outputs[3], outputs[3]
+    assert "across 1->2" in outputs[3], outputs[3]
+    assert "fleet mover: joined serving" in outputs[2], outputs[2]
+    assert "digest verified" in outputs[2], outputs[2]
+    for r in (0, 1):
+        assert "no RanksFailedError anywhere" in outputs[r], outputs[r]
+    assert "migration journal closed" in outputs[0], outputs[0]
+    _replay_witness(outputs, {"fleet-migrate", "fleet-depart",
+                              "fleet-join", "fleet-publish",
+                              "fleet-pull", "fleet-swap", "departed"})

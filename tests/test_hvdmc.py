@@ -35,6 +35,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TREE = os.path.join(REPO, "horovod_tpu")
 GOLDEN = os.path.join(REPO, "tests", "fixtures", "mc",
                       "toy_torn_trace.txt")
+FLEET_GOLDEN = os.path.join(REPO, "tests", "fixtures", "mc",
+                            "fleet_swap_trace.txt")
 
 
 # --- spec DSL ---------------------------------------------------------------
@@ -42,7 +44,7 @@ def test_all_specs_validate():
     specs = all_specs()
     assert {sp.name for sp in specs} == {
         "statesync-grow", "statesync-stream", "statesync-preempt",
-        "resilience-shrink", "rendezvous-failover"}
+        "resilience-shrink", "rendezvous-failover", "fleet-handoff"}
     for sp in specs + (toy_spec(),):
         assert sp.validate() == [], sp.name
         # Every transition id is unique across the registry too.
@@ -149,7 +151,8 @@ def test_unknown_mutation_rejected():
     with pytest.raises(ValueError):
         GrowModel(3, mutations=("no-such-guard",))
     assert set(MUTATIONS) == {"drop-torn-reject", "early-ready-ack",
-                              "accept-stale-lease"}
+                              "accept-stale-lease",
+                              "swap-before-verify"}
 
 
 # --- rendezvous failover (ISSUE 15) -----------------------------------------
@@ -194,6 +197,57 @@ def test_mutation_accept_stale_lease_caught_with_trace():
     lost_trace = render_trace(m, lost)
     assert "cli.write" in lost_trace and "pri.commit" in lost_trace
     assert "runner.network._kv_apply" in lost_trace
+
+
+# --- fleet handoff (ISSUE 20) -----------------------------------------------
+def test_fleet_model_clean_at_head():
+    """The train<->serve handoff at head: migration journaling across a
+    controller failover plus the publish/pull/verify/swap deployment
+    pipeline (with the shard-corrupt fault live) explores to a fixpoint
+    with zero violations — every journaled migration resolves and no
+    unverified image is ever swapped in."""
+    from horovod_tpu.analysis.hvdmc.machines import FleetModel
+
+    r = explore(FleetModel(2))
+    assert r.fixpoint and r.violations == []
+    assert r.states > 100, r.states
+    assert {"ctl.plan", "ctl.direct", "ctl.complete", "ctl.resume",
+            "ctl.abort-planned", "mov.depart", "mov.join", "mov.arrive",
+            "pub.head", "rep.verify-stage", "rep.verify-reject",
+            "rep.swap", "net.failover",
+            "net.shard-corrupt"} <= r.fired
+
+
+def test_fleet_mutation_swap_before_verify_caught_with_golden_trace():
+    """ISSUE 20 acceptance: dropping the digest-verify-before-stage
+    guard lets the shard-corrupt fault drive a corrupt image through
+    the staging path and into a plan-boundary swap.  The shortest
+    counterexample is deterministic; the rendering is asserted
+    byte-for-byte against the checked-in fixture."""
+    from horovod_tpu.analysis.hvdmc.machines import FleetModel
+
+    m = FleetModel(2, mutations=("swap-before-verify",))
+    r = explore(m)
+    assert r.fixpoint
+    assert [v.prop for v in r.violations] == ["swap-verified"]
+    trace = render_trace(m, r.violations[0])
+    assert "net.shard-corrupt" in trace
+    assert "rep.swap" in trace
+    assert "fleet.deploy.WeightPuller.poll_once" in trace
+    assert "serving.replica.ReplicaExecutor._apply_plan" in trace
+    with open(FLEET_GOLDEN, "rb") as f:
+        assert (trace + "\n").encode() == f.read()
+
+
+def test_fleet_spec_binds_real_functions():
+    from horovod_tpu.analysis.hvdsan.lockgraph import Program
+    from horovod_tpu.fleet.specs import fleet_spec
+
+    program = Program()
+    program.collect_paths([TREE])
+    missing = [(tr.tid, key) for tr in fleet_spec().transitions
+               for key in tr.binds if key not in program.functions]
+    assert missing == []
 
 
 # --- golden counterexample --------------------------------------------------
@@ -342,7 +396,8 @@ def test_cli_default_explores_all_protocols_clean():
     payload = json.loads(proc.stdout)
     protos = payload["protocols"]
     assert set(protos) == {"statesync-grow", "statesync-preempt",
-                           "resilience-shrink", "rendezvous-failover"}
+                           "resilience-shrink", "rendezvous-failover",
+                           "fleet-handoff"}
     for name, rec in protos.items():
         assert rec["fixpoint"] and rec["violations"] == [], name
         assert rec["states"] > 0
