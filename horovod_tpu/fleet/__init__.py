@@ -15,11 +15,12 @@ from .deploy import PUB_SCOPE, WeightPublisher, WeightPuller
 from .policy import (SERVE_TO_TRAIN, TRAIN_TO_SERVE, FleetDecision,
                      FleetPolicy)
 from .specs import fleet_spec
+from .wiring import FleetRuntime, attach_replica, attach_trainer
 
 __all__ = [
     "CTL_SCOPE", "GAUGE_SCOPE", "JOURNAL_SCOPE", "PUB_SCOPE",
     "SERVE_TO_TRAIN", "TRAIN_TO_SERVE", "FleetController",
-    "FleetDecision", "FleetPolicy", "WeightPublisher", "WeightPuller",
-    "fleet_spec", "mark_joined", "poll_depart", "publish_gauge",
-    "read_gauge",
+    "FleetDecision", "FleetPolicy", "FleetRuntime", "WeightPublisher",
+    "WeightPuller", "attach_replica", "attach_trainer", "fleet_spec",
+    "mark_joined", "poll_depart", "publish_gauge", "read_gauge",
 ]

@@ -9,7 +9,9 @@ KV, under three scopes:
   / straggler lag), so the controller never needs a direct channel to
   either world;
 - ``fleet.journal`` — an epoch-stamped record per migration
-  (``mig:{id}``) advancing planned -> departing -> done | aborted.
+  (``mig:{id}``) advancing planned -> departing -> done, or through
+  the abort path departing -> aborting (deadline exceeded, directive
+  withdrawn, a late join still reconciles to done) -> aborted.
   The journal is the failover story: a re-elected controller claims a
   fresh epoch, adopts every non-terminal record, and either resumes it
   (directive already written — the mover may be mid-join) or safely
@@ -44,6 +46,11 @@ __all__ = ["CTL_SCOPE", "GAUGE_SCOPE", "JOURNAL_SCOPE", "FleetController",
 GAUGE_SCOPE = "fleet.gauges"
 JOURNAL_SCOPE = "fleet.journal"
 CTL_SCOPE = "fleet.ctl"
+# The statesync membership scope (statesync/service.py): rank 0 of
+# each world publishes {"epoch", "size", "seq"} under the world's
+# HOROVOD_STATESYNC_WORLD name at every transition — the controller
+# reads it at actuation time, when a gauge may already be stale.
+STATESYNC_SCOPE = "statesync"
 
 
 # -- gauge + actuation records (both worlds' side) ------------------------
@@ -140,12 +147,35 @@ class FleetController(threading.Thread):
                 self._flight(rec, "aborted")
                 continue
             rec["deadline"] = time.time() + self.migrate_timeout_s
+            if rec.get("state") == "aborting":
+                # Adopted mid-abort-grace: keep watching for the late
+                # joined mark under a fresh grace window.
+                rec["abort_deadline"] = time.time() \
+                    + self.migrate_timeout_s
             self._journal(rec)
             self.open[int(rec["mid"])] = rec
             self.stats["resumed"] += 1
             self._flight(rec, "resumed")
 
     # -- migration lifecycle ---------------------------------------------
+    def _donor_size(self, world: str, gauge_size: int) -> int:
+        """The donor world's size at actuation time.  Gauges can be
+        stale — a real preemption may have shrunk the world since the
+        last publish, and a directive addressed to a rank that no
+        longer exists would sit unconsumed until the deadline abort.
+        The statesync membership record is refreshed at every world
+        transition, so it wins when present."""
+        try:
+            raw = self.kv.get(STATESYNC_SCOPE, world)
+        except (TimeoutError, OSError):
+            raw = None
+        if raw:
+            try:
+                return int(json.loads(raw)["size"])
+            except (KeyError, TypeError, ValueError):
+                pass
+        return int(gauge_size)
+
     def begin_migration(self, direction: str, donor_size: int) -> dict:
         """Journal + actuate one move: the donor world's highest rank
         departs.  Journal first (planned), directive second, journal
@@ -154,7 +184,8 @@ class FleetController(threading.Thread):
         mid = self.kv.claim(JOURNAL_SCOPE, "seq")
         donor = "train" if direction == TRAIN_TO_SERVE else "serve"
         rec = {"mid": mid, "direction": direction, "world": donor,
-               "rank": donor_size - 1, "state": "planned",
+               "rank": self._donor_size(donor, donor_size) - 1,
+               "state": "planned",
                "epoch": self.epoch, "ts": time.time(),
                "deadline": time.time() + self.migrate_timeout_s}
         self._journal(rec)
@@ -171,31 +202,61 @@ class FleetController(threading.Thread):
         return rec
 
     def _advance(self) -> None:
-        """Advance every open migration: joined mark -> done; expired
-        deadline -> aborted (directive withdrawn)."""
+        """Advance every open migration.  Joined mark -> done, with the
+        depart AND joined actuation records cleaned up (a closed
+        migration leaves nothing in CTL_SCOPE).  An expired deadline
+        only REQUESTS the abort: the directive is withdrawn (a donor
+        that has not consumed it yet will never depart), but a donor
+        whose boundary poll already consumed it is past recall — it
+        will depart and write its joined mark later.  Journaling
+        'aborted' immediately would lie about a rank that actually
+        migrated, leak its joined record, and let the policy fire a
+        second migration against the already-shrunk donor.  So the
+        record moves to 'aborting' and keeps watching for a late mark
+        through one more timeout window: a late join reconciles to
+        done, silence finally aborts."""
         if not self.open:
             return
         ctl = self.kv.get_scope(CTL_SCOPE)
+        now = time.time()
         for mid, rec in list(self.open.items()):
             if f"joined:{mid}" in ctl:
+                aborting = rec["state"] == "aborting"
                 rec["state"] = "done"
-                rec["done_ts"] = time.time()
+                rec["done_ts"] = now
+                if aborting:
+                    rec["why"] = ("mover joined after the abort "
+                                  "request: reconciled to done")
                 self._journal(rec)
                 self.kv.delete(CTL_SCOPE, f"depart:{mid}")
+                self.kv.delete(CTL_SCOPE, f"joined:{mid}")
                 del self.open[mid]
                 self.stats["completed"] += 1
                 self._flight(rec, "done")
-                logger.info("fleet: migration %d complete", mid)
-            elif time.time() > rec.get("deadline", 0):
-                rec["state"] = "aborted"
+                logger.info("fleet: migration %d complete%s", mid,
+                            " (late join reconciled)" if aborting
+                            else "")
+            elif rec["state"] == "departing" \
+                    and now > rec.get("deadline", 0):
+                rec["state"] = "aborting"
                 rec["why"] = "migration deadline exceeded"
+                rec["abort_deadline"] = now + self.migrate_timeout_s
                 self._journal(rec)
                 self.kv.delete(CTL_SCOPE, f"depart:{mid}")
+                self._flight(rec, "aborting")
+                logger.warning(
+                    "fleet: migration %d deadline exceeded; directive "
+                    "withdrawn, watching for a late join", mid)
+            elif rec["state"] == "aborting" \
+                    and now > rec.get("abort_deadline", 0):
+                rec["state"] = "aborted"
+                self._journal(rec)
+                self.kv.delete(CTL_SCOPE, f"joined:{mid}")
                 del self.open[mid]
                 self.stats["aborted"] += 1
                 self._flight(rec, "aborted")
-                logger.warning("fleet: migration %d aborted (deadline)",
-                               mid)
+                logger.warning("fleet: migration %d aborted (no join "
+                               "within the abort grace)", mid)
 
     # -- the loop --------------------------------------------------------
     def tick(self) -> dict | None:

@@ -63,12 +63,19 @@ class WeightPublisher(threading.Thread):
                                1)
         self.keep = max(config.FLEET_PUBLISH_KEEP.get()
                         if keep is None else int(keep), 2)
-        self._work: list = []          # [(version, step, image-bytes)]
+        # Pending hand-off to the publisher thread: AT MOST ONE
+        # (version, step, image-bytes) entry.  Only the newest image
+        # matters to pullers, and an unbounded queue would accumulate
+        # full flattened param images on the trainer host whenever KV
+        # commits run slower than the publish cadence.
+        self._work: list = []
+        self._inflight = False         # publisher thread mid-commit
         self._wake = threading.Event()
         self._halt = threading.Event()
         self._lock = threading.Lock()
         self.version = 0               # last version handed to the thread
         self.published = 0             # versions fully committed to KV
+        self.coalesced = 0             # superseded pending images dropped
         self._shards: dict[int, int] = {}   # version -> shard count
 
     # -- training-thread side -------------------------------------------
@@ -81,7 +88,15 @@ class WeightPublisher(threading.Thread):
         with self._lock:
             self.version += 1
             version = self.version
-            self._work.append((version, step, image))
+            if self._work:
+                # Coalesce: replace the not-yet-committed pending image
+                # instead of queueing behind it — the superseded version
+                # is simply never published (pullers only want newest),
+                # and host memory stays bounded at one pending image.
+                self._work[-1] = (version, step, image)
+                self.coalesced += 1
+            else:
+                self._work.append((version, step, image))
         self._wake.set()
         return version
 
@@ -95,7 +110,12 @@ class WeightPublisher(threading.Thread):
                     if not self._work:
                         break
                     version, step, image = self._work.pop(0)
-                self._publish(version, step, image)
+                    self._inflight = True
+                try:
+                    self._publish(version, step, image)
+                finally:
+                    with self._lock:
+                        self._inflight = False
 
     def _publish(self, version: int, step: int, image: bytes) -> None:
         digest = state_digest(image)
@@ -126,13 +146,14 @@ class WeightPublisher(threading.Thread):
                 self.kv.delete(PUB_SCOPE, _shard_key(old, i))
 
     def drain(self, timeout: float = 10.0) -> None:
-        """Block (bounded) until every enqueued version is committed —
-        the battery's determinism hook, not a production path."""
+        """Block (bounded) until the pending image (if any) is
+        committed — the battery's determinism hook, not a production
+        path."""
         import time
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
-                if not self._work and self.published >= self.version:
+                if not self._work and not self._inflight:
                     return
             self._wake.set()
             time.sleep(0.02)
@@ -147,7 +168,10 @@ class WeightPublisher(threading.Thread):
 class WeightPuller(threading.Thread):
     """Serving-side snapshot puller: polls ``head``, fetches + digest-
     verifies new versions, and stages them through ``stage(version,
-    image, meta)`` — the replica swaps at its next plan boundary."""
+    image, meta)`` — the replica swaps at a front-scheduled plan
+    boundary.  A stage callback returning ``False`` refuses the
+    version (staging window full); the puller keeps its watermark and
+    offers the then-current head again on the next poll."""
 
     def __init__(self, kv, stage, *, interval_s: float = 0.5) -> None:
         super().__init__(daemon=True, name="hvd-fleet-puller")
@@ -196,13 +220,17 @@ class WeightPuller(threading.Thread):
                 "fleet: snapshot v%d failed digest verify "
                 "(%d bytes); discarding", head, len(image))
             return None
+        # The stage callback may refuse (the replica's staging window
+        # is full): leave the watermark untouched so the next poll
+        # retries — a refused version is delayed, never dropped.
+        if self._stage(head, image, meta) is False:
+            return None
         self.seen = head
         self.pulled += 1
         rec = recorder()
         if rec.enabled:
             rec.record("fleet-pull", name=f"v{head}",
                        detail=f"nbytes={len(image)} verified")
-        self._stage(head, image, meta)
         return head
 
     def close(self) -> None:

@@ -44,7 +44,8 @@ def fleet_spec() -> ProtocolSpec:
                  "queue depth, straggler lag)"),
         Verb("JOURNAL", "kv", "mig:",
              doc="epoch-stamped migration journal record "
-                 "(planned -> departing -> done | aborted)"),
+                 "(planned -> departing -> done | aborting -> "
+                 "aborted)"),
         Verb("DEPART", "kv", "depart:",
              doc="the directive a donor rank consumes at its statesync "
                  "step boundary"),
@@ -88,9 +89,24 @@ def fleet_spec() -> ProtocolSpec:
                        "directive was never written: abort is safe, no "
                        "rank can be acting on it"),
         Transition("ctl.abort-deadline", "controller", "migrating",
-                   "idle", "internal:deadline-exceeded",
+                   "aborting", "internal:deadline-exceeded",
                    binds=(f"{_FC}._advance",),
-                   doc="a wedged mover never blocks the controller "
+                   doc="deadline passed: withdraw the directive, but "
+                       "the donor may have ALREADY consumed it — hold "
+                       "an abort-grace window rather than declaring "
+                       "aborted while the rank is mid-flight"),
+        Transition("ctl.reconcile-late-join", "controller", "aborting",
+                   "idle", "kv:JOINED", requires_calls=("delete",),
+                   binds=(f"{_FC}._advance",),
+                   doc="the mover's joined mark lands inside the abort "
+                       "grace: the rank really migrated, so the journal "
+                       "reconciles to done (an 'aborted' record here "
+                       "would let the policy double-shrink the donor)"),
+        Transition("ctl.abort-final", "controller", "aborting", "idle",
+                   "internal:abort-grace-exceeded",
+                   binds=(f"{_FC}._advance",),
+                   doc="no joined mark through the grace window either: "
+                       "a wedged mover never blocks the controller "
                        "forever"),
         Transition("ctl.resume", "controller", "migrating", "migrating",
                    "internal:epoch-claimed", guard="journal-resumable",
@@ -174,7 +190,8 @@ def fleet_spec() -> ProtocolSpec:
         doc="train<->serve rank migration + continuous weight "
             "deployment (docs/fleet.md)",
         roles=("controller", "mover", "publisher", "replica", "net"),
-        states={"controller": ("idle", "planning", "migrating"),
+        states={"controller": ("idle", "planning", "migrating",
+                               "aborting"),
                 "mover": ("training", "boundary", "joining", "serving"),
                 "publisher": ("run",),
                 "replica": ("serving", "fetched", "staged"),

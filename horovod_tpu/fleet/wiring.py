@@ -1,0 +1,170 @@
+"""What ``horovodrun --fleet`` (HOROVOD_FLEET=1) actually starts.
+
+The CLI flag only exports the env var (runner/launch.py args_to_env);
+these hooks are the runtime wiring the flag promises (docs/fleet.md):
+
+- **training side** (:meth:`Trainer.fit <horovod_tpu.training.Trainer
+  .fit>` calls :func:`attach_trainer`): rank 0 hosts the
+  FleetController and the WeightPublisher (single writer of the
+  ``fleet.pub`` scope); every rank's fit loop drives a throttled
+  train-gauge publish (world size + straggler lag) so the controller
+  sees the trainer's load without a direct channel;
+- **serving side** (:meth:`ReplicaExecutor.serve_loop
+  <horovod_tpu.serving.replica.ReplicaExecutor.serve_loop>` calls
+  :func:`attach_replica`): every replica attaches a WeightPuller
+  against the coordinator KV, and the front end publishes the serve
+  gauges (queue depth + per-interval shed rate) the rebalancing
+  policy thresholds.
+
+Everything rides the rendezvous KV the job already has
+(HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT) — no new endpoints, no new
+threads beyond the three hvdsan-rooted fleet loops.
+"""
+from __future__ import annotations
+
+import time
+
+from ..common import config
+from ..common.logging import logger
+from .controller import FleetController, publish_gauge
+from .deploy import WeightPublisher
+
+__all__ = ["FleetRuntime", "attach_replica", "attach_trainer"]
+
+
+def _fleet_kv():
+    from ..statesync.service import _kv_client
+
+    return _kv_client()
+
+
+class FleetRuntime:
+    """The per-process bundle ``--fleet`` starts, owning exactly what
+    it created: the optional controller + publisher (training rank 0)
+    and this world's throttled gauge publish.  ``close()`` stops them
+    in reverse dependency order."""
+
+    def __init__(self, kv, world: str, *, controller=None,
+                 publisher=None) -> None:
+        self.kv = kv
+        self.world = world
+        self.controller = controller
+        self.publisher = publisher
+        # Gauges refresh at half the controller interval: fresh enough
+        # that a policy tick never reasons from a whole-interval-old
+        # world, without a KV put per step.
+        self._gauge_interval_s = max(
+            config.FLEET_INTERVAL_S.get() / 2.0, 0.05)
+        self._last_gauge = 0.0
+
+    def publish_gauge(self, size_fn, fields_fn=None) -> None:
+        """Throttled gauge publish.  ``size_fn`` / ``fields_fn`` are
+        callables invoked only when the interval elapsed, keeping the
+        per-step cost of the hook to one clock read."""
+        now = time.monotonic()
+        if now - self._last_gauge < self._gauge_interval_s:
+            return
+        self._last_gauge = now
+        fields = fields_fn() if fields_fn is not None else {}
+        try:
+            publish_gauge(self.kv, self.world, int(size_fn()), **fields)
+        except (TimeoutError, OSError) as exc:
+            logger.debug("fleet: %s gauge publish failed: %s",
+                         self.world, exc)
+
+    def close(self) -> None:
+        if self.publisher is not None:
+            self.publisher.close()
+        if self.controller is not None:
+            self.controller.stop()
+
+
+def attach_trainer(trainer):
+    """Wire the training side of ``--fleet``: rank 0 hosts the
+    FleetController + WeightPublisher and the publisher is attached to
+    the trainer's publish hook; every rank gets a FleetRuntime whose
+    gauge hook the fit loop drives.  Returns None when fleet mode is
+    off or the coordinator KV is not configured."""
+    if not config.FLEET.get():
+        return None
+    from .. import core
+
+    try:
+        kv = _fleet_kv()
+    except RuntimeError as exc:
+        logger.warning("fleet: HOROVOD_FLEET set but no coordinator "
+                       "KV: %s", exc)
+        return None
+    controller = publisher = None
+    if core.global_state().rank == 0:
+        controller = FleetController(kv)
+        controller.start()
+        publisher = WeightPublisher(kv)
+        publisher.start()
+        trainer.attach_fleet_publisher(publisher)
+        logger.info("fleet: controller + weight publisher started on "
+                    "training rank 0")
+    return FleetRuntime(kv, "train", controller=controller,
+                        publisher=publisher)
+
+
+def trainer_gauges() -> dict:
+    """The trainer-side gauge fields the policy consumes: the
+    coordinator straggler-lag gauge when telemetry is live, 0.0
+    otherwise (the policy then simply never proposes serve->train on
+    straggler evidence)."""
+    from ..telemetry import metrics
+
+    reg = metrics()
+    lag = 0.0
+    if reg.enabled:
+        try:
+            lag = float(reg.gauge("horovod_controller_straggler_lag_ms",
+                                  labels={"stat": "mean"}).value)
+        except Exception:  # noqa: BLE001 - absent gauge reads as 0
+            lag = 0.0
+    return {"straggler_lag_ms": lag}
+
+
+def attach_replica(executor):
+    """Wire the serving side of ``--fleet``: the replica pulls
+    published weights (boundary swap stays front-scheduled), and the
+    front end's step path publishes the serve gauges.  Returns the
+    FleetRuntime (None when fleet mode is off or the KV is not
+    configured); the puller itself is owned by the executor
+    (``ReplicaExecutor.close`` joins it)."""
+    if not config.FLEET.get():
+        return None
+    try:
+        kv = _fleet_kv()
+    except RuntimeError as exc:
+        logger.warning("fleet: HOROVOD_FLEET set but no coordinator "
+                       "KV: %s", exc)
+        return None
+    executor.attach_fleet(kv)
+    runtime = FleetRuntime(kv, "serve")
+    totals = {"shed": 0.0, "offered": 0.0}
+
+    def _fields(ex=executor) -> dict:
+        # Per-interval shed rate over the admission outcome counters
+        # (the statesync/autoscale.py registry_source computation,
+        # scoped to this executor); queue depth is outstanding work —
+        # queued + in-flight — like the acceptance battery publishes.
+        out = ex.admission.outcome_totals()
+        shed = float(out.get("shed", 0.0)) + float(out.get("expired",
+                                                           0.0))
+        offered = shed + float(out.get("served", 0.0))
+        d_shed = shed - totals["shed"]
+        d_offered = offered - totals["offered"]
+        totals["shed"], totals["offered"] = shed, offered
+        return {
+            "shed_rate": (d_shed / d_offered) if d_offered > 0 else 0.0,
+            "queue_depth": float(ex.queue.depth()
+                                 + ex.batcher.inflight_count()),
+        }
+
+    executor._fleet_gauge = lambda ex: runtime.publish_gauge(
+        lambda: ex.size, _fields)
+    logger.info("fleet: serving replica attached (puller + front "
+                "gauges)")
+    return runtime

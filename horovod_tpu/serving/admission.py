@@ -118,5 +118,11 @@ class AdmissionController:
     def count(self, outcome: str, n: int = 1) -> None:
         self._m_outcome[outcome].inc(n)
 
+    def outcome_totals(self) -> dict:
+        """Cumulative request counts by outcome — the fleet gauge
+        publisher (fleet/wiring.py) computes per-interval shed rate
+        from the deltas."""
+        return {k: c.value for k, c in self._m_outcome.items()}
+
     def observe_latency_ms(self, ms: float) -> None:
         self._m_latency.observe(ms)

@@ -217,13 +217,22 @@ class ReplicaExecutor:
         # Fleet continuous weight deployment (fleet/deploy.py): the
         # puller thread stages verified snapshots here; the front
         # schedules the swap into a BatchPlan once EVERY rank's staged
-        # version (piggybacked on the completions allgather) covers it.
+        # set (piggybacked on the completions allgather) holds it.
         self.weight_version = 0
         self._weight_step = 0          # trainer step of the live weights
         self._fleet_lock = threading.Lock()
-        self._fleet_staged = None      # (version, params tree, step)
+        # version -> (params tree, trainer step, digest).  Keyed by
+        # version, NOT a single newest-wins slot: the puller can stage
+        # a newer version between the completions exchange (which
+        # reported this rank's staged set) and the plan's scheduled
+        # swap, and every rank of a sharded replica group must still
+        # swap exactly plan.swap_version at that boundary.
+        self._fleet_staged: dict[int, tuple] = {}
+        self._fleet_reported: set[int] = set()
         self._fleet_puller = None
-        self._fleet_minstaged = 0      # min staged across ranks (front)
+        self._fleet_gauge = None       # --fleet front gauge hook (wiring)
+        self._fleet_runtime = None
+        self._fleet_common = 0         # newest version staged on EVERY rank
         self._fleet_scheduled = 0      # newest version the front swapped
 
         self.queue = RequestQueue(maxsize=self.cfg.queue_depth,
@@ -404,12 +413,14 @@ class ReplicaExecutor:
             # Expired while queued: shed at admission, never executed.
             self.admission.count("expired")
             self.stats["expired"] += 1
-        # Fleet weight rollout: once every rank's staged version (from
-        # the last completions exchange) passes the current weights,
-        # schedule the swap — the broadcast makes it simultaneous.
-        if self._fleet_minstaged > max(self.weight_version,
-                                       self._fleet_scheduled):
-            plan.swap_version = self._fleet_minstaged
+        # Fleet weight rollout: schedule the newest version that EVERY
+        # rank reported staged in the last completions exchange — an
+        # intersection, not min(newest staged), so a rank that skipped
+        # a version (its head poll raced the publisher GC) is never
+        # scheduled for an image it does not hold.
+        if self._fleet_common > max(self.weight_version,
+                                    self._fleet_scheduled):
+            plan.swap_version = self._fleet_common
             self._fleet_scheduled = plan.swap_version
         return plan
 
@@ -778,17 +789,20 @@ class ReplicaExecutor:
 
     def _exchange_completions(self) -> list[dict]:
         from ..resilience import deadline_scope
-        # Completions plus this rank's staged weight version ride one
-        # allgather: the front learns min(staged) with zero extra
-        # collectives, exactly like completions ride the step.
+        # Completions plus this rank's staged weight versions ride one
+        # allgather: the front learns the version set every rank holds
+        # with zero extra collectives, exactly like completions ride
+        # the step.
         mine = {"done": list(self._unreported),
-                "staged": self._fleet_staged_version()}
+                "staged": self._fleet_staged_versions()}
         deadlines = [s.deadline for s in self.slots if s is not None]
         with deadline_scope(min(deadlines) if deadlines else None):
             per_rank = self.hvd.allgather_object(
                 mine, name=f"serve.done.g{self._gen}.{self._step}")
         self._unreported.clear()       # acknowledged by the exchange
-        self._fleet_minstaged = min(p.get("staged", 0) for p in per_rank)
+        common = set.intersection(
+            *(set(p.get("staged") or ()) for p in per_rank))
+        self._fleet_common = max(common) if common else 0
         return [rec for p in per_rank for rec in p["done"]]
 
     def _account(self, completions: list[dict]) -> None:
@@ -841,68 +855,128 @@ class ReplicaExecutor:
         self._fleet_puller.start()
         return self._fleet_puller
 
-    def _fleet_stage(self, version: int, image, meta) -> None:
+    # Staged-but-unswapped versions a rank holds at most, so a group
+    # whose swaps cannot land never accumulates unbounded full param
+    # images.  At the cap, a staged version never REPORTED in a
+    # completions exchange is evicted for a newer one (the front cannot
+    # have scheduled what it never saw); once every staged version has
+    # been reported the puller is refused and retries.
+    _FLEET_STAGE_CAP = 4
+
+    def _fleet_stage(self, version: int, image, meta) -> bool:
         """WeightPuller stage callback (puller thread): decode the
-        already-verified image into a params-shaped tree and park it for
-        the front-scheduled boundary swap.  Never touches live params —
-        the swap happens on the serve thread inside ``_apply_plan``."""
+        already-verified image into a params-shaped tree and park it,
+        keyed by version, for the front-scheduled boundary swap.  Never
+        touches live params — the swap happens on the serve thread
+        inside ``_apply_plan``.
+
+        At the window cap, the oldest version NOT yet reported in a
+        completions exchange is evicted to admit the newer one —
+        unreported versions cannot be in any plan, and while the serve
+        loop is paused (a grow resync: the joiner compiles for many
+        publish intervals) refusing instead would wedge the whole
+        group: this rank's window fills with versions the publisher
+        GCs before the joiner can ever pull them, the staged sets then
+        never intersect, and no swap ever frees the window.  A version
+        that HAS been reported may already be scheduled, so once every
+        staged version is reported the puller is refused (False) and
+        retries — a reported image is only ever dropped by the swap
+        path."""
         from ..statesync.snapshot import unflatten_state
 
+        if version <= self.weight_version:
+            return True                # already serving newer weights
+        with self._fleet_lock:
+            if version in self._fleet_staged:
+                return True            # duplicate push
+            if not self._fleet_can_admit():
+                return False
         template = {"params": jax.tree_util.tree_map(np.asarray,
                                                      self.params)}
         tree = unflatten_state(image, template)
         with self._fleet_lock:
-            self._fleet_staged = (version, tree["params"],
-                                  int(meta.get("step", 0)),
-                                  int(meta.get("digest", 0)))
+            if not self._fleet_can_admit():
+                return False
+            self._fleet_staged[version] = (tree["params"],
+                                           int(meta.get("step", 0)),
+                                           int(meta.get("digest", 0)))
+        return True
 
-    def _fleet_staged_version(self) -> int:
+    def _fleet_can_admit(self) -> bool:
+        """Make room under the stage cap (lock held): evict the oldest
+        never-reported version if the window is full; False when every
+        staged version has been reported (and so may be scheduled)."""
+        if len(self._fleet_staged) < self._FLEET_STAGE_CAP:
+            return True
+        evictable = sorted(set(self._fleet_staged)
+                           - self._fleet_reported)
+        if not evictable:
+            return False
+        del self._fleet_staged[evictable[0]]
+        return True
+
+    def _fleet_staged_versions(self) -> tuple:
+        """The versions this rank holds staged, for the completions
+        exchange: the front schedules the newest version present in
+        EVERY rank's report.  Reported versions become eviction-exempt
+        — from here on only the swap path may drop them."""
         with self._fleet_lock:
-            staged = self._fleet_staged
-        return max(self.weight_version,
-                   staged[0] if staged is not None else 0)
+            versions = tuple(sorted(self._fleet_staged))
+            self._fleet_reported.update(versions)
+            return versions
 
     def _fleet_staleness_steps(self) -> int:
         """Trainer steps between the newest snapshot this rank has
         staged and the weights currently serving (0 when current) — the
         loadgen staleness accounting (docs/fleet.md)."""
         with self._fleet_lock:
-            staged = self._fleet_staged
-        newest = staged[2] if staged is not None else self._weight_step
+            steps = [s[1] for s in self._fleet_staged.values()]
+        newest = max(steps) if steps else self._weight_step
         return max(0, newest - self._weight_step)
 
     def _fleet_swap(self, version: int) -> None:
-        """Swap the staged snapshot in at the plan boundary the front
-        scheduled.  Every rank executes this at the same step (the plan
-        broadcast IS the schedule): in-flight slots keep decoding under
-        the new weights, no admitted request is dropped."""
+        """Swap exactly the scheduled version in at the plan boundary
+        the front broadcast.  Every rank executes this at the same step
+        with the same version — never "whatever is staged locally",
+        which can differ across ranks when a puller staged a newer
+        image after the completions exchange, and would let ranks of
+        one sharded replica group decode a step under mixed weights.
+        In-flight slots keep decoding under the new weights, no
+        admitted request is dropped."""
         with self._fleet_lock:
-            staged = self._fleet_staged
-            if staged is not None and staged[0] >= version:
-                self._fleet_staged = None
-        if staged is None or staged[0] < version:
-            # The front schedules min(staged) across ranks, so a rank
-            # can only be missing the version after a local restart;
-            # keep serving the old weights until the puller re-stages.
+            staged = self._fleet_staged.pop(version, None)
+            if staged is not None:
+                # Older staged versions are superseded the moment a
+                # newer one swaps in; they are dropped only now, after
+                # the scheduled swap — never at stage time.
+                for old in [v for v in self._fleet_staged
+                            if v < version]:
+                    del self._fleet_staged[old]
+                self._fleet_reported &= set(self._fleet_staged)
+        if staged is None:
+            # The front schedules from the intersection of every
+            # rank's reported staged set, so the version can only be
+            # missing after a local restart; keep serving the old
+            # weights until the puller re-stages.
             return
-        v, params, meta_step, digest = staged
+        params, meta_step, digest = staged
         self.params = jax.tree_util.tree_map(jnp.asarray, params)
-        self.weight_version = v
+        self.weight_version = version
         self._weight_step = meta_step
         self.stats["weight_swaps"].append(
-            {"version": v, "step": self._step, "digest": digest,
+            {"version": version, "step": self._step, "digest": digest,
              "at": time.monotonic()})
         from ..telemetry import flight
         from ..telemetry import metrics as telemetry_metrics
 
         rec = flight.recorder()
         if rec.enabled:
-            rec.record("fleet-swap", name=f"v{v}",
+            rec.record("fleet-swap", name=f"v{version}",
                        detail=f"swapped at plan step {self._step}")
         tm = telemetry_metrics()
         if tm.enabled:
-            tm.gauge("horovod_fleet_weight_version").set(v)
-        logger.info("serving: weights v%d swapped at step %d", v,
+            tm.gauge("horovod_fleet_weight_version").set(version)
+        logger.info("serving: weights v%d swapped at step %d", version,
                     self._step)
 
     def _statesync_boundary(self) -> None:
@@ -1000,6 +1074,8 @@ class ReplicaExecutor:
         dt = time.monotonic() - t0
         self.admission.observe_step_ms(dt * 1e3)
         self._note_perf(decoded, ctx_sum, dt)
+        if self._fleet_gauge is not None and self.rank == self.front:
+            self._fleet_gauge(self)
         return True
 
     def serve_loop(self, *, stop_when=None, max_steps: int | None = None,
@@ -1008,6 +1084,12 @@ class ReplicaExecutor:
         drained (``stop_when()`` true on the front end AND queue and
         in-flight empty), riding elastic shrinks across rank failures.
         ``max_steps`` is a safety bound for tests."""
+        if self._fleet_puller is None and config.FLEET.get():
+            # HOROVOD_FLEET=1 (horovodrun --fleet): pull published
+            # weights and, on the front, feed the controller's serve
+            # gauges (fleet/wiring.py).
+            from ..fleet.wiring import attach_replica
+            self._fleet_runtime = attach_replica(self)
         while max_steps is None or self._step < max_steps:
             if self.rank == self.front:
                 if stop_when is not None and stop_when():

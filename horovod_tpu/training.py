@@ -389,6 +389,9 @@ class Trainer:
         self._fleet_publisher = publisher
 
     def _fleet_publish(self, state: TrainState) -> None:
+        # Called with the step's OUTPUT state: the input state's
+        # buffers are donated to the step executable and deleted by
+        # the time this runs.
         if self._fleet_publisher is None:
             return
         self._fleet_step += 1
@@ -423,7 +426,7 @@ class Trainer:
             try:
                 result = self._compiled(state, batch)
                 self._note_step(batch, first)
-                self._fleet_publish(state)
+                self._fleet_publish(result[0])
                 return result
             except TypeError:
                 # Shape/dtype drift vs the AOT signature (e.g. a ragged
@@ -433,7 +436,7 @@ class Trainer:
                 self._compiled = None
         result = self._step_fn(state, batch)
         self._note_step(batch, first)
-        self._fleet_publish(state)
+        self._fleet_publish(result[0])
         return result
 
     # -- fit loop with callbacks ------------------------------------------
@@ -449,37 +452,55 @@ class Trainer:
             if hasattr(cb, "set_state"):
                 cb.set_state(state)
         history: list[dict] = []
-        for cb in callbacks:
-            cb.on_train_begin()
-        for epoch in range(epochs):
+        from .common import config
+        fleet_runtime = None
+        if config.FLEET.get():
+            # --fleet runtime wiring: rank 0 hosts the controller and
+            # the weight publisher; every rank's loop drives the
+            # throttled train-gauge publish (fleet/wiring.py).
+            from .fleet.wiring import attach_trainer, trainer_gauges
+            fleet_runtime = attach_trainer(self)
+        try:
             for cb in callbacks:
-                cb.on_epoch_begin(epoch)
-            batches = data(epoch) if callable(data) else data
-            sums: dict[str, Any] = {}
-            count = 0
-            for i, batch in enumerate(batches):
-                if steps_per_epoch is not None and i >= steps_per_epoch:
-                    break
+                cb.on_train_begin()
+            for epoch in range(epochs):
                 for cb in callbacks:
-                    cb.on_batch_begin(i)
-                state, metrics = self.step(state, batch)
-                # Keep metrics as device arrays through the epoch: float()
-                # here would sync host↔device every step and serialize the
-                # async dispatch pipeline.
+                    cb.on_epoch_begin(epoch)
+                batches = data(epoch) if callable(data) else data
+                sums: dict[str, Any] = {}
+                count = 0
+                for i, batch in enumerate(batches):
+                    if steps_per_epoch is not None \
+                            and i >= steps_per_epoch:
+                        break
+                    for cb in callbacks:
+                        cb.on_batch_begin(i)
+                    state, metrics = self.step(state, batch)
+                    # Keep metrics as device arrays through the epoch:
+                    # float() here would sync host↔device every step and
+                    # serialize the async dispatch pipeline.
+                    for cb in callbacks:
+                        cb.on_batch_end(i, metrics)
+                    for k, v in metrics.items():
+                        sums[k] = v if k not in sums else sums[k] + v
+                    count += 1
+                    if fleet_runtime is not None:
+                        from . import core
+                        fleet_runtime.publish_gauge(
+                            lambda: core.global_state().size,
+                            trainer_gauges)
+                epoch_logs = {k: float(v) / max(count, 1)
+                              for k, v in sums.items()}
                 for cb in callbacks:
-                    cb.on_batch_end(i, metrics)
-                for k, v in metrics.items():
-                    sums[k] = v if k not in sums else sums[k] + v
-                count += 1
-            epoch_logs = {k: float(v) / max(count, 1)
-                          for k, v in sums.items()}
+                    if hasattr(cb, "set_state"):
+                        cb.set_state(state)
+                    cb.on_epoch_end(epoch, epoch_logs)
+                history.append(epoch_logs)
             for cb in callbacks:
-                if hasattr(cb, "set_state"):
-                    cb.set_state(state)
-                cb.on_epoch_end(epoch, epoch_logs)
-            history.append(epoch_logs)
-        for cb in callbacks:
-            cb.on_train_end()
+                cb.on_train_end()
+        finally:
+            if fleet_runtime is not None:
+                fleet_runtime.close()
         return state, history
 
     # -- evaluation --------------------------------------------------------

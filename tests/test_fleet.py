@@ -178,10 +178,16 @@ def test_controller_full_migration_lifecycle():
     journal = json.loads(kv.get(JOURNAL_SCOPE, f"mig:{rec['mid']}"))
     assert journal["state"] == "done"
     assert poll_depart(kv, "train", 3) is None   # directive withdrawn
+    # A closed migration leaves nothing in the actuation scope.
+    assert kv.get(CTL_SCOPE, f"joined:{rec['mid']}") is None
     assert ctl.stats["completed"] == 1 and not ctl.open
 
 
 def test_controller_deadline_aborts_wedged_migration():
+    """Deadline expiry first only REQUESTS the abort: the directive is
+    withdrawn and the journal moves to 'aborting' (the donor may have
+    already consumed the directive); silence through the grace window
+    finalises it."""
     kv = FakeKV()
     ctl = _controller(kv, migrate_timeout_s=0.0)
     publish_gauge(kv, "train", 4)
@@ -190,9 +196,57 @@ def test_controller_deadline_aborts_wedged_migration():
     assert rec is not None
     ctl.tick()                          # past the (zero) deadline
     journal = json.loads(kv.get(JOURNAL_SCOPE, f"mig:{rec['mid']}"))
-    assert journal["state"] == "aborted"
+    assert journal["state"] == "aborting"
     assert poll_depart(kv, "train", 3) is None   # directive withdrawn
-    assert ctl.stats["aborted"] == 1
+    assert ctl.stats["aborted"] == 0
+    assert rec["mid"] in ctl.open       # still watching for a late join
+    ctl.tick()                          # past the (zero) abort grace
+    journal = json.loads(kv.get(JOURNAL_SCOPE, f"mig:{rec['mid']}"))
+    assert journal["state"] == "aborted"
+    assert ctl.stats["aborted"] == 1 and not ctl.open
+
+
+def test_controller_abort_request_reconciles_late_join():
+    """The deadline-abort race the journal must not lie about: the
+    donor consumed the directive just before the controller withdrew
+    it, so the rank really departs and its joined mark lands inside the
+    abort grace.  The record reconciles to done — an 'aborted' journal
+    here would leak the joined record and let the policy double-shrink
+    the donor."""
+    kv = FakeKV()
+    ctl = _controller(kv, migrate_timeout_s=0.0)
+    publish_gauge(kv, "train", 4)
+    publish_gauge(kv, "serve", 2, queue_depth=10.0)
+    rec = ctl.tick()
+    assert rec is not None
+    ctl.tick()                          # deadline -> aborting
+    assert json.loads(kv.get(
+        JOURNAL_SCOPE, f"mig:{rec['mid']}"))["state"] == "aborting"
+    mark_joined(kv, rec["mid"], rank=2, size=3)   # the late arrival
+    ctl.tick()
+    journal = json.loads(kv.get(JOURNAL_SCOPE, f"mig:{rec['mid']}"))
+    assert journal["state"] == "done"
+    assert "reconciled" in journal["why"]
+    assert ctl.stats["completed"] == 1 and ctl.stats["aborted"] == 0
+    assert kv.get(CTL_SCOPE, f"joined:{rec['mid']}") is None
+    assert not ctl.open
+
+
+def test_controller_directive_uses_membership_size_over_stale_gauge():
+    """The donor gauge says 4 ranks but the statesync membership record
+    (refreshed at every world transition) says the world already shrank
+    to 3: the directive must address rank 2, not the nonexistent rank 3
+    (which would wedge until the deadline abort)."""
+    kv = FakeKV()
+    ctl = _controller(kv)
+    kv.put("statesync", "train",
+           json.dumps({"epoch": "e1", "size": 3, "seq": 7}).encode())
+    publish_gauge(kv, "train", 4)       # stale: published pre-shrink
+    publish_gauge(kv, "serve", 2, queue_depth=10.0)
+    rec = ctl.tick()
+    assert rec is not None and rec["rank"] == 2
+    assert poll_depart(kv, "train", 2) is not None
+    assert poll_depart(kv, "train", 3) is None
 
 
 def test_controller_failover_resumes_departing_migration():
@@ -306,8 +360,10 @@ def test_publisher_gc_keeps_newest_versions():
     kv = FakeKV()
     pub = WeightPublisher(kv, publish_steps=1, chunk_bytes=16, keep=2)
     for step in range(1, 4):
+        # Drive each version through: the pending slot coalesces, so
+        # only versions that actually commit exercise the GC.
         pub.maybe_publish(step, _pub_tree(fill=float(step)))
-    _drive(pub)
+        _drive(pub)
     assert kv.get(PUB_SCOPE, "head") == b"3"
     assert kv.get(PUB_SCOPE, "meta:1") is None
     assert not [k for k in kv.get_scope(PUB_SCOPE)
@@ -316,6 +372,178 @@ def test_publisher_gc_keeps_newest_versions():
         meta = json.loads(kv.get(PUB_SCOPE, f"meta:{v}"))
         assert all(kv.get(PUB_SCOPE, f"shard:{v}.{i}") is not None
                    for i in range(meta["shards"]))
+
+
+def test_publisher_pending_queue_is_bounded_and_coalesces():
+    """The hand-off to the publisher thread holds AT MOST ONE pending
+    image: KV commits running slower than the publish cadence must not
+    accumulate full flattened param images on the trainer host.  A
+    superseded pending version is simply never published — pullers only
+    want the newest."""
+    kv = FakeKV()
+    pub = WeightPublisher(kv, publish_steps=1, chunk_bytes=16, keep=2)
+    for step in range(1, 4):            # thread not started: all pend
+        pub.maybe_publish(step, _pub_tree(fill=float(step)))
+    assert len(pub._work) == 1          # bounded: newest image only
+    assert pub.coalesced == 2
+    _drive(pub)
+    assert kv.get(PUB_SCOPE, "head") == b"3"
+    assert pub.published == 1           # v1/v2 were never committed
+    assert kv.get(PUB_SCOPE, "meta:1") is None
+    assert kv.get(PUB_SCOPE, "meta:2") is None
+
+
+def test_puller_stage_refusal_keeps_watermark_and_retries():
+    """A stage callback returning False (the replica's staging window
+    is full) leaves the puller's watermark untouched: the version is
+    delayed, never dropped — the next poll offers the then-current head
+    again."""
+    kv = FakeKV()
+    pub = WeightPublisher(kv, publish_steps=1, chunk_bytes=16, keep=2)
+    pub.maybe_publish(1, _pub_tree())
+    _drive(pub)
+    staged = []
+    accept = [False]
+    pul = WeightPuller(
+        kv, lambda v, img, m: staged.append((v, img)) or accept[0])
+    assert pul.poll_once() is None      # refused: window full
+    assert pul.seen == 0 and pul.pulled == 0
+    accept[0] = True
+    assert pul.poll_once() == 1         # retried, now staged
+    assert pul.seen == 1 and pul.pulled == 1
+    assert [v for v, _ in staged] == [1, 1]
+
+
+# ---------------------------------------------------------------------------
+# --fleet runtime wiring (fleet/wiring.py)
+# ---------------------------------------------------------------------------
+def test_fleet_wiring_gates_on_flag_and_publishes_serve_gauges(
+        monkeypatch):
+    """HOROVOD_FLEET off -> attach_replica is inert; on -> the replica
+    gets the puller KV attached and the front gauge hook publishes
+    size + queue depth + per-interval shed rate computed from the
+    admission outcome counters."""
+    from horovod_tpu.fleet import wiring
+
+    class _Q:
+        def depth(self):
+            return 3
+
+    class _B:
+        def inflight_count(self):
+            return 2
+
+    class _Adm:
+        totals = {"shed": 0, "expired": 0, "served": 0}
+
+        def outcome_totals(self):
+            return dict(self.totals)
+
+    class _Ex:
+        size = 2
+        queue = _Q()
+        batcher = _B()
+
+        def __init__(self):
+            self.admission = _Adm()
+            self.attached = None
+
+        def attach_fleet(self, kv):
+            self.attached = kv
+
+    ex = _Ex()
+    monkeypatch.delenv("HOROVOD_FLEET", raising=False)
+    assert wiring.attach_replica(ex) is None       # flag off: inert
+    assert ex.attached is None
+    monkeypatch.setenv("HOROVOD_FLEET", "1")
+    kv = FakeKV()
+    monkeypatch.setattr(wiring, "_fleet_kv", lambda: kv)
+    rt = wiring.attach_replica(ex)
+    assert rt is not None and ex.attached is kv
+    ex.admission.totals = {"shed": 2, "expired": 1, "served": 7}
+    ex._fleet_gauge(ex)
+    gauge = json.loads(kv.get("fleet.gauges", "serve"))
+    assert gauge["size"] == 2
+    assert gauge["queue_depth"] == 5.0             # queued + in-flight
+    assert gauge["shed_rate"] == pytest.approx(0.3)
+    # No controller/publisher on the serving side to tear down.
+    assert rt.controller is None and rt.publisher is None
+    rt.close()
+
+
+# ---------------------------------------------------------------------------
+# replica staging + boundary swap (unit level: no serving world)
+# ---------------------------------------------------------------------------
+def _bare_replica():
+    """A ReplicaExecutor skeleton with exactly the state the fleet
+    staging/swap path touches — no serving world, no threads."""
+    import threading
+
+    from horovod_tpu.serving.replica import ReplicaExecutor
+
+    ex = object.__new__(ReplicaExecutor)
+    ex._fleet_lock = threading.Lock()
+    ex._fleet_staged = {}
+    ex._fleet_reported = set()
+    ex.weight_version = 0
+    ex._weight_step = 0
+    ex._step = 0
+    ex.stats = {"weight_swaps": []}
+    ex.params = {"w": np.zeros(6, np.float32)}
+    return ex
+
+
+def test_replica_swaps_exactly_the_scheduled_version():
+    """The boundary swap applies EXACTLY the version the front
+    broadcast — never "newest staged locally", which can differ across
+    ranks when a puller staged a newer image after the completions
+    exchange (mixed weights inside one sharded replica group)."""
+    ex = _bare_replica()
+    trees = {v: {"w": np.full(6, float(v), np.float32)}
+             for v in (1, 2, 3)}
+    ex._fleet_staged = {v: (trees[v], 10 * v, v) for v in (1, 2, 3)}
+    ex._fleet_swap(2)                   # v3 staged, but 2 is scheduled
+    assert ex.weight_version == 2 and ex._weight_step == 20
+    assert np.allclose(np.asarray(ex.params["w"]), 2.0)
+    # Superseded v1 pruned at swap time; newer v3 stays staged.
+    assert ex._fleet_staged_versions() == (3,)
+    ex._fleet_swap(3)
+    assert ex.weight_version == 3
+    assert ex._fleet_staged_versions() == ()
+    ex._fleet_swap(9)                   # not staged (local restart):
+    assert ex.weight_version == 3       # keep serving, no crash
+    assert [s["version"] for s in ex.stats["weight_swaps"]] == [2, 3]
+
+
+def test_replica_stage_window_evicts_unreported_refuses_reported():
+    """The staging window is bounded by _FLEET_STAGE_CAP.  At the cap,
+    a version never reported in a completions exchange is evicted for
+    a newer one (the front cannot have scheduled what it never saw —
+    and while the serve loop pauses for a grow resync, refusal would
+    wedge the group on versions the publisher GCs).  Once every staged
+    version HAS been reported the callback refuses (False -> the
+    puller retries): a reported version may be scheduled, so only the
+    swap path may drop it."""
+    ex = _bare_replica()
+    image = bytes(flatten_state({"params": ex.params}))
+    cap = ex._FLEET_STAGE_CAP
+    for v in range(1, cap + 1):        # serve loop paused: no reports
+        assert ex._fleet_stage(v, image, {"step": v, "digest": v})
+    # Full of UNREPORTED versions: the oldest is evicted, not refused.
+    assert ex._fleet_stage(cap + 1, image, {"step": 9, "digest": 9})
+    assert ex._fleet_staged_versions() == tuple(range(2, cap + 2))
+    # That call reported the window: now every slot is load-bearing.
+    assert ex._fleet_stage(cap + 2, image, {"step": 9, "digest": 9}) \
+        is False
+    assert ex._fleet_staged_versions() == tuple(range(2, cap + 2))
+    # Duplicates and stale versions report success without staging.
+    assert ex._fleet_stage(cap, image, {"step": 9, "digest": 9}) is True
+    ex.weight_version = 2
+    assert ex._fleet_stage(2, image, {"step": 9, "digest": 9}) is True
+    # The swap path is what frees a reported window.
+    ex._fleet_swap(cap + 1)
+    assert ex._fleet_staged_versions() == ()
+    assert ex._fleet_stage(cap + 2, image, {"step": 9, "digest": 9})
 
 
 # ---------------------------------------------------------------------------
